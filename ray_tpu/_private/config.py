@@ -1,0 +1,116 @@
+"""Typed runtime configuration flags.
+
+Equivalent in role to the reference's ``RAY_CONFIG(type, name, default)``
+registry (reference ``src/ray/common/ray_config_def.h``): a single place
+declaring every tunable, each overridable via environment variable
+``RAYTPU_<NAME>`` or via ``init(_system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict
+
+_ENV_PREFIX = "RAYTPU_"
+
+
+def _coerce(value: str, typ) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(value)
+    if typ is float:
+        return float(value)
+    return value
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    #: Size of the per-node shared-memory object store arena, bytes.
+    object_store_memory: int = 2 * 1024**3
+    #: Objects at or under this size are passed inline in RPCs instead of
+    #: going through the shared-memory store (reference analog:
+    #: max_direct_call_object_size = 100 KiB).
+    max_inline_object_size: int = 100 * 1024
+    #: Directory for spilled objects (filesystem spill backend).
+    spill_dir: str = ""
+    #: Fraction of the arena above which eviction/spill kicks in.
+    object_store_full_fraction: float = 0.95
+
+    # --- scheduling ---
+    #: Number of workers kept warm per node (defaults to num CPUs).
+    num_workers: int = 0
+    #: Seconds a leased-but-idle worker is kept before being returned.
+    idle_worker_keep_s: float = 300.0
+    #: Hybrid scheduling threshold: prefer the local node until its critical
+    #: resource utilization crosses this fraction, then spread (reference
+    #: analog: scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    #: Max times a task is retried on worker/node failure.
+    default_max_retries: int = 3
+
+    # --- timeouts / liveness ---
+    heartbeat_interval_s: float = 1.0
+    num_heartbeats_timeout: int = 30
+    rpc_connect_timeout_s: float = 10.0
+    worker_start_timeout_s: float = 60.0
+    #: Poll interval for blocking get() in the driver.
+    get_poll_interval_s: float = 0.005
+
+    # --- logging / observability ---
+    log_dir: str = ""
+    log_to_driver: bool = True
+    event_buffer_size: int = 10000
+    #: Record per-task profile events for the timeline.
+    enable_timeline: bool = True
+
+    # --- tpu ---
+    #: Treat each TPU chip as one unit of the "TPU" resource.
+    tpu_chips_per_host: int = 0  # 0 = autodetect
+    #: Platform preference for worker JAX initialisation.
+    jax_platform: str = ""
+
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+    def apply_env(self) -> "Config":
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type if isinstance(f.type, type) else type(getattr(self, f.name))))
+        return self
+
+    def apply_dict(self, overrides: Dict[str, Any]) -> "Config":
+        known = {f.name for f in fields(self)}
+        for k, v in (overrides or {}).items():
+            if k in known:
+                setattr(self, k, v)
+            else:
+                self.extras[k] = v
+        return self
+
+    def to_json(self) -> str:
+        d = {f.name: getattr(self, f.name) for f in fields(self) if f.name != "extras"}
+        d.update(self.extras)
+        return json.dumps(d)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls().apply_dict(json.loads(s))
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env()
+    return _global_config
+
+
+def set_global_config(cfg: Config) -> None:
+    global _global_config
+    _global_config = cfg

@@ -1,0 +1,39 @@
+"""Test configuration.
+
+Multi-device TPU-style tests run on a virtual 8-device CPU mesh (the
+reference's `_fake_gpus` trick generalized: reference
+rllib/algorithms/algorithm_config.py:66 places fake GPU towers on CPU; here
+XLA emulates N host devices).  Must be set before jax import anywhere in the
+test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Start a fresh single-node cluster for the test (reference analog:
+    python/ray/tests/conftest.py:245 ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    """Module-shared cluster (reference analog: ray_start_regular_shared)."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()

@@ -1,0 +1,56 @@
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    put_object_id,
+)
+
+
+def test_job_id_roundtrip():
+    j = JobID.from_int(7)
+    assert j.to_int() == 7
+    assert JobID.from_hex(j.hex()) == j
+    assert not j.is_nil()
+    assert JobID.nil().is_nil()
+
+
+def test_lineage_encoding():
+    job = JobID.from_int(3)
+    task = TaskID.for_task(job)
+    assert task.job_id() == job
+    obj = ObjectID.for_return(task, 2)
+    assert obj.task_id() == task
+    assert obj.job_id() == job
+    assert obj.return_index() == 2
+
+
+def test_actor_task_ids():
+    job = JobID.from_int(1)
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    t = TaskID.for_actor_task(actor)
+    assert t.actor_id() == actor
+
+
+def test_put_ids_unique_and_marked():
+    job = JobID.from_int(1)
+    t = TaskID.for_driver(job)
+    a, b = put_object_id(t), put_object_id(t)
+    assert a != b
+    assert a.return_index() & 0x80000000
+    assert a.task_id() == t
+
+
+def test_hash_and_sets():
+    n1, n2 = NodeID.from_random(), NodeID.from_random()
+    s = {n1, n2, n1}
+    assert len(s) == 2
+
+
+def test_pickle_roundtrip():
+    import pickle
+
+    t = TaskID.for_task(JobID.from_int(9))
+    assert pickle.loads(pickle.dumps(t)) == t
