@@ -1,0 +1,259 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Core abstractions (reference analog: python/ray/_private/worker.py):
+tasks (``@remote`` functions), actors (``@remote`` classes), and objects
+(immutable values in a per-node shared-memory store), plus a JAX/XLA device
+plane for TPU meshes (``ray_tpu.parallel``), distributed training
+(``ray_tpu.train``), hyperparameter search (``ray_tpu.tune``), datasets
+(``ray_tpu.data``), serving (``ray_tpu.serve``) and RL (``ray_tpu.rllib``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import Config
+from ray_tpu._private.worker_context import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "ObjectRef", "ActorHandle", "method",
+    "get_runtime_context", "exceptions", "__version__",
+]
+
+logger = logging.getLogger(__name__)
+_init_lock = threading.Lock()
+
+
+def init(address: Optional[str] = None, *,
+         num_cpus: Optional[int] = None,
+         num_tpus: Optional[int] = None,
+         resources: Optional[Dict[str, float]] = None,
+         object_store_memory: Optional[int] = None,
+         ignore_reinit_error: bool = False,
+         namespace: Optional[str] = None,
+         log_to_driver: bool = True,
+         _system_config: Optional[Dict[str, Any]] = None,
+         **kwargs):
+    """Start (or connect to) a cluster.
+
+    With no address: bootstraps a single-node cluster in-process (GCS +
+    node manager on an IO thread, workers as subprocesses) — the analog of
+    the reference's ``ray.init()`` local bootstrap (worker.py:1031).
+    With ``address="host:port"``: connects to an existing head started via
+    ``ray_tpu start --head``.
+    """
+    with _init_lock:
+        if worker_context.is_initialized():
+            if ignore_reinit_error:
+                return _client_info()
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                               "ignore_reinit_error=True to allow")
+        config = Config().apply_env()
+        if _system_config:
+            config.apply_dict(_system_config)
+        if object_store_memory:
+            config.object_store_memory = object_store_memory
+
+        from ray_tpu._private.client import CoreWorker
+        from ray_tpu._private.ids import JobID
+        from ray_tpu._private.node import Node
+
+        if address:
+            raise NotImplementedError(
+                "multi-node driver attach lands with the cluster CLI; "
+                "round-1 drivers bootstrap their own head node")
+        node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
+                    resources=resources,
+                    object_store_memory=object_store_memory, config=config)
+        node.start()
+        cw = CoreWorker(
+            gcs_address=node.gcs_address,
+            node_address=node.node_address,
+            object_store_name=node.shm_name,
+            job_id=JobID.from_int(1),
+            config=config, mode="driver")
+        job = cw.io.run(cw.gcs.call("job_register", {}))
+        cw.job_id = JobID(job["job_id"])
+        worker_context.set_core_worker(cw, node=node, mode="driver")
+        atexit.register(shutdown)
+        return _client_info()
+
+
+def _client_info():
+    node = worker_context.node()
+    return {
+        "session_dir": node.session_dir if node else "",
+        "node_id": node.node_id.hex() if node else "",
+        "gcs_address": node.gcs_address if node else "",
+    }
+
+
+def _auto_init():
+    if not worker_context.is_initialized():
+        init()
+
+
+def shutdown():
+    with _init_lock:
+        cw = worker_context.maybe_core_worker()
+        node = worker_context.node()
+        worker_context.clear()
+        if cw is not None:
+            cw.shutdown()
+        if node is not None:
+            node.stop()
+
+
+def is_initialized() -> bool:
+    return worker_context.is_initialized()
+
+
+def remote(*args, **kwargs):
+    """Decorator turning a function into a task / a class into an actor.
+
+    Usage: ``@remote`` or ``@remote(num_cpus=2, num_tpus=1, ...)``.
+    (Reference: worker.py:2694 remote decorator overloads.)
+    """
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def deco(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return deco
+
+
+def method(**opts):
+    """Decorator for actor methods to set per-method defaults
+    (``num_returns=...``). Reference: python/ray/actor.py:58 method."""
+
+    def deco(fn):
+        fn.__ray_tpu_method_opts__ = opts
+        return fn
+
+    return deco
+
+
+def put(value: Any) -> ObjectRef:
+    _auto_init()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed "
+                        "(matches reference semantics)")
+    cw = worker_context.core_worker()
+    return ObjectRef(cw.put(value))
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None):
+    _auto_init()
+    cw = worker_context.core_worker()
+    single = isinstance(refs, ObjectRef)
+    if single:
+        refs = [refs]
+    refs = list(refs)
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+    values = cw.get([r._info for r in refs], timeout=timeout)
+    return values[0] if single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True
+         ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    _auto_init()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    refs = list(refs)
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns={num_returns} exceeds number of refs {len(refs)}")
+    cw = worker_context.core_worker()
+    ready_idx, not_ready_idx = cw.wait(
+        [r._info for r in refs], num_returns, timeout, fetch_local)
+    return ([refs[i] for i in ready_idx], [refs[i] for i in not_ready_idx])
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    cw = worker_context.core_worker()
+    cw.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort task cancellation (reference: worker.py:2552 cancel)."""
+    logger.warning("cancel(): queued-task cancellation only in this version")
+
+
+def get_actor(name: str) -> ActorHandle:
+    _auto_init()
+    cw = worker_context.core_worker()
+    info = cw.get_actor_by_name(name)
+    if info is None:
+        raise ValueError(f"failed to look up actor with name {name!r}")
+    return ActorHandle(info["actor_id"])
+
+
+def nodes() -> List[dict]:
+    cw = worker_context.core_worker()
+    out = []
+    for n in cw.nodes():
+        out.append({
+            "NodeID": n["node_id"].hex(),
+            "Alive": n["alive"],
+            "Address": n["address"],
+            "Resources": n["resources_total"],
+        })
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    return worker_context.core_worker().cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return worker_context.core_worker().available_resources()
+
+
+class _RuntimeContext:
+    @property
+    def job_id(self):
+        return worker_context.core_worker().job_id
+
+    @property
+    def node_id(self):
+        return worker_context.core_worker().node_id
+
+    @property
+    def task_id(self) -> bytes:
+        return worker_context.current_task_id()
+
+    @property
+    def actor_id(self) -> bytes:
+        return worker_context.current_actor_id()
+
+    def get(self) -> dict:
+        return {"job_id": self.job_id, "node_id": self.node_id,
+                "task_id": self.task_id, "actor_id": self.actor_id}
+
+
+def get_runtime_context() -> _RuntimeContext:
+    return _RuntimeContext()

@@ -1,0 +1,799 @@
+"""CoreWorker: the distributed-futures runtime living in every driver and
+worker process.
+
+Role-equivalent of the reference's core worker library (reference
+``src/ray/core_worker/core_worker.h:194``): it owns the in-process memory
+store for small objects (``memory_store.h:43``), the shared-memory store
+client, task submission with the worker-lease protocol
+(``transport/direct_task_transport.h:57 CoreWorkerDirectTaskSubmitter``),
+and the direct actor transport with per-caller sequence numbers
+(``transport/direct_actor_task_submitter.cc:419 PushActorTask``).
+
+Threading: all network I/O runs on one asyncio loop (a daemon thread for
+drivers; the process main loop for workers). Public methods are synchronous
+facades that post coroutines to the loop — the analog of the reference's
+"the Cython layer releases the GIL and posts to the io_service"
+(_raylet.pyx:1798).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu._private import protocol, serialization
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
+                                  put_object_id)
+from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
+from ray_tpu import exceptions
+
+logger = logging.getLogger(__name__)
+
+INLINE_LIMIT_DEFAULT = 100 * 1024
+
+
+class EventLoopThread:
+    """Daemon thread running the client's asyncio loop."""
+
+    def __init__(self, name="raytpu-io"):
+        self.loop = asyncio.new_event_loop()
+        self.thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def post(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+class MemoryStoreEntry:
+    __slots__ = ("data", "is_error", "in_store", "event", "waiters")
+
+    def __init__(self):
+        self.data: Optional[bytes] = None
+        self.is_error = False
+        self.in_store = False  # value lives in the shared-memory store
+        self.event = threading.Event()
+        self.waiters: List[Tuple[asyncio.AbstractEventLoop, asyncio.Future]] = []
+
+    def _wake(self):
+        self.event.set()
+        waiters, self.waiters = self.waiters, []
+        for loop, fut in waiters:
+            loop.call_soon_threadsafe(
+                lambda f=fut: f.set_result(None) if not f.done() else None)
+
+    def put(self, data: bytes, is_error: bool):
+        self.data = data
+        self.is_error = is_error
+        self._wake()
+
+    def put_in_store(self):
+        self.in_store = True
+        self._wake()
+
+    async def ready(self):
+        """Await readiness from an asyncio loop (non-blocking)."""
+        if self.event.is_set():
+            return
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.waiters.append((loop, fut))
+        if self.event.is_set() and not fut.done():
+            fut.set_result(None)
+        await fut
+
+
+class LeaseState:
+    """Per-scheduling-key pool of leased workers with a task queue
+    (reference: direct_task_transport task queues keyed by SchedulingKey)."""
+
+    __slots__ = ("queue", "workers", "inflight_requests", "resources", "pg")
+
+    def __init__(self, resources, pg):
+        self.queue: List[Tuple[dict, asyncio.Future]] = []
+        self.workers: List[dict] = []  # idle leased workers
+        self.inflight_requests = 0
+        self.resources = resources
+        self.pg = pg
+
+
+class CoreWorker:
+    def __init__(self, *, gcs_address: str, node_address: str,
+                 object_store_name: str, job_id: JobID,
+                 worker_id: Optional[WorkerID] = None,
+                 config: Optional[Config] = None,
+                 loop_thread: Optional[EventLoopThread] = None,
+                 mode: str = "driver"):
+        self.config = config or Config()
+        self.mode = mode
+        self.job_id = job_id
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.gcs_address = gcs_address
+        self.node_address = node_address
+        self._own_loop = loop_thread is None
+        self.io = loop_thread or EventLoopThread()
+        self.store = ObjectStoreClient(object_store_name)
+        self.memory_store: Dict[bytes, MemoryStoreEntry] = {}
+        self._ms_lock = threading.Lock()
+        self.gcs: Optional[protocol.Connection] = None
+        self.nm: Optional[protocol.Connection] = None
+        self._worker_conns: Dict[str, protocol.Connection] = {}
+        self._dial_locks: Dict[str, asyncio.Lock] = {}
+        self._leases: Dict[bytes, LeaseState] = {}
+        self._exported_fns: set[bytes] = set()
+        self._fn_lock = threading.Lock()
+        self._actor_seqno: Dict[bytes, int] = {}
+        self._actor_send_locks: Dict[bytes, asyncio.Lock] = {}
+        self._actor_addr_cache: Dict[bytes, str] = {}
+        self._current_task_id = TaskID.for_driver(job_id)
+        self._task_counter = 0
+        self._closed = False
+        self.node_id: bytes = b""
+        self._pub_handlers: Dict[str, List[Any]] = {}
+        self.io.run(self._connect(), timeout=self.config.rpc_connect_timeout_s + 5)
+
+    # ---- bootstrap -------------------------------------------------------
+
+    async def _dial(self, addr: str) -> protocol.Connection:
+        if addr.startswith("/"):
+            return await protocol.connect_unix(addr)
+        host, port = addr.rsplit(":", 1)
+        return await protocol.connect_tcp(host, int(port))
+
+    async def _connect(self):
+        self.gcs = await self._dial(self.gcs_address)
+        self.gcs.set_push_handler(self._on_push)
+        self.nm = await self._dial(self.node_address)
+        self.nm.set_request_handler(self._handle_nm_request)
+        reply = await self.nm.call("register_core_worker",
+                                   {"worker_id": self.worker_id.binary()})
+        self.node_id = reply["node_id"]
+
+    def _on_push(self, method: str, payload):
+        if method.startswith("pub."):
+            channel = method[4:]
+            for fn in self._pub_handlers.get(channel, []):
+                try:
+                    fn(payload)
+                except Exception:  # noqa: BLE001 - user callback
+                    logger.exception("pubsub handler failed")
+
+    def subscribe(self, channel: str, handler):
+        self._pub_handlers.setdefault(channel, []).append(handler)
+        self.io.run(self.gcs.call("sub_subscribe", {"channels": [channel]}))
+
+    async def _handle_nm_request(self, method: str, payload):
+        if method == "promote_object":
+            return self._promote_object(payload["oid"])
+        raise protocol.RpcError(f"unknown method {method!r}")
+
+    def _promote_object(self, oid: bytes):
+        """Write a memory-store object into the shared store so another
+        process can read it (reference: inline object promotion to plasma)."""
+        entry = self.memory_store.get(oid)
+        if entry is not None and entry.in_store:
+            return {"in_store": True}
+        if entry is None or entry.data is None:
+            raise RuntimeError(f"owner does not have object {oid.hex()[:16]}")
+        if not self.store.contains(ObjectID(oid)):
+            try:
+                self.store.put_bytes(ObjectID(oid), entry.data)
+            except Exception as e:  # noqa: BLE001
+                if "exists" not in str(e):
+                    raise
+        return {"in_store": True}
+
+    def shutdown(self):
+        if self._closed:
+            return
+        self._closed = True
+
+        async def _close():
+            for c in list(self._worker_conns.values()):
+                await c.close()
+            if self.gcs:
+                await self.gcs.close()
+            if self.nm:
+                await self.nm.close()
+
+        try:
+            self.io.run(_close(), timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        if self._own_loop:
+            self.io.stop()
+        self.store.close()
+
+    # ---- object plane ----------------------------------------------------
+
+    def _store_local(self, oid: bytes, data: bytes, is_error: bool):
+        with self._ms_lock:
+            entry = self.memory_store.setdefault(oid, MemoryStoreEntry())
+        entry.put(data, is_error)
+
+    def _ensure_entry(self, oid: bytes) -> MemoryStoreEntry:
+        with self._ms_lock:
+            return self.memory_store.setdefault(oid, MemoryStoreEntry())
+
+    def _ctx_task_id(self) -> TaskID:
+        """Current task id: thread-local execution context if set (worker
+        threads running user code), else this process's root task."""
+        from ray_tpu._private import worker_context
+
+        tid = worker_context.current_task_id()
+        return TaskID(tid) if tid else self._current_task_id
+
+    def put(self, value: Any, owner_address: str = "") -> "ObjectRefInfo":
+        oid = put_object_id(self._ctx_task_id())
+        ser = serialization.serialize(value)
+        if ser.total_size <= self.config.max_inline_object_size:
+            self._store_local(oid.binary(), ser.to_bytes(), False)
+        else:
+            self._put_shm(oid, ser)
+        return ObjectRefInfo(oid.binary(), self.worker_id.binary(),
+                             self.node_address)
+
+    def _put_shm(self, oid: ObjectID, ser: serialization.SerializedObject):
+        try:
+            view = self.store.create(oid, ser.total_size)
+        except ObjectStoreFull:
+            self.store.evict(ser.total_size)
+            view = self.store.create(oid, ser.total_size)
+        try:
+            ser.write_into(view)
+        finally:
+            view.release()
+        self.store.seal(oid)
+
+    def _read_ready(self, oid: bytes) -> Optional[Tuple[Any, bool]]:
+        """Non-blocking read: memory store, then shared store."""
+        entry = self.memory_store.get(oid)
+        if entry is not None and entry.event.is_set() and not entry.in_store:
+            return serialization.deserialize(entry.data)
+        buf = self.store.get(ObjectID(oid), timeout_ms=0)
+        if buf is not None:
+            with buf:
+                # Copy out of shm before deserializing so views outlive pin.
+                return serialization.deserialize(
+                    bytes(buf.data) + bytes(buf.metadata))
+        return None
+
+    def is_ready(self, ref: "ObjectRefInfo") -> bool:
+        entry = self.memory_store.get(ref.oid)
+        if entry is not None and entry.event.is_set():
+            return True
+        return self.store.contains(ObjectID(ref.oid))
+
+    def get(self, refs: Sequence["ObjectRefInfo"],
+            timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out: List[Any] = [None] * len(refs)
+        pulled: set[int] = set()
+        # Objects whose owner promised "it's in the shared store" but the
+        # store disagrees: if that persists, the object was evicted and
+        # (for self-owned objects) cannot be recovered -> ObjectLostError.
+        miss_since: Dict[int, float] = {}
+        pending = list(range(len(refs)))
+        while pending:
+            still: List[int] = []
+            for i in pending:
+                ref = refs[i]
+                res = self._read_ready(ref.oid)
+                if res is None:
+                    if i not in pulled and ref.owner != self.worker_id.binary():
+                        pulled.add(i)
+                        self.io.post(self._request_pull(ref))
+                    entry = self.memory_store.get(ref.oid)
+                    if (entry is not None and entry.in_store
+                            and ref.owner == self.worker_id.binary()):
+                        t0 = miss_since.setdefault(i, time.monotonic())
+                        if time.monotonic() - t0 > 5.0:
+                            raise exceptions.ObjectLostError(
+                                f"object {ref.oid.hex()[:16]} was evicted "
+                                "from the local store and has no other copy")
+                    still.append(i)
+                else:
+                    value, is_error = res
+                    if is_error:
+                        self._raise_error(value)
+                    out[i] = value
+            pending = still
+            if not pending:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out waiting for {len(pending)} objects")
+            # Block efficiently on the first pending local future if any;
+            # if its event is already set (in_store marker) fall back to a
+            # short poll so we never hot-spin.
+            first = self.memory_store.get(refs[pending[0]].oid)
+            if first is not None and not first.event.is_set():
+                wait_s = 0.2 if deadline is None else min(
+                    0.2, max(0.0, deadline - time.monotonic()))
+                first.event.wait(wait_s)
+            else:
+                time.sleep(self.config.get_poll_interval_s)
+        return out
+
+    async def _request_pull(self, ref: "ObjectRefInfo"):
+        try:
+            await self.nm.call("pull_object", {
+                "oid": ref.oid, "owner": ref.owner,
+                "owner_node_address": ref.node_address})
+        except Exception as e:  # noqa: BLE001 - surfaced by get timeout
+            logger.debug("pull_object failed for %s: %s", ref.oid.hex()[:16], e)
+
+    def wait(self, refs: Sequence["ObjectRefInfo"], num_returns: int,
+             timeout: Optional[float], fetch_local: bool = True
+             ) -> Tuple[List[int], List[int]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ready = [i for i, r in enumerate(refs) if self.is_ready(r)]
+            if len(ready) >= num_returns or (
+                    deadline is not None and time.monotonic() >= deadline):
+                ready = ready[:num_returns]
+                picked = set(ready)
+                not_ready = [i for i in range(len(refs)) if i not in picked]
+                return ready, not_ready
+            time.sleep(self.config.get_poll_interval_s)
+
+    def free(self, refs: Sequence["ObjectRefInfo"]):
+        for ref in refs:
+            with self._ms_lock:
+                self.memory_store.pop(ref.oid, None)
+            try:
+                self.store.delete(ObjectID(ref.oid))
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _raise_error(self, err: Any):
+        if isinstance(err, BaseException):
+            raise err
+        raise exceptions.RayTaskError(repr(err), "")
+
+    # ---- function export -------------------------------------------------
+
+    def export_function(self, pickled: bytes) -> bytes:
+        fid = hashlib.sha1(pickled).digest()
+        with self._fn_lock:
+            if fid in self._exported_fns:
+                return fid
+        key = f"fn:{self.job_id.hex()}:{fid.hex()}"
+        self.io.run(self.gcs.call("kv_put", {"key": key, "value": pickled}))
+        with self._fn_lock:
+            self._exported_fns.add(fid)
+        return fid
+
+    def fetch_function(self, job_id: bytes, fid: bytes) -> bytes:
+        key = f"fn:{JobID(job_id).hex()}:{fid.hex()}"
+        pickled = self.io.run(self.gcs.call("kv_get", {"key": key}))
+        if pickled is None:
+            raise RuntimeError(f"function {fid.hex()[:12]} not found in GCS")
+        return pickled
+
+    # ---- argument marshalling -------------------------------------------
+
+    def _marshal_arg(self, arg: Any) -> dict:
+        from ray_tpu._private.worker_context import ObjectRefLike
+
+        if isinstance(arg, ObjectRefLike):
+            ref = arg._info
+            # Inline already-resolved small owner-local values (reference:
+            # LocalDependencyResolver inlines <100KiB resolved deps).
+            entry = self.memory_store.get(ref.oid)
+            if (entry is not None and entry.event.is_set() and not entry.is_error
+                    and entry.data is not None
+                    and len(entry.data) <= self.config.max_inline_object_size):
+                return {"k": "v", "d": entry.data}
+            return {"k": "r", "oid": ref.oid, "owner": ref.owner,
+                    "addr": ref.node_address}
+        ser = serialization.serialize(arg)
+        if ser.total_size > self.config.max_inline_object_size:
+            # Large pass-by-value arg: put in shm, pass as owned ref.
+            oid = put_object_id(self._ctx_task_id())
+            self._put_shm(oid, ser)
+            return {"k": "r", "oid": oid.binary(),
+                    "owner": self.worker_id.binary(),
+                    "addr": self.node_address}
+        return {"k": "v", "d": ser.to_bytes()}
+
+    def _await_ref_args(self, args, kwargs, timeout=None):
+        """Block until every ObjectRef argument is resolvable (owner-local
+        ready or in shm) so the leased worker never stalls on deps."""
+        from ray_tpu._private.worker_context import ObjectRefLike
+
+        refs = [a for a in list(args) + list(kwargs.values())
+                if isinstance(a, ObjectRefLike)]
+        for r in refs:
+            if r._info.owner == self.worker_id.binary():
+                entry = self.memory_store.get(r._info.oid)
+                if entry is not None and not entry.event.is_set():
+                    entry.event.wait()
+                if entry is not None and entry.is_error:
+                    value, _ = serialization.deserialize(entry.data)
+                    self._raise_error(value)
+
+    async def _async_resolve_deps(self, args, kwargs) -> Optional[bytes]:
+        """Await pending self-owned ref deps on the loop (keeps .remote()
+        non-blocking so task graphs compose asynchronously).  Returns the
+        serialized error bytes of the first failed dependency, if any
+        (dependency errors propagate to this task's returns, matching the
+        reference's error-on-get semantics)."""
+        from ray_tpu._private.worker_context import ObjectRefLike
+
+        for a in list(args) + list(kwargs.values()):
+            if not isinstance(a, ObjectRefLike):
+                continue
+            if a._info.owner != self.worker_id.binary():
+                continue
+            entry = self.memory_store.get(a._info.oid)
+            if entry is None:
+                continue
+            await entry.ready()
+            if entry.is_error:
+                return entry.data
+        return None
+
+    # ---- normal task submission (lease protocol) ------------------------
+
+    def submit_task(self, fid: bytes, args: tuple, kwargs: dict, *,
+                    num_returns: int = 1, resources: Dict[str, float],
+                    name: str = "", max_retries: int = 3,
+                    pg: Optional[Tuple[bytes, int]] = None
+                    ) -> List["ObjectRefInfo"]:
+        self._task_counter += 1
+        task_id = TaskID.for_task(self.job_id)
+        return_ids = [ObjectID.for_return(task_id, i + 1).binary()
+                      for i in range(num_returns)]
+        for oid in return_ids:
+            self._ensure_entry(oid)
+        skey = self._scheduling_key(resources, pg)
+        self.io.post(self._submit_on_loop(
+            skey, task_id, fid, name, args, kwargs, num_returns,
+            resources, pg, max_retries))
+        return [ObjectRefInfo(oid, self.worker_id.binary(), self.node_address)
+                for oid in return_ids]
+
+    def _scheduling_key(self, resources, pg) -> bytes:
+        items = tuple(sorted(resources.items())) + (pg or ())
+        return hashlib.sha1(repr(items).encode()).digest()
+
+    async def _submit_on_loop(self, skey, task_id, fid, name, args, kwargs,
+                              num_returns, resources, pg, max_retries):
+        spec = {
+            "task_id": task_id.binary(),
+            "job_id": self.job_id.binary(),
+            "fid": fid,
+            "name": name,
+            "num_returns": num_returns,
+            "caller": self.worker_id.binary(),
+            "caller_addr": self.node_address,
+            "retries_left": max_retries,
+        }
+        try:
+            dep_error = await self._async_resolve_deps(args, kwargs)
+            if dep_error is not None:
+                for i in range(num_returns):
+                    oid = ObjectID.for_return(task_id, i + 1).binary()
+                    self._store_local(oid, dep_error, True)
+                return
+            spec["args"] = [self._marshal_arg(a) for a in args]
+            spec["kwargs"] = {k: self._marshal_arg(v)
+                              for k, v in kwargs.items()}
+        except Exception as e:  # noqa: BLE001 - marshalling failed
+            self._fail_task(spec, e)
+            return
+        state = self._leases.get(skey)
+        if state is None:
+            state = LeaseState(resources, pg)
+            self._leases[skey] = state
+        fut = asyncio.get_running_loop().create_future()
+        state.queue.append((spec, fut))
+        self._maybe_request_lease(skey, state)
+        try:
+            await fut
+        except Exception as e:  # noqa: BLE001 - record as task error
+            self._fail_task(spec, e)
+
+    def _fail_task(self, spec, exc: Exception):
+        err = exceptions.RayTaskError(repr(exc), "")
+        data = serialization.serialize_error(err).to_bytes()
+        for i in range(spec["num_returns"]):
+            oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
+            self._store_local(oid, data, True)
+
+    def _maybe_request_lease(self, skey, state: LeaseState):
+        demand = len(state.queue)
+        if demand == 0:
+            return
+        if state.workers:
+            self._dispatch(skey, state)
+            return
+        if state.inflight_requests >= demand:
+            return
+        state.inflight_requests += 1
+        asyncio.get_running_loop().create_task(self._request_lease(skey, state))
+
+    async def _request_lease(self, skey, state: LeaseState):
+        try:
+            payload = {"resources": state.resources, "scheduling_key": skey}
+            if state.pg is not None:
+                payload["pg_id"] = state.pg[0]
+                payload["bundle_index"] = state.pg[1]
+            lease = await self.nm.call("request_worker_lease", payload)
+            state.workers.append(lease)
+            self._dispatch(skey, state)
+        except Exception as e:  # noqa: BLE001 - fail queued tasks
+            while state.queue:
+                _, fut = state.queue.pop(0)
+                if not fut.done():
+                    fut.set_exception(e)
+        finally:
+            state.inflight_requests -= 1
+
+    def _dispatch(self, skey, state: LeaseState):
+        while state.queue and state.workers:
+            spec, fut = state.queue.pop(0)
+            lease = state.workers.pop(0)
+            asyncio.get_running_loop().create_task(
+                self._push_task(skey, state, lease, spec, fut))
+        self._maybe_request_lease(skey, state)
+
+    async def _worker_conn(self, address: str) -> protocol.Connection:
+        conn = self._worker_conns.get(address)
+        if conn is None or conn.closed:
+            lock = self._dial_locks.setdefault(address, asyncio.Lock())
+            async with lock:
+                conn = self._worker_conns.get(address)
+                if conn is None or conn.closed:
+                    conn = await self._dial(address)
+                    self._worker_conns[address] = conn
+        return conn
+
+    async def _push_task(self, skey, state, lease, spec, fut):
+        try:
+            conn = await self._worker_conn(lease["address"])
+            reply = await conn.call("push_task", spec)
+            self._ingest_returns(spec, reply)
+            if not fut.done():
+                fut.set_result(None)
+        except protocol.RpcError as e:
+            self._fail_task_user_error(spec, e)
+            if not fut.done():
+                fut.set_result(None)
+        except Exception as e:  # noqa: BLE001 - worker died mid-task
+            lease = None  # lease is gone with the worker
+            if spec.get("retries_left", 0) > 0:
+                # Retry on a fresh lease (reference: TaskManager resubmits
+                # failed tasks up to max_retries, task_manager.h:85).
+                spec["retries_left"] -= 1
+                logger.warning("retrying task %s after worker failure "
+                               "(%d retries left)", spec.get("name", "?"),
+                               spec["retries_left"])
+                state.queue.append((spec, fut))
+            elif not fut.done():
+                fut.set_exception(
+                    exceptions.WorkerCrashedError(
+                        f"worker died executing task: {e}"))
+        finally:
+            if lease is not None:
+                state.workers.append(lease)
+            if state.queue:
+                self._dispatch(skey, state)
+            elif lease is not None:
+                await self._return_idle(skey, state)
+
+    def _fail_task_user_error(self, spec, e: protocol.RpcError):
+        err = exceptions.RayTaskError(str(e), e.remote_traceback)
+        data = serialization.serialize_error(err).to_bytes()
+        for i in range(spec["num_returns"]):
+            oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
+            self._store_local(oid, data, True)
+
+    async def _return_idle(self, skey, state: LeaseState):
+        while state.workers and not state.queue:
+            lease = state.workers.pop()
+            try:
+                await self.nm.call("return_worker",
+                                   {"lease_id": lease["lease_id"]})
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _ingest_returns(self, spec, reply):
+        for ret in reply["returns"]:
+            oid = ret["oid"]
+            if "d" in ret:
+                self._store_local(oid, ret["d"], bool(ret.get("err")))
+            else:
+                # Large return living in shm; wake blocked getters.
+                self._ensure_entry(oid).put_in_store()
+
+    # ---- actors ----------------------------------------------------------
+
+    def create_actor(self, fid: bytes, args: tuple, kwargs: dict, *,
+                     resources: Dict[str, float], name: str = "",
+                     max_restarts: int = 0, lifetime: str = "",
+                     max_concurrency: int = 1,
+                     pg: Optional[Tuple[bytes, int]] = None) -> bytes:
+        self._await_ref_args(args, kwargs)
+        actor_id = ActorID.of(self.job_id)
+        spec = {
+            "actor_id": actor_id.binary(),
+            "job_id": self.job_id.binary(),
+            "fid": fid,
+            "args": [self._marshal_arg(a) for a in args],
+            "kwargs": {k: self._marshal_arg(v) for k, v in kwargs.items()},
+            "resources": resources,
+            "max_concurrency": max_concurrency,
+        }
+        if pg is not None:
+            spec["placement_group_id"] = pg[0]
+            spec["bundle_index"] = pg[1]
+        self.io.run(self.gcs.call("actor_register", {
+            "actor_id": actor_id.binary(), "spec": spec, "name": name,
+            "max_restarts": max_restarts, "lifetime": lifetime}))
+        return actor_id.binary()
+
+    def wait_actor_ready(self, actor_id: bytes, timeout: float = 120.0) -> dict:
+        info = self.io.run(self.gcs.call(
+            "actor_get_info", {"actor_id": actor_id, "wait_ready": True}),
+            timeout=timeout)
+        if info["state"] == "DEAD":
+            raise exceptions.ActorDiedError(
+                f"actor failed to start: {info['death_cause']}")
+        self._actor_addr_cache[actor_id] = info["address"]
+        return info
+
+    def get_actor_by_name(self, name: str) -> Optional[dict]:
+        return self.io.run(self.gcs.call("actor_get_by_name", {"name": name}))
+
+    def submit_actor_task(self, actor_id: bytes, method: str, args: tuple,
+                          kwargs: dict, *, num_returns: int = 1
+                          ) -> List["ObjectRefInfo"]:
+        task_id = TaskID.for_actor_task(ActorID(actor_id))
+        spec = {
+            "task_id": task_id.binary(),
+            "actor_id": actor_id,
+            "method": method,
+            "num_returns": num_returns,
+            "caller": self.worker_id.binary(),
+            "caller_addr": self.node_address,
+        }
+        return_ids = [ObjectID.for_return(task_id, i + 1).binary()
+                      for i in range(num_returns)]
+        for oid in return_ids:
+            self._ensure_entry(oid)
+        self.io.post(self._push_actor_task(actor_id, spec, args, kwargs))
+        return [ObjectRefInfo(oid, self.worker_id.binary(), self.node_address)
+                for oid in return_ids]
+
+    async def _push_actor_task(self, actor_id: bytes, spec: dict,
+                               args: tuple, kwargs: dict,
+                               dial_retries: int = 3):
+        try:
+            dep_error = await self._async_resolve_deps(args, kwargs)
+            if dep_error is not None:
+                for i in range(spec["num_returns"]):
+                    oid = ObjectID.for_return(
+                        TaskID(spec["task_id"]), i + 1).binary()
+                    self._store_local(oid, dep_error, True)
+                return
+            spec["args"] = [self._marshal_arg(a) for a in args]
+            spec["kwargs"] = {k: self._marshal_arg(v)
+                              for k, v in kwargs.items()}
+        except Exception as e:  # noqa: BLE001 - marshalling failed
+            self._fail_actor_task(spec, e)
+            return
+        # Phase 1 — resolve + connect. Safe to retry: nothing was sent yet
+        # (a restarting actor resolves to its new address).
+        conn = None
+        for attempt in range(dial_retries + 1):
+            addr = self._actor_addr_cache.get(actor_id)
+            try:
+                if not addr:
+                    info = await self.gcs.call(
+                        "actor_get_info",
+                        {"actor_id": actor_id, "wait_ready": True})
+                    if info["state"] == "DEAD":
+                        raise exceptions.ActorDiedError(
+                            "actor is dead: " + (info.get("death_cause") or ""))
+                    addr = info["address"]
+                    self._actor_addr_cache[actor_id] = addr
+                conn = await self._worker_conn(addr)
+                break
+            except exceptions.ActorDiedError as e:
+                self._fail_actor_task(spec, e)
+                return
+            except Exception as e:  # noqa: BLE001 - stale address, retry
+                self._actor_addr_cache.pop(actor_id, None)
+                if attempt >= dial_retries:
+                    self._fail_actor_task(spec, exceptions.ActorDiedError(
+                        f"actor unreachable: {e}"))
+                    return
+                await asyncio.sleep(0.2)
+        # Phase 2 — push. Seqno is assigned at SEND time under a per-actor
+        # lock, so seqnos are contiguous and sent in order even when calls
+        # resolve deps/addresses at different speeds; a failed call before
+        # send never consumes a seqno. NOT retried after send: the task may
+        # have executed (actor tasks default to max_task_retries=0, matching
+        # reference ray_option_utils.py:159 semantics).
+        lock = self._actor_send_locks.setdefault(actor_id, asyncio.Lock())
+        try:
+            async with lock:
+                seqno = self._actor_seqno.get(actor_id, 0)
+                self._actor_seqno[actor_id] = seqno + 1
+                spec["seqno"] = seqno
+                waiter = await conn.call_send("push_actor_task", spec)
+            reply = await waiter
+            self._ingest_returns(spec, reply)
+        except protocol.RpcError as e:
+            self._fail_task_user_error(spec, e)
+        except Exception as e:  # noqa: BLE001 - actor died mid-call
+            self._actor_addr_cache.pop(actor_id, None)
+            self._fail_actor_task(spec, exceptions.ActorDiedError(
+                f"actor died while executing task: {e}"))
+
+    def _fail_actor_task(self, spec, err: BaseException):
+        data = serialization.serialize_error(err).to_bytes()
+        for i in range(spec["num_returns"]):
+            oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
+            self._store_local(oid, data, True)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True):
+        self.io.run(self.gcs.call("actor_kill", {
+            "actor_id": actor_id, "no_restart": no_restart}))
+        self._actor_addr_cache.pop(actor_id, None)
+
+    # ---- cluster introspection ------------------------------------------
+
+    def nodes(self) -> list:
+        return self.io.run(self.gcs.call("node_list", {}))
+
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.io.run(self.gcs.call("node_total_resources", {}))
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.io.run(self.gcs.call("node_available_resources", {}))
+
+    def kv_put(self, key: str, value: bytes, overwrite: bool = True) -> bool:
+        return self.io.run(self.gcs.call(
+            "kv_put", {"key": key, "value": value, "overwrite": overwrite}))
+
+    def kv_get(self, key: str) -> Optional[bytes]:
+        return self.io.run(self.gcs.call("kv_get", {"key": key}))
+
+    def kv_del(self, key: str) -> bool:
+        return self.io.run(self.gcs.call("kv_del", {"key": key}))
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        return self.io.run(self.gcs.call("kv_keys", {"prefix": prefix}))
+
+
+class ObjectRefInfo:
+    """The wire-level identity of an object: id + owner + owner's node."""
+
+    __slots__ = ("oid", "owner", "node_address")
+
+    def __init__(self, oid: bytes, owner: bytes, node_address: str):
+        self.oid = oid
+        self.owner = owner
+        self.node_address = node_address
+
+    def __repr__(self):
+        return f"ObjectRefInfo({self.oid.hex()[:16]})"

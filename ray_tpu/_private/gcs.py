@@ -1,0 +1,583 @@
+"""GCS (Global Control Service): cluster metadata authority.
+
+Role-equivalent of the reference's GCS server (reference
+``src/ray/gcs/gcs_server/gcs_server.cc:118`` initializes node / actor / job /
+KV / placement-group managers). Here it is an asyncio service speaking the
+framed msgpack RPC protocol; node managers hold a persistent bidirectional
+connection (registered at ``node.register``) that the GCS uses for outbound
+scheduling commands — the role of the reference's gRPC client pool back to
+raylets (``gcs_actor_scheduler.cc:84 LeaseWorkerFromNode``).
+
+Services & method namespaces:
+    kv.*      internal key-value store (function table, named config; the
+              reference's GcsKVManager / internal KV, gcs_utils.py:226)
+    node.*    node registry + resource view + heartbeats
+              (GcsNodeManager / GcsHeartbeatManager / GcsResourceManager)
+    job.*     job id allocation (GcsJobManager)
+    actor.*   actor lifecycle: register, schedule on a node, restart on
+              death, named lookup, kill (GcsActorManager,
+              gcs_actor_manager.cc:448 RegisterActor)
+    pg.*      placement groups: gang reservation across nodes
+              (GcsPlacementGroupManager; 2PC prepare/commit like
+              gcs_placement_group_scheduler.h:103)
+    sub.*     pubsub channels: actor updates, node updates, logs, errors
+              (the reference's GCS pubsub hub, src/ray/pubsub/)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+# Actor lifecycle states (reference: rpc::ActorTableData state machine).
+DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+PENDING_CREATION = "PENDING_CREATION"
+ALIVE = "ALIVE"
+RESTARTING = "RESTARTING"
+DEAD = "DEAD"
+
+
+class ActorInfo:
+    __slots__ = (
+        "actor_id", "name", "state", "node_id", "worker_id", "address",
+        "spec", "resources", "max_restarts", "num_restarts", "death_cause",
+        "lifetime_detached", "placement_group_id", "bundle_index",
+    )
+
+    def __init__(self, actor_id: bytes, spec: dict, name: str,
+                 resources: Dict[str, float], max_restarts: int,
+                 lifetime_detached: bool,
+                 placement_group_id: bytes = b"", bundle_index: int = -1):
+        self.actor_id = actor_id
+        self.name = name
+        self.state = PENDING_CREATION
+        self.node_id: bytes = b""
+        self.worker_id: bytes = b""
+        self.address: str = ""
+        self.spec = spec
+        self.resources = resources
+        self.max_restarts = max_restarts
+        self.num_restarts = 0
+        self.death_cause = ""
+        self.lifetime_detached = lifetime_detached
+        self.placement_group_id = placement_group_id
+        self.bundle_index = bundle_index
+
+    def public(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "name": self.name,
+            "state": self.state,
+            "node_id": self.node_id,
+            "address": self.address,
+            "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts,
+            "death_cause": self.death_cause,
+            "resources": self.spec.get("resources", {}),
+        }
+
+
+class NodeInfo:
+    __slots__ = ("node_id", "conn", "resources_total", "resources_available",
+                 "address", "object_store_name", "last_heartbeat", "alive",
+                 "labels")
+
+    def __init__(self, node_id: bytes, conn: protocol.Connection,
+                 resources: Dict[str, float], address: str,
+                 object_store_name: str, labels: Dict[str, str]):
+        self.node_id = node_id
+        self.conn = conn
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.address = address
+        self.object_store_name = object_store_name
+        self.last_heartbeat = time.monotonic()
+        self.alive = True
+        self.labels = labels
+
+
+class PlacementGroupInfo:
+    __slots__ = ("pg_id", "name", "bundles", "strategy", "state",
+                 "bundle_nodes", "creator_conn")
+
+    def __init__(self, pg_id: bytes, name: str, bundles: List[Dict[str, float]],
+                 strategy: str):
+        self.pg_id = pg_id
+        self.name = name
+        self.bundles = bundles
+        self.strategy = strategy
+        self.state = "PENDING"
+        self.bundle_nodes: List[bytes] = [b""] * len(bundles)
+
+    def public(self) -> dict:
+        return {"pg_id": self.pg_id, "name": self.name, "bundles": self.bundles,
+                "strategy": self.strategy, "state": self.state,
+                "bundle_nodes": self.bundle_nodes}
+
+
+class GcsServer:
+    def __init__(self, heartbeat_timeout_s: float = 30.0):
+        self.server = protocol.Server()
+        self.server.add_routes(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.kv: Dict[str, bytes] = {}
+        self.nodes: Dict[bytes, NodeInfo] = {}
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.placement_groups: Dict[bytes, PlacementGroupInfo] = {}
+        self.named_pgs: Dict[str, bytes] = {}
+        self._job_counter = 0
+        self._subscribers: Dict[str, Set[protocol.Connection]] = {}
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        self._monitor_task: Optional[asyncio.Task] = None
+        # Waiters keyed by actor_id for state transitions out of PENDING.
+        self._actor_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._pg_waiters: Dict[bytes, List[asyncio.Future]] = {}
+        self._pg_lock = asyncio.Lock()
+        self._closing = False
+
+    async def start_unix(self, path: str):
+        await self.server.start_unix(path)
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._monitor_loop())
+
+    async def start_tcp(self, host: str, port: int) -> int:
+        port = await self.server.start_tcp(host, port)
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._monitor_loop())
+        return port
+
+    async def close(self):
+        self._closing = True
+        if self._monitor_task:
+            self._monitor_task.cancel()
+        await self.server.close()
+
+    # ---- pubsub ----------------------------------------------------------
+
+    def _publish(self, channel: str, payload: Any):
+        for conn in list(self._subscribers.get(channel, ())):
+            if conn.closed:
+                self._subscribers[channel].discard(conn)
+                continue
+            asyncio.get_running_loop().create_task(
+                conn.push("pub." + channel, payload))
+
+    async def rpc_sub_subscribe(self, conn, payload):
+        for channel in payload["channels"]:
+            self._subscribers.setdefault(channel, set()).add(conn)
+        return True
+
+    async def rpc_sub_publish(self, conn, payload):
+        self._publish(payload["channel"], payload["message"])
+        return True
+
+    # ---- kv --------------------------------------------------------------
+
+    async def rpc_kv_put(self, conn, payload):
+        key = payload["key"]
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = payload["value"]
+        return True
+
+    async def rpc_kv_get(self, conn, payload):
+        return self.kv.get(payload["key"])
+
+    async def rpc_kv_multi_get(self, conn, payload):
+        return {k: self.kv[k] for k in payload["keys"] if k in self.kv}
+
+    async def rpc_kv_del(self, conn, payload):
+        return self.kv.pop(payload["key"], None) is not None
+
+    async def rpc_kv_exists(self, conn, payload):
+        return payload["key"] in self.kv
+
+    async def rpc_kv_keys(self, conn, payload):
+        prefix = payload.get("prefix", "")
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ---- jobs ------------------------------------------------------------
+
+    async def rpc_job_register(self, conn, payload):
+        self._job_counter += 1
+        job_id = JobID.from_int(self._job_counter)
+        return {"job_id": job_id.binary()}
+
+    # ---- nodes -----------------------------------------------------------
+
+    async def rpc_node_register(self, conn, payload):
+        node_id = payload["node_id"]
+        info = NodeInfo(node_id, conn, payload["resources"],
+                        payload["address"], payload.get("object_store", ""),
+                        payload.get("labels", {}))
+        self.nodes[node_id] = info
+        conn._gcs_node_id = node_id  # for disconnect detection
+        self._publish("node", {"event": "added", "node_id": node_id,
+                               "resources": payload["resources"],
+                               "address": payload["address"]})
+        logger.info("node registered: %s %s", NodeID(node_id), payload["address"])
+        return True
+
+    async def rpc_node_heartbeat(self, conn, payload):
+        info = self.nodes.get(payload["node_id"])
+        if info is None:
+            return {"reregister": True}
+        info.last_heartbeat = time.monotonic()
+        info.resources_available = payload.get(
+            "resources_available", info.resources_available)
+        return {"reregister": False}
+
+    async def rpc_node_list(self, conn, payload):
+        return [
+            {"node_id": n.node_id, "address": n.address, "alive": n.alive,
+             "resources_total": n.resources_total,
+             "resources_available": n.resources_available,
+             "object_store": n.object_store_name, "labels": n.labels}
+            for n in self.nodes.values()
+        ]
+
+    async def rpc_node_total_resources(self, conn, payload):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_total.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    async def rpc_node_available_resources(self, conn, payload):
+        out: Dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.resources_available.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def _on_disconnect(self, conn):
+        node_id = getattr(conn, "_gcs_node_id", None)
+        if node_id is not None and node_id in self.nodes:
+            asyncio.get_running_loop().create_task(self._handle_node_death(node_id))
+        for subs in self._subscribers.values():
+            subs.discard(conn)
+
+    async def _monitor_loop(self):
+        """Mark nodes dead after missed heartbeats (reference:
+        GcsHeartbeatManager, gcs_heartbeat_manager.h:36) and retry pending
+        placement groups as resources free up (reference:
+        GcsPlacementGroupManager::SchedulePendingPlacementGroups)."""
+        while True:
+            await asyncio.sleep(1.0)
+            now = time.monotonic()
+            for node_id, info in list(self.nodes.items()):
+                if info.alive and now - info.last_heartbeat > self._heartbeat_timeout_s:
+                    await self._handle_node_death(node_id)
+            for pg in list(self.placement_groups.values()):
+                if pg.state in ("PENDING", "INFEASIBLE"):
+                    async with self._pg_lock:
+                        if pg.state not in ("PENDING", "INFEASIBLE"):
+                            continue
+                        ok = await self._try_place_pg(pg)
+                    if ok:
+                        for fut in self._pg_waiters.pop(pg.pg_id, []):
+                            if not fut.done():
+                                fut.set_result(pg.public())
+
+    async def _handle_node_death(self, node_id: bytes):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive or self._closing:
+            return
+        info.alive = False
+        logger.warning("node dead: %s", NodeID(node_id))
+        self._publish("node", {"event": "removed", "node_id": node_id})
+        # Restart or fail actors that lived there (reference:
+        # GcsActorManager::OnNodeDead, gcs_actor_manager.h:318).
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION,
+                                                            RESTARTING):
+                await self._handle_actor_failure(actor, "node died")
+
+    # ---- actors ----------------------------------------------------------
+
+    def _pick_node(self, resources: Dict[str, float],
+                   node_id: Optional[bytes] = None) -> Optional[NodeInfo]:
+        """Pack-first node selection for actor creation (the reference GCS
+        schedules actor-creation via raylet leases with the same hybrid
+        policy; we keep a simple best-fit pack here and let the node's local
+        manager queue if resources are momentarily busy)."""
+        if node_id:
+            n = self.nodes.get(node_id)
+            return n if n is not None and n.alive else None
+        candidates = [n for n in self.nodes.values() if n.alive and all(
+            n.resources_total.get(k, 0.0) >= v for k, v in resources.items())]
+        if not candidates:
+            return None
+        # Prefer nodes that currently have the resources free.
+        free = [n for n in candidates if all(
+            n.resources_available.get(k, 0.0) >= v for k, v in resources.items())]
+        pool = free or candidates
+        return max(pool, key=lambda n: sum(n.resources_available.values()))
+
+    async def rpc_actor_register(self, conn, payload):
+        actor_id = payload["actor_id"]
+        name = payload.get("name") or ""
+        if name:
+            if name in self.named_actors:
+                existing = self.actors.get(self.named_actors[name])
+                if existing is not None and existing.state != DEAD:
+                    raise ValueError(f"actor name {name!r} already taken")
+            self.named_actors[name] = actor_id
+        spec = payload["spec"]
+        pg_id = spec.get("placement_group_id") or b""
+        info = ActorInfo(
+            actor_id, spec, name, spec.get("resources", {}),
+            payload.get("max_restarts", 0),
+            payload.get("lifetime") == "detached",
+            placement_group_id=pg_id,
+            bundle_index=spec.get("bundle_index", -1),
+        )
+        self.actors[actor_id] = info
+        await self._schedule_actor(info)
+        return True
+
+    async def _schedule_actor(self, info: ActorInfo):
+        target_node: Optional[bytes] = None
+        if info.placement_group_id:
+            pg = self.placement_groups.get(info.placement_group_id)
+            if pg is None or pg.state != "CREATED":
+                info.state = DEAD
+                info.death_cause = "placement group not ready"
+                self._actor_state_changed(info)
+                return
+            idx = info.bundle_index if info.bundle_index >= 0 else 0
+            target_node = pg.bundle_nodes[idx]
+        node = self._pick_node(info.resources, target_node)
+        if node is None:
+            info.state = DEAD
+            info.death_cause = (
+                f"no node with resources {info.resources} "
+                f"(cluster: {[n.resources_total for n in self.nodes.values()]})")
+            self._actor_state_changed(info)
+            return
+        info.node_id = node.node_id
+        try:
+            reply = await node.conn.call(
+                "create_actor",
+                {"actor_id": info.actor_id, "spec": info.spec})
+            info.worker_id = reply["worker_id"]
+            info.address = reply["address"]
+            info.state = ALIVE
+        except Exception as e:  # noqa: BLE001 - scheduling failure -> actor death
+            info.state = DEAD
+            info.death_cause = f"creation failed: {e}"
+        self._actor_state_changed(info)
+
+    def _actor_state_changed(self, info: ActorInfo):
+        self._publish("actor", info.public())
+        for fut in self._actor_waiters.pop(info.actor_id, []):
+            if not fut.done():
+                fut.set_result(info.public())
+
+    async def rpc_actor_get_info(self, conn, payload):
+        actor_id = payload["actor_id"]
+        wait = payload.get("wait_ready", False)
+        info = self.actors.get(actor_id)
+        if info is None:
+            raise ValueError(f"no such actor {ActorID(actor_id)}")
+        if wait and info.state in (PENDING_CREATION, RESTARTING):
+            fut = asyncio.get_running_loop().create_future()
+            self._actor_waiters.setdefault(actor_id, []).append(fut)
+            return await fut
+        return info.public()
+
+    async def rpc_actor_get_by_name(self, conn, payload):
+        actor_id = self.named_actors.get(payload["name"])
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        if info is None or info.state == DEAD:
+            return None
+        return info.public()
+
+    async def rpc_actor_list(self, conn, payload):
+        return [a.public() for a in self.actors.values()]
+
+    async def rpc_actor_report_death(self, conn, payload):
+        """Node manager reports an actor worker died (reference: raylet
+        notifies GCS of worker failure -> GcsActorManager restart logic)."""
+        info = self.actors.get(payload["actor_id"])
+        if info is None or info.state == DEAD:
+            return True
+        await self._handle_actor_failure(info, payload.get("cause", "worker died"))
+        return True
+
+    async def _handle_actor_failure(self, info: ActorInfo, cause: str):
+        if info.state == DEAD:
+            return
+        unlimited = info.max_restarts == -1
+        if unlimited or info.num_restarts < info.max_restarts:
+            info.num_restarts += 1
+            info.state = RESTARTING
+            info.address = ""
+            self._publish("actor", info.public())
+            logger.info("restarting actor %s (%d/%s): %s",
+                        ActorID(info.actor_id), info.num_restarts,
+                        "inf" if unlimited else info.max_restarts, cause)
+            await self._schedule_actor(info)
+        else:
+            info.state = DEAD
+            info.death_cause = cause
+            self._actor_state_changed(info)
+
+    async def rpc_actor_kill(self, conn, payload):
+        actor_id = payload["actor_id"]
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        no_restart = payload.get("no_restart", True)
+        if no_restart:
+            info.max_restarts = info.num_restarts  # exhaust restarts
+        node = self.nodes.get(info.node_id)
+        if node is not None and node.alive and info.worker_id:
+            try:
+                await node.conn.call("kill_worker",
+                                     {"worker_id": info.worker_id,
+                                      "actor_id": actor_id})
+            except Exception:  # noqa: BLE001 - node may be mid-death
+                pass
+        if no_restart and info.state != DEAD:
+            info.state = DEAD
+            info.death_cause = "killed via kill()"
+            if info.name:
+                self.named_actors.pop(info.name, None)
+            self._actor_state_changed(info)
+        return True
+
+    # ---- placement groups ------------------------------------------------
+
+    async def rpc_pg_create(self, conn, payload):
+        """Gang reservation with 2-phase prepare/commit across node managers
+        (reference: GcsPlacementGroupScheduler 2PC,
+        gcs_placement_group_scheduler.h:103-105)."""
+        pg_id = payload["pg_id"]
+        name = payload.get("name") or ""
+        pg = PlacementGroupInfo(pg_id, name, payload["bundles"],
+                                payload.get("strategy", "PACK"))
+        self.placement_groups[pg_id] = pg
+        if name:
+            self.named_pgs[name] = pg_id
+        async with self._pg_lock:
+            ok = await self._try_place_pg(pg)
+        if not ok:
+            pg.state = "INFEASIBLE" if not self._pg_feasible(pg) else "PENDING"
+        for fut in self._pg_waiters.pop(pg_id, []):
+            if not fut.done():
+                fut.set_result(pg.public())
+        return pg.public()
+
+    def _pg_feasible(self, pg) -> bool:
+        return all(
+            any(n.alive and all(n.resources_total.get(k, 0) >= v
+                                for k, v in bundle.items())
+                for n in self.nodes.values())
+            for bundle in pg.bundles)
+
+    async def _try_place_pg(self, pg: PlacementGroupInfo) -> bool:
+        alive = [n for n in self.nodes.values() if n.alive]
+        assignment: List[Tuple[int, NodeInfo]] = []
+        avail = {n.node_id: dict(n.resources_available) for n in alive}
+
+        def fits(node, bundle):
+            return all(avail[node.node_id].get(k, 0.0) >= v
+                       for k, v in bundle.items())
+
+        order = sorted(alive, key=lambda n: -sum(n.resources_available.values()))
+        for i, bundle in enumerate(pg.bundles):
+            placed = False
+            if pg.strategy in ("PACK", "STRICT_PACK"):
+                candidates = ([assignment[-1][1]] if assignment else order) \
+                    if pg.strategy == "STRICT_PACK" else \
+                    ([assignment[-1][1]] + order if assignment else order)
+            elif pg.strategy in ("SPREAD", "STRICT_SPREAD"):
+                used = {n.node_id for _, n in assignment}
+                fresh = [n for n in order if n.node_id not in used]
+                candidates = fresh + (order if pg.strategy == "SPREAD" else [])
+            else:
+                candidates = order
+            for node in candidates:
+                if fits(node, bundle):
+                    assignment.append((i, node))
+                    for k, v in bundle.items():
+                        avail[node.node_id][k] = avail[node.node_id].get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                return False
+        # Phase 1: prepare on each node; Phase 2: commit. Roll back on failure.
+        prepared: List[Tuple[int, NodeInfo]] = []
+        try:
+            for i, node in assignment:
+                await node.conn.call("pg_prepare_bundle", {
+                    "pg_id": pg.pg_id, "bundle_index": i,
+                    "resources": pg.bundles[i]})
+                prepared.append((i, node))
+            for i, node in prepared:
+                await node.conn.call("pg_commit_bundle", {
+                    "pg_id": pg.pg_id, "bundle_index": i})
+        except Exception:  # noqa: BLE001 - roll back partial prepare
+            for i, node in prepared:
+                try:
+                    await node.conn.call("pg_return_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": i})
+                except Exception:  # noqa: BLE001
+                    pass
+            return False
+        for i, node in assignment:
+            pg.bundle_nodes[i] = node.node_id
+        pg.state = "CREATED"
+        self._publish("pg", pg.public())
+        return True
+
+    async def rpc_pg_wait_ready(self, conn, payload):
+        pg = self.placement_groups.get(payload["pg_id"])
+        if pg is None:
+            raise ValueError("no such placement group")
+        if pg.state in ("CREATED", "REMOVED"):
+            return pg.public()
+        fut = asyncio.get_running_loop().create_future()
+        self._pg_waiters.setdefault(pg.pg_id, []).append(fut)
+        return await fut
+
+    async def rpc_pg_get(self, conn, payload):
+        pg = self.placement_groups.get(payload["pg_id"])
+        return pg.public() if pg else None
+
+    async def rpc_pg_list(self, conn, payload):
+        return [pg.public() for pg in self.placement_groups.values()]
+
+    async def rpc_pg_remove(self, conn, payload):
+        pg = self.placement_groups.pop(payload["pg_id"], None)
+        if pg is None:
+            return False
+        if pg.name:
+            self.named_pgs.pop(pg.name, None)
+        for i, node_id in enumerate(pg.bundle_nodes):
+            node = self.nodes.get(node_id)
+            if node is not None and node.alive:
+                try:
+                    await node.conn.call("pg_return_bundle", {
+                        "pg_id": pg.pg_id, "bundle_index": i})
+                except Exception:  # noqa: BLE001
+                    pass
+        pg.state = "REMOVED"
+        self._publish("pg", pg.public())
+        return True

@@ -1,0 +1,547 @@
+"""Per-node manager: worker pool, lease-based local scheduling, object
+fetch coordination, placement-group bundle 2PC.
+
+Role-equivalent of the reference raylet's NodeManager (reference
+``src/ray/raylet/node_manager.h:144``) with its LocalTaskManager
+(``local_task_manager.cc:57 QueueAndScheduleTask`` / ``:99 Dispatch``),
+WorkerPool (``worker_pool.h:156``, ``:413 StartWorkerProcess``) and
+PlacementGroupResourceManager (2PC prepare/commit,
+``placement_group_resource_manager.cc``).
+
+Scheduling follows the reference's worker-lease protocol
+(``direct_task_transport.cc:325 RequestNewWorkerIfNeeded``): submitters ask
+for a worker lease carrying the task's resource shape; the node manager
+grants a (possibly newly forked) worker once resources are free; the
+submitter then pushes tasks DIRECTLY to the worker — the node manager is
+not on the per-task hot path — and returns the lease when its queue drains.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import NodeID, WorkerID
+
+logger = logging.getLogger(__name__)
+
+
+class ResourceSet:
+    """Fixed-point-free float resource arithmetic (the reference uses
+    fixed-point FixedPoint in cluster_resource_data.cc; floats with an
+    epsilon are sufficient here)."""
+
+    EPS = 1e-9
+
+    def __init__(self, resources: Dict[str, float]):
+        self.total = dict(resources)
+        self.available = dict(resources)
+
+    def fits(self, demand: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + self.EPS >= v
+                   for k, v in demand.items())
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + self.EPS >= v
+                   for k, v in demand.items())
+
+    def acquire(self, demand: Dict[str, float]) -> bool:
+        if not self.fits(demand):
+            return False
+        for k, v in demand.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+        return True
+
+    def release(self, demand: Dict[str, float]) -> None:
+        for k, v in demand.items():
+            self.available[k] = min(self.total.get(k, 0.0),
+                                    self.available.get(k, 0.0) + v)
+
+
+class WorkerHandle:
+    __slots__ = ("worker_id", "pid", "address", "conn", "proc", "state",
+                 "actor_id", "lease_id", "started_at",
+                 "_actor_resources", "_actor_bundle")
+
+    def __init__(self, worker_id: bytes, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.pid = proc.pid
+        self.proc = proc
+        self.address = ""
+        self.conn: Optional[protocol.Connection] = None
+        self.state = "starting"  # starting|idle|leased|actor|dead
+        self.actor_id: bytes = b""
+        self.lease_id: int = 0
+        self.started_at = time.monotonic()
+        self._actor_resources = None
+        self._actor_bundle = None
+
+
+class LeaseRequest:
+    __slots__ = ("resources", "bundle", "future", "scheduling_key")
+
+    def __init__(self, resources, bundle, future, scheduling_key):
+        self.resources = resources
+        self.bundle = bundle  # (pg_id, bundle_index) or None
+        self.future = future
+        self.scheduling_key = scheduling_key
+
+
+class NodeManager:
+    def __init__(self, node_id: NodeID, session_dir: str, config: Config,
+                 resources: Dict[str, float], object_store_name: str,
+                 gcs_address: str, node_address: str = ""):
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.config = config
+        self.resources = ResourceSet(resources)
+        self.object_store_name = object_store_name
+        self.gcs_address = gcs_address
+        self.node_address = node_address or os.path.join(
+            session_dir, "sockets", "node_manager")
+        self.server = protocol.Server()
+        self.server.add_routes(self)
+        self.server.on_disconnect = self._on_disconnect
+        self.gcs_conn: Optional[protocol.Connection] = None
+
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.idle_workers: List[WorkerHandle] = []
+        self._worker_registered: Dict[bytes, asyncio.Future] = {}
+        self._lease_queue: List[LeaseRequest] = []
+        self._lease_counter = 0
+        self._leases: Dict[int, Tuple[WorkerHandle, Dict[str, float],
+                                      Optional[Tuple[bytes, int]]]] = {}
+        # Core-worker (driver/worker) connections by worker id, for owner
+        # object requests (reference: raylet knows local workers' rpc addrs).
+        self.owner_conns: Dict[bytes, protocol.Connection] = {}
+        # Placement-group bundles: (pg_id, idx) -> ResourceSet carved out of
+        # node resources at prepare time.
+        self.bundles: Dict[Tuple[bytes, int], ResourceSet] = {}
+        self._bundle_committed: Dict[Tuple[bytes, int], bool] = {}
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        self._closing = False
+
+    # ---- lifecycle -------------------------------------------------------
+
+    async def start(self):
+        if self.node_address.startswith("/"):
+            await self.server.start_unix(self.node_address)
+        else:
+            host, port = self.node_address.rsplit(":", 1)
+            real = await self.server.start_tcp(host, int(port))
+            self.node_address = f"{host}:{real}"
+        if self.gcs_address.startswith("/"):
+            self.gcs_conn = await protocol.connect_unix(self.gcs_address)
+        else:
+            host, port = self.gcs_address.rsplit(":", 1)
+            self.gcs_conn = await protocol.connect_tcp(host, int(port))
+        self.gcs_conn.set_request_handler(self._handle_gcs_request)
+        await self.gcs_conn.call("node_register", {
+            "node_id": self.node_id.binary(),
+            "resources": self.resources.total,
+            "address": self.node_address,
+            "object_store": self.object_store_name,
+        })
+        self._heartbeat_task = asyncio.get_running_loop().create_task(
+            self._heartbeat_loop())
+
+    async def _heartbeat_loop(self):
+        while not self._closing:
+            await asyncio.sleep(self.config.heartbeat_interval_s)
+            try:
+                reply = await self.gcs_conn.call("node_heartbeat", {
+                    "node_id": self.node_id.binary(),
+                    "resources_available": self.resources.available,
+                }, timeout=5.0)
+                if reply.get("reregister"):
+                    # GCS lost us (marked dead / restarted): rejoin
+                    # (reference: raylet re-registration on GCS restart).
+                    await self.gcs_conn.call("node_register", {
+                        "node_id": self.node_id.binary(),
+                        "resources": self.resources.total,
+                        "address": self.node_address,
+                        "object_store": self.object_store_name,
+                    })
+            except Exception:  # noqa: BLE001 - GCS momentarily unreachable
+                if self._closing:
+                    return
+
+    async def close(self):
+        self._closing = True
+        if self._heartbeat_task:
+            self._heartbeat_task.cancel()
+        for w in list(self.workers.values()):
+            self._kill_worker_process(w)
+        if self.gcs_conn:
+            await self.gcs_conn.close()
+        await self.server.close()
+
+    def _kill_worker_process(self, w: WorkerHandle):
+        w.state = "dead"
+        try:
+            w.proc.send_signal(signal.SIGKILL)
+        except Exception:  # noqa: BLE001 - already gone
+            pass
+
+    # ---- GCS -> node requests -------------------------------------------
+
+    async def _handle_gcs_request(self, method: str, payload):
+        handler = getattr(self, "rpc_" + method, None)
+        if handler is None:
+            raise protocol.RpcError(f"unknown method {method!r}")
+        return await handler(self.gcs_conn, payload)
+
+    # ---- worker pool -----------------------------------------------------
+
+    async def _start_worker(self, actor_id: bytes = b"") -> WorkerHandle:
+        """Fork a worker process (reference: worker_pool.h:413
+        StartWorkerProcess). The worker connects back and registers."""
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["RAYTPU_NODE_ADDRESS"] = self.node_address
+        env["RAYTPU_GCS_ADDRESS"] = self.gcs_address
+        env["RAYTPU_SESSION_DIR"] = self.session_dir
+        env["RAYTPU_OBJECT_STORE"] = self.object_store_name
+        env["RAYTPU_WORKER_ID"] = worker_id.hex()
+        env["RAYTPU_NODE_ID"] = self.node_id.hex()
+        # Make ray_tpu importable in the worker no matter where it runs from.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:12]}.log"),
+                   "ab", buffering=0)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=False)
+        handle = WorkerHandle(worker_id.binary(), proc)
+        handle.actor_id = actor_id
+        self.workers[worker_id.binary()] = handle
+        fut = asyncio.get_running_loop().create_future()
+        self._worker_registered[worker_id.binary()] = fut
+        try:
+            await asyncio.wait_for(fut, self.config.worker_start_timeout_s)
+        except asyncio.TimeoutError:
+            self._kill_worker_process(handle)
+            raise RuntimeError("worker failed to start in time")
+        return handle
+
+    async def rpc_register_worker(self, conn, payload):
+        worker_id = payload["worker_id"]
+        handle = self.workers.get(worker_id)
+        if handle is None:
+            raise ValueError("unknown worker")
+        handle.conn = conn
+        handle.address = payload["address"]
+        handle.state = "idle"
+        conn._nm_worker_id = worker_id
+        self.owner_conns[worker_id] = conn
+        fut = self._worker_registered.pop(worker_id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(handle)
+        return {"node_id": self.node_id.binary()}
+
+    async def rpc_register_core_worker(self, conn, payload):
+        """Driver (or any non-pooled core worker) registers as an owner so
+        the node manager can route object requests back to it."""
+        self.owner_conns[payload["worker_id"]] = conn
+        conn._nm_owner_id = payload["worker_id"]
+        return {"node_id": self.node_id.binary(),
+                "object_store": self.object_store_name}
+
+    def _on_disconnect(self, conn):
+        worker_id = getattr(conn, "_nm_worker_id", None)
+        owner_id = getattr(conn, "_nm_owner_id", None)
+        if owner_id is not None:
+            self.owner_conns.pop(owner_id, None)
+        if worker_id is None:
+            return
+        self.owner_conns.pop(worker_id, None)
+        handle = self.workers.pop(worker_id, None)
+        if handle is None or self._closing:
+            return
+        prev_state = handle.state
+        handle.state = "dead"
+        if handle in self.idle_workers:
+            self.idle_workers.remove(handle)
+        try:
+            handle.proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+        if prev_state == "leased" and handle.lease_id in self._leases:
+            _, res, bundle = self._leases.pop(handle.lease_id)
+            self._release(res, bundle)
+            self._pump_leases()
+        if prev_state == "actor" and handle.actor_id:
+            res = getattr(handle, "_actor_resources", None)
+            if res:
+                self._release(res, getattr(handle, "_actor_bundle", None))
+                self._pump_leases()
+            asyncio.get_running_loop().create_task(self._report_actor_death(
+                handle.actor_id, f"worker process {handle.pid} died"))
+        logger.warning("worker %s died (state=%s)", WorkerID(worker_id), prev_state)
+
+    async def _report_actor_death(self, actor_id: bytes, cause: str):
+        try:
+            await self.gcs_conn.call("actor_report_death",
+                                     {"actor_id": actor_id, "cause": cause})
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ---- resource acquire/release across node + bundles ------------------
+
+    def _rset(self, bundle: Optional[Tuple[bytes, int]]) -> Optional[ResourceSet]:
+        if bundle is None:
+            return self.resources
+        return self.bundles.get(bundle)
+
+    def _acquire(self, resources, bundle) -> bool:
+        rset = self._rset(bundle)
+        if rset is None:
+            return False
+        return rset.acquire(resources)
+
+    def _release(self, resources, bundle):
+        rset = self._rset(bundle)
+        if rset is not None:
+            rset.release(resources)
+
+    # ---- lease protocol --------------------------------------------------
+
+    async def rpc_request_worker_lease(self, conn, payload):
+        """Grant a worker lease once resources are available (reference:
+        NodeManager::HandleRequestWorkerLease node_manager.cc:1842 ->
+        LocalTaskManager dispatch)."""
+        resources = payload.get("resources", {"CPU": 1.0})
+        bundle = None
+        if payload.get("pg_id"):
+            bundle = (payload["pg_id"], payload.get("bundle_index", 0))
+        fut = asyncio.get_running_loop().create_future()
+        req = LeaseRequest(resources, bundle, fut,
+                           payload.get("scheduling_key", b""))
+        rset = self._rset(bundle)
+        if rset is None:
+            raise ValueError("unknown placement group bundle")
+        if not rset.feasible(resources):
+            raise ValueError(
+                f"infeasible resource request {resources}; node has "
+                f"{rset.total}")
+        self._lease_queue.append(req)
+        self._pump_leases()
+        return await fut
+
+    def _pump_leases(self):
+        """Grant every queued lease that fits current availability."""
+        if self._closing:
+            return
+        remaining: List[LeaseRequest] = []
+        for req in self._lease_queue:
+            if req.future.cancelled():
+                continue
+            if self._acquire(req.resources, req.bundle):
+                asyncio.get_running_loop().create_task(self._grant(req))
+            else:
+                remaining.append(req)
+        self._lease_queue = remaining
+
+    async def _grant(self, req: LeaseRequest):
+        try:
+            if self.idle_workers:
+                handle = self.idle_workers.pop()
+            else:
+                handle = await self._start_worker()
+                if handle.state != "idle":
+                    raise RuntimeError("worker died during startup")
+            self._lease_counter += 1
+            lease_id = self._lease_counter
+            handle.state = "leased"
+            handle.lease_id = lease_id
+            self._leases[lease_id] = (handle, req.resources, req.bundle)
+            if not req.future.done():
+                req.future.set_result({
+                    "lease_id": lease_id,
+                    "worker_id": handle.worker_id,
+                    "address": handle.address,
+                })
+            else:  # caller gave up while we were starting the worker
+                self._return_lease(lease_id)
+        except Exception as e:  # noqa: BLE001 - propagate to requester
+            self._release(req.resources, req.bundle)
+            if not req.future.done():
+                req.future.set_exception(e)
+
+    def _return_lease(self, lease_id: int):
+        entry = self._leases.pop(lease_id, None)
+        if entry is None:
+            return
+        handle, resources, bundle = entry
+        self._release(resources, bundle)
+        if handle.state == "leased":
+            handle.state = "idle"
+            handle.lease_id = 0
+            self.idle_workers.append(handle)
+        self._pump_leases()
+
+    async def rpc_return_worker(self, conn, payload):
+        self._return_lease(payload["lease_id"])
+        return True
+
+    # ---- actors ----------------------------------------------------------
+
+    async def rpc_create_actor(self, conn, payload):
+        """GCS asks this node to create an actor: dedicated worker process,
+        resources held for the actor's lifetime."""
+        spec = payload["spec"]
+        resources = spec.get("resources", {})
+        bundle = None
+        if spec.get("placement_group_id"):
+            idx = spec.get("bundle_index", -1)
+            bundle = (spec["placement_group_id"], idx if idx >= 0 else 0)
+        deadline = time.monotonic() + self.config.worker_start_timeout_s
+        while not self._acquire(resources, bundle):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"timed out acquiring actor resources {resources}")
+            await asyncio.sleep(0.02)
+        try:
+            handle = await self._start_worker(actor_id=payload["actor_id"])
+            handle.state = "actor"
+            handle.actor_id = payload["actor_id"]
+            handle._actor_resources = resources
+            handle._actor_bundle = bundle
+            reply = await handle.conn.call("become_actor", {
+                "actor_id": payload["actor_id"], "spec": spec})
+            if not reply.get("ok", False):
+                self._kill_worker_process(handle)
+                raise RuntimeError(
+                    "actor constructor failed: " + reply.get("error", "?"))
+            return {"worker_id": handle.worker_id, "address": handle.address}
+        except Exception:
+            self._release(resources, bundle)
+            raise
+
+    async def rpc_kill_worker(self, conn, payload):
+        handle = self.workers.get(payload["worker_id"])
+        if handle is None:
+            return False
+        self._kill_worker_process(handle)
+        return True
+
+    # ---- placement group bundles (2PC) -----------------------------------
+
+    async def rpc_pg_prepare_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        resources = payload["resources"]
+        if key in self.bundles:
+            return True
+        if not self.resources.acquire(resources):
+            raise RuntimeError("insufficient resources for bundle")
+        self.bundles[key] = ResourceSet(resources)
+        self._bundle_committed[key] = False
+        return True
+
+    async def rpc_pg_commit_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        if key not in self.bundles:
+            raise RuntimeError("bundle not prepared")
+        self._bundle_committed[key] = True
+        return True
+
+    async def rpc_pg_return_bundle(self, conn, payload):
+        key = (payload["pg_id"], payload["bundle_index"])
+        rset = self.bundles.pop(key, None)
+        self._bundle_committed.pop(key, None)
+        if rset is not None:
+            self.resources.release(rset.total)
+            self._pump_leases()
+        return True
+
+    # ---- object plane ----------------------------------------------------
+
+    async def rpc_pull_object(self, conn, payload):
+        """Make an object available in the local shared-memory store.
+
+        Local-owner path: ask the owner core worker to write the value into
+        the store (owners keep small objects in their in-process memory
+        store; reference analog: plasma promotion of inlined objects).
+        Remote-node path (multi-node): fetch chunks from the remote node
+        manager (reference: ObjectManager push/pull, object_manager.h:117).
+        """
+        oid = payload["oid"]
+        owner = payload.get("owner", b"")
+        owner_conn = self.owner_conns.get(owner)
+        if owner_conn is not None and not owner_conn.closed:
+            reply = await owner_conn.call("promote_object", {"oid": oid})
+            return reply
+        remote_addr = payload.get("owner_node_address", "")
+        if remote_addr and remote_addr != self.node_address:
+            return await self._pull_remote(oid, remote_addr)
+        raise RuntimeError(
+            f"cannot resolve object owner for {oid.hex()[:16]}")
+
+    async def _pull_remote(self, oid: bytes, remote_addr: str):
+        """Cross-node transfer: stream the object from the remote node
+        manager into the local store (chunked; reference push_manager.h)."""
+        from ray_tpu._private.object_store import ObjectStoreClient
+        from ray_tpu._private.ids import ObjectID
+
+        if remote_addr.startswith("/"):
+            peer = await protocol.connect_unix(remote_addr)
+        else:
+            host, port = remote_addr.rsplit(":", 1)
+            peer = await protocol.connect_tcp(host, int(port))
+        try:
+            reply = await peer.call("read_object", {"oid": oid})
+            data = reply["data"]
+            store = ObjectStoreClient(self.object_store_name)
+            try:
+                store.put_bytes(ObjectID(oid), data)
+            finally:
+                store.close()
+            return {"in_store": True}
+        finally:
+            await peer.close()
+
+    async def rpc_read_object(self, conn, payload):
+        """Serve an object's raw bytes to a peer node manager."""
+        from ray_tpu._private.object_store import ObjectStoreClient
+        from ray_tpu._private.ids import ObjectID
+
+        oid = payload["oid"]
+        store = ObjectStoreClient(self.object_store_name)
+        try:
+            buf = store.get(ObjectID(oid), timeout_ms=5000)
+            if buf is None:
+                raise RuntimeError("object not in store")
+            with buf:
+                return {"data": bytes(buf.data) + bytes(buf.metadata)}
+        finally:
+            store.close()
+
+    # ---- introspection ---------------------------------------------------
+
+    async def rpc_node_stats(self, conn, payload):
+        return {
+            "node_id": self.node_id.binary(),
+            "resources_total": self.resources.total,
+            "resources_available": self.resources.available,
+            "num_workers": len(self.workers),
+            "num_idle": len(self.idle_workers),
+            "pending_leases": len(self._lease_queue),
+            "bundles": [
+                {"pg_id": k[0], "index": k[1], "resources": v.total,
+                 "committed": self._bundle_committed.get(k, False)}
+                for k, v in self.bundles.items()],
+        }

@@ -106,6 +106,29 @@ class Connection:
             if not fut.done():
                 fut.cancel()
 
+    async def call_send(self, method: str, payload: Any = None):
+        """Send a request and return an awaitable for the response.  Lets a
+        caller serialize the *send* (e.g. for ordered actor pushes) while
+        awaiting replies concurrently."""
+        if self._closed:
+            raise ConnectionLost("connection closed")
+        rid = next(self._rid)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+
+        async def waiter():
+            try:
+                return await fut
+            finally:
+                self._pending.pop(rid, None)
+
+        try:
+            await self._send([REQUEST, rid, method, payload])
+        except Exception:
+            self._pending.pop(rid, None)
+            raise
+        return waiter()
+
     async def push(self, method: str, payload: Any = None) -> None:
         if self._closed:
             return

@@ -1,0 +1,115 @@
+"""Object serialization: msgpack envelope + pickle5 out-of-band buffers.
+
+Role-equivalent of the reference's SerializationContext (reference
+``python/ray/_private/serialization.py:92``, ``:380 _serialize_to_pickle5``):
+a small fixed header describes the payload kind, then the cloudpickle stream,
+then the out-of-band buffers laid end to end so large numpy / jax host
+buffers are written into (and read from) shared memory without an extra
+copy through the pickle stream.
+
+Wire layout (both for shm objects and inline bytes):
+
+    [4B header_len][msgpack header][pickle bytes][buf0][buf1]...
+
+header = {k: kind, bl: [buffer lengths], pl: pickle length}
+kinds:  PY   ordinary python value
+        RAW  raw bytes payload (zero pickle overhead fast path)
+        ERR  pickled exception (RayTaskError) -- get() re-raises
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+import msgpack
+
+_LEN = struct.Struct("<I")
+
+KIND_PY = 0
+KIND_RAW = 1
+KIND_ERR = 2
+
+
+class SerializedObject:
+    """A serialization result that knows its total size before writing, so
+    the object-store allocation can be exact and buffers copied in place."""
+
+    __slots__ = ("kind", "pickled", "buffers", "header", "total_size")
+
+    def __init__(self, kind: int, pickled: bytes, buffers: List[pickle.PickleBuffer]):
+        self.kind = kind
+        self.pickled = pickled
+        self.buffers = [b.raw() for b in buffers]
+        self.header = msgpack.packb(
+            {"k": kind, "bl": [len(b) for b in self.buffers], "pl": len(pickled)}
+        )
+        self.total_size = (
+            _LEN.size + len(self.header) + len(pickled) + sum(len(b) for b in self.buffers)
+        )
+
+    def write_into(self, view: memoryview) -> None:
+        off = 0
+        view[off:off + _LEN.size] = _LEN.pack(len(self.header))
+        off += _LEN.size
+        view[off:off + len(self.header)] = self.header
+        off += len(self.header)
+        view[off:off + len(self.pickled)] = self.pickled
+        off += len(self.pickled)
+        for b in self.buffers:
+            view[off:off + len(b)] = b
+            off += len(b)
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_size)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any) -> SerializedObject:
+    if isinstance(value, bytes):
+        return SerializedObject(KIND_RAW, value, [])
+    buffers: List[pickle.PickleBuffer] = []
+    pickled = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return SerializedObject(KIND_PY, pickled, buffers)
+
+
+def serialize_error(exc: BaseException) -> SerializedObject:
+    from ray_tpu.exceptions import RayTaskError
+
+    try:
+        pickled = cloudpickle.dumps(exc, protocol=5)
+    except Exception:
+        # Unpicklable cause: keep the wrapper (message + remote traceback),
+        # drop only the cause object.
+        if isinstance(exc, RayTaskError):
+            fallback = RayTaskError(exc.cause_repr, exc.remote_traceback)
+        else:
+            fallback = RayTaskError(repr(exc), "")
+        pickled = cloudpickle.dumps(fallback, protocol=5)
+    return SerializedObject(KIND_ERR, pickled, [])
+
+
+def deserialize(data) -> Tuple[Any, bool]:
+    """Returns (value, is_error). ``data`` is bytes or a memoryview aliasing
+    shared memory; out-of-band buffers are reconstructed as zero-copy views
+    (numpy arrays built on them copy only if the consumer writes)."""
+    view = memoryview(data)
+    (hlen,) = _LEN.unpack(view[:_LEN.size])
+    off = _LEN.size
+    header = msgpack.unpackb(bytes(view[off:off + hlen]), raw=False)
+    off += hlen
+    kind = header["k"]
+    plen = header["pl"]
+    pickled = view[off:off + plen]
+    off += plen
+    if kind == KIND_RAW:
+        return bytes(pickled), False
+    buffers = []
+    for blen in header["bl"]:
+        buffers.append(pickle.PickleBuffer(view[off:off + blen]))
+        off += blen
+    value = pickle.loads(bytes(pickled), buffers=buffers)
+    return value, kind == KIND_ERR

@@ -1,0 +1,313 @@
+"""Worker process: executes pushed tasks and hosts actors.
+
+Role-equivalent of the reference's worker main loop + task receiver
+(reference ``python/ray/_private/workers/default_worker.py:231`` →
+``worker.py:755 main_loop`` → ``_raylet.pyx:1392 run_task_loop``; inbound
+execution path ``_raylet.pyx:1009 task_execution_handler`` → ``:672
+execute_task``).  Each worker runs an RPC server so submitters push tasks
+DIRECTLY (the reference's CoreWorkerService::PushTask); actor tasks are
+ordered per caller by sequence number (the reference's
+ActorSchedulingQueue, transport/actor_scheduling_queue.cc).
+
+The worker exits when its node-manager connection drops (reference analog:
+core_worker.cc:780 ExitIfParentRayletDies).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import protocol, serialization, worker_context
+from ray_tpu._private.client import CoreWorker, ObjectRefInfo
+from ray_tpu._private.config import Config
+from ray_tpu._private.ids import JobID, ObjectID, TaskID, WorkerID
+from ray_tpu import exceptions
+
+logger = logging.getLogger(__name__)
+
+
+class FunctionCache:
+    def __init__(self, cw: CoreWorker):
+        self.cw = cw
+        self._cache: Dict[bytes, Any] = {}
+        self._lock = threading.Lock()
+
+    def get(self, job_id: bytes, fid: bytes):
+        with self._lock:
+            fn = self._cache.get(fid)
+        if fn is None:
+            pickled = self.cw.fetch_function(job_id, fid)
+            fn = cloudpickle.loads(pickled)
+            with self._lock:
+                self._cache[fid] = fn
+        return fn
+
+
+class ActorState:
+    def __init__(self):
+        self.instance: Any = None
+        self.actor_id: bytes = b""
+        self.max_concurrency = 1
+        # Per-caller ordering (reference: per-caller sequence numbers in
+        # direct_actor_task_submitter).
+        self.next_seqno: Dict[bytes, int] = {}
+        self.buffered: Dict[bytes, Dict[int, tuple]] = {}
+
+
+class WorkerServer:
+    def __init__(self):
+        self.worker_id = WorkerID.from_hex(os.environ["RAYTPU_WORKER_ID"])
+        self.session_dir = os.environ["RAYTPU_SESSION_DIR"]
+        self.node_address = os.environ["RAYTPU_NODE_ADDRESS"]
+        self.gcs_address = os.environ["RAYTPU_GCS_ADDRESS"]
+        self.object_store = os.environ["RAYTPU_OBJECT_STORE"]
+        self.config = Config().apply_env()
+        self.server = protocol.Server()
+        self.server.add_routes(self)
+        self.address = os.path.join(self.session_dir, "sockets",
+                                    f"worker-{self.worker_id.hex()[:16]}")
+        self.cw: Optional[CoreWorker] = None
+        self.fns: Optional[FunctionCache] = None
+        self.exec_pool = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="task-exec")
+        self.actor = ActorState()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    async def run(self):
+        self._loop = asyncio.get_running_loop()
+        await self.server.start_unix(self.address)
+        # The CoreWorker runs its own io thread; sync facades work from the
+        # execution threads exactly as they do on the driver.
+        self.cw = CoreWorker(
+            gcs_address=self.gcs_address, node_address=self.node_address,
+            object_store_name=self.object_store,
+            job_id=JobID.nil(), worker_id=self.worker_id,
+            config=self.config, mode="worker")
+        self.fns = FunctionCache(self.cw)
+        worker_context.set_core_worker(self.cw, mode="worker")
+        # Register as a pooled worker; the node-manager connection doubles
+        # as the liveness channel.
+        # Route node-manager -> worker commands (become_actor, kill, ...)
+        # arriving over the registration connection into our handlers.
+        worker_loop = self._loop
+
+        async def from_nm(method, payload):
+            handler = getattr(self, "rpc_" + method, None)
+            if handler is not None:
+                # Hop onto the worker server loop (the nm connection lives
+                # on the CoreWorker io loop).
+                fut = asyncio.run_coroutine_threadsafe(
+                    handler(None, payload), worker_loop)
+                return await asyncio.wrap_future(fut)
+            if method == "promote_object":
+                return self.cw._promote_object(payload["oid"])
+            raise protocol.RpcError(f"unknown method {method!r}")
+
+        self.cw.nm.set_request_handler(from_nm)
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.cw.io.run(self.cw.nm.call(
+                "register_worker",
+                {"worker_id": self.worker_id.binary(),
+                 "address": self.address})))
+        self.cw.nm.on_close = lambda conn: os._exit(1)
+        await asyncio.Event().wait()  # serve forever
+
+    # ---- helpers ---------------------------------------------------------
+
+    def _resolve_arg(self, m: dict) -> Any:
+        if m["k"] == "v":
+            value, is_err = serialization.deserialize(m["d"])
+            if is_err:
+                raise value if isinstance(value, BaseException) else \
+                    exceptions.RayTaskError(repr(value), "")
+            return value
+        ref = ObjectRefInfo(m["oid"], m["owner"], m["addr"])
+        return self.cw.get([ref], timeout=60.0)[0]
+
+    def _execute(self, spec: dict, fn) -> list:
+        """Run user code; build the returns list for the RPC reply.
+        [HOT LOOP — analog of _raylet.pyx:672 execute_task]."""
+        task_id = spec["task_id"]
+        num_returns = spec["num_returns"]
+        return_oids = [ObjectID.for_return(TaskID(task_id), i + 1)
+                       for i in range(num_returns)]
+        # Thread-local so concurrent actor threads don't clobber each other.
+        worker_context.set_task_context(task_id, spec.get("actor_id", b""))
+        try:
+            args = [self._resolve_arg(a) for a in spec["args"]]
+            kwargs = {k: self._resolve_arg(v)
+                      for k, v in spec["kwargs"].items()}
+            result = fn(*args, **kwargs)
+            if num_returns == 0:
+                return []
+            values = (result,) if num_returns == 1 else tuple(result)
+            if num_returns > 1 and len(values) != num_returns:
+                raise ValueError(
+                    f"task declared num_returns={num_returns} but returned "
+                    f"{len(values)} values")
+            out = []
+            for oid, value in zip(return_oids, values):
+                ser = serialization.serialize(value)
+                if ser.total_size <= self.config.max_inline_object_size:
+                    out.append({"oid": oid.binary(), "d": ser.to_bytes()})
+                else:
+                    self.cw._put_shm(oid, ser)
+                    out.append({"oid": oid.binary(), "in_store": True})
+            return out
+        except Exception as e:  # noqa: BLE001 - user code raised
+            tb = traceback.format_exc()
+            err = e if _picklable(e) else None
+            wrapped = exceptions.RayTaskError(repr(e), tb, cause=err)
+            data = serialization.serialize_error(wrapped).to_bytes()
+            return [{"oid": oid.binary(), "d": data, "err": True}
+                    for oid in return_oids]
+        finally:
+            worker_context.set_task_context(b"", b"")
+
+    # ---- rpc: normal tasks ----------------------------------------------
+
+    async def rpc_push_task(self, conn, spec):
+        # Function fetch can hit the GCS; keep it off the server loop.
+        fn = await self._loop.run_in_executor(
+            None, self.fns.get, spec["job_id"], spec["fid"])
+        returns = await self._loop.run_in_executor(
+            self.exec_pool, self._execute, spec, fn)
+        return {"returns": returns}
+
+    # ---- rpc: actor lifecycle -------------------------------------------
+
+    async def rpc_become_actor(self, conn, payload):
+        spec = payload["spec"]
+        self.actor.actor_id = payload["actor_id"]
+        self.actor.max_concurrency = spec.get("max_concurrency", 1)
+        if self.actor.max_concurrency > 1:
+            self.exec_pool = ThreadPoolExecutor(
+                max_workers=self.actor.max_concurrency,
+                thread_name_prefix="actor-exec")
+        try:
+            def construct():
+                cls = self.fns.get(spec["job_id"], spec["fid"])
+                args = [self._resolve_arg(a) for a in spec["args"]]
+                kwargs = {k: self._resolve_arg(v)
+                          for k, v in spec["kwargs"].items()}
+                worker_context.set_task_context(b"", payload["actor_id"])
+                return cls(*args, **kwargs)
+
+            self.actor.instance = await self._loop.run_in_executor(
+                self.exec_pool, construct)
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 - ctor failed
+            return {"ok": False,
+                    "error": f"{type(e).__name__}: {e}\n"
+                             + traceback.format_exc()}
+
+    async def rpc_push_actor_task(self, conn, spec):
+        if self.actor.instance is None:
+            raise RuntimeError("not an actor worker")
+        caller = spec["caller"]
+        seqno = spec["seqno"]
+        if self.actor.max_concurrency == 1:
+            # In-order per caller: buffer out-of-order arrivals.  The first
+            # seqno seen from a caller is the baseline — after an actor
+            # restart the replacement worker accepts the caller's current
+            # counter instead of demanding 0 (reference analog: actor
+            # incarnation/seqno reset in direct_actor_task_submitter).
+            nxt = self.actor.next_seqno.setdefault(caller, seqno)
+            if seqno != nxt:
+                fut = self._loop.create_future()
+                self.actor.buffered.setdefault(caller, {})[seqno] = (spec, fut)
+                self._loop.call_later(10.0, self._adopt_seqno_gap, caller)
+                return await fut
+            return await self._run_actor_task(spec)
+        return await self._run_actor_task(spec)
+
+    def _adopt_seqno_gap(self, caller: bytes):
+        """A seqno was lost in flight (caller's connection broke after
+        send): if the head-of-line seqno never arrives, adopt the lowest
+        buffered one so the queue doesn't stall forever."""
+        buf = self.actor.buffered.get(caller, {})
+        if not buf:
+            return
+        lowest = min(buf)
+        if lowest <= self.actor.next_seqno.get(caller, 0):
+            return  # progress was made; buffered drain will pick it up
+        self.actor.next_seqno[caller] = lowest
+        spec, fut = buf.pop(lowest)
+
+        async def run(spec=spec, fut=fut):
+            try:
+                result = await self._run_actor_task(spec)
+                if not fut.done():
+                    fut.set_result(result)
+            except Exception as e:  # noqa: BLE001
+                if not fut.done():
+                    fut.set_exception(e)
+
+        self._loop.create_task(run())
+
+    async def _run_actor_task(self, spec):
+        caller = spec["caller"]
+        try:
+            method = getattr(self.actor.instance, spec["method"])
+            returns = await self._loop.run_in_executor(
+                self.exec_pool, self._execute, spec,
+                method)
+            return {"returns": returns}
+        finally:
+            if self.actor.max_concurrency == 1:
+                self.actor.next_seqno[caller] = spec["seqno"] + 1
+                buf = self.actor.buffered.get(caller, {})
+                nxt = buf.pop(spec["seqno"] + 1, None)
+                if nxt is not None:
+                    nspec, fut = nxt
+
+                    async def run_buffered(nspec=nspec, fut=fut):
+                        try:
+                            fut.set_result(await self._run_actor_task(nspec))
+                        except Exception as e:  # noqa: BLE001
+                            if not fut.done():
+                                fut.set_exception(e)
+
+                    self._loop.create_task(run_buffered())
+
+    async def rpc_exit(self, conn, payload):
+        self._loop.call_later(0.05, os._exit, 0)
+        return True
+
+    # ---- rpc: health ----------------------------------------------------
+
+    async def rpc_ping(self, conn, payload):
+        return {"worker_id": self.worker_id.binary(),
+                "actor_id": self.actor.actor_id}
+
+
+def _picklable(e) -> bool:
+    try:
+        cloudpickle.loads(cloudpickle.dumps(e))
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def main():
+    logging.basicConfig(
+        level=os.environ.get("RAYTPU_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    server = WorkerServer()
+    try:
+        asyncio.run(server.run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,184 @@
+"""Actor tests (reference analog: python/ray/tests/test_actor.py,
+test_actor_failures.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    assert ray_tpu.get(c.incr.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_ctor_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    # In-order execution: results are 1..20.
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_independent_state(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote()
+    ray_tpu.get(a.incr.remote())
+    assert ray_tpu.get(b.read.remote()) == 0
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor method failed")
+
+        def ok(self):
+            return "fine"
+
+    a = Bad.remote()
+    with pytest.raises(exceptions.RayTaskError, match="actor method failed"):
+        ray_tpu.get(a.boom.remote())
+    # Actor survives a method error.
+    assert ray_tpu.get(a.ok.remote()) == "fine"
+
+
+def test_actor_ctor_failure(ray_start_regular):
+    @ray_tpu.remote
+    class FailsInit:
+        def __init__(self):
+            raise ValueError("ctor boom")
+
+    with pytest.raises(exceptions.RayActorError):
+        FailsInit.remote()
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(7)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.read.remote()) == 7
+
+
+def test_named_actor_duplicate(ray_start_regular):
+    Counter.options(name="dup").remote()
+    with pytest.raises(Exception, match="already taken"):
+        Counter.options(name="dup").remote()
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.3)
+    with pytest.raises(exceptions.RayActorError):
+        ray_tpu.get(c.incr.remote())
+
+
+def test_actor_handle_passed_to_task(ray_start_regular):
+    @ray_tpu.remote
+    def bump(counter):
+        return ray_tpu.get(counter.incr.remote(10))
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class Fragile:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    a = Fragile.remote()
+    pid1 = ray_tpu.get(a.pid.remote())
+    a.die.remote()
+    time.sleep(1.0)
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=10)
+            break
+        except exceptions.RayTpuError:
+            time.sleep(0.5)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_no_restart_dies(ray_start_regular):
+    @ray_tpu.remote
+    class OneShot:
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    a = OneShot.remote()
+    a.die.remote()
+    time.sleep(1.0)
+    with pytest.raises(exceptions.RayActorError):
+        ray_tpu.get(a.ping.remote(), timeout=15)
+
+
+def test_actor_resources_block_until_available(ray_start_regular):
+    """Two 3-CPU actors cannot coexist on a 4-CPU node: second creation
+    must fail (GCS finds no feasible placement while first holds)."""
+
+    @ray_tpu.remote(num_cpus=3)
+    class Big:
+        def ping(self):
+            return 1
+
+    a = Big.remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 1.0
+
+
+def test_max_concurrency_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Slow:
+        def nap(self):
+            time.sleep(0.8)
+            return 1
+
+    a = Slow.remote()
+    start = time.monotonic()
+    assert sum(ray_tpu.get([a.nap.remote() for _ in range(4)])) == 4
+    assert time.monotonic() - start < 3.0
+
+
+def test_detached_named_actor_lookup(ray_start_regular):
+    Counter.options(name="det", lifetime="detached").remote()
+    h = ray_tpu.get_actor("det")
+    assert ray_tpu.get(h.read.remote()) == 0
