@@ -284,7 +284,10 @@ class CoreWorker:
             timeout: Optional[float] = None) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
         out: List[Any] = [None] * len(refs)
-        pulled: set[int] = set()
+        # Pull requests are re-issued periodically: the first attempt can
+        # race object production at the owner (owner replies "don't have
+        # it yet"), so one-shot pulling would hang forever.
+        pull_last: Dict[int, float] = {}
         # Objects whose owner promised "it's in the shared store" but the
         # store disagrees: if that persists, the object was evicted and
         # (for self-owned objects) cannot be recovered -> ObjectLostError.
@@ -292,12 +295,15 @@ class CoreWorker:
         pending = list(range(len(refs)))
         while pending:
             still: List[int] = []
+            now = time.monotonic()
             for i in pending:
                 ref = refs[i]
                 res = self._read_ready(ref.oid)
                 if res is None:
-                    if i not in pulled and ref.owner != self.worker_id.binary():
-                        pulled.add(i)
+                    if (ref.owner != self.worker_id.binary() and
+                            now - pull_last.get(i, -1e9) >
+                            self.config.pull_retry_interval_s):
+                        pull_last[i] = now
                         self.io.post(self._request_pull(ref))
                     entry = self.memory_store.get(ref.oid)
                     if (entry is not None and entry.in_store
@@ -343,14 +349,28 @@ class CoreWorker:
              timeout: Optional[float], fetch_local: bool = True
              ) -> Tuple[List[int], List[int]]:
         deadline = None if timeout is None else time.monotonic() + timeout
+        pull_last: Dict[int, float] = {}
         while True:
-            ready = [i for i, r in enumerate(refs) if self.is_ready(r)]
+            ready, not_yet = [], []
+            for i, r in enumerate(refs):
+                (ready if self.is_ready(r) else not_yet).append(i)
             if len(ready) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 ready = ready[:num_returns]
                 picked = set(ready)
                 not_ready = [i for i in range(len(refs)) if i not in picked]
                 return ready, not_ready
+            if fetch_local:
+                # Borrowed objects only become locally ready if someone
+                # pulls them; re-issue pulls like get() does.
+                now = time.monotonic()
+                for i in not_yet:
+                    ref = refs[i]
+                    if (ref.owner != self.worker_id.binary() and
+                            now - pull_last.get(i, -1e9) >
+                            self.config.pull_retry_interval_s):
+                        pull_last[i] = now
+                        self.io.post(self._request_pull(ref))
             time.sleep(self.config.get_poll_interval_s)
 
     def free(self, refs: Sequence["ObjectRefInfo"]):

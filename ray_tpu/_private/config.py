@@ -59,6 +59,9 @@ class Config:
     worker_start_timeout_s: float = 60.0
     #: Poll interval for blocking get() in the driver.
     get_poll_interval_s: float = 0.005
+    # How often get()/wait() re-issue a pull for a borrowed object (the
+    # first pull can race production at the owner).
+    pull_retry_interval_s: float = 0.25
 
     # --- logging / observability ---
     log_dir: str = ""

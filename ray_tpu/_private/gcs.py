@@ -201,6 +201,22 @@ class GcsServer:
     async def rpc_kv_exists(self, conn, payload):
         return payload["key"] in self.kv
 
+    async def rpc_kv_incr(self, conn, payload):
+        """Atomic counter (single-threaded event loop = atomicity).  Used
+        for collective-group rendezvous generations."""
+        key = payload["key"]
+        cur = int(self.kv.get(key, b"0"))
+        cur += int(payload.get("by", 1))
+        self.kv[key] = str(cur).encode()
+        return cur
+
+    async def rpc_kv_del_prefix(self, conn, payload):
+        prefix = payload["prefix"]
+        doomed = [k for k in self.kv if k.startswith(prefix)]
+        for k in doomed:
+            del self.kv[k]
+        return len(doomed)
+
     async def rpc_kv_keys(self, conn, payload):
         prefix = payload.get("prefix", "")
         return [k for k in self.kv if k.startswith(prefix)]

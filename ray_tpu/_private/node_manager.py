@@ -184,8 +184,13 @@ class NodeManager:
             await self.gcs_conn.close()
         await self.server.close()
 
-    def _kill_worker_process(self, w: WorkerHandle):
-        w.state = "dead"
+    def _kill_worker_process(self, w: WorkerHandle, mark_dead: bool = True):
+        """SIGKILL a worker.  ``mark_dead=True`` pre-marks the handle so
+        the disconnect handler skips resource release / death reporting
+        (callers that do their own cleanup); ``mark_dead=False`` lets
+        ``_on_disconnect`` run the full cleanup path."""
+        if mark_dead:
+            w.state = "dead"
         try:
             w.proc.send_signal(signal.SIGKILL)
         except Exception:  # noqa: BLE001 - already gone
@@ -436,7 +441,10 @@ class NodeManager:
         handle = self.workers.get(payload["worker_id"])
         if handle is None:
             return False
-        self._kill_worker_process(handle)
+        # mark_dead=False: the disconnect handler must release the
+        # worker's lease/actor resources and report actor death (which
+        # drives restart when the kill allows it).
+        self._kill_worker_process(handle, mark_dead=False)
         return True
 
     # ---- placement group bundles (2PC) -----------------------------------

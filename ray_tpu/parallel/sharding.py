@@ -1,0 +1,107 @@
+"""Logical-axis sharding rules.
+
+Models annotate parameters/activations with *logical* axis names
+("embed", "mlp", "heads", "batch", ...); a rule table maps logical axes
+to mesh axes.  Changing parallelism strategy = changing the table, not
+the model (the GSPMD recipe from the scaling-book; the reference has no
+analog — its only sharded-training path is the Torch FSDP wrapper,
+train/torch/train_loop_utils.py:72-114).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu.parallel.mesh import (AXIS_DATA, AXIS_EXPERT, AXIS_FSDP,
+                                   AXIS_SEQ, AXIS_TENSOR)
+
+# A rule maps a logical axis name to a mesh axis (or tuple of mesh axes,
+# or None = replicate).
+LogicalAxisRules = Sequence[Tuple[str, Union[str, Tuple[str, ...], None]]]
+
+# Default table: batch over (data, fsdp); weights ZeRO-sharded over fsdp
+# on their largest dim; Megatron TP over heads/mlp; sequence axis over
+# seq for ring attention.
+DEFAULT_RULES: LogicalAxisRules = (
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq", AXIS_SEQ),
+    ("embed", AXIS_FSDP),
+    ("mlp", AXIS_TENSOR),
+    ("heads", AXIS_TENSOR),
+    ("kv", None),
+    ("head_dim", None),
+    ("vocab", AXIS_TENSOR),
+    ("expert", AXIS_EXPERT),
+    ("stage", None),
+    ("norm", None),
+)
+
+
+def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
+                         rules: LogicalAxisRules = DEFAULT_RULES):
+    """Map a tuple of logical axis names to a PartitionSpec, dropping
+    mesh axes that are already taken by an earlier dimension (a mesh
+    axis may shard at most one dim of one array)."""
+    from jax.sharding import PartitionSpec
+
+    table = dict(rules)
+    used: set = set()
+    out: List[Union[str, Tuple[str, ...], None]] = []
+    for name in logical_axes:
+        mesh_axes = table.get(name) if name else None
+        if mesh_axes is None:
+            out.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        avail = tuple(a for a in mesh_axes if a not in used)
+        used.update(avail)
+        out.append(avail if len(avail) > 1 else (avail[0] if avail else None))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def shard_params(params, logical_axes, mesh, rules: LogicalAxisRules =
+                 DEFAULT_RULES):
+    """Device-put a param pytree according to its logical-axes pytree
+    (matching structure, leaves = tuples of logical names)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def place(p, axes):
+        spec = logical_to_mesh_axes(axes, rules)
+        return jax.device_put(p, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params, logical_axes,
+                        is_leaf=lambda x: x is None)
+
+
+def param_shardings(logical_axes, mesh, rules: LogicalAxisRules =
+                    DEFAULT_RULES):
+    """NamedSharding pytree for use as jit in_shardings/out_shardings."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_mesh_axes(axes, rules)),
+        logical_axes, is_leaf=lambda x: isinstance(x, tuple) or x is None)
+
+
+def with_logical_constraint(x, logical_axes, rules: LogicalAxisRules =
+                            DEFAULT_RULES, mesh=None):
+    """Constrain an intermediate activation's sharding inside jit.
+    No-op outside a mesh context."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    if mesh is None:
+        try:
+            from jax._src.mesh import thread_resources
+            mesh = thread_resources.env.physical_mesh
+            if mesh.empty:
+                return x
+        except Exception:
+            return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

@@ -9,11 +9,20 @@ test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment points JAX at a real TPU
+# (JAX_PLATFORMS=axon); bench.py is what runs on the chip, not pytest.
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
+
+# A site hook may have force-registered a TPU backend and overridden
+# jax_platforms at interpreter start; jax.config wins over the env var,
+# so set it through jax.config too.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
