@@ -1,0 +1,115 @@
+"""North-star benchmark: GPT-2-124M training throughput on TPU.
+
+Measures full training steps (forward + backward + AdamW) of the GPT-2
+flagship (ray_tpu/models/gpt2.py, pallas flash attention) on the local
+chip(s) and prints ONE JSON line.
+
+Baseline: the reference publishes no absolute GPT-2 tokens/s (SURVEY.md
+§6; BASELINE.json "published": {}).  Its GPU north-star anchor (BASELINE
+"GPU-parity throughput") is encoded as 40% MFU — a strong torch/DDP GPU
+baseline for a 124M model — against this chip's peak bf16 FLOPs, so
+vs_baseline = achieved_MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+
+BASELINE_MFU = 0.40
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {  # dense bf16 peak, per chip
+        "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+        "v5p": 459e12, "v4": 275e12, "v6 lite": 918e12, "v6e": 918e12,
+        "cpu": 1e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import (gpt2_config, gpt2_init, gpt2_logical_axes,
+                                gpt2_loss)
+    from ray_tpu.models.gpt2 import gpt2_param_count
+    from ray_tpu.parallel import MeshSpec, make_mesh
+    from ray_tpu.parallel.sharding import param_shardings, shard_params
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    seq = 1024
+    batch = 16 * max(1, n_chips) if on_tpu else 2
+    cfg = gpt2_config("gpt2", max_seq=seq, use_flash=None if on_tpu
+                      else False)  # None = measured-crossover dispatch
+    if not on_tpu:  # CPU smoke fallback so bench.py always emits a line
+        cfg = gpt2_config("tiny", use_flash=False)
+        seq = cfg.max_seq
+
+    mesh = make_mesh(MeshSpec(data=-1))
+    axes = gpt2_logical_axes(cfg)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+
+    with jax.set_mesh(mesh):
+        params = shard_params(params, axes, mesh)
+        opt_state = tx.init(params)
+        p_shard = param_shardings(axes, mesh)
+
+        @functools.partial(jax.jit, in_shardings=(p_shard, None, None))
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt2_loss(p, batch, cfg))(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                    (batch, seq + 1), 0, cfg.vocab_size)
+        data = {"tokens": tokens}
+
+        # warmup (compile) + steady-state timing.  The fence is a host
+        # transfer (float(loss)) — the final loss depends on every prior
+        # step's params, so fetching it waits for the whole chain even on
+        # backends whose block_until_ready returns early.
+        params, opt_state, loss = train_step(params, opt_state, data)
+        float(loss)
+        n_steps = 20 if on_tpu else 2
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, loss = train_step(params, opt_state, data)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tok_s = tokens_per_step * n_steps / dt
+    tok_s_chip = tok_s / max(1, n_chips)
+    n_params = gpt2_param_count(cfg)
+    model_flops = 6 * n_params * tok_s_chip  # fwd+bwd FLOPs per token
+    mfu = model_flops / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
+                  if on_tpu else "gpt2_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "detail": {"chips": n_chips, "batch": batch, "seq": seq,
+                   "mfu": round(mfu, 4),
+                   "loss": round(final_loss, 3),
+                   "backend": jax.default_backend()},
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

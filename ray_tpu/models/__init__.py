@@ -1,0 +1,24 @@
+"""Model zoo: TPU-first reference models driven by ray_tpu.train.
+
+Pure-functional JAX (init/apply pairs over pytrees), layers stacked for
+`lax.scan`, parameters annotated with logical sharding axes
+(ray_tpu.parallel.sharding) so one model definition serves DP, FSDP, TP,
+and sequence parallelism by swapping the rule table.
+"""
+
+from ray_tpu.models.gpt2 import (GPT2Config, gpt2_config, gpt2_forward,
+                                 gpt2_init, gpt2_logical_axes, gpt2_loss,
+                                 gpt2_param_count)
+from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
+                                mlp_logical_axes, mlp_loss)
+from ray_tpu.models.resnet import (ResNetConfig, resnet_config,
+                                   resnet_forward, resnet_init,
+                                   resnet_logical_axes, resnet_loss)
+
+__all__ = [
+    "GPT2Config", "gpt2_config", "gpt2_init", "gpt2_forward", "gpt2_loss",
+    "gpt2_logical_axes", "gpt2_param_count",
+    "MLPConfig", "mlp_init", "mlp_forward", "mlp_loss", "mlp_logical_axes",
+    "ResNetConfig", "resnet_config", "resnet_init", "resnet_forward",
+    "resnet_loss", "resnet_logical_axes",
+]

@@ -1,0 +1,248 @@
+"""GPT-2 family, TPU-first.
+
+The flagship model for the north-star benchmark (BASELINE.json: "GPT-2-125M
+language modeling, pjit FSDP across pod").  The reference has no model zoo
+of its own — Ray Train wraps user torch modules (reference
+python/ray/train/torch/train_loop_utils.py:28 prepare_model); here the
+framework ships the model because the TPU path *is* the framework's value.
+
+Design choices (all TPU-motivated, none ported):
+  * pure functional init/apply over a param pytree — jit/grad/shard friendly;
+  * layers stacked on a leading axis and iterated with `lax.scan` — one
+    layer gets traced/compiled once regardless of depth;
+  * every param dim carries a logical axis name; DP/FSDP/TP/SP are rule
+    tables (ray_tpu/parallel/sharding.py), not model edits;
+  * compute in bfloat16 on the MXU, params + optimizer state in float32;
+  * per-layer `jax.checkpoint` (remat) so activation memory is O(sqrt)
+    and HBM goes to batch instead;
+  * attention dispatches to the pallas flash kernel on TPU
+    (ray_tpu/ops/flash_attention.py), plain XLA softmax elsewhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.sharding import DEFAULT_RULES, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-native)
+    param_dtype: Any = jnp.float32   # master weights
+    remat: bool = True
+    use_flash: Optional[bool] = None  # None = auto (flash on TPU)
+    # pad vocab to a multiple of 128 so the logits matmul tiles the MXU
+    # cleanly and the vocab dim shards evenly under tensor parallelism
+    vocab_pad_to: int = 128
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def padded_vocab(self) -> int:
+        p = self.vocab_pad_to
+        return (self.vocab_size + p - 1) // p * p
+
+
+_PRESETS = {
+    # name: (n_layer, n_head, d_model)
+    "nano": (2, 2, 64),          # test-sized
+    "tiny": (4, 4, 128),
+    "gpt2": (12, 12, 768),       # 124M — the north-star config
+    "gpt2-medium": (24, 16, 1024),
+    "gpt2-large": (36, 20, 1280),
+    "gpt2-xl": (48, 25, 1600),
+}
+
+
+def gpt2_config(name: str = "gpt2", **overrides) -> GPT2Config:
+    n_layer, n_head, d_model = _PRESETS[name]
+    kw: Dict[str, Any] = dict(n_layer=n_layer, n_head=n_head,
+                              d_model=d_model, d_ff=4 * d_model)
+    if name in ("nano", "tiny"):
+        kw.update(vocab_size=512, max_seq=128)
+    kw.update(overrides)
+    return GPT2Config(**kw)
+
+
+def gpt2_param_count(cfg: GPT2Config) -> int:
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layer
+    per_layer = (4 * d * d + 4 * d) + (2 * d * f + d + f) + 4 * d  # attn+mlp+2ln
+    return cfg.vocab_size * d + cfg.max_seq * d + L * per_layer + 2 * d
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+def gpt2_logical_axes(cfg: GPT2Config) -> Dict[str, Any]:
+    """Pytree (matching gpt2_init's) of logical-axis tuples.
+
+    Leading `None` on block leaves is the stacked-layer axis.  "embed" maps
+    to fsdp (ZeRO-3), "heads"/"mlp"/"vocab" to tensor — see
+    parallel/sharding.py DEFAULT_RULES.
+    """
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+        "blocks": {
+            "ln1": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "ln2": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "attn": {
+                "qkv_w": (None, "embed", None, "heads", "head_dim"),
+                "qkv_b": (None, None, "heads", "head_dim"),
+                "o_w": (None, "heads", "head_dim", "embed"),
+                "o_b": (None, "embed"),
+            },
+            "mlp": {
+                "fc_w": (None, "embed", "mlp"),
+                "fc_b": (None, "mlp"),
+                "proj_w": (None, "mlp", "embed"),
+                "proj_b": (None, "embed"),
+            },
+        },
+    }
+
+
+def gpt2_init(key, cfg: GPT2Config) -> Dict[str, Any]:
+    """Initialize parameters (GPT-2 style: N(0, 0.02), residual projections
+    scaled by 1/sqrt(2*n_layer))."""
+    L, d, f, h, hd = (cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.n_head,
+                      cfg.head_dim)
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(key, 8))
+    std = 0.02
+    res_std = std / math.sqrt(2 * L)
+
+    def norm(kk, shape, s=std):
+        return (jax.random.normal(kk, shape, dtype=jnp.float32) * s).astype(pd)
+
+    return {
+        "wte": norm(next(k), (cfg.padded_vocab, d)),
+        "wpe": norm(next(k), (cfg.max_seq, d), s=0.01),
+        "ln_f": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+        "blocks": {
+            "ln1": {"scale": jnp.ones((L, d), pd),
+                    "bias": jnp.zeros((L, d), pd)},
+            "ln2": {"scale": jnp.ones((L, d), pd),
+                    "bias": jnp.zeros((L, d), pd)},
+            "attn": {
+                "qkv_w": norm(next(k), (L, d, 3, h, hd)),
+                "qkv_b": jnp.zeros((L, 3, h, hd), pd),
+                "o_w": norm(next(k), (L, h, hd, d), s=res_std),
+                "o_b": jnp.zeros((L, d), pd),
+            },
+            "mlp": {
+                "fc_w": norm(next(k), (L, d, f)),
+                "fc_b": jnp.zeros((L, f), pd),
+                "proj_w": norm(next(k), (L, f, d), s=res_std),
+                "proj_b": jnp.zeros((L, d), pd),
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    # LN in float32 for stability, cast back to compute dtype.
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _attention(x, p, cfg: GPT2Config, rules):
+    B, T, d = x.shape
+    qkv = jnp.einsum("btd,dchk->btchk", x, p["qkv_w"].astype(cfg.dtype))
+    qkv = qkv + p["qkv_b"].astype(cfg.dtype)
+    q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,T,H,hd)
+    q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"),
+                                rules)
+    from ray_tpu.ops.attention import causal_attention
+    o = causal_attention(q, kk, v, use_flash=cfg.use_flash)
+    out = jnp.einsum("bthk,hkd->btd", o, p["o_w"].astype(cfg.dtype))
+    return out + p["o_b"].astype(cfg.dtype)
+
+
+def _mlp(x, p, cfg: GPT2Config, rules):
+    h = jnp.einsum("btd,df->btf", x, p["fc_w"].astype(cfg.dtype))
+    h = jax.nn.gelu(h + p["fc_b"].astype(cfg.dtype))
+    h = with_logical_constraint(h, ("batch", "seq", "mlp"), rules)
+    out = jnp.einsum("btf,fd->btd", h, p["proj_w"].astype(cfg.dtype))
+    return out + p["proj_b"].astype(cfg.dtype)
+
+
+def _block(x, layer_params, cfg: GPT2Config, rules):
+    p = layer_params
+    x = x + _attention(
+        _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"]), p["attn"], cfg,
+        rules)
+    x = x + _mlp(_layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"]),
+                 p["mlp"], cfg, rules)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+    return x
+
+
+def gpt2_forward(params, tokens, cfg: GPT2Config,
+                 rules=DEFAULT_RULES) -> jnp.ndarray:
+    """tokens (B, T) int32 → logits (B, T, padded_vocab) float32."""
+    B, T = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    x = x + params["wpe"].astype(cfg.dtype)[:T]
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+
+    block = partial(_block, cfg=cfg, rules=rules)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    # tied embeddings; logits in float32 for a stable softmax/loss
+    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
+                        params["wte"].astype(jnp.float32))
+    return with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+
+
+def gpt2_loss(params, batch, cfg: GPT2Config,
+              rules=DEFAULT_RULES) -> jnp.ndarray:
+    """Next-token cross-entropy.  batch = {"tokens": (B, T+1) int32} or
+    {"inputs": (B,T), "targets": (B,T)}; padded-vocab tail masked out."""
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = gpt2_forward(params, inputs, cfg, rules)
+    if cfg.padded_vocab != cfg.vocab_size:
+        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e9,
+                       dtype=logits.dtype)
+        logits = logits.at[..., cfg.vocab_size:].set(neg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

@@ -1,0 +1,51 @@
+"""MLP classifier — the fashion-MNIST baseline config (BASELINE.json:
+"DataParallelTrainer: fashion-MNIST MLP (2 CPU workers)") and the smoke
+model for trainer tests."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (128, 128)
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def mlp_logical_axes(cfg: MLPConfig) -> Dict[str, Any]:
+    n = len(cfg.hidden) + 1
+    return {"layers": [{"w": ("embed", "mlp"), "b": ("mlp",)}
+                       for _ in range(n)]}
+
+
+def mlp_init(key, cfg: MLPConfig) -> Dict[str, Any]:
+    dims = [cfg.in_dim, *cfg.hidden, cfg.n_classes]
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (d_in, d_out), cfg.dtype)
+        layers.append({"w": w * jnp.sqrt(2.0 / d_in),
+                       "b": jnp.zeros((d_out,), cfg.dtype)})
+    return {"layers": layers}
+
+
+def mlp_forward(params, x, cfg: MLPConfig) -> jnp.ndarray:
+    x = x.reshape(x.shape[0], -1).astype(cfg.dtype)
+    layers = params["layers"]
+    for layer in layers[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ layers[-1]["w"] + layers[-1]["b"]
+
+
+def mlp_loss(params, batch, cfg: MLPConfig) -> jnp.ndarray:
+    logits = mlp_forward(params, batch["x"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    labels = batch["y"]
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

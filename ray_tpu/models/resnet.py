@@ -1,0 +1,226 @@
+"""ResNet (v1.5, post-activation) — the image-training baseline config
+(BASELINE.json: "JaxTrainer: ResNet-50 ImageNet data-parallel").
+
+TPU-first choices: NHWC layout (the TPU-native conv layout), bfloat16
+compute with float32 batch-norm statistics, channels padded-friendly
+widths (all multiples of 64), functional batch-norm carrying running
+stats in a separate `state` pytree so the train step stays pure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_STAGES = {
+    # name: (block sizes, bottleneck?)
+    "resnet18": ((2, 2, 2, 2), False),
+    "resnet34": ((3, 4, 6, 3), False),
+    "resnet50": ((3, 4, 6, 3), True),
+    "resnet101": ((3, 4, 23, 3), True),
+    "tiny": ((1, 1), False),  # test-sized: 2 stages
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    blocks: Sequence[int] = (3, 4, 6, 3)
+    bottleneck: bool = True
+    n_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    bn_momentum: float = 0.9
+
+
+def resnet_config(name: str = "resnet50", **overrides) -> ResNetConfig:
+    blocks, bottleneck = _STAGES[name]
+    kw: Dict[str, Any] = dict(blocks=blocks, bottleneck=bottleneck)
+    if name == "tiny":
+        kw.update(width=32, n_classes=10)
+    kw.update(overrides)
+    return ResNetConfig(**kw)
+
+
+def _conv_init(key, kh, kw_, cin, cout, dtype):
+    fan_in = kh * kw_ * cin
+    w = jax.random.normal(key, (kh, kw_, cin, cout), jnp.float32)
+    return (w * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def _block_channels(cfg: ResNetConfig) -> List[Tuple[int, int, int]]:
+    """(cin, cmid, cout) per residual block, flattened over stages."""
+    out: List[Tuple[int, int, int]] = []
+    expansion = 4 if cfg.bottleneck else 1
+    cin = cfg.width
+    for stage, n in enumerate(cfg.blocks):
+        cmid = cfg.width * (2 ** stage)
+        cout = cmid * expansion
+        for _ in range(n):
+            out.append((cin, cmid, cout))
+            cin = cout
+    return out
+
+
+def resnet_init(key, cfg: ResNetConfig):
+    """Returns (params, state): state holds BN running stats."""
+    keys = iter(jax.random.split(key, 4 + 4 * sum(cfg.blocks)))
+    pd = cfg.param_dtype
+    params: Dict[str, Any] = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width, pd),
+                 "bn": _bn_init(cfg.width, pd)},
+        "blocks": [],
+        "head": {},
+    }
+    state: Dict[str, Any] = {"stem": _bn_state(cfg.width), "blocks": []}
+    for i, (cin, cmid, cout) in enumerate(_block_channels(cfg)):
+        if cfg.bottleneck:
+            convs = [_conv_init(next(keys), 1, 1, cin, cmid, pd),
+                     _conv_init(next(keys), 3, 3, cmid, cmid, pd),
+                     _conv_init(next(keys), 1, 1, cmid, cout, pd)]
+            bns = [_bn_init(cmid, pd), _bn_init(cmid, pd),
+                   _bn_init(cout, pd)]
+            sts = [_bn_state(cmid), _bn_state(cmid), _bn_state(cout)]
+        else:
+            convs = [_conv_init(next(keys), 3, 3, cin, cmid, pd),
+                     _conv_init(next(keys), 3, 3, cmid, cout, pd)]
+            bns = [_bn_init(cmid, pd), _bn_init(cout, pd)]
+            sts = [_bn_state(cmid), _bn_state(cout)]
+        blk = {"convs": convs, "bns": bns}
+        st = {"bns": sts}
+        if cin != cout:
+            blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, pd)
+            blk["proj_bn"] = _bn_init(cout, pd)
+            st["proj_bn"] = _bn_state(cout)
+        params["blocks"].append(blk)
+        state["blocks"].append(st)
+    chead = _block_channels(cfg)[-1][2]
+    kh = next(keys)
+    params["head"] = {
+        "w": (jax.random.normal(kh, (chead, cfg.n_classes), jnp.float32)
+              * 0.01).astype(pd),
+        "b": jnp.zeros((cfg.n_classes,), pd),
+    }
+    return params, state
+
+
+def resnet_logical_axes(cfg: ResNetConfig):
+    """Conv kernels shard cout over tensor, cin over fsdp (HWIO layout)."""
+    conv_ax = (None, None, "embed", "mlp")
+    bn_ax = {"scale": ("norm",), "bias": ("norm",)}
+    axes: Dict[str, Any] = {
+        "stem": {"conv": conv_ax, "bn": bn_ax},
+        "blocks": [],
+        "head": {"w": ("embed", "vocab"), "b": ("vocab",)},
+    }
+    for blk_ch, blk in zip(_block_channels(cfg), _params_blocks(cfg)):
+        b: Dict[str, Any] = {"convs": [conv_ax] * blk["n"],
+                             "bns": [bn_ax] * blk["n"]}
+        if blk["proj"]:
+            b["proj"] = conv_ax
+            b["proj_bn"] = bn_ax
+        axes["blocks"].append(b)
+    return axes
+
+
+def _params_blocks(cfg: ResNetConfig):
+    n = 3 if cfg.bottleneck else 2
+    out = []
+    for (cin, _, cout) in _block_channels(cfg):
+        out.append({"n": n, "proj": cin != cout})
+    return out
+
+
+def _batchnorm(x, p, st, *, training: bool, momentum: float):
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_st = {"mean": momentum * st["mean"] + (1 - momentum) * mean,
+                  "var": momentum * st["var"] + (1 - momentum) * var}
+    else:
+        mean, var = st["mean"], st["var"]
+        new_st = st
+    y = (xf - mean) * lax.rsqrt(var + 1e-5)
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_st
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def resnet_forward(params, state, images, cfg: ResNetConfig, *,
+                   training: bool = True):
+    """images (B, H, W, 3) → (logits (B, n_classes), new_state)."""
+    x = images.astype(cfg.dtype)
+    mom = cfg.bn_momentum
+    new_state: Dict[str, Any] = {"blocks": []}
+
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, new_state["stem"] = _batchnorm(x, params["stem"]["bn"],
+                                      state["stem"], training=training,
+                                      momentum=mom)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+
+    chans = _block_channels(cfg)
+    stage_starts = set()
+    acc = 0
+    for n in cfg.blocks:
+        stage_starts.add(acc)
+        acc += n
+
+    for i, (blk, st, (cin, cmid, cout)) in enumerate(
+            zip(params["blocks"], state["blocks"], chans)):
+        stride = 2 if (i in stage_starts and i != 0) else 1
+        shortcut = x
+        new_blk: Dict[str, Any] = {"bns": []}
+        strides = ([1, stride, 1] if cfg.bottleneck else [stride, 1])
+        h = x
+        for j, (w, bn, bst, s) in enumerate(
+                zip(blk["convs"], blk["bns"], st["bns"], strides)):
+            h = _conv(h, w, stride=s)
+            h, nst = _batchnorm(h, bn, bst, training=training, momentum=mom)
+            new_blk["bns"].append(nst)
+            if j < len(blk["convs"]) - 1:
+                h = jax.nn.relu(h)
+        if "proj" in blk:
+            shortcut = _conv(shortcut, blk["proj"], stride=stride)
+            shortcut, nst = _batchnorm(shortcut, blk["proj_bn"],
+                                       st["proj_bn"], training=training,
+                                       momentum=mom)
+            new_blk["proj_bn"] = nst
+        x = jax.nn.relu(h + shortcut)
+        new_state["blocks"].append(new_blk)
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+    logits = x @ params["head"]["w"].astype(jnp.float32) + \
+        params["head"]["b"].astype(jnp.float32)
+    return logits, new_state
+
+
+def resnet_loss(params, state, batch, cfg: ResNetConfig, *,
+                training: bool = True):
+    logits, new_state = resnet_forward(params, state, batch["x"], cfg,
+                                       training=training)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=-1)
+    return jnp.mean(nll), new_state

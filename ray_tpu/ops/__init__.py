@@ -1,0 +1,6 @@
+"""TPU kernel library: pallas kernels for the hot ops plus XLA reference
+implementations used on CPU and as numerics oracles in tests."""
+
+from ray_tpu.ops.attention import causal_attention, reference_attention
+
+__all__ = ["causal_attention", "reference_attention"]

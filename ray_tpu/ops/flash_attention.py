@@ -1,0 +1,319 @@
+"""Flash attention for TPU, written in pallas.
+
+Blockwise online-softmax attention (the FlashAttention recurrence): the
+T×T score matrix never materializes in HBM, and VMEM holds only one
+(block_q, block_k) tile of work at a time.  The kv loop is a grid
+dimension — pallas double-buffers the k/v block DMAs against compute —
+and the online-softmax state (m, l, acc) lives in VMEM scratch that
+persists across the sequentially-executed kv grid steps.  Both matmuls
+hit the MXU with float32 accumulation.  Causal masking skips
+fully-masked tiles (`pl.when`), so the causal kernel does ~half the
+FLOPs.
+
+Backward is the standard recompute scheme: forward saves only O(T) row
+statistics (logsumexp); two kernels recompute score tiles on the fly —
+one accumulates dq over kv blocks, one accumulates dk/dv over q blocks —
+so backward memory is O(T) as well.
+
+No analog in the reference framework (it defers attention to torch); the
+algorithm is from the public FlashAttention/blockwise-attention literature
+(see PAPERS.md), implemented fresh against the pallas TPU API
+(/opt/skills/guides/pallas_guide.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _blocks(T: int, want: int) -> int:
+    b = min(want, T)
+    while T % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _causal_tile_visible(qi, ki, block_q: int, block_k: int):
+    """True unless the (qi, ki) tile is entirely above the diagonal."""
+    return qi * block_q + block_q - 1 >= ki * block_k
+
+
+def _tile_mask(qi, ki, block_q: int, block_k: int):
+    rows = qi * block_q + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+    cols = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+    return rows >= cols
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, block_q: int, block_k: int, causal: bool,
+                num_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    visible = _causal_tile_visible(qi, ki, block_q, block_k) \
+        if causal else True
+
+    @pl.when(visible)
+    def _tile():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_tile_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(ki == num_kv - 1)
+    def _flush():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[:] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, :] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _fwd(q3, k3, v3, *, scale, block_q, block_k, causal, interpret):
+    """q3/k3/v3: (BH, T, D) → o (BH, T, D), lse (BH, 1, T) float32."""
+    BH, T, D = q3.shape
+    bq = _blocks(T, block_q)
+    bk = _blocks(T, block_k)
+    nq, nk = T // bq, T // bk
+    kern = functools.partial(_fwd_kernel, scale=scale, block_q=bq,
+                             block_k=bk, causal=causal, num_kv=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
+        ],
+        scratch_shapes=[_vmem((bq, 1)), _vmem((bq, 1)), _vmem((bq, D))],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale: float, block_q: int, block_k: int,
+                   causal: bool, num_kv: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visible = _causal_tile_visible(qi, ki, block_q, block_k) \
+        if causal else True
+
+    @pl.when(visible)
+    def _tile():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[0, :][:, None]
+        delta = delta_ref[0, :][:, None]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_tile_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kv - 1)
+    def _flush():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    block_q: int, block_k: int, causal: bool, num_q: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    visible = _causal_tile_visible(qi, ki, block_q, block_k) \
+        if causal else True
+
+    @pl.when(visible)
+    def _tile():
+        q = q_ref[:]
+        k = k_ref[:]
+        v = v_ref[:]
+        do = do_ref[:]
+        lse = lse_ref[0, :][:, None]
+        delta = delta_ref[0, :][:, None]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = jnp.where(_tile_mask(qi, ki, block_q, block_k), s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dv_scr[:] = dv_scr[:] + lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale              # (bq, bk)
+        dk_scr[:] = dk_scr[:] + lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _flush():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(res, do3, *, scale, block_q, block_k, causal, interpret):
+    q3, k3, v3, o3, lse = res
+    BH, T, D = q3.shape
+    bq = _blocks(T, block_q)
+    bk = _blocks(T, block_k)
+    nq, nk = T // bq, T // bk
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]  # (BH, 1, T)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=bq,
+                          block_k=bk, causal=causal, num_kv=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi, ki: (bh, 0, qi)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi, ki: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda bh, qi, ki:
+                               (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+        scratch_shapes=[_vmem((bq, D))],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=bq,
+                          block_k=bk, causal=causal, num_q=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
+            pl.BlockSpec((None, 1, bq), lambda bh, ki, qi: (bh, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v3.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, D)), _vmem((bk, D))],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, scale, block_q, block_k, causal, interpret):
+    o, _ = _fwd(q3, k3, v3, scale=scale, block_q=block_q, block_k=block_k,
+                causal=causal, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q3, k3, v3, scale, block_q, block_k, causal, interpret):
+    o, lse = _fwd(q3, k3, v3, scale=scale, block_q=block_q, block_k=block_k,
+                  causal=causal, interpret=interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(scale, block_q, block_k, causal, interpret, res, do3):
+    return _bwd(res, do3, scale=scale, block_q=block_q, block_k=block_k,
+                causal=causal, interpret=interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Flash attention on (B, T, H, D) tensors.  Differentiable; VMEM use
+    is O(block), HBM use O(T); causal masking skips ~half the tiles."""
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    o3 = _flash(to3(q), to3(k), to3(v), scale, block_q, block_k, causal,
+                interpret)
+    return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,74 @@
+"""Flash-attention kernel numerics vs the XLA reference oracle.
+
+Runs the pallas kernels in interpreter mode on CPU (pallas_call
+interpret=True) — real-TPU execution is covered by bench.py on the chip.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(key, B, T, H, D, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), dtype)
+    k = jax.random.normal(kk, (B, T, H, D), dtype)
+    v = jax.random.normal(kv, (B, T, H, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_reference(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0), 2, 128, 2, 64)
+    got = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_flash_forward_uneven_blocks():
+    # T not a multiple of the requested block → block shrink path
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), 1, 96, 1, 64)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_gradients_match_reference(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), 1, 64, 2, 32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32,
+                            interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_flash_bf16_close_to_f32_reference():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 128, 2, 64, jnp.bfloat16)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True).astype(jnp.float32)
+    want = reference_attention(q.astype(jnp.float32),
+                               k.astype(jnp.float32),
+                               v.astype(jnp.float32), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-2,
+                               rtol=5e-2)
