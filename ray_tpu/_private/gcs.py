@@ -567,7 +567,9 @@ class GcsServer:
         pg = self.placement_groups.get(payload["pg_id"])
         if pg is None:
             raise ValueError("no such placement group")
-        if pg.state in ("CREATED", "REMOVED"):
+        if pg.state in ("CREATED", "REMOVED", "INFEASIBLE"):
+            # INFEASIBLE returns immediately — no node will ever fit it;
+            # callers surface the error instead of hanging
             return pg.public()
         fut = asyncio.get_running_loop().create_future()
         self._pg_waiters.setdefault(pg.pg_id, []).append(fut)

@@ -68,8 +68,8 @@ class ResourceSet:
 
 class WorkerHandle:
     __slots__ = ("worker_id", "pid", "address", "conn", "proc", "state",
-                 "actor_id", "lease_id", "started_at",
-                 "_actor_resources", "_actor_bundle")
+                 "actor_id", "lease_id", "started_at", "tpu_grant",
+                 "tpu_chips", "_actor_resources", "_actor_bundle")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -81,6 +81,8 @@ class WorkerHandle:
         self.actor_id: bytes = b""
         self.lease_id: int = 0
         self.started_at = time.monotonic()
+        self.tpu_grant = 0.0
+        self.tpu_chips: List[int] = []
         self._actor_resources = None
         self._actor_bundle = None
 
@@ -114,6 +116,11 @@ class NodeManager:
 
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.idle_workers: List[WorkerHandle] = []
+        # Physical TPU chip allocator: chip indices handed to workers via
+        # TPU_VISIBLE_CHIPS (libtpu claims chips exclusively per process,
+        # so visibility must be partitioned, not just counted).
+        self._tpu_chips_free: List[int] = list(
+            range(int(resources.get("TPU", 0))))
         self._worker_registered: Dict[bytes, asyncio.Future] = {}
         self._lease_queue: List[LeaseRequest] = []
         self._lease_counter = 0
@@ -206,11 +213,37 @@ class NodeManager:
 
     # ---- worker pool -----------------------------------------------------
 
-    async def _start_worker(self, actor_id: bytes = b"") -> WorkerHandle:
+    async def _start_worker(self, actor_id: bytes = b"",
+                            tpu_grant: float = 0.0) -> WorkerHandle:
         """Fork a worker process (reference: worker_pool.h:413
-        StartWorkerProcess). The worker connects back and registers."""
+        StartWorkerProcess). The worker connects back and registers.
+
+        TPU visibility is gated by the resource grant — the TPU analog of
+        the reference's per-worker CUDA_VISIBLE_DEVICES isolation
+        (backend_executor.py:126 _share_cuda_visible_devices): a worker
+        whose task/actor holds no "TPU" resource gets JAX pinned to CPU
+        (and any TPU-plugin bootstrap hook disabled), so it can never
+        claim the chip out from under the worker that owns it.
+        """
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        chips: List[int] = []
+        if tpu_grant <= 0:
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)  # disarm TPU site hook
+        else:
+            need = max(1, -int(-tpu_grant // 1))  # ceil
+            if len(self._tpu_chips_free) < need:
+                self._reclaim_idle_tpu_chips(need)
+            if len(self._tpu_chips_free) < need:
+                raise RuntimeError(
+                    f"no free TPU chips for grant {tpu_grant} "
+                    f"(free={self._tpu_chips_free})")
+            chips = [self._tpu_chips_free.pop(0) for _ in range(need)]
+            csv = ",".join(str(c) for c in chips)
+            env["TPU_VISIBLE_CHIPS"] = csv
+            env["TPU_VISIBLE_DEVICES"] = csv
+        env["RAYTPU_TPU_GRANT"] = str(tpu_grant)
         env["RAYTPU_NODE_ADDRESS"] = self.node_address
         env["RAYTPU_GCS_ADDRESS"] = self.gcs_address
         env["RAYTPU_SESSION_DIR"] = self.session_dir
@@ -233,6 +266,8 @@ class NodeManager:
             start_new_session=False)
         handle = WorkerHandle(worker_id.binary(), proc)
         handle.actor_id = actor_id
+        handle.tpu_grant = tpu_grant
+        handle.tpu_chips = chips
         self.workers[worker_id.binary()] = handle
         fut = asyncio.get_running_loop().create_future()
         self._worker_registered[worker_id.binary()] = fut
@@ -240,8 +275,25 @@ class NodeManager:
             await asyncio.wait_for(fut, self.config.worker_start_timeout_s)
         except asyncio.TimeoutError:
             self._kill_worker_process(handle)
+            self._release_chips(handle)
             raise RuntimeError("worker failed to start in time")
         return handle
+
+    def _release_chips(self, handle: WorkerHandle) -> None:
+        if handle.tpu_chips:
+            self._tpu_chips_free.extend(handle.tpu_chips)
+            handle.tpu_chips = []
+
+    def _reclaim_idle_tpu_chips(self, need: int) -> None:
+        """Free chips held by idle pooled TPU workers by retiring them
+        (their libtpu runtime keeps the chip locked while alive)."""
+        for w in list(self.idle_workers):
+            if len(self._tpu_chips_free) >= need:
+                break
+            if w.tpu_chips:
+                self.idle_workers.remove(w)
+                self._kill_worker_process(w)
+                self._release_chips(w)
 
     async def rpc_register_worker(self, conn, payload):
         worker_id = payload["worker_id"]
@@ -281,6 +333,7 @@ class NodeManager:
         handle.state = "dead"
         if handle in self.idle_workers:
             self.idle_workers.remove(handle)
+        self._release_chips(handle)
         try:
             handle.proc.kill()
         except Exception:  # noqa: BLE001
@@ -363,10 +416,20 @@ class NodeManager:
 
     async def _grant(self, req: LeaseRequest):
         try:
-            if self.idle_workers:
-                handle = self.idle_workers.pop()
-            else:
-                handle = await self._start_worker()
+            want_tpu = req.resources.get("TPU", 0.0)
+            need_chips = max(1, -int(-want_tpu // 1)) if want_tpu > 0 else 0
+            handle = None
+            for i, w in enumerate(self.idle_workers):
+                # pooled workers are reusable only within their TPU-
+                # visibility class (a CPU-gated process can't serve a TPU
+                # task and vice versa), and only with the same chip set
+                # size (visibility is fixed at process start)
+                if (w.tpu_grant > 0) == (want_tpu > 0) and \
+                        len(w.tpu_chips) == need_chips:
+                    handle = self.idle_workers.pop(i)
+                    break
+            if handle is None:
+                handle = await self._start_worker(tpu_grant=want_tpu)
                 if handle.state != "idle":
                     raise RuntimeError("worker died during startup")
             self._lease_counter += 1
@@ -421,7 +484,9 @@ class NodeManager:
                     f"timed out acquiring actor resources {resources}")
             await asyncio.sleep(0.02)
         try:
-            handle = await self._start_worker(actor_id=payload["actor_id"])
+            handle = await self._start_worker(
+                actor_id=payload["actor_id"],
+                tpu_grant=resources.get("TPU", 0.0))
             handle.state = "actor"
             handle.actor_id = payload["actor_id"]
             handle._actor_resources = resources

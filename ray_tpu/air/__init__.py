@@ -1,0 +1,16 @@
+"""Shared ML plumbing (reference analog: python/ray/air/).
+
+`Checkpoint` (dict ⇄ directory ⇄ object-store interconvertible artifact),
+`session` (worker-side report/context API), and the config dataclasses
+consumed by Train/Tune (`ScalingConfig`, `RunConfig`, `FailureConfig`,
+`CheckpointConfig`).
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = ["Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+           "CheckpointConfig", "Result", "session"]

@@ -1,0 +1,79 @@
+"""Run/scaling configuration dataclasses.
+
+Reference analogs: air/config.py:79 ScalingConfig, :640 RunConfig,
+:452 FailureConfig, :511 CheckpointConfig.  TPU-first deltas: the worker
+resource is ``num_tpus`` (the "TPU" predefined resource), and ScalingConfig
+carries an optional ``topology`` (e.g. "v5e-8") plus a ``mesh`` spec so
+trainers can build slice-aware meshes instead of flat process groups.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Union
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers, what each gets, and how devices form a mesh."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    num_cpus_per_worker: float = 1.0
+    num_tpus_per_worker: float = 0.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: Optional[str] = None     # e.g. "v5e-8": reserve an ICI domain
+    mesh: Optional[Any] = None         # ray_tpu.parallel.MeshSpec override
+
+    def __post_init__(self):
+        if self.use_tpu and not self.num_tpus_per_worker:
+            self.num_tpus_per_worker = 1.0
+
+    @property
+    def _trainer_resources(self) -> Dict[str, float]:
+        res: Dict[str, float] = {"CPU": float(self.num_cpus_per_worker)}
+        if self.num_tpus_per_worker:
+            res["TPU"] = float(self.num_tpus_per_worker)
+        for k, v in (self.resources_per_worker or {}).items():
+            res[k] = float(v)
+        return res
+
+    def as_placement_group_bundles(self):
+        return [dict(self._trainer_resources)
+                for _ in range(self.num_workers)]
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: -1 = unlimited restarts, 0 = fail fast (reference
+    air/config.py:452)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Retention policy for checkpoints (reference air/config.py:511)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Experiment-level config (reference air/config.py:640)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    stop: Optional[Union[Dict[str, Any], int]] = None
+    verbose: int = 1

@@ -1,0 +1,26 @@
+"""Distributed training orchestration (reference analog: python/ray/train).
+
+Stack (reference call path 3.4 in SURVEY.md): Trainer.fit →
+training_loop → BackendExecutor → WorkerGroup of actors → Backend
+process-group setup → user train_loop_per_worker with air.session.
+"""
+
+from ray_tpu.air import session  # re-export: ray_tpu.train.session.report
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                ScalingConfig)
+from ray_tpu.air.result import Result
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.backend_executor import (BackendExecutor,
+                                            TrainingWorkerError)
+from ray_tpu.train.base_trainer import BaseTrainer
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.jax_backend import JaxConfig
+from ray_tpu.train.jax_trainer import JaxTrainer, jax_utils
+
+__all__ = [
+    "session", "Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+    "CheckpointConfig", "Result", "Backend", "BackendConfig",
+    "BackendExecutor", "TrainingWorkerError", "BaseTrainer",
+    "DataParallelTrainer", "JaxConfig", "JaxTrainer", "jax_utils",
+]

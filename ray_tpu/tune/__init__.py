@@ -1,0 +1,16 @@
+"""Hyperparameter search / experiment engine (reference analog:
+python/ray/tune — Tuner.fit → TrialRunner event loop over trial actors,
+searchers + schedulers)."""
+
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     TrialScheduler)
+from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+                                 uniform)
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+]

@@ -1,0 +1,74 @@
+"""Trial schedulers: FIFO and ASHA (async successive halving).
+
+Reference analog: tune/schedulers/{trial_scheduler.py,async_hyperband.py}.
+ASHA keeps rungs at r, r*rf, r*rf², …; when a trial reaches a rung it
+continues only if its metric is in the top 1/rf of results recorded at
+that rung so far (asynchronous — no waiting for full brackets).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4,
+                 time_attr: str = "training_iteration"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min or max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung milestones: grace, grace*rf, grace*rf^2, ... < max_t
+        self.milestones: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.milestones.append(t)
+            t *= reduction_factor
+        self._rungs: Dict[int, List[float]] = {m: [] for m in
+                                               self.milestones}
+        self._recorded: Dict[str, set] = {}  # trial_id -> rungs entered
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, trial.iteration)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        decision = CONTINUE
+        seen = self._recorded.setdefault(trial.trial_id, set())
+        # a trial enters each rung the first time it reaches (or passes)
+        # the milestone — reports need not land exactly on it
+        for m in self.milestones:
+            if t >= m and m not in seen:
+                seen.add(m)
+                rung = self._rungs[m]
+                rung.append(float(val))
+                if len(rung) >= self.rf:
+                    k = max(1, math.floor(len(rung) / self.rf))
+                    ordered = sorted(rung, reverse=(self.mode == "max"))
+                    cutoff = ordered[k - 1]
+                    good = (val >= cutoff if self.mode == "max"
+                            else val <= cutoff)
+                    if not good:
+                        decision = STOP
+        return decision

@@ -1,0 +1,137 @@
+"""TrialRunner: the tune event loop.
+
+Reference analog: tune/execution/trial_runner.py:236 TrialRunner (:867
+step).  Each trial runs its function-trainable inside a RayTrainWorker
+actor (the same session machinery Train uses — reference function
+trainables share this shape via function_runner.py).  The runner keeps up
+to ``max_concurrent`` trials in flight, pumps one result at a time per
+trial via next_result, applies scheduler decisions (ASHA early stop), and
+records checkpoints.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import trial as trial_mod
+from ray_tpu.tune.schedulers import CONTINUE, STOP, TrialScheduler
+from ray_tpu.tune.trial import Trial
+
+logger = logging.getLogger(__name__)
+
+
+class TrialRunner:
+    def __init__(self, trainable: Callable, trials: List[Trial], *,
+                 scheduler: Optional[TrialScheduler] = None,
+                 max_concurrent: int = 0,
+                 stop: Optional[Dict[str, Any]] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self.trainable = trainable
+        self.trials = trials
+        self.scheduler = scheduler or TrialScheduler()
+        self.stop_criteria = stop or {}
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        if max_concurrent <= 0:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            per = self.resources.get("CPU", 1.0) or 1.0
+            max_concurrent = max(1, int(cpus // per))
+        self.max_concurrent = max_concurrent
+        self._actors: Dict[str, Any] = {}     # trial_id -> worker actor
+        self._inflight: Dict[Any, Trial] = {}  # next_result ref -> trial
+
+    # -- lifecycle --------------------------------------------------------
+    def run(self) -> List[Trial]:
+        pending = list(self.trials)
+        try:
+            while pending or self._inflight:
+                while pending and len(self._actors) < self.max_concurrent:
+                    trial = pending.pop(0)
+                    try:
+                        self._launch(trial)
+                    except Exception as e:  # noqa: BLE001 - isolate trial
+                        logger.warning("trial %s failed to launch: %s",
+                                       trial.trial_id, e)
+                        self._finish(trial, trial_mod.ERROR, e)
+                self._pump()
+        finally:
+            # never leak trial actors, whatever aborted the loop
+            for trial in self.trials:
+                if trial.trial_id in self._actors:
+                    self._finish(trial, trial.status if trial.is_finished
+                                 else trial_mod.ERROR,
+                                 trial.error or RuntimeError(
+                                     "experiment aborted"))
+        return self.trials
+
+    def _launch(self, trial: Trial) -> None:
+        from ray_tpu.train._internal.worker_group import RayTrainWorker
+
+        opts: Dict[str, Any] = {"num_cpus": self.resources.get("CPU", 1.0)}
+        if self.resources.get("TPU"):
+            opts["num_tpus"] = self.resources["TPU"]
+        actor = ray_tpu.remote(**opts)(RayTrainWorker).remote()
+        ray_tpu.get([actor.init_session.remote(
+            world_rank=0, local_rank=0, world_size=1,
+            trial_name=f"trial_{trial.trial_id}", trial_id=trial.trial_id,
+            config=trial.config, dataset_shards={}, checkpoint=None)],
+            timeout=60)
+        ray_tpu.get([actor.start_training.remote(self.trainable)],
+                    timeout=60)
+        trial.status = trial_mod.RUNNING
+        self._actors[trial.trial_id] = actor
+        self._inflight[actor.next_result.remote()] = trial
+
+    def _finish(self, trial: Trial, status: str,
+                error: Optional[BaseException] = None) -> None:
+        trial.status = status
+        trial.error = error
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _pump(self) -> None:
+        if not self._inflight:
+            return
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=600.0)
+        for ref in ready:
+            trial = self._inflight.pop(ref)
+            try:
+                res = ray_tpu.get([ref], timeout=60)[0]
+            except Exception as e:  # noqa: BLE001 - actor died
+                self._finish(trial, trial_mod.ERROR, e)
+                continue
+            if res.type == "done":
+                self._finish(trial, trial_mod.TERMINATED)
+            elif res.type == "error":
+                self._finish(trial, trial_mod.ERROR, res.error)
+            else:
+                self._on_report(trial, res)
+
+    def _on_report(self, trial: Trial, res) -> None:
+        trial.iteration += 1
+        metrics = dict(res.metrics or {})
+        metrics.setdefault("training_iteration", trial.iteration)
+        trial.metrics_history.append(metrics)
+        trial.last_result = metrics
+        if res.checkpoint is not None:
+            trial.checkpoint = res.checkpoint
+
+        if self._should_stop(metrics) or \
+                self.scheduler.on_trial_result(trial, metrics) == STOP:
+            self._finish(trial, trial_mod.STOPPED)
+            return
+        actor = self._actors[trial.trial_id]
+        self._inflight[actor.next_result.remote()] = trial
+
+    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
+        for key, bound in self.stop_criteria.items():
+            v = metrics.get(key)
+            if v is not None and v >= bound:
+                return True
+        return False

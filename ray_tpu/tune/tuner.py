@@ -1,0 +1,105 @@
+"""Tuner — the public tune API (reference analog: tune/tuner.py:220
+Tuner.fit; tune/tune.py:130 run)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.tune.schedulers import TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.trial_runner import TrialRunner
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    def __init__(self, trials: List[Trial]):
+        self.trials = trials
+
+    def __len__(self):
+        return len(self.trials)
+
+    def __iter__(self):
+        return iter(self._results())
+
+    def _results(self) -> List[Result]:
+        return [Result(metrics=t.last_result, checkpoint=t.checkpoint,
+                       error=t.error, metrics_history=t.metrics_history)
+                for t in self.trials]
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+        scored = [(t.best_metric(metric, mode), t) for t in self.trials
+                  if t.best_metric(metric, mode) is not None]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (max if mode == "max" else min)(scored, key=lambda s: s[0])[1]
+        return Result(metrics=best.last_result, checkpoint=best.checkpoint,
+                      error=best.error,
+                      metrics_history=best.metrics_history)
+
+    @property
+    def errors(self) -> List[BaseException]:
+        return [t.error for t in self.trials if t.error is not None]
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        from ray_tpu.train.base_trainer import BaseTrainer
+
+        if isinstance(trainable, BaseTrainer):
+            # Trainer-in-Tuner: each trial runs trainer.training_loop with
+            # the trial config merged into its loop config (reference
+            # base_trainer.py:353 routes fit() here).
+            self._trainable = trainable.as_trainable()
+            resources_per_trial = resources_per_trial or {"CPU": 0.5}
+        else:
+            self._trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        gen = BasicVariantGenerator(self.param_space,
+                                    num_samples=self.tune_config.num_samples,
+                                    seed=self.tune_config.seed)
+        trials = [Trial(config=c) for c in gen.variants()]
+        stop = self.run_config.stop if isinstance(self.run_config.stop,
+                                                  dict) else None
+        runner = TrialRunner(
+            self._trainable, trials,
+            scheduler=self.tune_config.scheduler,
+            max_concurrent=self.tune_config.max_concurrent_trials,
+            stop=stop,
+            resources_per_trial=self.resources_per_trial)
+        runner.run()
+        return ResultGrid(trials)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, scheduler: Optional[TrialScheduler] = None,
+        stop: Optional[Dict[str, Any]] = None,
+        metric: Optional[str] = None, mode: str = "min") -> ResultGrid:
+    """tune.run-style entry point (reference tune/tune.py:130)."""
+    return Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(num_samples=num_samples,
+                               scheduler=scheduler, metric=metric,
+                               mode=mode),
+        run_config=RunConfig(stop=stop)).fit()

@@ -161,8 +161,13 @@ def test_actor_resources_block_until_available(ray_start_regular):
 
     a = Big.remote()
     assert ray_tpu.get(a.ping.remote()) == 1
-    avail = ray_tpu.available_resources()
-    assert avail["CPU"] <= 1.0
+    # availability is heartbeat-propagated (node -> GCS): poll for it
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) <= 1.0:
+            break
+        time.sleep(0.1)
+    assert ray_tpu.available_resources()["CPU"] <= 1.0
 
 
 def test_max_concurrency_actor(ray_start_regular):

@@ -1,0 +1,21 @@
+"""Directory-native checkpoints must carry file bytes across pickling."""
+
+import os
+import pickle
+
+from ray_tpu.air import Checkpoint
+
+
+def test_directory_checkpoint_packs_files(tmp_path):
+    src = tmp_path / "src"
+    os.makedirs(src / "nested")
+    (src / "weights.bin").write_bytes(b"\x01\x02\x03" * 100)
+    (src / "nested" / "meta.txt").write_text("hello")
+
+    c = Checkpoint.from_directory(str(src))
+    c2 = pickle.loads(pickle.dumps(c))  # crosses a process boundary
+
+    out = c2.to_directory(str(tmp_path / "out"))
+    assert (tmp_path / "out" / "weights.bin").read_bytes() == \
+        b"\x01\x02\x03" * 100
+    assert (tmp_path / "out" / "nested" / "meta.txt").read_text() == "hello"

@@ -1,0 +1,146 @@
+"""Train stack tests: Checkpoint forms, DataParallelTrainer/JaxTrainer
+end-to-end on real worker actor processes (2 CPU workers — the
+BASELINE.json fashion-MNIST-MLP shape), failure surfacing, checkpoints.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.air import Checkpoint
+from ray_tpu.train import (DataParallelTrainer, FailureConfig, JaxConfig,
+                           JaxTrainer, RunConfig, ScalingConfig)
+
+
+def test_checkpoint_dict_dir_roundtrip(tmp_path):
+    data = {"step": 3, "params": {"w": np.arange(6).reshape(2, 3)}}
+    c = Checkpoint.from_dict(data)
+    d = c.to_directory(str(tmp_path / "ck"))
+    c2 = Checkpoint.from_directory(d)
+    got = c2.to_dict()
+    assert got["step"] == 3
+    np.testing.assert_array_equal(got["params"]["w"],
+                                  data["params"]["w"])
+
+
+def test_checkpoint_object_ref_roundtrip(ray_start_shared):
+    c = Checkpoint.from_dict({"x": np.ones(4)})
+    ref = c.to_object_ref()
+    c2 = Checkpoint.from_object_ref(ref)
+    np.testing.assert_array_equal(c2.to_dict()["x"], np.ones(4))
+
+
+def _mlp_loop(config):
+    """2-worker data-parallel MLP: local grads + store allreduce."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.air import session
+    from ray_tpu.models import MLPConfig, mlp_init, mlp_loss
+    from ray_tpu.train import jax_utils
+
+    cfg = MLPConfig(in_dim=8, hidden=(16,), n_classes=3)
+    params = mlp_init(jax.random.PRNGKey(0), cfg)  # same init on all ranks
+    shard = session.get_dataset_shard("train")
+    x = jnp.asarray(shard["x"])
+    y = jnp.asarray(shard["y"])
+
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p: mlp_loss(p, {"x": x, "y": y}, cfg)))
+    lr = config["lr"]
+    for step in range(config["steps"]):
+        loss, grads = grad_fn(params)
+        if session.get_world_size() > 1:
+            grads = jax_utils.allreduce_gradients(grads)
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        ckpt = None
+        if step == config["steps"] - 1:
+            ckpt = {"params": params, "step": step}
+        session.report({"loss": float(loss),
+                        "rank": session.get_world_rank()},
+                       checkpoint=ckpt)
+
+
+def test_jax_trainer_two_workers_mlp(ray_start_shared):
+    rng = np.random.RandomState(0)
+    n = 64
+    x = rng.randn(n, 8).astype(np.float32)
+    w_true = rng.randn(8, 3)
+    y = (x @ w_true).argmax(axis=1)
+
+    trainer = JaxTrainer(
+        _mlp_loop,
+        train_loop_config={"lr": 0.3, "steps": 5},
+        jax_config=JaxConfig(distributed="store"),
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": {"x": x, "y": y}},
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert len(result.metrics_history) == 5
+    first, last = (result.metrics_history[0]["loss"],
+                   result.metrics_history[-1]["loss"])
+    assert last < first
+    assert result.checkpoint is not None
+    ck = result.checkpoint.to_dict()
+    assert ck["step"] == 4
+
+
+def _shard_check_loop(config):
+    from ray_tpu.air import session
+
+    shard = session.get_dataset_shard("train")
+    session.report({"n": len(shard["x"]),
+                    "rank": session.get_world_rank()})
+
+
+def test_dataset_dict_of_arrays_sharded(ray_start_shared):
+    x = np.arange(10)
+    trainer = DataParallelTrainer(
+        _shard_check_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": {"x": x, "y": x}},
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["n"] == 5  # 10 rows / 2 workers
+
+
+def _failing_loop(config):
+    from ray_tpu.air import session
+
+    session.report({"ok": 1})
+    if session.get_world_rank() == 0:
+        raise ValueError("boom at rank 0")
+    session.report({"ok": 2})
+
+
+def test_worker_failure_surfaces_in_result(ray_start_shared):
+    trainer = DataParallelTrainer(
+        _failing_loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=0)),
+    )
+    result = trainer.fit()
+    assert result.error is not None
+    assert "boom at rank 0" in str(result.error)
+    assert len(result.metrics_history) == 1  # one good round before crash
+
+
+def _resume_loop(config):
+    from ray_tpu.air import session
+
+    ck = session.get_checkpoint()
+    start = ck.to_dict()["step"] + 1 if ck is not None else 0
+    session.report({"resumed_from": start})
+
+
+def test_resume_from_checkpoint(ray_start_shared):
+    trainer = DataParallelTrainer(
+        _resume_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        resume_from_checkpoint=Checkpoint.from_dict({"step": 7}),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["resumed_from"] == 8
