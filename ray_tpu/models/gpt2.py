@@ -45,6 +45,7 @@ class GPT2Config:
     param_dtype: Any = jnp.float32   # master weights
     remat: bool = True
     use_flash: Optional[bool] = None  # None = auto (flash on TPU)
+    seq_parallel: bool = False  # ring attention over the mesh "seq" axis
     # pad vocab to a multiple of 128 so the logits matmul tiles the MXU
     # cleanly and the vocab dim shards evenly under tensor parallelism
     vocab_pad_to: int = 128
@@ -178,10 +179,41 @@ def _attention(x, p, cfg: GPT2Config, rules):
     q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # (B,T,H,hd)
     q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"),
                                 rules)
-    from ray_tpu.ops.attention import causal_attention
-    o = causal_attention(q, kk, v, use_flash=cfg.use_flash)
+    o = None
+    if cfg.seq_parallel:
+        o = _ring_attention_sharded(q, kk, v, rules)
+    if o is None:
+        from ray_tpu.ops.attention import causal_attention
+        o = causal_attention(q, kk, v, use_flash=cfg.use_flash)
     out = jnp.einsum("bthk,hkd->btd", o, p["o_w"].astype(cfg.dtype))
     return out + p["o_b"].astype(cfg.dtype)
+
+
+def _ring_attention_sharded(q, k, v, rules):
+    """Context parallelism: the model stays GSPMD-partitioned, but
+    attention (the one op coupling all sequence positions) drops into an
+    explicit shard_map running ring attention over the "seq" mesh axis.
+    Returns None when no mesh is active (e.g. single-device eval)."""
+    import jax
+    from jax.sharding import PartitionSpec
+
+    try:
+        from jax._src.mesh import thread_resources
+        mesh = thread_resources.env.physical_mesh
+        if mesh.empty or mesh.shape.get("seq", 1) == 1:
+            return None
+    except Exception:  # noqa: BLE001 - no mesh machinery available
+        return None
+    from ray_tpu.ops.ring_attention import ring_attention
+    from ray_tpu.parallel.sharding import logical_to_mesh_axes
+
+    spec = logical_to_mesh_axes(("batch", "seq", "heads", "head_dim"),
+                                rules)
+    import functools
+
+    return jax.shard_map(
+        functools.partial(ring_attention, causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
 
 
 def _mlp(x, p, cfg: GPT2Config, rules):

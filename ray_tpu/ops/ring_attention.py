@@ -1,0 +1,144 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+**Absent in the reference** (SURVEY.md §5.7: no ring attention, sequence
+or context parallelism anywhere) — this layer is new, built TPU-first
+per the public blockwise/ring-attention literature (PAPERS.md).
+
+Ring attention: the sequence axis is sharded over a mesh axis; each
+device keeps its q shard resident and passes k/v shards around the ring
+with `lax.ppermute` (single-hop ICI neighbor exchanges — the mesh is
+built on torus coordinates, parallel/mesh.py).  Per step, a device
+attends its local q against the visiting k/v chunk and merges the
+partial result with a log-sum-exp running state, so the full T×T score
+matrix never exists on any one device and max sequence length scales
+linearly with the ring size.  Written in differentiable jax (scan +
+ppermute), so the backward pass is the reverse ring for free.
+
+Ulysses: all-to-all reshards (seq-sharded, all heads) → (all seq, head-
+sharded), runs ordinary attention per head group locally, and reverses —
+one all_to_all each way instead of a ring; better when heads ≥ ring size
+and full-seq activations fit per device.
+
+Use inside shard_map over the mesh's "seq" axis — see
+tests/test_ring_attention.py and models/gpt2.py's sequence-parallel mode.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30
+
+
+def _chunk_attend(q, k, v, q_off, k_off, *, causal: bool, scale: float):
+    """Blockwise attention of a q chunk against one k/v chunk, returning
+    the UNNORMALIZED accumulator and row statistics for LSE merging.
+
+    q: (B, Tq, H, D); k/v: (B, Tk, H, D); offsets are global sequence
+    positions of element 0 (traced scalars under the ring loop).
+    Returns (acc (B,Tq,H,D) f32, m (B,Tq,H) f32, l (B,Tq,H) f32).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        rows = q_off + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+        cols = k_off + lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+        s = jnp.where(rows >= cols, s, _NEG_BIG)
+    m = jnp.max(s, axis=-1)                      # (B,H,Tq)
+    p = jnp.exp(s - m[..., None])
+    # fully-masked rows: make their contribution exactly zero
+    p = jnp.where((m == _NEG_BIG)[..., None], 0.0, p)
+    l = jnp.sum(p, axis=-1)                      # (B,H,Tq)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return (acc.astype(jnp.float32),
+            m.transpose(0, 2, 1), l.transpose(0, 2, 1))  # (B,Tq,H)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq",
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jnp.ndarray:
+    """Causal MHA over a sequence-sharded axis.  Call inside shard_map;
+    q/k/v are the LOCAL shards (B, T_local, H, D) and the result is the
+    local shard of the attention output."""
+    B, Tl, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    q_off = idx * Tl
+
+    # derive carries from q so they inherit its varying mesh axes
+    # (a literal jnp.zeros would be "unvarying" and fail scan's typing)
+    m0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32) + _NEG_BIG
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        m, l, acc, kc, vc = carry
+        src = (idx - s) % n           # ring step s holds src's shard
+        k_off = src * Tl
+        a_s, m_s, l_s = _chunk_attend(q, kc, vc, q_off, k_off,
+                                      causal=causal, scale=scale)
+        m_new = jnp.maximum(m, m_s)
+        # rescale both the running accumulator and the new partial
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_s - m_new)
+        l = l * alpha + l_s * beta
+        acc = acc * alpha[..., None] + a_s * beta[..., None]
+        # pass k/v to the next device (skip the final, useless hop)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (m_new, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
+                                    jnp.arange(n))
+    l = jnp.maximum(l, 1e-30)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str = "seq",
+                      causal: bool = True,
+                      scale: Optional[float] = None,
+                      attend_fn=None) -> jnp.ndarray:
+    """Head-scatter / seq-gather attention (the Ulysses pattern).
+
+    Inside shard_map over `axis_name`: all_to_all converts the local
+    (B, T_local, H, D) shards into (B, T_full, H/n, D), runs ordinary
+    full-sequence attention on the local head group (any kernel — the
+    pallas flash kernel by default on TPU), then converts back.
+    Requires H % axis_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by the "
+                         f"sequence axis size ({n})")
+
+    def scatter(x):  # (B,Tl,H,D) -> (B,T,H/n,D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather(x):   # (B,T,H/n,D) -> (B,Tl,H,D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = scatter(q), scatter(k), scatter(v)
+    if attend_fn is None:
+        from ray_tpu.ops.attention import causal_attention
+
+        of = causal_attention(qf, kf, vf, scale=scale) if causal else \
+            _plain(qf, kf, vf, scale)
+    else:
+        of = attend_fn(qf, kf, vf)
+    return gather(of)
+
+
+def _plain(q, k, v, scale):
+    from ray_tpu.ops.attention import reference_attention
+
+    return reference_attention(q, k, v, causal=False, scale=scale)
