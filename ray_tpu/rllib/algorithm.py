@@ -1,0 +1,102 @@
+"""Algorithm: the trainable RL loop (reference analog:
+rllib/algorithms/algorithm.py:150 Algorithm(Trainable), :728 step).
+
+`train()` runs one training iteration and returns a metrics dict; the
+class also works as a tune trainable via `as_trainable()` (iterating
+train() and reporting each result), matching how the reference runs
+learning tests through tune.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    env: Any = None
+    env_config: Optional[Dict[str, Any]] = None
+    num_workers: int = 2
+    num_envs_per_worker: int = 1
+    rollout_fragment_length: int = 200
+    train_batch_size: int = 4000
+    gamma: float = 0.99
+    lr: float = 3e-4
+    seed: int = 0
+    num_cpus_per_worker: float = 1.0
+    # learner placement: {"TPU": 1} puts the learner policy on the chip
+    learner_resources: Optional[Dict[str, float]] = None
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def update(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown config field {k!r}")
+            setattr(self, k, v)
+        return self
+
+
+class Algorithm:
+    _config_cls = AlgorithmConfig
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._episode_returns: List[float] = []
+        self.setup(config)
+
+    # -- subclass surface -------------------------------------------------
+    def setup(self, config: AlgorithmConfig) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- public API -------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        start = time.monotonic()
+        result = self.training_step()
+        self.iteration += 1
+        self._timesteps_total += result.get("timesteps_this_iter", 0)
+        recent = self._episode_returns[-100:]
+        result.update({
+            "training_iteration": self.iteration,
+            "timesteps_total": self._timesteps_total,
+            "episode_reward_mean": (sum(recent) / len(recent))
+            if recent else float("nan"),
+            "episodes_total": len(self._episode_returns),
+            "time_this_iter_s": time.monotonic() - start,
+        })
+        return result
+
+    def stop(self) -> None:
+        self.cleanup()
+
+    @classmethod
+    def as_trainable(cls, base_config: AlgorithmConfig,
+                     stop_iters: int = 10) -> Callable:
+        """Function trainable for ray_tpu.tune (reference: Algorithm IS a
+        Trainable; here the adapter closes over the config)."""
+
+        def trainable(config: Dict[str, Any]):
+            from ray_tpu.air import session
+
+            cfg = base_config.copy().update(**config)
+            algo = cls(cfg)
+            try:
+                for _ in range(stop_iters):
+                    session.report(algo.train())
+            finally:
+                algo.stop()
+
+        trainable.__name__ = cls.__name__
+        return trainable

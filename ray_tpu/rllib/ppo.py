@@ -1,0 +1,103 @@
+"""PPO (reference analog: rllib/algorithms/ppo/ppo.py:401 training_step).
+
+Sync path, TPU-first learner: parallel rollout sample from CPU workers →
+advantage standardization → ONE jitted update call on the learner policy
+(epochs × minibatches compiled as lax.scan — policy.py) → weight
+broadcast through the object store.  The learner policy lives on this
+process's default jax device: run the algorithm in a `num_tpus=1` actor
+and the update executes on the chip while workers stay CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+
+
+@dataclasses.dataclass
+class PPOConfig(AlgorithmConfig):
+    clip_param: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_sgd_iter: int = 6
+    minibatch_size: int = 128
+    lam: float = 0.95
+    grad_clip: float = 0.5
+    hidden: Tuple[int, ...] = (64, 64)
+    # set from the env when obs/action spaces are introspectable
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+    def policy_spec(self) -> PolicySpec:
+        if self.obs_dim is None or self.n_actions is None:
+            raise ValueError("obs_dim/n_actions unset; pass them or use "
+                             "a gymnasium env id")
+        return PolicySpec(
+            obs_dim=self.obs_dim, n_actions=self.n_actions,
+            hidden=tuple(self.hidden), lr=self.lr,
+            clip_param=self.clip_param, vf_coeff=self.vf_coeff,
+            entropy_coeff=self.entropy_coeff,
+            num_sgd_iter=self.num_sgd_iter,
+            minibatch_size=self.minibatch_size, grad_clip=self.grad_clip)
+
+
+def _introspect_spaces(cfg: PPOConfig) -> None:
+    if cfg.obs_dim is not None and cfg.n_actions is not None:
+        return
+    from ray_tpu.rllib.rollout_worker import _make_env
+
+    env = _make_env(cfg.env, cfg.env_config)
+    try:
+        cfg.obs_dim = int(np.prod(env.observation_space.shape))
+        cfg.n_actions = int(env.action_space.n)
+    finally:
+        env.close() if hasattr(env, "close") else None
+
+
+class PPO(Algorithm):
+    _config_cls = PPOConfig
+
+    def setup(self, config: PPOConfig) -> None:
+        _introspect_spaces(config)
+        spec = config.policy_spec()
+        self.learner_policy = JaxPolicy(spec, seed=config.seed)
+        self.workers = WorkerSet(
+            num_workers=config.num_workers, env=config.env,
+            env_config=config.env_config, policy_spec=spec,
+            num_envs_per_worker=config.num_envs_per_worker,
+            rollout_fragment_length=config.rollout_fragment_length,
+            gamma=config.gamma, lam=config.lam,
+            num_cpus_per_worker=config.num_cpus_per_worker,
+            seed=config.seed)
+        self.workers.sync_weights(self.learner_policy.get_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        batches = []
+        steps = 0
+        while steps < self.config.train_batch_size:
+            parts = self.workers.sample()
+            batches.extend(parts)
+            steps += sum(b.count for b in parts)
+        batch = SampleBatch.concat_samples(batches)
+
+        # standardize advantages (reference ppo.py standardize_fields)
+        adv = batch[sb.ADVANTAGES]
+        batch[sb.ADVANTAGES] = ((adv - adv.mean()) /
+                                max(adv.std(), 1e-6)).astype(np.float32)
+
+        stats = self.learner_policy.learn_on_batch(batch)
+        self.workers.sync_weights(self.learner_policy.get_weights())
+        self._episode_returns.extend(self.workers.episode_returns())
+        stats["timesteps_this_iter"] = batch.count
+        return stats
+
+    def cleanup(self) -> None:
+        self.workers.stop()

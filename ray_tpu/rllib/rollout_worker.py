@@ -1,0 +1,100 @@
+"""RolloutWorker: CPU actor that steps envs with the current policy and
+emits GAE-processed SampleBatches.
+
+Reference analog: rllib/evaluation/rollout_worker.py:134 (:779 sample)
+with the SyncSampler loop (evaluation/sampler.py:145).  Kept
+deliberately lean: vectorized-by-loop gymnasium envs, batched policy
+inference per step, trajectory postprocessing (GAE) at episode/horizon
+boundaries — all numpy/CPU; the TPU never appears here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+
+def _make_env(env_name_or_creator, env_config):
+    if callable(env_name_or_creator):
+        return env_name_or_creator(env_config or {})
+    import gymnasium as gym
+
+    return gym.make(env_name_or_creator)
+
+
+class RolloutWorker:
+    def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
+                 policy_spec: PolicySpec, num_envs: int = 1,
+                 gamma: float = 0.99, lam: float = 0.95,
+                 rollout_fragment_length: int = 200, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.envs = [_make_env(env, env_config) for _ in range(num_envs)]
+        self.policy = JaxPolicy(policy_spec, seed=seed)
+        self.gamma = gamma
+        self.lam = lam
+        self.fragment = rollout_fragment_length
+        self._obs = [e.reset(seed=seed + i)[0]
+                     for i, e in enumerate(self.envs)]
+        self._ep_rewards = [0.0] * num_envs
+        self.episode_returns: List[float] = []
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self) -> SampleBatch:
+        """One fragment per env, GAE-postprocessed and concatenated."""
+        n_env = len(self.envs)
+        T = self.fragment
+        obs_buf = np.zeros((T, n_env) + np.shape(self._obs[0]), np.float32)
+        act_buf = np.zeros((T, n_env), np.int64)
+        rew_buf = np.zeros((T, n_env), np.float32)
+        done_buf = np.zeros((T, n_env), np.bool_)
+        logp_buf = np.zeros((T, n_env), np.float32)
+        vf_buf = np.zeros((T, n_env), np.float32)
+
+        for t in range(T):
+            obs = np.stack(self._obs).astype(np.float32)
+            actions, logp, vf = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            vf_buf[t] = vf
+            for i, env in enumerate(self.envs):
+                o2, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self._ep_rewards[i] += r
+                done = term or trunc
+                done_buf[t, i] = term  # bootstraps through truncation
+                if done:
+                    self.episode_returns.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    o2 = env.reset()[0]
+                self._obs[i] = o2
+
+        last_obs = np.stack(self._obs).astype(np.float32)
+        last_vf = self.policy.value(last_obs)
+
+        parts = []
+        for i in range(n_env):
+            adv, vt = compute_gae(rew_buf[:, i], vf_buf[:, i],
+                                  done_buf[:, i], float(last_vf[i]),
+                                  gamma=self.gamma, lam=self.lam)
+            parts.append(SampleBatch({
+                sb.OBS: obs_buf[:, i], sb.ACTIONS: act_buf[:, i],
+                sb.REWARDS: rew_buf[:, i], sb.DONES: done_buf[:, i],
+                sb.ACTION_LOGP: logp_buf[:, i], sb.VF_PREDS: vf_buf[:, i],
+                sb.ADVANTAGES: adv, sb.VALUE_TARGETS: vt,
+            }))
+        return SampleBatch.concat_samples(parts)
+
+    def pop_episode_returns(self) -> List[float]:
+        out = self.episode_returns
+        self.episode_returns = []
+        return out

@@ -1,0 +1,56 @@
+"""WorkerSet: the gang of remote RolloutWorker actors plus a local
+learner-side policy (reference analog: rllib/evaluation/worker_set.py:64)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class WorkerSet:
+    def __init__(self, *, num_workers: int, env: Any,
+                 env_config: Optional[Dict] = None,
+                 policy_spec: PolicySpec,
+                 num_envs_per_worker: int = 1,
+                 rollout_fragment_length: int = 200,
+                 gamma: float = 0.99, lam: float = 0.95,
+                 num_cpus_per_worker: float = 1.0, seed: int = 0):
+        self.num_workers = num_workers
+        kwargs = dict(env=env, env_config=env_config,
+                      policy_spec=policy_spec,
+                      num_envs=num_envs_per_worker, gamma=gamma, lam=lam,
+                      rollout_fragment_length=rollout_fragment_length)
+        remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
+            RolloutWorker)
+        self.workers = [remote_cls.remote(seed=seed + 1000 * (i + 1),
+                                          **kwargs)
+                        for i in range(num_workers)]
+
+    def sample(self, timeout: float = 300.0) -> List[SampleBatch]:
+        """reference rollout_ops.py:36 synchronous_parallel_sample."""
+        return ray_tpu.get([w.sample.remote() for w in self.workers],
+                           timeout=timeout)
+
+    def sync_weights(self, weights, timeout: float = 60.0) -> None:
+        """Broadcast learner weights via one object-store put."""
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([w.set_weights.remote(ref) for w in self.workers],
+                    timeout=timeout)
+
+    def episode_returns(self, timeout: float = 60.0) -> List[float]:
+        parts = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=timeout)
+        return [r for p in parts for r in p]
+
+    def stop(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -1,0 +1,92 @@
+"""RLlib slice tests: SampleBatch/GAE units, policy update mechanics,
+and the PPO learning tier — CartPole reward must improve within a small
+budget (the reference's check_learning_achieved pattern,
+rllib/utils/test_utils.py:480, scaled down for CI)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig, SampleBatch
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import compute_gae
+
+
+def test_sample_batch_concat_slice_shuffle():
+    b1 = SampleBatch({"x": np.arange(4), "y": np.arange(4) * 2})
+    b2 = SampleBatch({"x": np.arange(4, 6), "y": np.arange(4, 6) * 2})
+    cat = SampleBatch.concat_samples([b1, b2])
+    assert cat.count == 6
+    assert list(cat.slice(2, 4)["x"]) == [2, 3]
+    sh = cat.shuffle(np.random.RandomState(0))
+    assert sorted(sh["x"]) == list(range(6))
+    np.testing.assert_array_equal(sh["y"], sh["x"] * 2)
+
+
+def test_gae_simple_case():
+    # constant reward 1, value 0, no dones, gamma=lam=1: adv[t] = T-t + last
+    r = np.ones(4, np.float32)
+    v = np.zeros(4, np.float32)
+    d = np.zeros(4, bool)
+    adv, vt = compute_gae(r, v, d, last_value=0.0, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(adv, [4, 3, 2, 1])
+    np.testing.assert_allclose(vt, adv)
+    # terminal cuts the bootstrap
+    d2 = np.array([0, 1, 0, 0], bool)
+    adv2, _ = compute_gae(r, v, d2, last_value=100.0, gamma=1.0, lam=1.0)
+    np.testing.assert_allclose(adv2[:2], [2, 1])
+
+
+def test_policy_update_reduces_loss():
+    spec = PolicySpec(obs_dim=4, n_actions=2, hidden=(16,),
+                      num_sgd_iter=4, minibatch_size=32, lr=5e-3)
+    pol = JaxPolicy(spec, seed=0)
+    rng = np.random.RandomState(0)
+    n = 128
+    obs = rng.randn(n, 4).astype(np.float32)
+    actions, logp, vf = pol.compute_actions(obs)
+    batch = SampleBatch({
+        sb.OBS: obs, sb.ACTIONS: actions, sb.ACTION_LOGP: logp,
+        sb.ADVANTAGES: rng.randn(n).astype(np.float32),
+        sb.VALUE_TARGETS: rng.randn(n).astype(np.float32),
+    })
+    stats1 = pol.learn_on_batch(batch)
+    stats2 = pol.learn_on_batch(batch)
+    assert np.isfinite(stats1["total_loss"])
+    assert stats2["vf_loss"] < stats1["vf_loss"]  # vf regression fits
+
+
+def test_policy_weights_roundtrip():
+    spec = PolicySpec(obs_dim=4, n_actions=2, hidden=(8,))
+    p1 = JaxPolicy(spec, seed=0)
+    p2 = JaxPolicy(spec, seed=99)
+    obs = np.zeros((3, 4), np.float32)
+    p2.set_weights(p1.get_weights())
+    a1 = p1.compute_actions(obs)[2]
+    a2 = p2.compute_actions(obs)[2]
+    np.testing.assert_allclose(a1, a2, atol=1e-6)
+
+
+def test_ppo_cartpole_learns(ray_start_shared):
+    cfg = PPOConfig(
+        env="CartPole-v1", num_workers=2, num_envs_per_worker=2,
+        rollout_fragment_length=100, train_batch_size=800,
+        minibatch_size=128, num_sgd_iter=6, lr=5e-3,
+        entropy_coeff=0.0, hidden=(32, 32), seed=0)
+    algo = PPO(cfg)
+    try:
+        first = None
+        best = -np.inf
+        for i in range(12):
+            res = algo.train()
+            rmean = res["episode_reward_mean"]
+            if first is None and np.isfinite(rmean):
+                first = rmean
+            best = max(best, rmean if np.isfinite(rmean) else best)
+        # CartPole starts ~20; PPO should clearly improve within 12 iters
+        assert first is not None
+        assert best > first + 30, (first, best)
+        assert res["timesteps_total"] >= 12 * 800
+    finally:
+        algo.stop()
