@@ -1,0 +1,19 @@
+"""Distributed datasets (reference analog: python/ray/data/).
+
+Blocks are pyarrow Tables living in the object store as ObjectRefs;
+transforms build a lazy stage chain that is FUSED into one remote task
+per block at execution (the reference's ExecutionPlan stage fusion,
+data/_internal/plan.py:59,368, done eagerly-on-demand instead of via a
+separate optimizer pass).
+"""
+
+from ray_tpu.data.dataset import (Dataset, from_arrow, from_items,
+                                  from_numpy, from_pandas, range as range_,
+                                  read_csv, read_parquet)
+
+# `range` shadows the builtin only inside this namespace, as in the
+# reference's ray.data.range
+range = range_
+
+__all__ = ["Dataset", "from_items", "from_numpy", "from_pandas",
+           "from_arrow", "range", "read_parquet", "read_csv"]

@@ -1,0 +1,59 @@
+"""Block utilities.  A block is a pyarrow.Table; BlockAccessor converts
+between the user-facing batch formats (reference analog: data/block.py
+BlockAccessor — numpy/pandas/arrow interconversion, fresh impl)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Union
+
+import numpy as np
+
+
+def to_table(data) -> "pyarrow.Table":
+    import pandas as pd
+    import pyarrow as pa
+
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, pd.DataFrame):
+        return pa.Table.from_pandas(data, preserve_index=False)
+    if isinstance(data, dict):
+        return pa.table({k: np.asarray(v) for k, v in data.items()})
+    if isinstance(data, np.ndarray):
+        return pa.table({"value": data} if data.ndim == 1 else
+                        {"value": list(data)})
+    if isinstance(data, list):
+        if data and isinstance(data[0], dict):
+            cols: Dict[str, List[Any]] = {}
+            for row in data:
+                for k, v in row.items():
+                    cols.setdefault(k, []).append(v)
+            return pa.table(cols)
+        return pa.table({"value": data})
+    raise TypeError(f"cannot make a block from {type(data)}")
+
+
+def format_batch(table, batch_format: str):
+    if batch_format in ("pyarrow", "arrow"):
+        return table
+    if batch_format == "pandas":
+        return table.to_pandas()
+    if batch_format in ("numpy", "dict", "default"):
+        return {name: col.to_numpy(zero_copy_only=False)
+                for name, col in zip(table.column_names, table.columns)}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def num_rows(table) -> int:
+    return table.num_rows
+
+
+def concat_tables(tables):
+    import pyarrow as pa
+
+    tables = [t for t in tables if t.num_rows]
+    if not tables:
+        import pyarrow as pa
+
+        return pa.table({})
+    return pa.concat_tables(tables)

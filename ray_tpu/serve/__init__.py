@@ -1,0 +1,10 @@
+"""Model serving on actors (reference analog: python/ray/serve/)."""
+
+from ray_tpu.serve.api import (Deployment, delete, deployment,
+                               get_deployment_handle, run, shutdown,
+                               start_http_proxy)
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
+           "DeploymentHandle", "get_deployment_handle",
+           "start_http_proxy"]

@@ -1,0 +1,126 @@
+"""Public serve API (reference analog: serve/api.py:251-277
+@serve.deployment, :455 serve.run)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Union
+
+import cloudpickle
+
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+_PROXY_NAME = "SERVE_HTTP_PROXY"
+
+
+@dataclasses.dataclass
+class Deployment:
+    func_or_class: Union[Callable, type]
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Optional[Dict[str, Any]] = None
+    max_concurrent_queries: int = 8
+    route_prefix: Optional[str] = None
+    init_args: tuple = ()
+    init_kwargs: Optional[Dict[str, Any]] = None
+
+    def bind(self, *args, **kwargs) -> "Deployment":
+        return dataclasses.replace(self, init_args=args,
+                                   init_kwargs=kwargs)
+
+    def options(self, **kwargs) -> "Deployment":
+        return dataclasses.replace(self, **kwargs)
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               max_concurrent_queries: int = 8,
+               route_prefix: Optional[str] = None):
+    """@serve.deployment decorator."""
+
+    def wrap(target):
+        return Deployment(
+            target, name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            ray_actor_options=ray_actor_options,
+            max_concurrent_queries=max_concurrent_queries,
+            route_prefix=route_prefix)
+
+    return wrap(_func_or_class) if _func_or_class is not None else wrap
+
+
+def _get_or_create_controller():
+    import ray_tpu
+
+    ray_tpu._auto_init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:  # noqa: BLE001 - not created yet
+        return ray_tpu.remote(num_cpus=0.1, lifetime="detached",
+                              name=CONTROLLER_NAME, max_concurrency=16)(
+            ServeController).remote()
+
+
+def run(target: Deployment, *, route_prefix: Optional[str] = None,
+        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+    """Deploy and return a handle (reference serve.run, serve/api.py:455).
+    With http=True an aiohttp ingress proxy is started as well."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    prefix = route_prefix or target.route_prefix or \
+        (f"/{target.name}" if http else None)
+    ray_tpu.get(controller.deploy.remote(
+        target.name, cloudpickle.dumps(target.func_or_class),
+        target.init_args, target.init_kwargs or {},
+        num_replicas=target.num_replicas,
+        ray_actor_options=target.ray_actor_options,
+        max_concurrent_queries=target.max_concurrent_queries,
+        route_prefix=prefix), timeout=120)
+    if http:
+        start_http_proxy(port=http_port)
+    return DeploymentHandle(target.name, controller)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_or_create_controller())
+
+
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1") -> str:
+    import ray_tpu
+    from ray_tpu.serve.http_proxy import HTTPProxyActor
+
+    controller = _get_or_create_controller()
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+    except Exception:  # noqa: BLE001
+        proxy = ray_tpu.remote(num_cpus=0.1, lifetime="detached",
+                               name=_PROXY_NAME)(HTTPProxyActor).remote(
+            controller, host, port)
+    ray_tpu.get(proxy.ping.remote(), timeout=60)
+    return ray_tpu.get(proxy.address.remote(), timeout=30)
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    import ray_tpu
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote(), timeout=60)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        proxy = ray_tpu.get_actor(_PROXY_NAME)
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,132 @@
+"""ServeController: the serve control plane, one named detached actor.
+
+Reference analog: serve/controller.py:61 ServeController (:410
+deploy_app) + _private/deployment_state.py reconciliation.  Owns desired
+deployment state, creates/updates replica actors, repairs dead replicas
+(background reconcile thread), and hands routing tables to handles —
+the pull-based stand-in for the reference's LongPollHost push channel
+(serve/_private/long_poll.py:184).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        # name -> {config, replicas: [ActorHandle], version}
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+        self.routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._lock = threading.Lock()
+        self._stop = False
+        self._reconciler = threading.Thread(target=self._reconcile_loop,
+                                            daemon=True,
+                                            name="serve_reconcile")
+        self._reconciler.start()
+
+    # -- deploy path ------------------------------------------------------
+    def deploy(self, name: str, serialized_def: bytes, init_args: tuple,
+               init_kwargs: Dict[str, Any], *, num_replicas: int = 1,
+               ray_actor_options: Optional[Dict[str, Any]] = None,
+               max_concurrent_queries: int = 8,
+               route_prefix: Optional[str] = None) -> bool:
+        with self._lock:
+            old = self.deployments.get(name)
+            cfg = {"serialized_def": serialized_def,
+                   "init_args": init_args, "init_kwargs": init_kwargs,
+                   "num_replicas": num_replicas,
+                   "actor_options": ray_actor_options or {},
+                   "max_concurrent_queries": max_concurrent_queries}
+            version = (old["version"] + 1) if old else 1
+            replicas = [self._start_replica(name, cfg)
+                        for _ in range(num_replicas)]
+            self.deployments[name] = {"config": cfg, "replicas": replicas,
+                                      "version": version}
+            if route_prefix:
+                self.routes[route_prefix] = name
+            if old:
+                for r in old["replicas"]:
+                    self._kill_replica(r)
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            dep = self.deployments.pop(name, None)
+            self.routes = {p: d for p, d in self.routes.items()
+                           if d != name}
+        if dep:
+            for r in dep["replicas"]:
+                self._kill_replica(r)
+        return dep is not None
+
+    def _start_replica(self, name: str, cfg: Dict[str, Any]):
+        import ray_tpu
+        from ray_tpu.serve.replica import RayServeReplica
+
+        opts = dict(cfg["actor_options"])
+        opts.setdefault("num_cpus", 0.1)
+        opts["max_concurrency"] = cfg["max_concurrent_queries"]
+        return ray_tpu.remote(**opts)(RayServeReplica).remote(
+            cfg["serialized_def"], cfg["init_args"], cfg["init_kwargs"],
+            name)
+
+    def _kill_replica(self, replica) -> None:
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- routing ----------------------------------------------------------
+    def get_replicas(self, name: str) -> List:
+        with self._lock:
+            dep = self.deployments.get(name)
+            return list(dep["replicas"]) if dep else []
+
+    def get_routing_table(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"routes": dict(self.routes),
+                    "versions": {n: d["version"]
+                                 for n, d in self.deployments.items()}}
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return sorted(self.deployments)
+
+    # -- reconciliation ---------------------------------------------------
+    def _reconcile_loop(self):
+        import ray_tpu
+
+        while not self._stop:
+            time.sleep(2.0)
+            with self._lock:
+                deps = {n: list(d["replicas"])
+                        for n, d in self.deployments.items()}
+            for name, replicas in deps.items():
+                for r in replicas:
+                    try:
+                        ray_tpu.get(r.ping.remote(), timeout=5)
+                    except Exception:  # noqa: BLE001 - replica dead
+                        with self._lock:
+                            dep = self.deployments.get(name)
+                            if dep is None or r not in dep["replicas"]:
+                                continue
+                            dep["replicas"].remove(r)
+                            try:
+                                dep["replicas"].append(
+                                    self._start_replica(name,
+                                                        dep["config"]))
+                            except Exception:  # noqa: BLE001
+                                pass
+
+    def shutdown(self) -> bool:
+        self._stop = True
+        for name in list(self.deployments):
+            self.delete_deployment(name)
+        return True

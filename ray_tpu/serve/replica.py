@@ -1,0 +1,43 @@
+"""Replica actor: hosts one copy of a deployment's callable.
+
+Reference analog: serve/_private/replica.py:250 RayServeReplica (:494
+handle_request).  The user object is constructed once per replica; sync
+callables run on the actor's concurrency slots (max_concurrency >1 gives
+intra-replica parallelism, the analog of max_concurrent_queries).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+
+class RayServeReplica:
+    def __init__(self, serialized_def: bytes, init_args: tuple,
+                 init_kwargs: Dict[str, Any], deployment_name: str):
+        target = cloudpickle.loads(serialized_def)
+        self.deployment_name = deployment_name
+        if isinstance(target, type):
+            self.callable = target(*init_args, **init_kwargs)
+        else:
+            self.callable = target
+        self.num_requests = 0
+        self.started_at = time.time()
+
+    def handle_request(self, *args, _serve_method: str = "__call__",
+                       **kwargs):
+        self.num_requests += 1
+        fn = self.callable if _serve_method == "__call__" and \
+            callable(self.callable) else getattr(self.callable,
+                                                 _serve_method)
+        return fn(*args, **kwargs)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"deployment": self.deployment_name,
+                "num_requests": self.num_requests,
+                "uptime_s": time.time() - self.started_at}
+
+    def ping(self) -> bool:
+        return True
