@@ -70,12 +70,19 @@ def init(address: Optional[str] = None, *,
         from ray_tpu._private.node import Node
 
         if address:
-            raise NotImplementedError(
-                "multi-node driver attach lands with the cluster CLI; "
-                "round-1 drivers bootstrap their own head node")
-        node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
-                    resources=resources,
-                    object_store_memory=object_store_memory, config=config)
+            # Attach to an existing cluster: the driver brings up its own
+            # worker node (local store + node manager) registered with the
+            # remote GCS — so it always has a local object store and lease
+            # target, and its tasks spill to the rest of the cluster.
+            node = Node(head=False, num_cpus=num_cpus,
+                        num_tpus=num_tpus, resources=resources,
+                        object_store_memory=object_store_memory,
+                        config=config, gcs_address=address)
+        else:
+            node = Node(head=True, num_cpus=num_cpus, num_tpus=num_tpus,
+                        resources=resources,
+                        object_store_memory=object_store_memory,
+                        config=config)
         node.start()
         cw = CoreWorker(
             gcs_address=node.gcs_address,

@@ -557,6 +557,20 @@ class CoreWorker:
                 payload["pg_id"] = state.pg[0]
                 payload["bundle_index"] = state.pg[1]
             lease = await self.nm.call("request_worker_lease", payload)
+            # Spillback: local node can't fit the shape — re-lease at the
+            # node the scheduler pointed us to (reference:
+            # direct_task_transport.cc:473 retry at raylet address).
+            hops = 0
+            while isinstance(lease, dict) and lease.get("spillback"):
+                addr = lease["spillback"]
+                hops += 1
+                if hops > 4:
+                    raise RuntimeError("spillback loop; cluster resources "
+                                       "changing too fast")
+                nm = await self._worker_conn(addr)
+                lease = await nm.call("request_worker_lease", payload)
+                if not lease.get("spillback"):
+                    lease["nm_addr"] = addr
             state.workers.append(lease)
             self._dispatch(skey, state)
         except Exception as e:  # noqa: BLE001 - fail queued tasks
@@ -630,8 +644,10 @@ class CoreWorker:
         while state.workers and not state.queue:
             lease = state.workers.pop()
             try:
-                await self.nm.call("return_worker",
-                                   {"lease_id": lease["lease_id"]})
+                nm = self.nm if not lease.get("nm_addr") else \
+                    await self._worker_conn(lease["nm_addr"])
+                await nm.call("return_worker",
+                              {"lease_id": lease["lease_id"]})
             except Exception:  # noqa: BLE001
                 pass
 
@@ -640,9 +656,27 @@ class CoreWorker:
             oid = ret["oid"]
             if "d" in ret:
                 self._store_local(oid, ret["d"], bool(ret.get("err")))
+                continue
+            node = ret.get("node", "")
+            if node and node != self.node_address:
+                # Large return lives in a REMOTE node's store: have our
+                # node manager pull it across before waking getters
+                # (reference: ObjectManager pull, pull_manager.h:48).
+                asyncio.get_running_loop().create_task(
+                    self._pull_return(oid, node))
             else:
                 # Large return living in shm; wake blocked getters.
                 self._ensure_entry(oid).put_in_store()
+
+    async def _pull_return(self, oid: bytes, node_addr: str):
+        try:
+            await self.nm.call("pull_object", {
+                "oid": oid, "owner": b"",
+                "owner_node_address": node_addr})
+            self._ensure_entry(oid).put_in_store()
+        except Exception as e:  # noqa: BLE001 - surfaced by get() timeout
+            logger.warning("cross-node return pull failed for %s: %s",
+                           oid.hex()[:16], e)
 
     # ---- actors ----------------------------------------------------------
 

@@ -322,6 +322,26 @@ class GcsServer:
                                                             RESTARTING):
                 await self._handle_actor_failure(actor, "node died")
 
+    async def rpc_pick_node_for_lease(self, conn, payload):
+        """Spillback target selection: a node manager that cannot fit a
+        lease locally asks where the shape IS feasible (reference:
+        hybrid_scheduling_policy.cc:139 Schedule + the Spillback reply in
+        node_manager.cc HandleRequestWorkerLease)."""
+        exclude = payload.get("exclude", b"")
+        resources = payload["resources"]
+        candidates = [n for n in self.nodes.values()
+                      if n.alive and n.node_id != exclude and all(
+                          n.resources_total.get(k, 0.0) >= v
+                          for k, v in resources.items())]
+        if not candidates:
+            return None
+        free = [n for n in candidates if all(
+            n.resources_available.get(k, 0.0) >= v
+            for k, v in resources.items())]
+        pool = free or candidates
+        best = max(pool, key=lambda n: sum(n.resources_available.values()))
+        return {"node_id": best.node_id, "address": best.address}
+
     # ---- actors ----------------------------------------------------------
 
     def _pick_node(self, resources: Dict[str, float],

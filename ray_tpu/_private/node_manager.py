@@ -393,6 +393,19 @@ class NodeManager:
         if rset is None:
             raise ValueError("unknown placement group bundle")
         if not rset.feasible(resources):
+            if bundle is None:
+                # Spillback: point the submitter at a node where the
+                # shape fits (reference: the Spillback reply with
+                # retry_at_raylet_address, direct_task_transport.cc:473).
+                try:
+                    pick = await self.gcs_conn.call(
+                        "pick_node_for_lease",
+                        {"resources": resources,
+                         "exclude": self.node_id.binary()}, timeout=10.0)
+                except Exception:  # noqa: BLE001 - GCS unreachable
+                    pick = None
+                if pick is not None:
+                    return {"spillback": pick["address"]}
             raise ValueError(
                 f"infeasible resource request {resources}; node has "
                 f"{rset.total}")

@@ -161,7 +161,10 @@ class WorkerServer:
                     out.append({"oid": oid.binary(), "d": ser.to_bytes()})
                 else:
                     self.cw._put_shm(oid, ser)
-                    out.append({"oid": oid.binary(), "in_store": True})
+                    # carry the executing node's address: a cross-node
+                    # submitter must pull the object to its own store
+                    out.append({"oid": oid.binary(), "in_store": True,
+                                "node": self.cw.node_address})
             return out
         except Exception as e:  # noqa: BLE001 - user code raised
             tb = traceback.format_exc()

@@ -1,0 +1,166 @@
+"""Cluster CLI (reference analog: python/ray/scripts/scripts.py —
+`ray start/stop/status/...`).  Run as `python -m ray_tpu <cmd>`.
+
+`start --head` runs GCS + a node manager in the foreground (daemonize
+with --block=false + nohup/systemd as you prefer); `start --address`
+joins an existing head; `status` prints the cluster resource summary;
+`stop` kills nodes started on this host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_ADDR_FILE = "/tmp/raytpu/ray_current_cluster"
+_PID_DIR = "/tmp/raytpu/pids"
+
+
+def _write_pidfile(role: str) -> str:
+    os.makedirs(_PID_DIR, exist_ok=True)
+    path = os.path.join(_PID_DIR, f"{role}-{os.getpid()}.pid")
+    with open(path, "w") as f:
+        f.write(str(os.getpid()))
+    return path
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private.config import Config
+    from ray_tpu._private.node import Node
+
+    config = Config().apply_env()
+    if args.head:
+        gcs_address = f"{args.host}:{args.port}"
+        node = Node(head=True, num_cpus=args.num_cpus,
+                    num_tpus=args.num_tpus,
+                    object_store_memory=args.object_store_memory,
+                    config=config, gcs_address=gcs_address)
+        node.start()
+        os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
+        with open(_ADDR_FILE, "w") as f:
+            f.write(node.gcs_address)
+        print(f"head started; GCS at {node.gcs_address}")
+        print(f"attach drivers with ray_tpu.init("
+              f"address={node.gcs_address!r})")
+        print(f"join workers with: python -m ray_tpu start "
+              f"--address {node.gcs_address}")
+    else:
+        address = args.address or _read_addr()
+        if not address:
+            print("--address required (no local cluster found)",
+                  file=sys.stderr)
+            return 1
+        node = Node(head=False, num_cpus=args.num_cpus,
+                    num_tpus=args.num_tpus,
+                    object_store_memory=args.object_store_memory,
+                    config=config, gcs_address=address)
+        node.start()
+        print(f"node {node.node_id.hex()[:12]} joined {address}")
+
+    pidfile = _write_pidfile("head" if args.head else "node")
+    stop = {"flag": False}
+
+    def _sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _sig)
+    signal.signal(signal.SIGINT, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        node.stop()
+        for p in (pidfile, _ADDR_FILE if args.head else None):
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+    return 0
+
+
+def _read_addr() -> str:
+    try:
+        with open(_ADDR_FILE) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def cmd_stop(_args) -> int:
+    n = 0
+    if os.path.isdir(_PID_DIR):
+        for name in os.listdir(_PID_DIR):
+            try:
+                pid = int(open(os.path.join(_PID_DIR, name)).read())
+                os.kill(pid, signal.SIGTERM)
+                n += 1
+            except (OSError, ValueError):
+                pass
+            try:
+                os.unlink(os.path.join(_PID_DIR, name))
+            except OSError:
+                pass
+    print(f"signalled {n} node process(es)")
+    return 0
+
+
+def cmd_status(args) -> int:
+    import ray_tpu
+
+    address = args.address or _read_addr()
+    if not address:
+        print("no cluster address (start one or pass --address)",
+              file=sys.stderr)
+        return 1
+    ray_tpu.init(address=address, num_cpus=0, num_tpus=0)
+    try:
+        nodes = ray_tpu.nodes()
+        total = ray_tpu.cluster_resources()
+        avail = ray_tpu.available_resources()
+        print(f"{len(nodes)} node(s) @ {address}")
+        for n in nodes:
+            print(f"  {n['NodeID'][:12]} alive={n['Alive']} "
+                  f"total={n['Resources']}")
+        print("cluster totals:", json.dumps(total))
+        print("available:   ", json.dumps(avail))
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu", description="TPU-native distributed runtime CLI")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start a head or worker node")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--address", default="")
+    p_start.add_argument("--host", default="0.0.0.0")
+    p_start.add_argument("--port", type=int, default=6380)
+    p_start.add_argument("--num-cpus", type=int, default=None,
+                         dest="num_cpus")
+    p_start.add_argument("--num-tpus", type=int, default=None,
+                         dest="num_tpus")
+    p_start.add_argument("--object-store-memory", type=int, default=None,
+                         dest="object_store_memory")
+    p_start.set_defaults(fn=cmd_start)
+
+    p_stop = sub.add_parser("stop", help="stop nodes on this host")
+    p_stop.set_defaults(fn=cmd_stop)
+
+    p_status = sub.add_parser("status", help="cluster resource summary")
+    p_status.add_argument("--address", default="")
+    p_status.set_defaults(fn=cmd_status)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""CLI end-to-end: start a real head process, join a worker node
+process, attach a driver, run a task across them, status, stop."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn(args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu", *args], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+
+def _wait_line(proc, needle, timeout=30):
+    deadline = time.monotonic() + timeout
+    lines = []
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            time.sleep(0.05)
+            continue
+        lines.append(line)
+        if needle in line:
+            return line
+    raise AssertionError(f"{needle!r} not seen in: {lines}")
+
+
+def test_cli_cluster_end_to_end(tmp_path):
+    head = _spawn(["start", "--head", "--host", "127.0.0.1", "--port",
+                   "0", "--num-cpus", "0", "--num-tpus", "0"])
+    worker = None
+    try:
+        line = _wait_line(head, "GCS at ")
+        address = line.strip().split("GCS at ")[-1]
+        worker = _spawn(["start", "--address", address, "--num-cpus",
+                         "2", "--num-tpus", "0"])
+        _wait_line(worker, "joined")
+
+        ray_tpu.init(address=address, num_cpus=0, num_tpus=0)
+        try:
+            @ray_tpu.remote(num_cpus=1)
+            def f(x):
+                return x * 2
+
+            assert ray_tpu.get([f.remote(21)], timeout=60)[0] == 42
+            nodes = [n for n in ray_tpu.nodes() if n.get("Alive")]
+            assert len(nodes) >= 3  # head + worker + driver's node
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        for p in (worker, head):
+            if p is not None:
+                p.send_signal(signal.SIGTERM)
+        for p in (worker, head):
+            if p is not None:
+                try:
+                    p.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    p.kill()
