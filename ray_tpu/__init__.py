@@ -74,8 +74,13 @@ def init(address: Optional[str] = None, *,
             # worker node (local store + node manager) registered with the
             # remote GCS — so it always has a local object store and lease
             # target, and its tasks spill to the rest of the cluster.
-            node = Node(head=False, num_cpus=num_cpus,
-                        num_tpus=num_tpus, resources=resources,
+            # Attaching drivers contribute NO schedulable capacity by
+            # default (their host isn't cluster hardware and dies with
+            # them); pass num_cpus/num_tpus explicitly to opt in.
+            node = Node(head=False,
+                        num_cpus=0 if num_cpus is None else num_cpus,
+                        num_tpus=0 if num_tpus is None else num_tpus,
+                        resources=resources,
                         object_store_memory=object_store_memory,
                         config=config, gcs_address=address)
         else:

@@ -47,12 +47,16 @@ class Dataset:
 
     def materialize(self) -> "Dataset":
         """Execute pending stages: one fused task per block (the stage-
-        fusion property: N stages do NOT mean N tasks per block)."""
+        fusion property: N stages do NOT mean N tasks per block).  The
+        result is cached in place, so repeated consumption (count() then
+        iter_batches(), ...) never re-runs the pipeline."""
         if not self._stages:
             return self
         refs = [_run_stages.remote(b, self._stages)
                 for b in self._block_refs]
-        return Dataset(refs)
+        self._block_refs = refs
+        self._stages = []
+        return self
 
     def _tables(self) -> List:
         ds = self.materialize()
@@ -166,10 +170,12 @@ class Dataset:
         return [r for t in self._tables() for r in t.to_pylist()]
 
     def schema(self):
-        ds = self.materialize()
-        if not ds._block_refs:
+        if not self._block_refs:
             return None
-        return ray_tpu.get([ds._block_refs[0]], timeout=60)[0].schema
+        if self._stages:  # run the fused pipeline on ONE block only
+            ref = _run_stages.remote(self._block_refs[0], self._stages)
+            return ray_tpu.get([ref], timeout=60)[0].schema
+        return ray_tpu.get([self._block_refs[0]], timeout=60)[0].schema
 
     @property
     def num_blocks(self) -> int:

@@ -144,13 +144,14 @@ class JaxPolicy:
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def update(params, opt_state, batch, rng):
             n = batch[sb.OBS].shape[0]
-            n_mb = max(1, n // mb)
-            usable = n_mb * mb
+            mb_eff = min(mb, n)  # batches smaller than one minibatch
+            n_mb = max(1, n // mb_eff)
+            usable = n_mb * mb_eff
 
             def epoch(carry, key):
                 params, opt_state = carry
                 perm = jax.random.permutation(key, n)[:usable]
-                idx = perm.reshape(n_mb, mb)
+                idx = perm.reshape(n_mb, mb_eff)
 
                 def mb_step(carry, rows):
                     params, opt_state = carry

@@ -70,9 +70,15 @@ class RolloutWorker:
                 o2, r, term, trunc, _ = env.step(int(actions[i]))
                 rew_buf[t, i] = r
                 self._ep_rewards[i] += r
-                done = term or trunc
-                done_buf[t, i] = term  # bootstraps through truncation
-                if done:
+                if trunc and not term:
+                    # truncation: bootstrap with V of the PRE-reset state
+                    # folded into the reward, then cut the GAE chain —
+                    # otherwise the next episode's reset value leaks in
+                    v_boot = float(self.policy.value(
+                        np.asarray(o2, np.float32)[None])[0])
+                    rew_buf[t, i] += self.gamma * v_boot
+                done_buf[t, i] = term or trunc
+                if term or trunc:
                     self.episode_returns.append(self._ep_rewards[i])
                     self._ep_rewards[i] = 0.0
                     o2 = env.reset()[0]

@@ -33,8 +33,10 @@ class DeploymentHandle:
         self._replicas = ray_tpu.get(
             self._controller.get_replicas.remote(self.deployment_name),
             timeout=30)
-        self._inflight = {r: self._inflight.get(r, 0)
-                          for r in self._replicas}
+        # reset the load counters each refresh window: they approximate
+        # RECENT load for the power-of-two picker, not lifetime totals
+        # (which would flood any freshly restarted replica)
+        self._inflight = {r: 0 for r in self._replicas}
         self._fetched_at = time.monotonic()
 
     def _pick(self):

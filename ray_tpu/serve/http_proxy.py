@@ -22,6 +22,8 @@ class HTTPProxyActor:
         self.port = port
         self._handles: Dict[str, Any] = {}
         self._routes: Dict[str, str] = {}
+        self._routes_at = 0.0
+        self._routes_ttl = 2.0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True,
@@ -30,11 +32,17 @@ class HTTPProxyActor:
         self._started.wait(timeout=30)
 
     def _refresh_routes(self):
+        """Blocking controller round trip — call off the event loop."""
+        import time
+
         import ray_tpu
 
+        if time.monotonic() - self._routes_at < self._routes_ttl:
+            return
         table = ray_tpu.get(
             self._controller.get_routing_table.remote(), timeout=30)
         self._routes = table["routes"]
+        self._routes_at = time.monotonic()
 
     def _handle_for(self, deployment: str):
         from ray_tpu.serve.handle import DeploymentHandle
@@ -53,7 +61,7 @@ class HTTPProxyActor:
 
         async def dispatch(request: "web.Request") -> "web.Response":
             path = "/" + request.match_info.get("tail", "")
-            self._refresh_routes()
+            await loop.run_in_executor(None, self._refresh_routes)
             target = None
             for prefix, dep in sorted(self._routes.items(),
                                       key=lambda kv: -len(kv[0])):

@@ -1,0 +1,49 @@
+"""Regression tests for the multi-layer review findings (batch 2)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def test_ppo_update_with_batch_smaller_than_minibatch():
+    spec = PolicySpec(obs_dim=4, n_actions=2, hidden=(8,),
+                      num_sgd_iter=2, minibatch_size=128)
+    pol = JaxPolicy(spec, seed=0)
+    rng = np.random.RandomState(0)
+    n = 40  # < minibatch_size
+    obs = rng.randn(n, 4).astype(np.float32)
+    actions, logp, _ = pol.compute_actions(obs)
+    stats = pol.learn_on_batch(SampleBatch({
+        sb.OBS: obs, sb.ACTIONS: actions, sb.ACTION_LOGP: logp,
+        sb.ADVANTAGES: rng.randn(n).astype(np.float32),
+        sb.VALUE_TARGETS: rng.randn(n).astype(np.float32)}))
+    assert np.isfinite(stats["total_loss"])
+
+
+def test_dataset_materialization_cached(ray_start_shared, tmp_path):
+    marker = str(tmp_path / "runs")
+
+    def stage(batch, _marker=marker):
+        with open(_marker, "a") as f:
+            f.write("x")
+        return batch
+
+    ds = rdata.range(20, parallelism=2).map_batches(stage)
+    assert ds.count() == 20
+    assert len(ds.take_all()) == 20  # second consumption: no re-run
+    with open(marker) as f:
+        assert len(f.read()) == 2  # once per block, once total
+
+
+def test_init_address_defaults_to_zero_capacity(ray_start_shared):
+    """Attach-mode zero-capacity default is asserted end-to-end in
+    tests/test_multinode.py (head has no CPUs; tasks never land on the
+    driver's node).  Local mode keeps full requested capacity:"""
+    assert ray_tpu.cluster_resources().get("CPU", 0) == 4.0
