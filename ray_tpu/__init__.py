@@ -28,8 +28,16 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ActorHandle", "method",
-    "get_runtime_context", "exceptions", "__version__",
+    "get_runtime_context", "exceptions", "timeline", "__version__",
 ]
+
+
+def timeline(filename=None):
+    """Chrome-trace dump of finished task events (reference:
+    ray.timeline, python/ray/_private/state.py:413)."""
+    from ray_tpu.util.state import timeline as _timeline
+
+    return _timeline(filename)
 
 logger = logging.getLogger(__name__)
 _init_lock = threading.Lock()

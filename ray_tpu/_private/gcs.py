@@ -132,6 +132,8 @@ class GcsServer:
         self.actors: Dict[bytes, ActorInfo] = {}
         self.named_actors: Dict[str, bytes] = {}
         self.placement_groups: Dict[bytes, PlacementGroupInfo] = {}
+        self.task_events: List[dict] = []
+        self.max_task_events = 20000
         self.named_pgs: Dict[str, bytes] = {}
         self._job_counter = 0
         self._subscribers: Dict[str, Set[protocol.Connection]] = {}
@@ -321,6 +323,19 @@ class GcsServer:
             if actor.node_id == node_id and actor.state in (ALIVE, PENDING_CREATION,
                                                             RESTARTING):
                 await self._handle_actor_failure(actor, "node died")
+
+    async def rpc_task_events_report(self, conn, payload):
+        """Profile-event sink (reference: profile events flow into the GCS
+        for ray.timeline, core_worker/profiling.cc)."""
+        self.task_events.extend(payload["events"])
+        if len(self.task_events) > self.max_task_events:
+            del self.task_events[:len(self.task_events)
+                                 - self.max_task_events // 2]
+        return True
+
+    async def rpc_task_events_list(self, conn, payload):
+        limit = payload.get("limit", 10000)
+        return self.task_events[-limit:]
 
     async def rpc_pick_node_for_lease(self, conn, payload):
         """Spillback target selection: a node manager that cannot fit a

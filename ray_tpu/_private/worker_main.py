@@ -20,6 +20,7 @@ import logging
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
@@ -81,6 +82,9 @@ class WorkerServer:
                                             thread_name_prefix="task-exec")
         self.actor = ActorState()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Profile events buffered off the hot path, flushed to GCS by a
+        # background task (reference: core_worker/profiling.cc batches).
+        self._events: list = []
 
     async def run(self):
         self._loop = asyncio.get_running_loop()
@@ -119,7 +123,23 @@ class WorkerServer:
                 {"worker_id": self.worker_id.binary(),
                  "address": self.address})))
         self.cw.nm.on_close = lambda conn: os._exit(1)
+        self._loop.create_task(self._flush_events_loop())
         await asyncio.Event().wait()  # serve forever
+
+    async def _flush_events_loop(self):
+        while True:
+            await asyncio.sleep(1.0)
+            if not self._events:
+                continue
+            batch, self._events = self._events, []
+            try:
+                await asyncio.wrap_future(
+                    asyncio.run_coroutine_threadsafe(
+                        self.cw.gcs.call("task_events_report",
+                                         {"events": batch}),
+                        self.cw.io.loop))
+            except Exception:  # noqa: BLE001 - drop events, never crash
+                pass
 
     # ---- helpers ---------------------------------------------------------
 
@@ -142,6 +162,11 @@ class WorkerServer:
                        for i in range(num_returns)]
         # Thread-local so concurrent actor threads don't clobber each other.
         worker_context.set_task_context(task_id, spec.get("actor_id", b""))
+        ev = {"task_id": task_id.hex(), "name": spec.get("name", "")
+              or spec.get("method", "task"),
+              "worker_id": self.worker_id.hex()[:16], "pid": os.getpid(),
+              "actor_id": spec.get("actor_id", b"").hex(),
+              "start": time.time()}
         try:
             args = [self._resolve_arg(a) for a in spec["args"]]
             kwargs = {k: self._resolve_arg(v)
@@ -175,6 +200,10 @@ class WorkerServer:
                     for oid in return_oids]
         finally:
             worker_context.set_task_context(b"", b"")
+            ev["end"] = time.time()
+            self._events.append(ev)
+            if len(self._events) > 10000:  # cap: drop oldest half
+                del self._events[:5000]
 
     # ---- rpc: normal tasks ----------------------------------------------
 

@@ -1,0 +1,6 @@
+"""Dashboard-lite (reference analog: dashboard/ head + modules): a JSON
+state API + Prometheus metrics endpoint over aiohttp."""
+
+from ray_tpu.dashboard.app import start_dashboard
+
+__all__ = ["start_dashboard"]

@@ -1,0 +1,122 @@
+"""Dashboard server: cluster state as JSON + Prometheus text metrics.
+
+Reference analog: dashboard/head.py:62 DashboardHead (+ the metrics
+agent's Prometheus re-export, _private/metrics_agent.py:93).  One aiohttp
+server inside a detached actor:
+
+  GET /api/nodes | /api/actors | /api/tasks | /api/placement_groups
+  GET /api/summary
+  GET /metrics          (Prometheus text format)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+DASHBOARD_NAME = "RAYTPU_DASHBOARD"
+
+
+class DashboardActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="dashboard")
+        self._thread.start()
+        self._started.wait(timeout=30)
+
+    def _state(self):
+        from ray_tpu.util import state
+
+        return state
+
+    def _metrics_text(self) -> str:
+        from ray_tpu.util import state
+
+        lines = []
+        nodes = state.list_nodes()
+        lines.append("# TYPE raytpu_nodes gauge")
+        lines.append(f"raytpu_nodes {sum(n['alive'] for n in nodes)}")
+        for n in nodes:
+            nid = n["node_id"][:12]
+            for res, total in n["resources"].items():
+                avail = n["available"].get(res, 0.0)
+                name = res.lower().replace("-", "_")
+                lines.append(
+                    f'raytpu_resource_total{{node="{nid}",resource='
+                    f'"{name}"}} {total}')
+                lines.append(
+                    f'raytpu_resource_available{{node="{nid}",resource='
+                    f'"{name}"}} {avail}')
+        actors = state.summarize_actors()
+        lines.append("# TYPE raytpu_actors gauge")
+        for st, count in actors["by_state"].items():
+            lines.append(f'raytpu_actors{{state="{st}"}} {count}')
+        tasks = state.summarize_tasks()
+        lines.append("# TYPE raytpu_tasks_finished_total counter")
+        lines.append(f"raytpu_tasks_finished_total {tasks['total']}")
+        lines.append("# TYPE raytpu_task_execution_seconds_total counter")
+        lines.append(f"raytpu_task_execution_seconds_total "
+                     f"{tasks['total_execution_s']}")
+        return "\n".join(lines) + "\n"
+
+    def _serve(self):
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        state = self._state()
+
+        def j(fn):
+            async def handler(_req):
+                data = await loop.run_in_executor(None, fn)
+                return web.json_response(data)
+
+            return handler
+
+        async def metrics(_req):
+            text = await loop.run_in_executor(None, self._metrics_text)
+            return web.Response(text=text,
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/api/nodes", j(state.list_nodes))
+        app.router.add_get("/api/actors", j(state.list_actors))
+        app.router.add_get("/api/tasks", j(state.list_tasks))
+        app.router.add_get("/api/placement_groups",
+                           j(state.list_placement_groups))
+        app.router.add_get("/api/summary", j(lambda: {
+            "tasks": state.summarize_tasks(),
+            "actors": state.summarize_actors(),
+            "nodes": len(state.list_nodes())}))
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self.host, self.port)
+        loop.run_until_complete(site.start())
+        self._started.set()
+        loop.run_forever()
+
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def ping(self) -> bool:
+        return self._started.is_set()
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1") -> str:
+    """Start (or find) the dashboard actor; returns its URL."""
+    import ray_tpu
+
+    ray_tpu._auto_init()
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_NAME)
+    except Exception:  # noqa: BLE001
+        actor = ray_tpu.remote(num_cpus=0.1, lifetime="detached",
+                               name=DASHBOARD_NAME)(DashboardActor).remote(
+            host, port)
+    ray_tpu.get(actor.ping.remote(), timeout=60)
+    return ray_tpu.get(actor.address.remote(), timeout=30)

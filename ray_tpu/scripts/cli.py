@@ -158,8 +158,72 @@ def main(argv=None) -> int:
     p_status.add_argument("--address", default="")
     p_status.set_defaults(fn=cmd_status)
 
+    p_list = sub.add_parser(
+        "list", help="list cluster state: actors|nodes|tasks|pgs")
+    p_list.add_argument("kind",
+                        choices=["actors", "nodes", "tasks", "pgs"])
+    p_list.add_argument("--address", default="")
+    p_list.set_defaults(fn=cmd_list)
+
+    p_sum = sub.add_parser("summary", help="task/actor summaries")
+    p_sum.add_argument("--address", default="")
+    p_sum.set_defaults(fn=cmd_summary)
+
+    p_tl = sub.add_parser("timeline",
+                          help="dump chrome-trace of task events")
+    p_tl.add_argument("--address", default="")
+    p_tl.add_argument("--out", default="timeline.json")
+    p_tl.set_defaults(fn=cmd_timeline)
+
     args = parser.parse_args(argv)
     return args.fn(args)
+
+
+def _attached(args):
+    import contextlib
+
+    import ray_tpu
+
+    @contextlib.contextmanager
+    def ctx():
+        address = args.address or _read_addr()
+        if not address:
+            raise SystemExit("no cluster address; pass --address")
+        ray_tpu.init(address=address)
+        try:
+            yield
+        finally:
+            ray_tpu.shutdown()
+
+    return ctx()
+
+
+def cmd_list(args) -> int:
+    from ray_tpu.util import state
+
+    fns = {"actors": state.list_actors, "nodes": state.list_nodes,
+           "tasks": state.list_tasks, "pgs": state.list_placement_groups}
+    with _attached(args):
+        print(json.dumps(fns[args.kind](), indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    from ray_tpu.util import state
+
+    with _attached(args):
+        print(json.dumps({"tasks": state.summarize_tasks(),
+                          "actors": state.summarize_actors()}, indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+
+    with _attached(args):
+        events = ray_tpu.timeline(args.out)
+    print(f"wrote {len(events)} events to {args.out}")
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,80 @@
+"""ActorPool (reference analog: python/ray/util/actor_pool.py): schedule
+a stream of method calls over a fixed set of actors."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable, value) -> None:
+        """fn(actor, value) -> ObjectRef; blocks if no actor is idle."""
+        if not self._idle:
+            self._wait_one()
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._index_to_future[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _wait_one(self) -> None:
+        refs = list(self._future_to_actor)
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
+        for ref in ready:
+            self._idle.append(self._future_to_actor[ref])
+            del self._future_to_actor[ref]
+
+    def get_next(self, timeout: float = 300.0):
+        """Next result in submission order."""
+        idx = self._next_return_index
+        if idx not in self._index_to_future:
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(idx)
+        self._next_return_index += 1
+        value = ray_tpu.get(ref, timeout=timeout)
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return value
+
+    def get_next_unordered(self, timeout: float = 300.0):
+        refs = [r for r in self._index_to_future.values()
+                if r in self._future_to_actor] or \
+            list(self._index_to_future.values())
+        if not refs:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
+        ref = ready[0]
+        for idx, r in list(self._index_to_future.items()):
+            if r == ref:
+                del self._index_to_future[idx]
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+        return ray_tpu.get(ref, timeout=timeout)
+
+    def map(self, fn: Callable, values: Iterable) -> Iterable:
+        values = list(values)
+        for v in values:
+            self.submit(fn, v)
+        for _ in values:
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterable:
+        values = list(values)
+        for v in values:
+            self.submit(fn, v)
+        for _ in values:
+            yield self.get_next_unordered()
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future)

@@ -1,0 +1,87 @@
+"""multiprocessing.Pool drop-in backed by tasks (reference analog:
+python/ray/util/multiprocessing/pool.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: List):
+        self._refs = refs
+
+    def get(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._refs, timeout=timeout or 300)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+
+class Pool:
+    """Parallelism comes from the cluster, not local forks; `processes`
+    caps in-flight tasks."""
+
+    def __init__(self, processes: Optional[int] = None):
+        self._limit = processes or int(
+            ray_tpu.cluster_resources().get("CPU", 1))
+
+    def _task(self, func):
+        return ray_tpu.remote(func)
+
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(func, iterable).get()
+
+    def map_async(self, func: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        remote_fn = self._task(func)
+        refs = []
+        items = list(iterable)
+        for i in range(0, len(items), max(1, self._limit)):
+            window = items[i:i + max(1, self._limit)]
+            refs.extend(remote_fn.remote(x) for x in window)
+        return AsyncResult(refs)
+
+    def starmap(self, func: Callable, iterable: Iterable) -> List[Any]:
+        remote_fn = self._task(func)
+        refs = [remote_fn.remote(*args) for args in iterable]
+        return ray_tpu.get(refs, timeout=300)
+
+    def apply(self, func: Callable, args: tuple = (),
+              kwds: Optional[dict] = None):
+        return ray_tpu.get(
+            self._task(func).remote(*args, **(kwds or {})), timeout=300)
+
+    def apply_async(self, func: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        return AsyncResult([self._task(func).remote(*args,
+                                                    **(kwds or {}))])
+
+    def imap(self, func: Callable, iterable: Iterable):
+        remote_fn = self._task(func)
+        refs = [remote_fn.remote(x) for x in iterable]
+        for ref in refs:
+            yield ray_tpu.get(ref, timeout=300)
+
+    def close(self) -> None:
+        pass
+
+    def terminate(self) -> None:
+        pass
+
+    def join(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,103 @@
+"""Distributed FIFO queue backed by an actor (reference analog:
+python/ray/util/queue.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self.maxsize = maxsize
+        self.items = collections.deque()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item) -> bool:
+        if self.maxsize > 0 and len(self.items) >= self.maxsize:
+            return False
+        self.items.append(item)
+        return True
+
+    def get(self):
+        if not self.items:
+            return False, None
+        return True, self.items.popleft()
+
+    def put_batch(self, items) -> int:
+        n = 0
+        for item in items:
+            if self.maxsize > 0 and len(self.items) >= self.maxsize:
+                break
+            self.items.append(item)
+            n += 1
+        return n
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *,
+                 actor_options: Optional[dict] = None):
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.1)
+        self._actor = ray_tpu.remote(**opts)(_QueueActor).remote(maxsize)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def put(self, item, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item), timeout=30):
+                return
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Full("queue full")
+            time.sleep(0.05)
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote(), timeout=30)
+            if ok:
+                return item
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Empty("queue empty")
+            time.sleep(0.05)
+
+    def put_nowait(self, item) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def shutdown(self) -> None:
+        try:
+            ray_tpu.kill(self._actor)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __reduce__(self):
+        return (_rebuild_queue, (self._actor,))
+
+
+def _rebuild_queue(actor):
+    q = object.__new__(Queue)
+    q._actor = actor
+    return q

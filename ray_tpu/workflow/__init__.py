@@ -1,0 +1,10 @@
+"""Durable workflows (reference analog: python/ray/workflow/ —
+workflow_executor.py:32, workflow_storage.py): a DAG of steps whose
+results are durably persisted as each step finishes, so a crashed run
+resumes from the last completed step instead of recomputing.
+"""
+
+from ray_tpu.workflow.api import (get_output, list_all, resume, run, step,
+                                  Step)
+
+__all__ = ["step", "Step", "run", "resume", "get_output", "list_all"]

@@ -1,0 +1,177 @@
+"""Workflow steps, executor, and file-backed durable storage.
+
+Reference analogs: workflow/api.py (step decorator / run),
+workflow/workflow_executor.py:32 (DAG execution), workflow_storage.py
+(durable step results).  Storage layout:
+
+    <storage>/<workflow_id>/steps/<step_id>.pkl   one finished step
+    <storage>/<workflow_id>/meta.json             dag + status
+
+Step ids are content-addressed from the function name and the ids of
+upstream steps, so re-building the same DAG on resume maps onto the
+stored results deterministically.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+from typing import Any, Callable, Dict, List, Optional
+
+_DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
+
+
+class Step:
+    """A node in the workflow DAG: fn + (possibly Step-valued) args."""
+
+    def __init__(self, fn: Callable, args: tuple, kwargs: dict,
+                 name: Optional[str] = None, num_cpus: float = 1.0):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+        self.num_cpus = num_cpus
+
+    def step_id(self) -> str:
+        h = hashlib.sha1(self.name.encode())
+        for a in list(self.args) + sorted(
+                self.kwargs.items(), key=lambda kv: kv[0]):
+            if isinstance(a, tuple):
+                a = a[1]
+            if isinstance(a, Step):
+                h.update(a.step_id().encode())
+            else:
+                try:
+                    h.update(pickle.dumps(a))
+                except Exception:  # noqa: BLE001 - unpicklable arg
+                    h.update(repr(a).encode())
+        return f"{self.name}-{h.hexdigest()[:16]}"
+
+
+class _StepFactory:
+    def __init__(self, fn: Callable, **opts):
+        self.fn = fn
+        self.opts = opts
+
+    def step(self, *args, **kwargs) -> Step:
+        return Step(self.fn, args, kwargs, **self.opts)
+
+    def options(self, **opts) -> "_StepFactory":
+        merged = dict(self.opts)
+        merged.update(opts)
+        return _StepFactory(self.fn, **merged)
+
+    def __call__(self, *args, **kwargs):
+        return self.fn(*args, **kwargs)
+
+
+def step(_fn=None, *, name: Optional[str] = None, num_cpus: float = 1.0):
+    """Decorator: make a function a workflow step factory."""
+
+    def wrap(fn):
+        return _StepFactory(fn, name=name, num_cpus=num_cpus)
+
+    return wrap(_fn) if _fn is not None else wrap
+
+
+class _Storage:
+    def __init__(self, root: str, workflow_id: str):
+        self.dir = os.path.join(root, workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    def _step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+
+    def has(self, step_id: str) -> bool:
+        return os.path.exists(self._step_path(step_id))
+
+    def load(self, step_id: str):
+        with open(self._step_path(step_id), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, step_id: str, value) -> None:
+        tmp = self._step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self._step_path(step_id))  # atomic commit
+
+    def write_meta(self, meta: Dict[str, Any]) -> None:
+        with open(os.path.join(self.dir, "meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def read_meta(self) -> Dict[str, Any]:
+        try:
+            with open(os.path.join(self.dir, "meta.json")) as f:
+                return json.load(f)
+        except OSError:
+            return {}
+
+
+def _execute(node: Step, storage: _Storage):
+    """Post-order DAG execution; finished steps short-circuit from
+    storage (this IS the resume mechanism)."""
+    import ray_tpu
+
+    sid = node.step_id()
+    if storage.has(sid):
+        return storage.load(sid)
+
+    def resolve(v):
+        return _execute(v, storage) if isinstance(v, Step) else v
+
+    args = [resolve(a) for a in node.args]
+    kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+    remote_fn = ray_tpu.remote(num_cpus=node.num_cpus)(node.fn)
+    value = ray_tpu.get(remote_fn.remote(*args, **kwargs), timeout=600)
+    storage.save(sid, value)  # durable BEFORE downstream runs
+    return value
+
+
+def run(dag: Step, *, workflow_id: str,
+        storage: Optional[str] = None) -> Any:
+    import ray_tpu
+
+    ray_tpu._auto_init()
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    store.write_meta({"workflow_id": workflow_id, "status": "RUNNING",
+                      "output_step": dag.step_id()})
+    try:
+        result = _execute(dag, store)
+    except Exception:
+        store.write_meta({"workflow_id": workflow_id, "status": "FAILED",
+                          "output_step": dag.step_id()})
+        raise
+    store.write_meta({"workflow_id": workflow_id, "status": "SUCCEEDED",
+                      "output_step": dag.step_id()})
+    return result
+
+
+def resume(dag: Step, *, workflow_id: str,
+           storage: Optional[str] = None) -> Any:
+    """Re-run a workflow: completed steps load from storage, the rest
+    execute.  (The dag is re-built by the caller — step ids are
+    deterministic, so stored results line up.)"""
+    return run(dag, workflow_id=workflow_id, storage=storage)
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None):
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+    meta = store.read_meta()
+    if meta.get("status") != "SUCCEEDED":
+        raise ValueError(
+            f"workflow {workflow_id} not finished "
+            f"(status={meta.get('status')!r})")
+    return store.load(meta["output_step"])
+
+
+def list_all(storage: Optional[str] = None) -> List[Dict[str, Any]]:
+    root = storage or _DEFAULT_STORAGE
+    out = []
+    if os.path.isdir(root):
+        for wid in sorted(os.listdir(root)):
+            meta = _Storage(root, wid).read_meta()
+            if meta:
+                out.append(meta)
+    return out

@@ -1,0 +1,85 @@
+"""State API, task timeline, and dashboard-lite tests."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def obs_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait_events(n, timeout=15):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        events = state.list_tasks()
+        if len(events) >= n:
+            return events
+        time.sleep(0.3)
+    raise AssertionError(f"only {len(state.list_tasks())} events")
+
+
+def test_task_events_and_timeline(obs_cluster, tmp_path):
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    ray_tpu.get([work.remote(i) for i in range(5)], timeout=60)
+    events = _wait_events(5)
+    assert all(e["end"] >= e["start"] for e in events)
+    assert any(e["name"] == "work" for e in events)
+
+    out = str(tmp_path / "trace.json")
+    trace = ray_tpu.timeline(out)
+    assert len(trace) >= 5
+    loaded = json.load(open(out))
+    assert loaded[0]["ph"] == "X" and loaded[0]["dur"] >= 0
+
+    summary = state.summarize_tasks()
+    assert summary["by_func_name"].get("work", 0) >= 5
+
+
+def test_list_actors_and_nodes(obs_cluster):
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    a = A.remote()
+    ray_tpu.get(a.ping.remote(), timeout=30)
+    actors = state.list_actors()
+    assert any(x["state"] == "ALIVE" for x in actors)
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert state.summarize_actors()["total"] >= 1
+
+
+def test_dashboard_endpoints(obs_cluster):
+    import requests
+
+    from ray_tpu.dashboard import start_dashboard
+
+    @ray_tpu.remote
+    def t():
+        return 1
+
+    ray_tpu.get([t.remote() for _ in range(3)], timeout=30)
+    _wait_events(3)
+    url = start_dashboard(port=18265)
+    nodes = requests.get(f"{url}/api/nodes", timeout=30).json()
+    assert len(nodes) == 1
+    summary = requests.get(f"{url}/api/summary", timeout=30).json()
+    assert summary["tasks"]["total"] >= 3
+    metrics = requests.get(f"{url}/metrics", timeout=30).text
+    assert "raytpu_nodes 1" in metrics
+    assert "raytpu_tasks_finished_total" in metrics
+    assert 'raytpu_resource_total{node=' in metrics

@@ -1,0 +1,76 @@
+"""util ecosystem: ActorPool, Queue, multiprocessing.Pool shim, state
+module import surface."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.multiprocessing import Pool
+from ray_tpu.util.queue import Empty, Queue
+
+
+def test_actor_pool_ordered_map(ray_start_shared):
+    @ray_tpu.remote(num_cpus=0.5)
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    actors = [Sq.remote(), Sq.remote()]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.sq.remote(v), range(6)))
+    assert out == [0, 1, 4, 9, 16, 25]
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_actor_pool_unordered(ray_start_shared):
+    @ray_tpu.remote(num_cpus=0.5)
+    class Id:
+        def f(self, x):
+            return x
+
+    actors = [Id.remote(), Id.remote()]
+    pool = ActorPool(actors)
+    out = set(pool.map_unordered(lambda a, v: a.f.remote(v), range(5)))
+    assert out == set(range(5))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_queue_fifo_and_empty(ray_start_shared):
+    q = Queue()
+    q.put(1)
+    q.put({"x": 2})
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == {"x": 2}
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_across_actors(ray_start_shared):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    assert ray_tpu.get(producer.remote(q, 4), timeout=60)
+    assert sorted(q.get(timeout=10) for _ in range(4)) == [0, 1, 2, 3]
+    q.shutdown()
+
+
+def _double(x):
+    return x * 2
+
+
+def test_multiprocessing_pool(ray_start_shared):
+    with Pool(processes=2) as p:
+        assert p.map(_double, range(5)) == [0, 2, 4, 6, 8]
+        assert p.apply(_double, (21,)) == 42
+        assert list(p.imap(_double, [1, 2])) == [2, 4]
+        r = p.apply_async(_double, (5,))
+        assert r.get() == [10]
