@@ -23,13 +23,15 @@ import hashlib
 import logging
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu._private import protocol, serialization
 from ray_tpu._private.config import Config
 from ray_tpu._private.ids import (ActorID, JobID, ObjectID, TaskID, WorkerID,
                                   put_object_id)
-from ray_tpu._private.object_store import ObjectStoreClient, ObjectStoreFull
+from ray_tpu._private.object_store import (ObjectStoreClient,
+                                           ObjectStoreError, ObjectStoreFull)
 from ray_tpu import exceptions
 
 logger = logging.getLogger(__name__)
@@ -130,7 +132,10 @@ class CoreWorker:
         self.io = loop_thread or EventLoopThread()
         self.store = ObjectStoreClient(object_store_name)
         self.memory_store: Dict[bytes, MemoryStoreEntry] = {}
-        self._ms_lock = threading.Lock()
+        # RLock: the free path takes it while holding _ref_lock, and a
+        # GC-fired __del__ inside a _ms_lock section may re-enter the
+        # refcount machinery on the same thread.
+        self._ms_lock = threading.RLock()
         self.gcs: Optional[protocol.Connection] = None
         self.nm: Optional[protocol.Connection] = None
         self._worker_conns: Dict[str, protocol.Connection] = {}
@@ -146,7 +151,60 @@ class CoreWorker:
         self._closed = False
         self.node_id: bytes = b""
         self._pub_handlers: Dict[str, List[Any]] = {}
+        # ---- ownership state (reference: reference_count.h:61) ----
+        # RLock: refcount ops nest (drain -> free -> lineage unpin).
+        self._ref_lock = threading.RLock()
+        #: live python ObjectRef count per oid in THIS process (+ pins for
+        #: in-flight task args and lineage deps).
+        self._local_refs: Dict[bytes, int] = {}
+        #: releases queued from ObjectRef.__del__.  The GC can fire __del__
+        #: while ANY lock is held (allocations trigger collection), so the
+        #: release path must never block on a lock: it appends here
+        #: (deque.append is atomic) and the queue is drained at safe
+        #: points + by a periodic io-loop timer.
+        self._decref_queue: deque = deque()
+        #: owner side: oid -> {borrower worker id: acquire-release balance}.
+        self._borrowers: Dict[bytes, Dict[bytes, int]] = {}
+        #: owner side: return oid -> task lineage for re-execution,
+        #: insertion-ordered for byte-budget eviction (reference:
+        #: task_manager.h:85 lineage resubmission).
+        self._lineage: "OrderedDict[bytes, dict]" = OrderedDict()
+        self._lineage_bytes = 0
+        #: reconstructions in flight (oid -> attempts used).
+        self._recovering: Dict[bytes, int] = {}
+        #: oids freed by refcount; late task replies for them are dropped.
+        self._freed: "OrderedDict[bytes, None]" = OrderedDict()
+        #: outer oid -> refs contained in its serialized value; the outer
+        #: object keeps them pinned (reference: contained-object-ref
+        #: tracking in serialization + reference_count.cc AddNestedObjectIds).
+        self._contained: Dict[bytes, List["ObjectRefInfo"]] = {}
+        #: store deletions deferred off the refcount locks (the shm call
+        #: blocks; _maybe_free_owned runs under _ref_lock / in GC context).
+        self._store_delete_q: deque = deque()
         self.io.run(self._connect(), timeout=self.config.rpc_connect_timeout_s + 5)
+        self.io.post(self._decref_pump())
+
+    async def _decref_pump(self):
+        """Periodic drain so refs dropped by GC free promptly even when no
+        other API call comes along to drain the queue."""
+        while not self._closed:
+            await asyncio.sleep(0.05)
+            if self._decref_queue:
+                self._drain_decrefs(block=False)
+            if self._store_delete_q:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._flush_store_deletes)
+
+    def _flush_store_deletes(self):
+        while True:
+            try:
+                oid = self._store_delete_q.popleft()
+            except IndexError:
+                return
+            try:
+                self.store.delete(ObjectID(oid))
+            except Exception:  # noqa: BLE001 - already gone / store closed
+                pass
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -181,6 +239,10 @@ class CoreWorker:
     async def _handle_nm_request(self, method: str, payload):
         if method == "promote_object":
             return self._promote_object(payload["oid"])
+        if method == "ref_borrow":
+            self.on_borrow_change(payload["oid"], payload["borrower"],
+                                  payload["delta"])
+            return True
         raise protocol.RpcError(f"unknown method {method!r}")
 
     def _promote_object(self, oid: bytes):
@@ -220,9 +282,209 @@ class CoreWorker:
             self.io.stop()
         self.store.close()
 
+    # ---- distributed reference counting ---------------------------------
+    # The owner of an object (the worker that created its ref) frees it when
+    # (a) its own process holds no more python refs or in-flight-task pins
+    # and (b) no borrower process holds any.  Borrowers report acquire /
+    # release to the owner via the owner's node manager, which routes over
+    # the owner's registration connection.  (Reference: the borrowing
+    # protocol of core_worker/reference_count.h:61, collapsed to
+    # per-process balances — order-insensitive counts make the acquire /
+    # release races benign.)
+
+    def add_local_ref(self, info: "ObjectRefInfo"):
+        if self._closed:
+            return
+        with self._ref_lock:
+            c = self._local_refs.get(info.oid, 0) + 1
+            self._local_refs[info.oid] = c
+            if c == 1 and info.owner != self.worker_id.binary():
+                self._post_borrow(info, +1)
+        self._drain_decrefs(block=False)
+
+    def remove_local_ref(self, info: "ObjectRefInfo"):
+        """Queue a reference release.  Called from ObjectRef.__del__, which
+        the GC may fire at ANY point — including while this or another
+        thread holds _ms_lock/_ref_lock — so this never blocks on a lock;
+        the actual free happens at the next drain point."""
+        if self._closed:
+            return
+        self._decref_queue.append(info)
+        self._drain_decrefs(block=False)
+
+    def _drain_decrefs(self, block: bool = True):
+        if not self._decref_queue:
+            return
+        if block:
+            self._ref_lock.acquire()
+        elif not self._ref_lock.acquire(blocking=False):
+            return  # someone else holds it; they / the pump will drain
+        try:
+            while True:
+                try:
+                    info = self._decref_queue.popleft()
+                except IndexError:
+                    break
+                c = self._local_refs.get(info.oid, 0) - 1
+                if c > 0:
+                    self._local_refs[info.oid] = c
+                    continue
+                self._local_refs.pop(info.oid, None)
+                if info.owner == self.worker_id.binary():
+                    self._maybe_free_owned(info.oid)
+                else:
+                    self._post_borrow(info, -1)
+        finally:
+            self._ref_lock.release()
+
+    def _post_borrow(self, info: "ObjectRefInfo", delta: int):
+        if not info.node_address:
+            return
+        try:
+            self.io.post(self._notify_owner(
+                info.oid, info.owner, info.node_address, delta))
+        except Exception:  # noqa: BLE001 - loop shut down
+            pass
+
+    async def _notify_owner(self, oid: bytes, owner: bytes, addr: str,
+                            delta: int):
+        try:
+            conn = self.nm if addr == self.node_address else \
+                await self._worker_conn(addr)
+            await conn.call("ref_borrow", {
+                "oid": oid, "owner": owner, "delta": delta,
+                "borrower": self.worker_id.binary()})
+        except Exception as e:  # noqa: BLE001 - owner gone: nothing to free
+            logger.debug("borrow notify failed for %s: %s",
+                         oid.hex()[:16], e)
+
+    def on_borrow_change(self, oid: bytes, borrower: bytes, delta: int):
+        """Owner side: a borrower's acquire/release arrived (any order)."""
+        with self._ref_lock:
+            per = self._borrowers.setdefault(oid, {})
+            bal = per.get(borrower, 0) + delta
+            if bal == 0:
+                per.pop(borrower, None)
+            else:
+                per[borrower] = bal
+            if not per:
+                self._borrowers.pop(oid, None)
+                if self._local_refs.get(oid, 0) == 0:
+                    self._maybe_free_owned(oid)
+
+    def _maybe_free_owned(self, oid: bytes):
+        """Free an owned object once nothing references it anywhere.
+        Never blocks: the shm delete is deferred to the pump."""
+        with self._ref_lock:
+            if (self._local_refs.get(oid, 0) > 0
+                    or any(self._borrowers.get(oid, {}).values())):
+                return
+            self._drop_lineage(oid)
+            self._freed[oid] = None
+            while len(self._freed) > 100_000:
+                self._freed.popitem(last=False)
+            # release refs the outer value contained
+            for info in self._contained.pop(oid, ()):
+                self._decref_queue.append(info)
+        with self._ms_lock:
+            self.memory_store.pop(oid, None)
+        self._store_delete_q.append(oid)
+
+    # ---- lineage bookkeeping --------------------------------------------
+    # (_drop_lineage/_release_lineage_entry require _ref_lock held;
+    #  _record_lineage takes it itself.)
+
+    def _record_lineage(self, task_id: TaskID, num_returns: int, spec: dict,
+                        skey: bytes, resources, pg,
+                        dep_pins: List["ObjectRefInfo"]):
+        """Retain the task spec for re-execution of lost returns, pinning
+        its by-reference args for as long as the lineage lives (reference:
+        lineage_pinning_enabled, ray_config_def.h:160).  Budget-bounded:
+        oldest lineage is evicted past max_lineage_bytes."""
+        nbytes = 512 + sum(
+            len(m.get("d", b"")) + 64
+            for m in list(spec["args"]) + list(spec["kwargs"].values()))
+        lin = {"spec": spec, "skey": skey, "resources": resources,
+               "pg": pg, "live_returns": 0, "nbytes": nbytes,
+               "dep_pins": list(dep_pins)}
+        for info in lin["dep_pins"]:
+            self.add_local_ref(info)
+        with self._ref_lock:
+            for i in range(num_returns):
+                roid = ObjectID.for_return(task_id, i + 1).binary()
+                if roid not in self._freed:
+                    self._lineage[roid] = lin
+                    lin["live_returns"] += 1
+            if lin["live_returns"] > 0:
+                self._lineage_bytes += lin["nbytes"]
+                while (self._lineage_bytes > self.config.max_lineage_bytes
+                       and self._lineage):
+                    _, old_lin = self._lineage.popitem(last=False)
+                    self._release_lineage_entry(old_lin)
+            else:
+                for info in lin["dep_pins"]:
+                    self._decref_queue.append(info)
+
+    def _drop_lineage(self, oid: bytes):
+        lin = self._lineage.pop(oid, None)
+        if lin is not None:
+            self._release_lineage_entry(lin)
+
+    def _release_lineage_entry(self, lin: dict):
+        lin["live_returns"] -= 1
+        if lin["live_returns"] <= 0:
+            self._lineage_bytes -= lin["nbytes"]
+            self._recovering.pop(lin["spec"]["task_id"], None)
+            for info in lin.pop("dep_pins", []):
+                self._decref_queue.append(info)  # deferred unpin
+
+    def _pin_refs(self, marshalled: list,
+                  nested: Sequence["ObjectRefInfo"] = ()
+                  ) -> List["ObjectRefInfo"]:
+        """Pin every by-reference arg of an in-flight task — including refs
+        nested inside pickled by-value args — so the objects outlive the
+        submission even if the caller drops its python refs (reference:
+        TaskManager holds deps of pending tasks)."""
+        pins = []
+        for m in marshalled:
+            if m.get("k") == "r":
+                info = ObjectRefInfo(m["oid"], m["owner"], m["addr"])
+                self.add_local_ref(info)
+                pins.append(info)
+        for info in nested:
+            self.add_local_ref(info)
+            pins.append(info)
+        return pins
+
+    def _unpin_refs_later(self, pins: List["ObjectRefInfo"],
+                          delay: Optional[float] = None):
+        """Release task-arg pins after a grace period.  The grace covers
+        the borrow race: a worker that stashed a borrowed ref registers
+        with us asynchronously (its +1 is posted when the ref is
+        deserialized, i.e. before user code even ran), so by reply + grace
+        it has long arrived.  (Reference closes this exactly instead, by
+        merging borrower lists carried on the task reply.)"""
+        if not pins:
+            return
+        delay = self.config.borrow_grace_s if delay is None else delay
+        try:
+            asyncio.get_running_loop().create_task(
+                self._unpin_after(pins, delay))
+        except RuntimeError:  # caller is not on the loop
+            self.io.post(self._unpin_after(pins, delay))
+
+    async def _unpin_after(self, pins: List["ObjectRefInfo"], delay: float):
+        await asyncio.sleep(delay)
+        for info in pins:
+            self._decref_queue.append(info)
+        self._drain_decrefs(block=False)
+
     # ---- object plane ----------------------------------------------------
 
     def _store_local(self, oid: bytes, data: bytes, is_error: bool):
+        with self._ref_lock:
+            if oid in self._freed:
+                return  # all refs dropped while the task was in flight
         with self._ms_lock:
             entry = self.memory_store.setdefault(oid, MemoryStoreEntry())
         entry.put(data, is_error)
@@ -254,6 +516,18 @@ class CoreWorker:
             view = self.store.create(oid, ser.total_size)
         except ObjectStoreFull:
             self.store.evict(ser.total_size)
+            view = self.store.create(oid, ser.total_size)
+        except ObjectStoreError as e:
+            if "exists" not in str(e):
+                raise
+            if self.store.contains(oid):
+                return  # sealed copy already present: idempotent re-create
+            # created-but-unsealed orphan (crashed writer): abort it
+            # (os_obj_abort handles unsealed entries) and retry once
+            try:
+                self.store.abort(oid)
+            except Exception:  # noqa: BLE001
+                pass
             view = self.store.create(oid, ser.total_size)
         try:
             ser.write_into(view)
@@ -309,10 +583,17 @@ class CoreWorker:
                     if (entry is not None and entry.in_store
                             and ref.owner == self.worker_id.binary()):
                         t0 = miss_since.setdefault(i, time.monotonic())
-                        if time.monotonic() - t0 > 5.0:
-                            raise exceptions.ObjectLostError(
-                                f"object {ref.oid.hex()[:16]} was evicted "
-                                "from the local store and has no other copy")
+                        if time.monotonic() - t0 > \
+                                self.config.object_miss_grace_s:
+                            if self._try_recover(ref.oid):
+                                miss_since[i] = time.monotonic()
+                            else:
+                                raise exceptions.ObjectLostError(
+                                    f"object {ref.oid.hex()[:16]} was "
+                                    "evicted from the local store, has no "
+                                    "other copy, and cannot be "
+                                    "reconstructed (no lineage or "
+                                    "reconstruction attempts exhausted)")
                     still.append(i)
                 else:
                     value, is_error = res
@@ -336,6 +617,48 @@ class CoreWorker:
             else:
                 time.sleep(self.config.get_poll_interval_s)
         return out
+
+    def _try_recover(self, oid: bytes) -> bool:
+        """Kick off lineage re-execution for a lost owned object.  Returns
+        True if a reconstruction is (now) in flight.  Keyed by TASK id so a
+        multi-return task with several lost returns re-executes once.
+        (Reference: object_recovery_manager.h:41.)"""
+        with self._ref_lock:
+            lin = self._lineage.get(oid)
+            if lin is None:
+                return False
+            tid = lin["spec"]["task_id"]
+            attempts = self._recovering.get(tid, 0)
+            if attempts < 0:
+                return True  # this task's re-execution already in flight
+            if attempts >= self.config.max_lineage_reexecutions:
+                return False
+            self._recovering[tid] = -(attempts + 1)  # negative = in flight
+        logger.warning("lost object %s: re-executing task %s from lineage",
+                       oid.hex()[:16], lin["spec"].get("name", "?"))
+        self.io.post(self._resubmit_lineage(tid, lin))
+        return True
+
+    async def _resubmit_lineage(self, tid: bytes, lin: dict):
+        spec = dict(lin["spec"])
+        skey = lin["skey"]
+        state = self._leases.get(skey)
+        if state is None:
+            state = LeaseState(lin["resources"], lin["pg"])
+            self._leases[skey] = state
+        fut = asyncio.get_running_loop().create_future()
+        state.queue.append((spec, fut))
+        self._maybe_request_lease(skey, state)
+        try:
+            await fut
+        except Exception as e:  # noqa: BLE001 - reconstruction failed
+            logger.warning("lineage re-execution of task %s failed: %s",
+                           tid.hex()[:12], e)
+        finally:
+            with self._ref_lock:
+                att = self._recovering.get(tid)
+                if att is not None:
+                    self._recovering[tid] = -att  # mark not-in-flight
 
     async def _request_pull(self, ref: "ObjectRefInfo"):
         try:
@@ -375,6 +698,9 @@ class CoreWorker:
 
     def free(self, refs: Sequence["ObjectRefInfo"]):
         for ref in refs:
+            with self._ref_lock:
+                self._drop_lineage(ref.oid)
+                self._freed[ref.oid] = None
             with self._ms_lock:
                 self.memory_store.pop(ref.oid, None)
             try:
@@ -409,8 +735,9 @@ class CoreWorker:
 
     # ---- argument marshalling -------------------------------------------
 
-    def _marshal_arg(self, arg: Any) -> dict:
-        from ray_tpu._private.worker_context import ObjectRefLike
+    def _marshal_arg(self, arg: Any,
+                     nested_out: Optional[list] = None) -> dict:
+        from ray_tpu._private.worker_context import ObjectRefLike, _ser_scope
 
         if isinstance(arg, ObjectRefLike):
             ref = arg._info
@@ -423,7 +750,17 @@ class CoreWorker:
                 return {"k": "v", "d": entry.data}
             return {"k": "r", "oid": ref.oid, "owner": ref.owner,
                     "addr": ref.node_address}
-        ser = serialization.serialize(arg)
+        # Collect refs nested inside the pickled value so the submitter can
+        # pin them for the task's lifetime (they are invisible in the
+        # marshalled dict otherwise).
+        prev = getattr(_ser_scope, "refs", None)
+        _ser_scope.refs = collected = []
+        try:
+            ser = serialization.serialize(arg)
+        finally:
+            _ser_scope.refs = prev
+        if nested_out is not None:
+            nested_out.extend(collected)
         if ser.total_size > self.config.max_inline_object_size:
             # Large pass-by-value arg: put in shm, pass as owned ref.
             oid = put_object_id(self._ctx_task_id())
@@ -506,6 +843,7 @@ class CoreWorker:
             "caller_addr": self.node_address,
             "retries_left": max_retries,
         }
+        pins: List[ObjectRefInfo] = []
         try:
             dep_error = await self._async_resolve_deps(args, kwargs)
             if dep_error is not None:
@@ -513,12 +851,18 @@ class CoreWorker:
                     oid = ObjectID.for_return(task_id, i + 1).binary()
                     self._store_local(oid, dep_error, True)
                 return
-            spec["args"] = [self._marshal_arg(a) for a in args]
-            spec["kwargs"] = {k: self._marshal_arg(v)
+            nested: List[ObjectRefInfo] = []
+            spec["args"] = [self._marshal_arg(a, nested) for a in args]
+            spec["kwargs"] = {k: self._marshal_arg(v, nested)
                               for k, v in kwargs.items()}
+            pins = self._pin_refs(
+                list(spec["args"]) + list(spec["kwargs"].values()), nested)
         except Exception as e:  # noqa: BLE001 - marshalling failed
             self._fail_task(spec, e)
             return
+        if self.config.lineage_enabled:
+            self._record_lineage(task_id, num_returns, spec, skey,
+                                 resources, pg, pins)
         state = self._leases.get(skey)
         if state is None:
             state = LeaseState(resources, pg)
@@ -530,6 +874,8 @@ class CoreWorker:
             await fut
         except Exception as e:  # noqa: BLE001 - record as task error
             self._fail_task(spec, e)
+        finally:
+            self._unpin_refs_later(pins)
 
     def _fail_task(self, spec, exc: Exception):
         err = exceptions.RayTaskError(repr(exc), "")
@@ -654,6 +1000,9 @@ class CoreWorker:
     def _ingest_returns(self, spec, reply):
         for ret in reply["returns"]:
             oid = ret["oid"]
+            with self._ref_lock:
+                if oid in self._freed:
+                    continue  # every ref was dropped while in flight
             if "d" in ret:
                 self._store_local(oid, ret["d"], bool(ret.get("err")))
                 continue
@@ -687,15 +1036,22 @@ class CoreWorker:
                      pg: Optional[Tuple[bytes, int]] = None) -> bytes:
         self._await_ref_args(args, kwargs)
         actor_id = ActorID.of(self.job_id)
+        nested: List[ObjectRefInfo] = []
         spec = {
             "actor_id": actor_id.binary(),
             "job_id": self.job_id.binary(),
             "fid": fid,
-            "args": [self._marshal_arg(a) for a in args],
-            "kwargs": {k: self._marshal_arg(v) for k, v in kwargs.items()},
+            "args": [self._marshal_arg(a, nested) for a in args],
+            "kwargs": {k: self._marshal_arg(v, nested)
+                       for k, v in kwargs.items()},
             "resources": resources,
             "max_concurrency": max_concurrency,
         }
+        # Pin ctor args until the actor had ample time to construct (its
+        # own borrow registrations take over from there).
+        pins = self._pin_refs(
+            list(spec["args"]) + list(spec["kwargs"].values()), nested)
+        self._unpin_refs_later(pins, self.config.worker_start_timeout_s)
         if pg is not None:
             spec["placement_group_id"] = pg[0]
             spec["bundle_index"] = pg[1]
@@ -740,6 +1096,7 @@ class CoreWorker:
     async def _push_actor_task(self, actor_id: bytes, spec: dict,
                                args: tuple, kwargs: dict,
                                dial_retries: int = 3):
+        pins: List[ObjectRefInfo] = []
         try:
             dep_error = await self._async_resolve_deps(args, kwargs)
             if dep_error is not None:
@@ -748,12 +1105,22 @@ class CoreWorker:
                         TaskID(spec["task_id"]), i + 1).binary()
                     self._store_local(oid, dep_error, True)
                 return
-            spec["args"] = [self._marshal_arg(a) for a in args]
-            spec["kwargs"] = {k: self._marshal_arg(v)
+            nested: List[ObjectRefInfo] = []
+            spec["args"] = [self._marshal_arg(a, nested) for a in args]
+            spec["kwargs"] = {k: self._marshal_arg(v, nested)
                               for k, v in kwargs.items()}
+            pins = self._pin_refs(
+                list(spec["args"]) + list(spec["kwargs"].values()), nested)
         except Exception as e:  # noqa: BLE001 - marshalling failed
             self._fail_actor_task(spec, e)
             return
+        try:
+            await self._push_actor_task_inner(actor_id, spec, dial_retries)
+        finally:
+            self._unpin_refs_later(pins)
+
+    async def _push_actor_task_inner(self, actor_id: bytes, spec: dict,
+                                     dial_retries: int = 3):
         # Phase 1 — resolve + connect. Safe to retry: nothing was sent yet
         # (a restarting actor resolves to its new address).
         conn = None

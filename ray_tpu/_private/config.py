@@ -63,6 +63,31 @@ class Config:
     # first pull can race production at the owner).
     pull_retry_interval_s: float = 0.25
 
+    # --- ownership / recovery ---
+    #: Seconds an owner-promised-in-store object may be missing from the
+    #: shared store before it is declared evicted (and reconstruction or
+    #: ObjectLostError kicks in).
+    object_miss_grace_s: float = 2.0
+    #: Re-execute lost task returns from their task spec (reference analog:
+    #: lineage_pinning_enabled, object_recovery_manager.h:41).
+    lineage_enabled: bool = True
+    #: Max reconstruction attempts per lost object.
+    max_lineage_reexecutions: int = 3
+    #: Byte budget for retained task specs; oldest lineage is evicted past
+    #: this (reference analog: max_lineage_bytes).
+    max_lineage_bytes: int = 64 * 1024 * 1024
+    #: Grace after a task reply before its arg pins are released, covering
+    #: the in-flight window of a borrower's async acquire notification.
+    borrow_grace_s: float = 1.0
+
+    # --- object transfer ---
+    #: Chunk size for node-to-node object streaming (reference analog:
+    #: object_manager chunked push/pull, push_manager.h:29).
+    object_transfer_chunk_bytes: int = 4 * 1024 * 1024
+    #: Bound on concurrently in-flight chunks per transfer (admission
+    #: control, pull_manager.h:48).
+    object_transfer_max_inflight_chunks: int = 8
+
     # --- logging / observability ---
     log_dir: str = ""
     log_to_driver: bool = True

@@ -556,6 +556,19 @@ class NodeManager:
 
     # ---- object plane ----------------------------------------------------
 
+    async def rpc_ref_borrow(self, conn, payload):
+        """Route a borrower's acquire/release to the owner core worker on
+        this node (reference analog: the owner-addressed borrow messages of
+        the reference_count.h borrowing protocol)."""
+        owner_conn = self.owner_conns.get(payload["owner"])
+        if owner_conn is None or owner_conn.closed:
+            return False  # owner gone; its objects die with it anyway
+        try:
+            await owner_conn.call("ref_borrow", payload)
+        except Exception:  # noqa: BLE001 - owner exiting
+            return False
+        return True
+
     async def rpc_pull_object(self, conn, payload):
         """Make an object available in the local shared-memory store.
 

@@ -29,9 +29,33 @@ class ObjectRef(ObjectRefLike):
     Reference analog: python/ray/includes/object_ref.pxi:38.  Picklable:
     passing a ref into a task or putting it inside a data structure carries
     (id, owner, owner node) so any process can resolve it.
+
+    Each live instance counts one local reference: construction registers
+    with the core worker, GC deregisters; the owner frees the object when
+    all processes report zero (reference: reference_count.h:61).
     """
 
-    __slots__ = ()
+    __slots__ = ("_cw",)
+
+    def __init__(self, info):
+        super().__init__(info)
+        self._cw = None
+        cw = _core_worker
+        if cw is not None:
+            try:
+                cw.add_local_ref(info)
+                self._cw = cw  # decref must go to the SAME worker
+            except Exception:  # noqa: BLE001 - shutdown race
+                pass
+
+    def __del__(self):
+        cw = getattr(self, "_cw", None)
+        if cw is None:
+            return
+        try:
+            cw.remove_local_ref(self._info)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def binary(self) -> bytes:
         return self._info.oid
@@ -56,9 +80,13 @@ class ObjectRef(ObjectRefLike):
         return f"ObjectRef({self.hex()})"
 
     def __reduce__(self):
-        from ray_tpu._private.client import ObjectRefInfo
-
         i = self._info
+        # Surface nested refs to an active serialization scope so task
+        # submission can pin them (reference: contained-ObjectRef tracking
+        # in serialization.py's SerializationContext).
+        collector = getattr(_ser_scope, "refs", None)
+        if collector is not None:
+            collector.append(i)
         return (_rebuild_ref, (i.oid, i.owner, i.node_address))
 
     def future(self):
@@ -83,6 +111,11 @@ def _rebuild_ref(oid: bytes, owner: bytes, node_address: str) -> ObjectRef:
     from ray_tpu._private.client import ObjectRefInfo
 
     return ObjectRef(ObjectRefInfo(oid, owner, node_address))
+
+
+#: Thread-local scope used to collect refs encountered while pickling a
+#: task argument (set by CoreWorker._marshal_arg).
+_ser_scope = threading.local()
 
 
 class _GlobalState(threading.local):

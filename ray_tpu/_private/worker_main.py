@@ -112,9 +112,9 @@ class WorkerServer:
                 fut = asyncio.run_coroutine_threadsafe(
                     handler(None, payload), worker_loop)
                 return await asyncio.wrap_future(fut)
-            if method == "promote_object":
-                return self.cw._promote_object(payload["oid"])
-            raise protocol.RpcError(f"unknown method {method!r}")
+            # Object-plane methods (promote_object, ref_borrow, ...) are
+            # handled by the CoreWorker like on any owner process.
+            return await self.cw._handle_nm_request(method, payload)
 
         self.cw.nm.set_request_handler(from_nm)
         await asyncio.get_running_loop().run_in_executor(

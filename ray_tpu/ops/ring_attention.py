@@ -78,8 +78,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
     acc0 = jnp.zeros_like(q, dtype=jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(carry, s):
-        m, l, acc, kc, vc = carry
+    def attend_merge(m, l, acc, kc, vc, s):
         src = (idx - s) % n           # ring step s holds src's shard
         k_off = src * Tl
         a_s, m_s, l_s = _chunk_attend(q, kc, vc, q_off, k_off,
@@ -90,13 +89,21 @@ def ring_attention(q, k, v, *, axis_name: str = "seq",
         beta = jnp.exp(m_s - m_new)
         l = l * alpha + l_s * beta
         acc = acc * alpha[..., None] + a_s * beta[..., None]
-        # pass k/v to the next device (skip the final, useless hop)
+        return m_new, l, acc
+
+    def step(carry, s):
+        m, l, acc, kc, vc = carry
+        m, l, acc = attend_merge(m, l, acc, kc, vc, s)
+        # pass k/v to the next device
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return (m_new, l, acc, kc, vc), None
+        return (m, l, acc, kc, vc), None
 
-    (m, l, acc, _, _), _ = lax.scan(step, (m0, l0, acc0, k, v),
-                                    jnp.arange(n))
+    # scan runs the n-1 rotating steps; the last shard is merged outside
+    # the loop so the final (useless) ppermute hop is never issued.
+    (m, l, acc, kc, vc), _ = lax.scan(step, (m0, l0, acc0, k, v),
+                                      jnp.arange(n - 1))
+    m, l, acc = attend_merge(m, l, acc, kc, vc, n - 1)
     l = jnp.maximum(l, 1e-30)
     return (acc / l[..., None]).astype(q.dtype)
 

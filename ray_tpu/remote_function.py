@@ -27,6 +27,15 @@ def _build_resources(opts: Dict[str, Any]) -> Dict[str, float]:
         resources[k] = float(v)
     if opts.get("memory"):
         resources["memory"] = float(opts["memory"])
+    # TPU chips are exclusive per process under libtpu: a worker is handed
+    # whole chips via TPU_VISIBLE_CHIPS, so fractional grants would pass
+    # ResourceSet admission but fail at worker start (ADVICE r1).
+    tpu = resources.get("TPU")
+    if tpu is not None and tpu != int(tpu):
+        raise ValueError(
+            f"num_tpus must be a whole number of chips (got {tpu}): TPU "
+            f"chips are dedicated per worker process under libtpu and "
+            f"cannot be fractionally shared the way CPUs can.")
     return resources
 
 

@@ -18,8 +18,13 @@ class ActorPool:
 
     def submit(self, fn: Callable, value) -> None:
         """fn(actor, value) -> ObjectRef; blocks if no actor is idle."""
-        if not self._idle:
+        while not self._idle:
+            before = len(self._idle)
             self._wait_one()
+            if len(self._idle) == before:
+                raise TimeoutError(
+                    "ActorPool.submit: no actor became idle within the "
+                    "wait timeout; all actors still have pending tasks")
         actor = self._idle.pop()
         ref = fn(actor, value)
         self._future_to_actor[ref] = actor

@@ -36,17 +36,21 @@ class Step:
 
     def step_id(self) -> str:
         h = hashlib.sha1(self.name.encode())
-        for a in list(self.args) + sorted(
-                self.kwargs.items(), key=lambda kv: kv[0]):
-            if isinstance(a, tuple):
-                a = a[1]
-            if isinstance(a, Step):
-                h.update(a.step_id().encode())
-            else:
-                try:
-                    h.update(pickle.dumps(a))
-                except Exception:  # noqa: BLE001 - unpicklable arg
-                    h.update(repr(a).encode())
+
+        def feed(v) -> None:
+            if isinstance(v, Step):
+                h.update(v.step_id().encode())
+                return
+            try:
+                h.update(pickle.dumps(v))
+            except Exception:  # noqa: BLE001 - unpicklable arg
+                h.update(repr(v).encode())
+
+        for a in self.args:
+            feed(a)
+        for k, v in sorted(self.kwargs.items()):
+            h.update(k.encode())  # key is part of identity: f(x=1) != f(y=1)
+            feed(v)
         return f"{self.name}-{h.hexdigest()[:16]}"
 
 

@@ -1,0 +1,195 @@
+"""Distributed reference counting + lineage reconstruction tests.
+
+Reference analogs: python/ray/tests/test_reference_counting.py and
+test_reconstruction.py (ownership model: reference_count.h:61,
+object_recovery_manager.h:41).
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.ids import ObjectID
+
+
+def _cw():
+    return worker_context.core_worker()
+
+
+def _settle():
+    """Let the GC and the io loop drain pending decrefs."""
+    import time
+
+    gc.collect()
+    time.sleep(0.1)
+
+
+def test_put_ref_freed_on_drop(ray_start_regular):
+    cw = _cw()
+    ref = ray_tpu.put(np.arange(200_000, dtype=np.float32))  # > inline limit
+    oid = ref.binary()
+    assert cw.store.contains(ObjectID(oid))
+    assert cw._local_refs.get(oid, 0) == 1
+    del ref
+    _settle()
+    assert cw._local_refs.get(oid, 0) == 0
+    assert not cw.store.contains(ObjectID(oid))
+
+
+def test_small_put_memory_store_freed(ray_start_regular):
+    cw = _cw()
+    ref = ray_tpu.put({"small": 1})
+    oid = ref.binary()
+    assert oid in cw.memory_store
+    del ref
+    _settle()
+    assert oid not in cw.memory_store
+
+
+def test_task_return_freed_on_drop(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    cw = _cw()
+    ref = f.remote()
+    assert ray_tpu.get(ref) == 7
+    oid = ref.binary()
+    assert oid in cw.memory_store
+    del ref
+    _settle()
+    assert oid not in cw.memory_store
+    assert oid not in cw._lineage
+
+
+def test_flat_memory_many_objects(ray_start_regular):
+    """10k dropped put() refs must not accumulate entries (VERDICT r1 #2)."""
+    cw = _cw()
+    before = len(cw.memory_store)
+    for i in range(10_000):
+        ray_tpu.put(i)  # ref dropped immediately
+    _settle()
+    after = len(cw.memory_store)
+    assert after - before < 100, f"leaked {after - before} entries"
+
+
+def test_inflight_task_pins_dropped_arg(ray_start_regular):
+    """Dropping a ref right after passing it to a task must not free the
+    object before the task reads it."""
+    import time
+
+    @ray_tpu.remote
+    def slow_identity(x):
+        time.sleep(0.3)
+        return x.sum()
+
+    arr = np.ones(300_000, dtype=np.float32)  # shm-sized
+    ref = ray_tpu.put(arr)
+    out = slow_identity.remote(ref)
+    del ref
+    gc.collect()
+    assert ray_tpu.get(out) == 300_000.0
+
+
+def test_lineage_reconstruction_after_eviction(ray_start_regular):
+    """Evict a task return from the store; get() must re-execute the task
+    (reference: object_recovery_manager.h:41)."""
+
+    @ray_tpu.remote
+    def make_array(n):
+        return np.full(n, 3.0, dtype=np.float32)
+
+    cw = _cw()
+    ref = make_array.remote(200_000)  # > inline limit -> lives in shm
+    first = ray_tpu.get(ref)
+    assert first[0] == 3.0
+    # Simulate eviction: delete the only store copy behind the owner's back.
+    assert cw.store.delete(ObjectID(ref.binary()))
+    assert not cw.store.contains(ObjectID(ref.binary()))
+    recovered = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(recovered, first)
+
+
+def test_put_object_not_reconstructable(ray_start_regular):
+    """put() objects have no lineage: eviction is a hard loss (matches
+    reference semantics for ray.put)."""
+    cw = _cw()
+    ref = ray_tpu.put(np.zeros(200_000, dtype=np.float32))
+    assert cw.store.delete(ObjectID(ref.binary()))
+    with pytest.raises((exceptions.ObjectLostError,
+                        exceptions.GetTimeoutError)):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_borrower_keeps_object_alive(ray_start_regular):
+    """An actor that stashes a borrowed ref must keep the owner from
+    freeing the object after the driver drops its own ref."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, boxed):
+            self.ref = boxed[0]  # nested ref -> stays a borrowed ObjectRef
+            return True
+
+        def read(self):
+            return ray_tpu.get(self.ref).sum()
+
+    cw = _cw()
+    h = Holder.remote()
+    arr = np.ones(200_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    oid = ref.binary()
+    assert ray_tpu.get(h.hold.remote([ref]))
+    _settle()  # borrower registration is async
+    del ref
+    _settle()
+    # Owner must still hold the object: the actor has it borrowed.
+    assert cw.store.contains(ObjectID(oid)), "freed while borrowed"
+    assert ray_tpu.get(h.read.remote()) == 200_000.0
+    ray_tpu.kill(h)
+
+
+def test_borrow_release_frees(ray_start_regular):
+    """When the borrower drops its ref too, the owner frees the object."""
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.ref = None
+
+        def hold(self, boxed):
+            self.ref = boxed[0]
+            return True
+
+        def drop(self):
+            self.ref = None
+            gc.collect()
+            return True
+
+    import time
+
+    cw = _cw()
+    h = Holder.remote()
+    ref = ray_tpu.put(np.ones(200_000, dtype=np.float32))
+    oid = ref.binary()
+    assert ray_tpu.get(h.hold.remote([ref]))
+    _settle()
+    del ref
+    _settle()
+    assert cw.store.contains(ObjectID(oid))
+    assert ray_tpu.get(h.drop.remote())
+    # Caller-side pins release after a borrow grace; poll for the free.
+    deadline = time.monotonic() + 10
+    while cw.store.contains(ObjectID(oid)):
+        if time.monotonic() > deadline:
+            raise AssertionError("not freed after borrower release")
+        time.sleep(0.2)
+        gc.collect()
+    ray_tpu.kill(h)
