@@ -77,6 +77,20 @@ def init(address: Optional[str] = None, *,
         from ray_tpu._private.ids import JobID
         from ray_tpu._private.node import Node
 
+        # Address resolution (reference: worker.py:1092-1110): explicit
+        # address wins; "auto"/None fall back to RAYTPU_ADDRESS (set for
+        # submitted jobs by the JobSupervisor, like RAY_ADDRESS).
+        import os as _os
+
+        if address == "auto":
+            address = _os.environ.get("RAYTPU_ADDRESS") or None
+            if address is None:
+                raise ConnectionError(
+                    'init(address="auto") but RAYTPU_ADDRESS is not set '
+                    "and no running cluster was found")
+        elif address is None:
+            address = _os.environ.get("RAYTPU_ADDRESS") or None
+
         if address:
             # Attach to an existing cluster: the driver brings up its own
             # worker node (local store + node manager) registered with the
@@ -219,8 +233,18 @@ def kill(actor: ActorHandle, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
-    """Best-effort task cancellation (reference: worker.py:2552 cancel)."""
-    logger.warning("cancel(): queued-task cancellation only in this version")
+    """Cancel the task that produces ``ref`` (reference: worker.py:2552).
+
+    Queued tasks are dequeued and fail with TaskCancelledError.  Running
+    tasks (normal or actor) get a TaskCancelledError raised
+    asynchronously in their executing thread (best-effort, like the
+    reference's KeyboardInterrupt delivery); ``force=True`` kills the
+    executing worker process instead.  ``recursive`` is best-effort:
+    child tasks the cancelled task already submitted are not chased
+    individually — they die with the worker under ``force=True``.
+    """
+    cw = worker_context.core_worker()
+    cw.cancel_task(ref._info, force=force, recursive=recursive)
 
 
 def get_actor(name: str) -> ActorHandle:

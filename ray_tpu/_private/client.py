@@ -178,9 +178,29 @@ class CoreWorker:
         #: object keeps them pinned (reference: contained-object-ref
         #: tracking in serialization + reference_count.cc AddNestedObjectIds).
         self._contained: Dict[bytes, List["ObjectRefInfo"]] = {}
+        #: in-flight borrow +1 registrations (concurrent futures).  Flushed
+        #: before a task reply is sent so the owner has this process's
+        #: borrow on record before the caller releases its pins — the exact
+        #: closure of the borrow race (reference: borrower lists merged on
+        #: the task reply, reference_count.cc).
+        self._borrow_acks: set = set()
+        #: worker side: task_id -> pins backing refs embedded in that
+        #: task's returns, held until the caller confirms it re-pinned them
+        #: (release_return_pins) or a crash-fallback timer fires.
+        self._return_pins: Dict[bytes, List["ObjectRefInfo"]] = {}
+        #: submitter side: task_id -> worker address, while the push RPC is
+        #: in flight (so cancel() can reach the executing worker).
+        self._inflight_tasks: Dict[bytes, str] = {}
+        #: task ids cancelled before dispatch; checked at dispatch time.
+        #: Insertion-ordered so the bound evicts the OLDEST (long-finished)
+        #: ids, never live cancellation state.
+        self._cancelled: "OrderedDict[bytes, None]" = OrderedDict()
         #: store deletions deferred off the refcount locks (the shm call
         #: blocks; _maybe_free_owned runs under _ref_lock / in GC context).
         self._store_delete_q: deque = deque()
+        #: True while _flush_store_deletes is inside store calls on an
+        #: executor thread (shutdown waits on it before unmapping).
+        self._flushing = False
         self.io.run(self._connect(), timeout=self.config.rpc_connect_timeout_s + 5)
         self.io.post(self._decref_pump())
 
@@ -189,22 +209,33 @@ class CoreWorker:
         other API call comes along to drain the queue."""
         while not self._closed:
             await asyncio.sleep(0.05)
-            if self._decref_queue:
+            if self._decref_queue and not self._closed:
                 self._drain_decrefs(block=False)
-            if self._store_delete_q:
+            if self._store_delete_q and not self._closed:
                 await asyncio.get_running_loop().run_in_executor(
                     None, self._flush_store_deletes)
 
     def _flush_store_deletes(self):
-        while True:
-            try:
-                oid = self._store_delete_q.popleft()
-            except IndexError:
-                return
-            try:
-                self.store.delete(ObjectID(oid))
-            except Exception:  # noqa: BLE001 - already gone / store closed
-                pass
+        # Runs on an executor thread: it must never touch the store after
+        # shutdown() unmaps it.  _flushing lets shutdown wait for an
+        # in-flight pass (use-after-munmap = segfault in the C store).
+        self._flushing = True
+        try:
+            while not self._closed:
+                try:
+                    oid = self._store_delete_q.popleft()
+                except IndexError:
+                    return
+                try:
+                    self.store.delete(ObjectID(oid))
+                except Exception:  # noqa: BLE001 - already gone
+                    pass
+                try:
+                    self.spill.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+        finally:
+            self._flushing = False
 
     # ---- bootstrap -------------------------------------------------------
 
@@ -222,6 +253,9 @@ class CoreWorker:
         reply = await self.nm.call("register_core_worker",
                                    {"worker_id": self.worker_id.binary()})
         self.node_id = reply["node_id"]
+        from ray_tpu._private.spill import SpillManager
+
+        self.spill = SpillManager(self.store, reply.get("spill_dir", ""))
 
     def _on_push(self, method: str, payload):
         if method.startswith("pub."):
@@ -243,7 +277,30 @@ class CoreWorker:
             self.on_borrow_change(payload["oid"], payload["borrower"],
                                   payload["delta"])
             return True
+        if method == "object_unavailable":
+            # A borrower cannot obtain one of our objects anywhere (its
+            # storing node died): re-execute from lineage (reference:
+            # ObjectRecoveryManager reacting to location loss,
+            # object_recovery_manager.h:41).
+            return self.on_object_unavailable(payload["oid"])
         raise protocol.RpcError(f"unknown method {method!r}")
+
+    def on_object_unavailable(self, oid: bytes) -> bool:
+        with self._ref_lock:
+            if oid in self._freed:
+                return False
+        if self.store.contains(ObjectID(oid)) or self.spill.contains(oid):
+            return True  # a live copy exists right here; borrower retries
+        entry = self.memory_store.get(oid)
+        if entry is not None:
+            if entry.data is not None:
+                return True  # inline copy; promote path serves it
+            if not entry.event.is_set():
+                # The producing task is still RUNNING (no reply yet):
+                # recovery here would duplicate-execute it.  The borrower
+                # keeps polling; production will land.
+                return True
+        return self._try_recover(oid)
 
     def _promote_object(self, oid: bytes):
         """Write a memory-store object into the shared store so another
@@ -280,6 +337,11 @@ class CoreWorker:
             pass
         if self._own_loop:
             self.io.stop()
+        # An in-flight delete pass on the executor thread must leave the
+        # store before we unmap it (it checks _closed per iteration).
+        deadline = time.monotonic() + 2.0
+        while self._flushing and time.monotonic() < deadline:
+            time.sleep(0.01)
         self.store.close()
 
     # ---- distributed reference counting ---------------------------------
@@ -341,9 +403,35 @@ class CoreWorker:
         if not info.node_address:
             return
         try:
-            self.io.post(self._notify_owner(
+            fut = self.io.post(self._notify_owner(
                 info.oid, info.owner, info.node_address, delta))
         except Exception:  # noqa: BLE001 - loop shut down
+            return
+        if delta > 0:
+            # Track the registration so flush_borrows() can await the
+            # owner's ack before a task reply is sent.
+            self._borrow_acks.add(fut)
+            fut.add_done_callback(self._borrow_acks.discard)
+
+    async def _flush_borrows_async(self, timeout: float = 5.0):
+        """Await every in-flight borrow +1 registration's ack."""
+        futs = [asyncio.wrap_future(f) for f in list(self._borrow_acks)
+                if not f.done()]
+        if futs:
+            await asyncio.wait(futs, timeout=timeout)
+
+    def flush_borrows(self, timeout: float = 5.0):
+        """Block until outstanding borrow +1 registrations are acked by
+        their owners.  Called by workers before replying to a task push:
+        afterwards the caller can release its arg pins immediately — the
+        owner provably knows about this process's borrows (the role of the
+        reference's borrower-list merge on task replies)."""
+        if not self._borrow_acks:
+            return
+        try:
+            self.io.run(self._flush_borrows_async(timeout),
+                        timeout=timeout + 2)
+        except Exception:  # noqa: BLE001 - loop shutting down
             pass
 
     async def _notify_owner(self, oid: bytes, owner: bytes, addr: str,
@@ -456,28 +544,36 @@ class CoreWorker:
             pins.append(info)
         return pins
 
-    def _unpin_refs_later(self, pins: List["ObjectRefInfo"],
-                          delay: Optional[float] = None):
-        """Release task-arg pins after a grace period.  The grace covers
-        the borrow race: a worker that stashed a borrowed ref registers
-        with us asynchronously (its +1 is posted when the ref is
-        deserialized, i.e. before user code even ran), so by reply + grace
-        it has long arrived.  (Reference closes this exactly instead, by
-        merging borrower lists carried on the task reply.)"""
-        if not pins:
-            return
-        delay = self.config.borrow_grace_s if delay is None else delay
-        try:
-            asyncio.get_running_loop().create_task(
-                self._unpin_after(pins, delay))
-        except RuntimeError:  # caller is not on the loop
-            self.io.post(self._unpin_after(pins, delay))
-
-    async def _unpin_after(self, pins: List["ObjectRefInfo"], delay: float):
-        await asyncio.sleep(delay)
+    def _unpin_now(self, pins: List["ObjectRefInfo"]):
         for info in pins:
             self._decref_queue.append(info)
         self._drain_decrefs(block=False)
+
+    # -- worker-side pins for refs embedded in task returns ----------------
+    # The executing worker keeps refs nested in its return values pinned
+    # until the caller (the owner of the return object) confirms it has
+    # registered its own borrows (release_return_pins), with a timer only
+    # as the caller-crashed fallback — if the caller died, the return
+    # object is orphaned anyway and third-party nested refs must not leak.
+
+    def hold_return_pins(self, task_id: bytes,
+                         pins: List["ObjectRefInfo"]):
+        with self._ref_lock:
+            self._return_pins.setdefault(task_id, []).extend(pins)
+        try:
+            self.io.post(self._return_pin_fallback(task_id))
+        except Exception:  # noqa: BLE001 - loop shut down
+            pass
+
+    async def _return_pin_fallback(self, task_id: bytes):
+        await asyncio.sleep(self.config.worker_start_timeout_s)
+        self.release_return_pins(task_id)
+
+    def release_return_pins(self, task_id: bytes):
+        with self._ref_lock:
+            pins = self._return_pins.pop(task_id, None)
+        if pins:
+            self._unpin_now(pins)
 
     # ---- object plane ----------------------------------------------------
 
@@ -503,7 +599,11 @@ class CoreWorker:
 
     def put(self, value: Any, owner_address: str = "") -> "ObjectRefInfo":
         oid = put_object_id(self._ctx_task_id())
-        ser = serialization.serialize(value)
+        ser, collected = self._serialize_collecting(value)
+        if collected:
+            # Refs nested inside the value stay pinned by the outer object
+            # until it is freed (reference: AddNestedObjectIds).
+            self._pin_contained(oid.binary(), collected)
         if ser.total_size <= self.config.max_inline_object_size:
             self._store_local(oid.binary(), ser.to_bytes(), False)
         else:
@@ -511,32 +611,83 @@ class CoreWorker:
         return ObjectRefInfo(oid.binary(), self.worker_id.binary(),
                              self.node_address)
 
-    def _put_shm(self, oid: ObjectID, ser: serialization.SerializedObject):
+    def _serialize_collecting(self, value: Any):
+        """serialize(value) while collecting ObjectRefs nested inside it."""
+        from ray_tpu._private.worker_context import _ser_scope
+
+        prev = getattr(_ser_scope, "refs", None)
+        _ser_scope.refs = collected = []
         try:
-            view = self.store.create(oid, ser.total_size)
-        except ObjectStoreFull:
-            self.store.evict(ser.total_size)
-            view = self.store.create(oid, ser.total_size)
-        except ObjectStoreError as e:
-            if "exists" not in str(e):
-                raise
-            if self.store.contains(oid):
-                return  # sealed copy already present: idempotent re-create
-            # created-but-unsealed orphan (crashed writer): abort it
-            # (os_obj_abort handles unsealed entries) and retry once
-            try:
-                self.store.abort(oid)
-            except Exception:  # noqa: BLE001
-                pass
-            view = self.store.create(oid, ser.total_size)
+            ser = serialization.serialize(value)
+        finally:
+            _ser_scope.refs = prev
+        return ser, collected
+
+    def _pin_contained(self, outer_oid: bytes,
+                       infos: List["ObjectRefInfo"]):
+        for info in infos:
+            self.add_local_ref(info)
+        with self._ref_lock:
+            if outer_oid in self._freed:
+                for info in infos:
+                    self._decref_queue.append(info)
+            else:
+                self._contained.setdefault(outer_oid, []).extend(infos)
+
+    def _put_shm(self, oid: ObjectID, ser: serialization.SerializedObject):
+        view = self._create_with_backpressure(oid, ser.total_size)
+        if view is None:
+            return  # sealed copy already present: idempotent re-create
         try:
             ser.write_into(view)
         finally:
             view.release()
         self.store.seal(oid)
 
+    def _create_with_backpressure(self, oid: ObjectID, size: int):
+        """create() with spill-then-evict pressure relief and a bounded
+        retry queue when the arena stays full (reference: plasma's
+        CreateRequestQueue retries creates instead of failing,
+        create_request_queue.cc; spill preferred over eviction,
+        local_object_manager.h:206)."""
+        deadline = time.monotonic() + self.config.create_retry_timeout_s
+        while True:
+            try:
+                # Spill-first: with a spill dir configured the allocator
+                # must NOT silently evict (that destroys data lineage may
+                # have to rebuild); we move LRU objects to disk instead.
+                return self.store.create(
+                    oid, size, allow_evict=not self.spill.enabled)
+            except ObjectStoreFull:
+                freed = self.spill.spill(size) if self.spill.enabled else 0
+                if freed < size:
+                    self.store.evict(size - freed)
+                if time.monotonic() > deadline:
+                    # Final attempt surfaces the real error — still
+                    # honoring the no-silent-eviction invariant when
+                    # spilling is configured.
+                    return self.store.create(
+                        oid, size, allow_evict=not self.spill.enabled)
+                time.sleep(0.01)
+            except ObjectStoreError as e:
+                if "exists" not in str(e):
+                    raise
+                if self.store.contains(oid):
+                    return None  # sealed copy present: idempotent
+                # created-but-unsealed orphan (crashed writer): abort and
+                # retry (os_obj_abort handles unsealed entries)
+                try:
+                    self.store.abort(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.01)
+
     def _read_ready(self, oid: bytes) -> Optional[Tuple[Any, bool]]:
-        """Non-blocking read: memory store, then shared store."""
+        """Non-blocking read: memory store, then shared store, then the
+        node's spill directory (restore-on-get without re-inserting, so a
+        read never triggers further spilling)."""
         entry = self.memory_store.get(oid)
         if entry is not None and entry.event.is_set() and not entry.in_store:
             return serialization.deserialize(entry.data)
@@ -546,13 +697,17 @@ class CoreWorker:
                 # Copy out of shm before deserializing so views outlive pin.
                 return serialization.deserialize(
                     bytes(buf.data) + bytes(buf.metadata))
+        data = self.spill.read(oid)
+        if data is not None:
+            return serialization.deserialize(data)
         return None
 
     def is_ready(self, ref: "ObjectRefInfo") -> bool:
         entry = self.memory_store.get(ref.oid)
         if entry is not None and entry.event.is_set():
             return True
-        return self.store.contains(ObjectID(ref.oid))
+        return self.store.contains(ObjectID(ref.oid)) or \
+            self.spill.contains(ref.oid)
 
     def get(self, refs: Sequence["ObjectRefInfo"],
             timeout: Optional[float] = None) -> List[Any]:
@@ -579,6 +734,13 @@ class CoreWorker:
                             self.config.pull_retry_interval_s):
                         pull_last[i] = now
                         self.io.post(self._request_pull(ref))
+                        # Borrowed object that stays unpullable: tell the
+                        # owner so it can reconstruct from lineage (the
+                        # storing node may be dead).
+                        t0 = miss_since.setdefault(i, now)
+                        if now - t0 > self.config.object_miss_grace_s:
+                            miss_since[i] = now
+                            self.io.post(self._report_unavailable(ref))
                     entry = self.memory_store.get(ref.oid)
                     if (entry is not None and entry.in_store
                             and ref.owner == self.worker_id.binary()):
@@ -664,9 +826,21 @@ class CoreWorker:
         try:
             await self.nm.call("pull_object", {
                 "oid": ref.oid, "owner": ref.owner,
-                "owner_node_address": ref.node_address})
+                "owner_node_address": ref.node_address}, timeout=60.0)
         except Exception as e:  # noqa: BLE001 - surfaced by get timeout
             logger.debug("pull_object failed for %s: %s", ref.oid.hex()[:16], e)
+
+    async def _report_unavailable(self, ref: "ObjectRefInfo"):
+        """Route object_unavailable to the owner via its node manager
+        (same path as borrow notifications)."""
+        try:
+            conn = self.nm if ref.node_address == self.node_address else \
+                await self._worker_conn(ref.node_address)
+            await conn.call("object_unavailable", {
+                "oid": ref.oid, "owner": ref.owner})
+        except Exception as e:  # noqa: BLE001 - owner/node gone
+            logger.debug("unavailability report failed for %s: %s",
+                         ref.oid.hex()[:16], e)
 
     def wait(self, refs: Sequence["ObjectRefInfo"], num_returns: int,
              timeout: Optional[float], fetch_local: bool = True
@@ -707,6 +881,7 @@ class CoreWorker:
                 self.store.delete(ObjectID(ref.oid))
             except Exception:  # noqa: BLE001
                 pass
+            self.spill.delete(ref.oid)
 
     def _raise_error(self, err: Any):
         if isinstance(err, BaseException):
@@ -875,10 +1050,16 @@ class CoreWorker:
         except Exception as e:  # noqa: BLE001 - record as task error
             self._fail_task(spec, e)
         finally:
-            self._unpin_refs_later(pins)
+            # Safe to unpin immediately: the worker acked its borrow
+            # registrations to every owner before replying (flush_borrows
+            # in _execute), and a crashed worker holds no borrows.
+            self._unpin_now(pins)
 
     def _fail_task(self, spec, exc: Exception):
-        err = exceptions.RayTaskError(repr(exc), "")
+        # Cancellation (and other framework errors) surface as themselves
+        # from get(); only opaque failures are wrapped.
+        err = exc if isinstance(exc, exceptions.RayTpuError) else \
+            exceptions.RayTaskError(repr(exc), "")
         data = serialization.serialize_error(err).to_bytes()
         for i in range(spec["num_returns"]):
             oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
@@ -902,30 +1083,55 @@ class CoreWorker:
             if state.pg is not None:
                 payload["pg_id"] = state.pg[0]
                 payload["bundle_index"] = state.pg[1]
-            lease = await self.nm.call("request_worker_lease", payload)
-            # Spillback: local node can't fit the shape — re-lease at the
-            # node the scheduler pointed us to (reference:
-            # direct_task_transport.cc:473 retry at raylet address).
-            hops = 0
-            while isinstance(lease, dict) and lease.get("spillback"):
-                addr = lease["spillback"]
-                hops += 1
-                if hops > 4:
-                    raise RuntimeError("spillback loop; cluster resources "
-                                       "changing too fast")
-                nm = await self._worker_conn(addr)
-                lease = await nm.call("request_worker_lease", payload)
-                if not lease.get("spillback"):
-                    lease["nm_addr"] = addr
-            state.workers.append(lease)
-            self._dispatch(skey, state)
-        except Exception as e:  # noqa: BLE001 - fail queued tasks
+            last_exc: Optional[BaseException] = None
+            # A lease attempt dying mid-flight (target node killed while
+            # granting / starting a worker) is retried with a FRESH
+            # spillback pick — the GCS will route around the dead node
+            # (reference: lease retries in direct_task_transport on raylet
+            # failure).  Only persistent failure surfaces to the tasks.
+            for attempt in range(5):
+                try:
+                    lease = await self._lease_once(payload)
+                    state.workers.append(lease)
+                    self._dispatch(skey, state)
+                    if not state.queue:
+                        # Every queued task vanished while the lease was
+                        # being granted (e.g. cancel()): hand it straight
+                        # back or the worker's resources stay held.
+                        await self._return_idle(skey, state)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    last_exc = e
+                    if not state.queue:
+                        return  # nobody waiting anymore
+                    logger.warning(
+                        "lease attempt %d for %s failed: %s", attempt + 1,
+                        state.resources, e)
+                    await asyncio.sleep(0.3 * (attempt + 1))
             while state.queue:
                 _, fut = state.queue.pop(0)
                 if not fut.done():
-                    fut.set_exception(e)
+                    fut.set_exception(last_exc)
         finally:
             state.inflight_requests -= 1
+
+    async def _lease_once(self, payload) -> dict:
+        lease = await self.nm.call("request_worker_lease", payload)
+        # Spillback: local node can't fit the shape — re-lease at the
+        # node the scheduler pointed us to (reference:
+        # direct_task_transport.cc:473 retry at raylet address).
+        hops = 0
+        while isinstance(lease, dict) and lease.get("spillback"):
+            addr = lease["spillback"]
+            hops += 1
+            if hops > 4:
+                raise RuntimeError("spillback loop; cluster resources "
+                                   "changing too fast")
+            nm = await self._worker_conn(addr)
+            lease = await nm.call("request_worker_lease", payload)
+            if not lease.get("spillback"):
+                lease["nm_addr"] = addr
+        return lease
 
     def _dispatch(self, skey, state: LeaseState):
         while state.queue and state.workers:
@@ -947,10 +1153,25 @@ class CoreWorker:
         return conn
 
     async def _push_task(self, skey, state, lease, spec, fut):
+        tid = spec["task_id"]
+        if tid in self._cancelled:
+            self._fail_task(spec, exceptions.TaskCancelledError(
+                f"task {spec.get('name', '?')} was cancelled"))
+            if not fut.done():
+                fut.set_result(None)
+            state.workers.append(lease)
+            if state.queue:
+                self._dispatch(skey, state)
+            else:
+                await self._return_idle(skey, state)
+            return
         try:
             conn = await self._worker_conn(lease["address"])
+            self._inflight_tasks[tid] = lease["address"]
             reply = await conn.call("push_task", spec)
-            self._ingest_returns(spec, reply)
+            if self._ingest_returns(spec, reply):
+                asyncio.get_running_loop().create_task(
+                    self._confirm_return_pins(conn, spec["task_id"]))
             if not fut.done():
                 fut.set_result(None)
         except protocol.RpcError as e:
@@ -959,7 +1180,14 @@ class CoreWorker:
                 fut.set_result(None)
         except Exception as e:  # noqa: BLE001 - worker died mid-task
             lease = None  # lease is gone with the worker
-            if spec.get("retries_left", 0) > 0:
+            if tid in self._cancelled:
+                # force-cancel kills the worker: report cancellation, not a
+                # crash, and never retry.
+                self._fail_task(spec, exceptions.TaskCancelledError(
+                    f"task {spec.get('name', '?')} was cancelled"))
+                if not fut.done():
+                    fut.set_result(None)
+            elif spec.get("retries_left", 0) > 0:
                 # Retry on a fresh lease (reference: TaskManager resubmits
                 # failed tasks up to max_retries, task_manager.h:85).
                 spec["retries_left"] -= 1
@@ -972,6 +1200,7 @@ class CoreWorker:
                     exceptions.WorkerCrashedError(
                         f"worker died executing task: {e}"))
         finally:
+            self._inflight_tasks.pop(tid, None)
             if lease is not None:
                 state.workers.append(lease)
             if state.queue:
@@ -997,12 +1226,21 @@ class CoreWorker:
             except Exception:  # noqa: BLE001
                 pass
 
-    def _ingest_returns(self, spec, reply):
+    def _ingest_returns(self, spec, reply) -> bool:
+        """Record task returns; returns True when any return embedded
+        nested ObjectRefs (the worker is then holding pins that must be
+        released via release_return_pins once our own borrows are acked)."""
+        had_contained = False
         for ret in reply["returns"]:
             oid = ret["oid"]
             with self._ref_lock:
                 if oid in self._freed:
                     continue  # every ref was dropped while in flight
+            contained = ret.get("contained")
+            if contained:
+                had_contained = True
+                self._pin_contained(oid, [
+                    ObjectRefInfo(o, w, a) for o, w, a in contained])
             if "d" in ret:
                 self._store_local(oid, ret["d"], bool(ret.get("err")))
                 continue
@@ -1016,16 +1254,43 @@ class CoreWorker:
             else:
                 # Large return living in shm; wake blocked getters.
                 self._ensure_entry(oid).put_in_store()
+        return had_contained
+
+    async def _confirm_return_pins(self, conn, task_id: bytes):
+        """Ack our nested-return borrows to their owners, then tell the
+        executing worker to drop its bridging pins (exact handover)."""
+        try:
+            await self._flush_borrows_async()
+            await conn.call("release_return_pins", {"task_id": task_id})
+        except Exception:  # noqa: BLE001 - worker exited; its fallback runs
+            pass
 
     async def _pull_return(self, oid: bytes, node_addr: str):
-        try:
-            await self.nm.call("pull_object", {
-                "oid": oid, "owner": b"",
-                "owner_node_address": node_addr})
-            self._ensure_entry(oid).put_in_store()
-        except Exception as e:  # noqa: BLE001 - surfaced by get() timeout
-            logger.warning("cross-node return pull failed for %s: %s",
-                           oid.hex()[:16], e)
+        for attempt in range(3):
+            try:
+                await self.nm.call("pull_object", {
+                    "oid": oid, "owner": b"",
+                    "owner_node_address": node_addr}, timeout=30.0)
+                self._ensure_entry(oid).put_in_store()
+                return
+            except Exception as e:  # noqa: BLE001 - storing node may be dead
+                logger.warning(
+                    "cross-node return pull failed for %s (try %d): %s",
+                    oid.hex()[:16], attempt + 1, e)
+                await asyncio.sleep(0.5 * (attempt + 1))
+        # The storing node is gone before we secured a copy: re-execute
+        # the producing task from lineage (reference:
+        # object_recovery_manager.h:41).  If that's impossible the entry
+        # must resolve to an ERROR — dependents await its readiness and
+        # would otherwise hang forever.
+        if not self._try_recover(oid):
+            logger.warning("return object %s unrecoverable",
+                           oid.hex()[:16])
+            err = exceptions.ObjectLostError(
+                f"object {oid.hex()[:16]}'s storing node died before the "
+                "owner pulled a copy, and it cannot be reconstructed")
+            self._store_local(
+                oid, serialization.serialize_error(err).to_bytes(), True)
 
     # ---- actors ----------------------------------------------------------
 
@@ -1047,11 +1312,14 @@ class CoreWorker:
             "resources": resources,
             "max_concurrency": max_concurrency,
         }
-        # Pin ctor args until the actor had ample time to construct (its
-        # own borrow registrations take over from there).
+        # Pin ctor args until the actor is READY or DEAD — not a timer
+        # from submission: the actor may sit in the lease queue arbitrarily
+        # long before its ctor deserializes the args.  The actor worker
+        # flushes its borrow acks before reporting ready, so release on
+        # READY is exact.
         pins = self._pin_refs(
             list(spec["args"]) + list(spec["kwargs"].values()), nested)
-        self._unpin_refs_later(pins, self.config.worker_start_timeout_s)
+        self.io.post(self._unpin_on_actor_ready(actor_id.binary(), pins))
         if pg is not None:
             spec["placement_group_id"] = pg[0]
             spec["bundle_index"] = pg[1]
@@ -1059,6 +1327,15 @@ class CoreWorker:
             "actor_id": actor_id.binary(), "spec": spec, "name": name,
             "max_restarts": max_restarts, "lifetime": lifetime}))
         return actor_id.binary()
+
+    async def _unpin_on_actor_ready(self, actor_id: bytes,
+                                    pins: List["ObjectRefInfo"]):
+        try:
+            await self.gcs.call("actor_get_info",
+                                {"actor_id": actor_id, "wait_ready": True})
+        except Exception:  # noqa: BLE001 - GCS gone; release regardless
+            pass
+        self._unpin_now(pins)
 
     def wait_actor_ready(self, actor_id: bytes, timeout: float = 120.0) -> dict:
         info = self.io.run(self.gcs.call(
@@ -1117,7 +1394,7 @@ class CoreWorker:
         try:
             await self._push_actor_task_inner(actor_id, spec, dial_retries)
         finally:
-            self._unpin_refs_later(pins)
+            self._unpin_now(pins)  # worker acked its borrows pre-reply
 
     async def _push_actor_task_inner(self, actor_id: bytes, spec: dict,
                                      dial_retries: int = 3):
@@ -1154,27 +1431,79 @@ class CoreWorker:
         # send never consumes a seqno. NOT retried after send: the task may
         # have executed (actor tasks default to max_task_retries=0, matching
         # reference ray_option_utils.py:159 semantics).
+        if spec["task_id"] in self._cancelled:
+            self._fail_actor_task(spec, exceptions.TaskCancelledError(
+                "actor task was cancelled"))
+            return
         lock = self._actor_send_locks.setdefault(actor_id, asyncio.Lock())
         try:
             async with lock:
-                seqno = self._actor_seqno.get(actor_id, 0)
-                self._actor_seqno[actor_id] = seqno + 1
-                spec["seqno"] = seqno
+                if spec["method"] == "raytpu_probe":
+                    # Out-of-band: answered on the worker's server loop,
+                    # never enters the ordered queue — consuming a seqno
+                    # would leave a permanent gap stalling real calls.
+                    spec["seqno"] = -1
+                else:
+                    seqno = self._actor_seqno.get(actor_id, 0)
+                    self._actor_seqno[actor_id] = seqno + 1
+                    spec["seqno"] = seqno
                 waiter = await conn.call_send("push_actor_task", spec)
+            self._inflight_tasks[spec["task_id"]] = addr
             reply = await waiter
-            self._ingest_returns(spec, reply)
+            if self._ingest_returns(spec, reply):
+                asyncio.get_running_loop().create_task(
+                    self._confirm_return_pins(conn, spec["task_id"]))
         except protocol.RpcError as e:
             self._fail_task_user_error(spec, e)
         except Exception as e:  # noqa: BLE001 - actor died mid-call
             self._actor_addr_cache.pop(actor_id, None)
             self._fail_actor_task(spec, exceptions.ActorDiedError(
                 f"actor died while executing task: {e}"))
+        finally:
+            self._inflight_tasks.pop(spec["task_id"], None)
 
     def _fail_actor_task(self, spec, err: BaseException):
         data = serialization.serialize_error(err).to_bytes()
         for i in range(spec["num_returns"]):
             oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
             self._store_local(oid, data, True)
+
+    def cancel_task(self, ref: "ObjectRefInfo", force: bool = False,
+                    recursive: bool = True):
+        """Cancel the task producing ``ref`` (reference: worker.py:2552 +
+        CoreWorker::CancelTask).  Dequeues if not yet dispatched, else
+        delivers an async TaskCancelledError (or kills the worker when
+        force=True)."""
+        tid = ObjectID(ref.oid).task_id().binary()
+        self.io.run(self._cancel_on_loop(tid, force), timeout=30)
+
+    async def _cancel_on_loop(self, tid: bytes, force: bool):
+        self._cancelled[tid] = None
+        while len(self._cancelled) > 100_000:
+            self._cancelled.popitem(last=False)  # oldest = long-finished
+        # Dequeue if still waiting for a lease.
+        for state in self._leases.values():
+            for i, (spec, fut) in enumerate(list(state.queue)):
+                if spec["task_id"] == tid:
+                    state.queue.pop(i)
+                    spec["retries_left"] = 0
+                    self._fail_task(spec, exceptions.TaskCancelledError(
+                        f"task {spec.get('name', '?')} was cancelled"))
+                    if not fut.done():
+                        fut.set_result(None)
+                    return
+        # Already pushed (normal task on a leased worker, or an actor
+        # task): reach into the executing worker.
+        addr = self._inflight_tasks.get(tid)
+        if addr is None:
+            return  # finished (or unknown): nothing to do
+        try:
+            conn = await self._worker_conn(addr)
+            await conn.call("cancel_task",
+                            {"task_id": tid, "force": force})
+        except Exception as e:  # noqa: BLE001 - worker already gone
+            logger.debug("cancel delivery failed for %s: %s",
+                         tid.hex()[:12], e)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True):
         self.io.run(self.gcs.call("actor_kill", {

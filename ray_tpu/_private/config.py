@@ -35,10 +35,14 @@ class Config:
     #: going through the shared-memory store (reference analog:
     #: max_direct_call_object_size = 100 KiB).
     max_inline_object_size: int = 100 * 1024
-    #: Directory for spilled objects (filesystem spill backend).
+    #: Directory for spilled objects (filesystem spill backend); empty =
+    #: <session_dir>/spill on each node.
     spill_dir: str = ""
     #: Fraction of the arena above which eviction/spill kicks in.
     object_store_full_fraction: float = 0.95
+    #: How long a create() queues against a full arena (spilling in the
+    #: background) before giving up (reference: plasma CreateRequestQueue).
+    create_retry_timeout_s: float = 30.0
 
     # --- scheduling ---
     #: Number of workers kept warm per node (defaults to num CPUs).
@@ -51,6 +55,13 @@ class Config:
     scheduler_spread_threshold: float = 0.5
     #: Max times a task is retried on worker/node failure.
     default_max_retries: int = 3
+    #: How long a cluster-wide-infeasible lease keeps retrying spillback
+    #: picks (covers autoscaler node-launch latency) before failing.
+    infeasible_lease_grace_s: float = 20.0
+
+    #: GCS fault-tolerance snapshot file (empty = in-memory only; the
+    #: reference's Redis-backed store, redis_store_client.h:28).
+    gcs_persist_path: str = ""
 
     # --- timeouts / liveness ---
     heartbeat_interval_s: float = 1.0
@@ -76,9 +87,6 @@ class Config:
     #: Byte budget for retained task specs; oldest lineage is evicted past
     #: this (reference analog: max_lineage_bytes).
     max_lineage_bytes: int = 64 * 1024 * 1024
-    #: Grace after a task reply before its arg pins are released, covering
-    #: the in-flight window of a borrower's async acquire notification.
-    borrow_grace_s: float = 1.0
 
     # --- object transfer ---
     #: Chunk size for node-to-node object streaming (reference analog:

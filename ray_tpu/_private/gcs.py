@@ -87,7 +87,7 @@ class ActorInfo:
 class NodeInfo:
     __slots__ = ("node_id", "conn", "resources_total", "resources_available",
                  "address", "object_store_name", "last_heartbeat", "alive",
-                 "labels")
+                 "labels", "pending_demand", "num_busy_workers")
 
     def __init__(self, node_id: bytes, conn: protocol.Connection,
                  resources: Dict[str, float], address: str,
@@ -101,6 +101,12 @@ class NodeInfo:
         self.last_heartbeat = time.monotonic()
         self.alive = True
         self.labels = labels
+        #: queued lease shapes from the node's last heartbeat (autoscaler
+        #: demand signal).
+        self.pending_demand: List[Dict[str, float]] = []
+        #: leased/actor workers on the node (autoscaler occupancy signal —
+        #: zero-resource actors must block idle scale-down).
+        self.num_busy_workers = 0
 
 
 class PlacementGroupInfo:
@@ -123,7 +129,13 @@ class PlacementGroupInfo:
 
 
 class GcsServer:
-    def __init__(self, heartbeat_timeout_s: float = 30.0):
+    def __init__(self, heartbeat_timeout_s: float = 30.0,
+                 persist_path: str = ""):
+        #: Snapshot file for GCS fault tolerance (reference: the pluggable
+        #: RedisStoreClient, store_client/redis_store_client.h:28 — here a
+        #: local file store; empty = in-memory only).  State is restored
+        #: in start_*() and snapshotted after mutations.
+        self.persist_path = persist_path
         self.server = protocol.Server()
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_disconnect
@@ -143,18 +155,105 @@ class GcsServer:
         self._actor_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self._pg_waiters: Dict[bytes, List[asyncio.Future]] = {}
         self._pg_lock = asyncio.Lock()
+        #: shape-tuple -> last-seen time of cluster-wide-infeasible lease
+        #: shapes (deduped) — the autoscaler's launch trigger.
+        self._unschedulable: Dict[Tuple, float] = {}
+        #: actor ids with a monitor-initiated scheduling task in flight.
+        self._actor_scheduling: Set[bytes] = set()
+        #: snapshot throttle: mutators set this; the monitor loop writes.
+        self._dirty = False
         self._closing = False
 
     async def start_unix(self, path: str):
+        self._restore()
         await self.server.start_unix(path)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._monitor_loop())
 
     async def start_tcp(self, host: str, port: int) -> int:
+        self._restore()
         port = await self.server.start_tcp(host, port)
         self._monitor_task = asyncio.get_running_loop().create_task(
             self._monitor_loop())
         return port
+
+    # ---- fault-tolerance snapshot/restore --------------------------------
+
+    def snapshot(self) -> None:
+        """Durably record recoverable control state: KV, job counter,
+        named-actor registry + detached actor specs, placement-group
+        metadata.  Live node/worker connections are NOT state — nodes
+        re-register after a head restart (reference: raylet reconnect on
+        GCS failover, test_gcs_fault_tolerance.py)."""
+        if not self.persist_path:
+            return
+        import os
+        import pickle
+
+        actors = {}
+        for aid, a in self.actors.items():
+            if not a.lifetime_detached:
+                continue
+            actors[aid] = {
+                "spec": a.spec, "name": a.name,
+                "resources": a.resources, "max_restarts": a.max_restarts,
+                "placement_group_id": a.placement_group_id,
+                "bundle_index": a.bundle_index,
+            }
+        state = {
+            "kv": dict(self.kv),
+            "job_counter": self._job_counter,
+            "detached_actors": actors,
+            "placement_groups": {
+                pid: {"name": pg.name, "bundles": pg.bundles,
+                      "strategy": pg.strategy}
+                for pid, pg in self.placement_groups.items()},
+        }
+        self._write_snapshot(state)
+
+    def _write_snapshot(self, state: dict) -> None:
+        import os
+        import pickle
+
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self.persist_path)
+
+    def _restore(self) -> None:
+        if not self.persist_path:
+            return
+        import os
+        import pickle
+
+        if not os.path.exists(self.persist_path):
+            return
+        with open(self.persist_path, "rb") as f:
+            state = pickle.load(f)
+        self.kv = state.get("kv", {})
+        self._job_counter = state.get("job_counter", 0)
+        for aid, a in state.get("detached_actors", {}).items():
+            info = ActorInfo(aid, a["spec"], a["name"], a["resources"],
+                             a["max_restarts"], True,
+                             a["placement_group_id"], a["bundle_index"])
+            # Comes back RESTARTING: the monitor re-schedules it once a
+            # node with capacity registers (its old worker died with the
+            # old head).
+            info.state = RESTARTING
+            self.actors[aid] = info
+            if a["name"]:
+                self.named_actors[a["name"]] = aid
+        for pid, p in state.get("placement_groups", {}).items():
+            pg = PlacementGroupInfo(pid, p["name"], p["bundles"],
+                                    p["strategy"])
+            pg.state = "PENDING"  # re-place on the new cluster
+            self.placement_groups[pid] = pg
+            if p["name"]:
+                self.named_pgs[p["name"]] = pid
+        logger.info("GCS restored from %s: %d kv keys, %d detached "
+                    "actors, %d placement groups", self.persist_path,
+                    len(self.kv), len(state.get("detached_actors", {})),
+                    len(state.get("placement_groups", {})))
 
     async def close(self):
         self._closing = True
@@ -189,6 +288,7 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return False
         self.kv[key] = payload["value"]
+        self._dirty = True
         return True
 
     async def rpc_kv_get(self, conn, payload):
@@ -198,6 +298,7 @@ class GcsServer:
         return {k: self.kv[k] for k in payload["keys"] if k in self.kv}
 
     async def rpc_kv_del(self, conn, payload):
+        self._dirty = True
         return self.kv.pop(payload["key"], None) is not None
 
     async def rpc_kv_exists(self, conn, payload):
@@ -227,6 +328,7 @@ class GcsServer:
 
     async def rpc_job_register(self, conn, payload):
         self._job_counter += 1
+        self._dirty = True
         job_id = JobID.from_int(self._job_counter)
         return {"job_id": job_id.binary()}
 
@@ -252,6 +354,8 @@ class GcsServer:
         info.last_heartbeat = time.monotonic()
         info.resources_available = payload.get(
             "resources_available", info.resources_available)
+        info.pending_demand = payload.get("pending_demand", [])
+        info.num_busy_workers = payload.get("num_busy_workers", 0)
         return {"reregister": False}
 
     async def rpc_node_list(self, conn, payload):
@@ -259,6 +363,7 @@ class GcsServer:
             {"node_id": n.node_id, "address": n.address, "alive": n.alive,
              "resources_total": n.resources_total,
              "resources_available": n.resources_available,
+             "num_busy_workers": n.num_busy_workers,
              "object_store": n.object_store_name, "labels": n.labels}
             for n in self.nodes.values()
         ]
@@ -296,6 +401,17 @@ class GcsServer:
         while True:
             await asyncio.sleep(1.0)
             now = time.monotonic()
+            if self._dirty and self.persist_path:
+                self._dirty = False
+                try:
+                    # Pickle+write can be large (KV holds runtime-env
+                    # packages): keep the event loop responsive by doing
+                    # the IO on an executor thread.  State is captured
+                    # into plain dicts on the loop first.
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.snapshot)
+                except Exception:  # noqa: BLE001 - disk hiccup; retry next tick
+                    self._dirty = True
             for node_id, info in list(self.nodes.items()):
                 if info.alive and now - info.last_heartbeat > self._heartbeat_timeout_s:
                     await self._handle_node_death(node_id)
@@ -309,6 +425,26 @@ class GcsServer:
                         for fut in self._pg_waiters.pop(pg.pg_id, []):
                             if not fut.done():
                                 fut.set_result(pg.public())
+            # Re-place restored detached actors once a feasible node has
+            # registered (GCS-restart recovery; reference:
+            # GcsActorManager reconstruction on failover).
+            for info in list(self.actors.values()):
+                if info.placement_group_id:
+                    pg = self.placement_groups.get(info.placement_group_id)
+                    if pg is None or pg.state != "CREATED":
+                        continue  # wait for the PG to re-place first
+                if (info.state == RESTARTING and not info.address
+                        and info.actor_id not in self._actor_scheduling
+                        and self._pick_node(info.resources) is not None):
+                    self._actor_scheduling.add(info.actor_id)
+
+                    async def resched(info=info):
+                        try:
+                            await self._schedule_actor(info)
+                        finally:
+                            self._actor_scheduling.discard(info.actor_id)
+
+                    asyncio.get_running_loop().create_task(resched())
 
     async def _handle_node_death(self, node_id: bytes):
         info = self.nodes.get(node_id)
@@ -349,6 +485,13 @@ class GcsServer:
                           n.resources_total.get(k, 0.0) >= v
                           for k, v in resources.items())]
         if not candidates:
+            # Cluster-wide infeasible: record as unschedulable demand so
+            # the autoscaler can launch a node for it.  Deduped by shape —
+            # the grace-window retry loop in the node manager re-asks
+            # every second and must not multiply one task into N demand
+            # entries (reference: LoadMetrics aggregates demand by shape).
+            key = tuple(sorted(resources.items()))
+            self._unschedulable[key] = time.monotonic()
             return None
         free = [n for n in candidates if all(
             n.resources_available.get(k, 0.0) >= v
@@ -356,6 +499,28 @@ class GcsServer:
         pool = free or candidates
         best = max(pool, key=lambda n: sum(n.resources_available.values()))
         return {"node_id": best.node_id, "address": best.address}
+
+    async def rpc_autoscaler_demand(self, conn, payload):
+        """Aggregate demand for the autoscaler: queued lease shapes from
+        node heartbeats, recently-unschedulable shapes, and resources of
+        actors stuck pending (reference: the load/demand summary the
+        monitor feeds StandardAutoscaler.update)."""
+        now = time.monotonic()
+        horizon = payload.get("horizon_s", 30.0)
+        pending: List[Dict[str, float]] = []
+        for n in self.nodes.values():
+            if n.alive:
+                pending.extend(n.pending_demand)
+        for a in self.actors.values():
+            if a.state == PENDING_CREATION:
+                res = a.spec.get("resources", {})
+                if res:
+                    pending.append(res)
+        for key, seen in list(self._unschedulable.items()):
+            if now - seen > horizon:
+                del self._unschedulable[key]
+        return {"pending": pending,
+                "infeasible": [dict(k) for k in self._unschedulable]}
 
     # ---- actors ----------------------------------------------------------
 
@@ -397,6 +562,8 @@ class GcsServer:
             bundle_index=spec.get("bundle_index", -1),
         )
         self.actors[actor_id] = info
+        if info.lifetime_detached:
+            self._dirty = True
         await self._schedule_actor(info)
         return True
 

@@ -91,7 +91,8 @@ class Node:
         if self.head:
             self.gcs = GcsServer(
                 heartbeat_timeout_s=self.config.heartbeat_interval_s
-                * self.config.num_heartbeats_timeout)
+                * self.config.num_heartbeats_timeout,
+                persist_path=self.config.gcs_persist_path)
             if self.gcs_address.startswith("/"):
                 self.io.run(self.gcs.start_unix(self.gcs_address))
             else:

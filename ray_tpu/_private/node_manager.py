@@ -109,6 +109,9 @@ class NodeManager:
         self.gcs_address = gcs_address
         self.node_address = node_address or os.path.join(
             session_dir, "sockets", "node_manager")
+        #: Node-local spill directory, shared by every process on the node
+        #: (announced in registration replies).
+        self.spill_dir = config.spill_dir or os.path.join(session_dir, "spill")
         self.server = protocol.Server()
         self.server.add_routes(self)
         self.server.on_disconnect = self._on_disconnect
@@ -167,6 +170,18 @@ class NodeManager:
                 reply = await self.gcs_conn.call("node_heartbeat", {
                     "node_id": self.node_id.binary(),
                     "resources_available": self.resources.available,
+                    # Queued lease shapes ride the heartbeat so the
+                    # autoscaler sees per-node pending demand (reference:
+                    # load metrics in the resource usage report consumed by
+                    # StandardAutoscaler).
+                    "pending_demand": [
+                        req.resources for req in self._lease_queue][:100],
+                    # Occupancy signal: zero-resource actors (controllers,
+                    # job supervisors) hold no resources but must keep
+                    # their node alive for the autoscaler.
+                    "num_busy_workers": sum(
+                        1 for w in self.workers.values()
+                        if w.state in ("leased", "actor")),
                 }, timeout=5.0)
                 if reply.get("reregister"):
                     # GCS lost us (marked dead / restarted): rejoin
@@ -185,6 +200,13 @@ class NodeManager:
         self._closing = True
         if self._heartbeat_task:
             self._heartbeat_task.cancel()
+        # Fail queued lease requests so their handler coroutines (and the
+        # remote submitters awaiting them) unwind instead of hanging.
+        for req in self._lease_queue:
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("node shutting down"))
+        self._lease_queue.clear()
         for w in list(self.workers.values()):
             self._kill_worker_process(w)
         if self.gcs_conn:
@@ -316,7 +338,8 @@ class NodeManager:
         self.owner_conns[payload["worker_id"]] = conn
         conn._nm_owner_id = payload["worker_id"]
         return {"node_id": self.node_id.binary(),
-                "object_store": self.object_store_name}
+                "object_store": self.object_store_name,
+                "spill_dir": self.spill_dir}
 
     def _on_disconnect(self, conn):
         worker_id = getattr(conn, "_nm_worker_id", None)
@@ -394,24 +417,45 @@ class NodeManager:
             raise ValueError("unknown placement group bundle")
         if not rset.feasible(resources):
             if bundle is None:
-                # Spillback: point the submitter at a node where the
-                # shape fits (reference: the Spillback reply with
+                # Spillback: point the submitter at a node where the shape
+                # fits (reference: the Spillback reply with
                 # retry_at_raylet_address, direct_task_transport.cc:473).
-                try:
-                    pick = await self.gcs_conn.call(
-                        "pick_node_for_lease",
-                        {"resources": resources,
-                         "exclude": self.node_id.binary()}, timeout=10.0)
-                except Exception:  # noqa: BLE001 - GCS unreachable
-                    pick = None
-                if pick is not None:
-                    return {"spillback": pick["address"]}
+                # With a live autoscaler, cluster-wide-infeasible shapes
+                # are retried for a grace window (the GCS records them as
+                # unschedulable demand and a node may be launching right
+                # now); without one they fail fast.
+                deadline = time.monotonic() + \
+                    self.config.infeasible_lease_grace_s
+                while True:
+                    try:
+                        pick = await self.gcs_conn.call(
+                            "pick_node_for_lease",
+                            {"resources": resources,
+                             "exclude": self.node_id.binary()}, timeout=10.0)
+                    except Exception:  # noqa: BLE001 - GCS unreachable
+                        pick = None
+                    if pick is not None:
+                        return {"spillback": pick["address"]}
+                    if time.monotonic() > deadline or \
+                            not await self._autoscaler_alive():
+                        break
+                    await asyncio.sleep(1.0)
             raise ValueError(
                 f"infeasible resource request {resources}; node has "
                 f"{rset.total}")
         self._lease_queue.append(req)
         self._pump_leases()
         return await fut
+
+    async def _autoscaler_alive(self) -> bool:
+        """True when an autoscaler heartbeat landed in GCS KV recently."""
+        try:
+            raw = await self.gcs_conn.call(
+                "kv_get", {"key": "__autoscaler_alive"}, timeout=5.0)
+            return raw is not None and \
+                time.time() - float(raw.decode()) < 30.0
+        except Exception:  # noqa: BLE001 - GCS unreachable
+            return False
 
     def _pump_leases(self):
         """Grant every queued lease that fits current availability."""
@@ -560,11 +604,19 @@ class NodeManager:
         """Route a borrower's acquire/release to the owner core worker on
         this node (reference analog: the owner-addressed borrow messages of
         the reference_count.h borrowing protocol)."""
+        return await self._route_to_owner("ref_borrow", payload)
+
+    async def rpc_object_unavailable(self, conn, payload):
+        """Route a borrower's lost-object report to the owner (triggers
+        lineage reconstruction there)."""
+        return await self._route_to_owner("object_unavailable", payload)
+
+    async def _route_to_owner(self, method: str, payload) -> bool:
         owner_conn = self.owner_conns.get(payload["owner"])
         if owner_conn is None or owner_conn.closed:
             return False  # owner gone; its objects die with it anyway
         try:
-            await owner_conn.call("ref_borrow", payload)
+            await owner_conn.call(method, payload)
         except Exception:  # noqa: BLE001 - owner exiting
             return False
         return True
@@ -590,44 +642,139 @@ class NodeManager:
         raise RuntimeError(
             f"cannot resolve object owner for {oid.hex()[:16]}")
 
+    def _store(self):
+        """Lazily-opened long-lived store client + spill manager for the
+        node manager's own object serving."""
+        from ray_tpu._private.object_store import ObjectStoreClient
+        from ray_tpu._private.spill import SpillManager
+
+        if not hasattr(self, "_store_client"):
+            self._store_client = ObjectStoreClient(self.object_store_name)
+            self._spill = SpillManager(self._store_client, self.spill_dir)
+        return self._store_client
+
     async def _pull_remote(self, oid: bytes, remote_addr: str):
         """Cross-node transfer: stream the object from the remote node
-        manager into the local store (chunked; reference push_manager.h)."""
-        from ray_tpu._private.object_store import ObjectStoreClient
+        manager into the local store in bounded chunks with admission
+        control (reference: ObjectManager chunked pull,
+        pull_manager.h:48 / object_buffer_pool.cc).  Large objects never
+        occupy one RPC frame, so a multi-GiB transfer neither hits the
+        4-byte frame cap nor head-of-line-blocks this loop."""
         from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_store import ObjectStoreError
 
+        store = self._store()
+        object_id = ObjectID(oid)
+        if store.contains(object_id) or self._spill.contains(oid):
+            return {"in_store": True}
         if remote_addr.startswith("/"):
-            peer = await protocol.connect_unix(remote_addr)
+            peer = await asyncio.wait_for(
+                protocol.connect_unix(remote_addr), timeout=5.0)
         else:
             host, port = remote_addr.rsplit(":", 1)
-            peer = await protocol.connect_tcp(host, int(port))
+            peer = await asyncio.wait_for(
+                protocol.connect_tcp(host, int(port)), timeout=5.0)
         try:
-            reply = await peer.call("read_object", {"oid": oid})
-            data = reply["data"]
-            store = ObjectStoreClient(self.object_store_name)
+            info = await peer.call("object_info", {"oid": oid},
+                                   timeout=15.0)
+            size = info["size"]
+            chunk = self.config.object_transfer_chunk_bytes
             try:
-                store.put_bytes(ObjectID(oid), data)
+                view = store.create(object_id, size)
+            except ObjectStoreError:
+                if store.contains(object_id):
+                    return {"in_store": True}  # concurrent pull won
+                raise
+            try:
+                sem = asyncio.Semaphore(
+                    self.config.object_transfer_max_inflight_chunks)
+
+                async def fetch(off: int):
+                    async with sem:
+                        r = await peer.call("read_object_chunk", {
+                            "oid": oid, "off": off,
+                            "len": min(chunk, size - off)}, timeout=30.0)
+                        view[off:off + len(r["data"])] = r["data"]
+
+                tasks = [asyncio.ensure_future(fetch(off))
+                         for off in range(0, size, chunk)]
+                try:
+                    await asyncio.gather(*tasks)
+                except BaseException:
+                    # Cancel the siblings BEFORE releasing the view, or a
+                    # straggler faults writing into released memory.
+                    for t in tasks:
+                        t.cancel()
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                    raise
+            except BaseException:
+                store.abort(object_id)
+                raise
             finally:
-                store.close()
+                view.release()
+            store.seal(object_id)
             return {"in_store": True}
         finally:
             await peer.close()
 
-    async def rpc_read_object(self, conn, payload):
-        """Serve an object's raw bytes to a peer node manager."""
-        from ray_tpu._private.object_store import ObjectStoreClient
+    async def rpc_object_info(self, conn, payload):
+        """Size of a local object (store or spill) for a pulling peer."""
         from ray_tpu._private.ids import ObjectID
 
         oid = payload["oid"]
-        store = ObjectStoreClient(self.object_store_name)
-        try:
-            buf = store.get(ObjectID(oid), timeout_ms=5000)
-            if buf is None:
-                raise RuntimeError("object not in store")
+        store = self._store()
+        buf = store.get(ObjectID(oid), timeout_ms=0)
+        if buf is not None:
+            with buf:
+                return {"size": len(buf.data) + len(buf.metadata)}
+        size = self._spill.size(oid)
+        if size is not None:
+            return {"size": size}
+        # Brief wait: the pull can race the producer's seal.
+        buf = store.get(ObjectID(oid), timeout_ms=5000)
+        if buf is None:
+            raise RuntimeError("object not in store")
+        with buf:
+            return {"size": len(buf.data) + len(buf.metadata)}
+
+    async def rpc_read_object_chunk(self, conn, payload):
+        """Serve one chunk of an object's payload (data ++ metadata)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid, off, length = payload["oid"], payload["off"], payload["len"]
+        store = self._store()
+        buf = store.get(ObjectID(oid), timeout_ms=0)
+        if buf is not None:
+            with buf:
+                # Slice without materializing the whole payload: the
+                # payload is data ++ metadata as two shm views.
+                d = len(buf.data)
+                parts = []
+                if off < d:
+                    parts.append(bytes(buf.data[off:min(d, off + length)]))
+                if off + length > d:
+                    parts.append(bytes(
+                        buf.metadata[max(0, off - d):off + length - d]))
+                return {"data": b"".join(parts)}
+        data = self._spill.read_range(oid, off, length)
+        if data is not None:
+            return {"data": data}
+        raise RuntimeError("object no longer in store")
+
+    async def rpc_read_object(self, conn, payload):
+        """Whole-object read (small objects / compatibility path)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid = payload["oid"]
+        store = self._store()
+        buf = store.get(ObjectID(oid), timeout_ms=5000)
+        if buf is not None:
             with buf:
                 return {"data": bytes(buf.data) + bytes(buf.metadata)}
-        finally:
-            store.close()
+        data = self._spill.read(oid)
+        if data is None:
+            raise RuntimeError("object not in store")
+        return {"data": data}
 
     # ---- introspection ---------------------------------------------------
 

@@ -117,6 +117,10 @@ def _load_lib() -> ctypes.CDLL:
     lib.os_obj_create.restype = ctypes.c_int64
     lib.os_obj_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_uint64, ctypes.c_uint64]
+    lib.os_obj_create2.restype = ctypes.c_int64
+    lib.os_obj_create2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64, ctypes.c_uint64,
+                                   ctypes.c_int]
     lib.os_obj_seal.restype = ctypes.c_int64
     lib.os_obj_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.os_obj_get.restype = ctypes.c_int64
@@ -130,6 +134,10 @@ def _load_lib() -> ctypes.CDLL:
         fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.os_evict.restype = ctypes.c_int64
     lib.os_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.os_lru_candidates.restype = ctypes.c_int64
+    lib.os_lru_candidates.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int64]
     lib.os_stats.argtypes = [ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
     return lib
 
@@ -206,11 +214,13 @@ class ObjectStoreClient:
     # -- write path --------------------------------------------------------
 
     def create(self, object_id: ObjectID, data_size: int,
-               metadata: bytes = b"") -> memoryview:
+               metadata: bytes = b"", allow_evict: bool = True) -> memoryview:
         """Allocate an object; returns a writable view of the data region.
-        Call seal() when filled, or abort() to drop it."""
-        off = self._lib.os_obj_create(self._h, object_id.binary(), data_size,
-                                      len(metadata))
+        Call seal() when filled, or abort() to drop it.  allow_evict=False
+        raises ObjectStoreFull instead of silently evicting LRU objects —
+        the spill-first path."""
+        off = self._lib.os_obj_create2(self._h, object_id.binary(), data_size,
+                                       len(metadata), 1 if allow_evict else 0)
         if off == OS_ERR_EXISTS:
             raise ObjectStoreError(f"object {object_id} already exists")
         if off == OS_ERR_FULL:
@@ -259,6 +269,8 @@ class ObjectStoreClient:
         return ObjectBuffer(self, object_id, data, meta)
 
     def contains(self, object_id: ObjectID) -> bool:
+        if self._closed:
+            return False
         return bool(self._lib.os_obj_contains(self._h, object_id.binary()))
 
     # -- lifecycle ---------------------------------------------------------
@@ -269,10 +281,24 @@ class ObjectStoreClient:
             self._lib.os_obj_release(self._h, object_id.binary())
 
     def delete(self, object_id: ObjectID) -> bool:
+        if self._closed:
+            return False  # mapping gone; touching it would segfault
         return self._lib.os_obj_delete(self._h, object_id.binary()) == OS_OK
 
     def evict(self, nbytes: int) -> int:
         return self._lib.os_evict(self._h, nbytes)
+
+    def lru_candidates(self, nbytes: int, max_out: int = 128
+                       ) -> list[tuple[ObjectID, int]]:
+        """LRU unpinned sealed objects (oldest first) totaling >= nbytes,
+        as (id, size) pairs — the spill victim list (reference:
+        local_object_manager.h:206 SpillObjectsOfSize)."""
+        id_size = 24  # kIdSize in objstore.cc
+        ids = ctypes.create_string_buffer(id_size * max_out)
+        sizes = (ctypes.c_uint64 * max_out)()
+        n = self._lib.os_lru_candidates(self._h, nbytes, ids, sizes, max_out)
+        return [(ObjectID(ids.raw[i * id_size:(i + 1) * id_size]), sizes[i])
+                for i in range(n)]
 
     def stats(self) -> dict:
         used = ctypes.c_uint64()

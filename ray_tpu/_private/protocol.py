@@ -275,11 +275,19 @@ class Server:
         conn.start()
 
     async def close(self):
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        # Connections first: a handler may be awaiting something that
+        # never resolves (a lease grant, a dead peer), and since 3.12
+        # Server.wait_closed waits for handlers — closing the transports
+        # wakes every remote caller with ConnectionLost immediately.
         for conn in list(self.connections):
             await conn.close()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(),
+                                       timeout=2.0)
+            except asyncio.TimeoutError:
+                pass  # stuck handler; transports are already closed
 
 
 async def connect_unix(path: str) -> Connection:

@@ -82,6 +82,19 @@ class WorkerServer:
                                             thread_name_prefix="task-exec")
         self.actor = ActorState()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        #: task_id -> executing thread ident, for cancellation delivery.
+        self._running_tasks: Dict[bytes, int] = {}
+        #: actor requests from arrival to completion (running + queued) —
+        #: the raytpu_probe load signal.
+        self._actor_pending = 0
+        #: cancellations that arrived before their task started executing.
+        self._cancelled_pending: set = set()
+        #: task ids whose thread got an async exc delivered (not yet raised).
+        self._cancel_delivered: set = set()
+        #: serializes async-exc delivery against task start/finish so a
+        #: cancellation can never land in the NEXT task run by the same
+        #: pool thread.
+        self._cancel_lock = threading.Lock()
         # Profile events buffered off the hot path, flushed to GCS by a
         # background task (reference: core_worker/profiling.cc batches).
         self._events: list = []
@@ -162,6 +175,17 @@ class WorkerServer:
                        for i in range(num_returns)]
         # Thread-local so concurrent actor threads don't clobber each other.
         worker_context.set_task_context(task_id, spec.get("actor_id", b""))
+        with self._cancel_lock:
+            if task_id in self._cancelled_pending:
+                # cancelled before it started: never run user code
+                self._cancelled_pending.discard(task_id)
+                err = exceptions.TaskCancelledError(
+                    "task was cancelled before execution")
+                data = serialization.serialize_error(err).to_bytes()
+                return [{"oid": ObjectID.for_return(
+                    TaskID(task_id), i + 1).binary(), "d": data,
+                    "err": True} for i in range(num_returns)]
+            self._running_tasks[task_id] = threading.get_ident()
         ev = {"task_id": task_id.hex(), "name": spec.get("name", "")
               or spec.get("method", "task"),
               "worker_id": self.worker_id.hex()[:16], "pid": os.getpid(),
@@ -180,17 +204,36 @@ class WorkerServer:
                     f"task declared num_returns={num_returns} but returned "
                     f"{len(values)} values")
             out = []
+            ret_pins = []
             for oid, value in zip(return_oids, values):
-                ser = serialization.serialize(value)
+                ser, collected = self.cw._serialize_collecting(value)
+                entry = {"oid": oid.binary()}
+                if collected:
+                    # Refs embedded in the return: report them to the
+                    # caller (the return's owner pins them as contained)
+                    # and bridge-pin them here until it confirms
+                    # (client.py hold_return_pins / release_return_pins).
+                    entry["contained"] = [
+                        (i.oid, i.owner, i.node_address) for i in collected]
+                    for info in collected:
+                        self.cw.add_local_ref(info)
+                    ret_pins.extend(collected)
                 if ser.total_size <= self.config.max_inline_object_size:
-                    out.append({"oid": oid.binary(), "d": ser.to_bytes()})
+                    entry["d"] = ser.to_bytes()
                 else:
                     self.cw._put_shm(oid, ser)
                     # carry the executing node's address: a cross-node
                     # submitter must pull the object to its own store
-                    out.append({"oid": oid.binary(), "in_store": True,
-                                "node": self.cw.node_address})
+                    entry["in_store"] = True
+                    entry["node"] = self.cw.node_address
+                out.append(entry)
+            if ret_pins:
+                self.cw.hold_return_pins(task_id, ret_pins)
             return out
+        except exceptions.TaskCancelledError as e:
+            data = serialization.serialize_error(e).to_bytes()
+            return [{"oid": oid.binary(), "d": data, "err": True}
+                    for oid in return_oids]
         except Exception as e:  # noqa: BLE001 - user code raised
             tb = traceback.format_exc()
             err = e if _picklable(e) else None
@@ -199,6 +242,21 @@ class WorkerServer:
             return [{"oid": oid.binary(), "d": data, "err": True}
                     for oid in return_oids]
         finally:
+            with self._cancel_lock:
+                self._running_tasks.pop(task_id, None)
+                if task_id in self._cancel_delivered:
+                    # The async exc was delivered but user code finished
+                    # first: clear it so it cannot fire inside whatever
+                    # this pool thread runs next.
+                    self._cancel_delivered.discard(task_id)
+                    import ctypes
+
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(threading.get_ident()), None)
+            # Ack-before-reply: once every borrow +1 this task posted is
+            # registered at its owner, the caller may release its arg pins
+            # the moment our reply lands (exact borrower handover).
+            self.cw.flush_borrows()
             worker_context.set_task_context(b"", b"")
             ev["end"] = time.time()
             self._events.append(ev)
@@ -232,7 +290,12 @@ class WorkerServer:
                 kwargs = {k: self._resolve_arg(v)
                           for k, v in spec["kwargs"].items()}
                 worker_context.set_task_context(b"", payload["actor_id"])
-                return cls(*args, **kwargs)
+                instance = cls(*args, **kwargs)
+                # Ack-before-ready: the creator releases its ctor-arg pins
+                # when GCS reports READY, so our borrows must be registered
+                # at their owners before this reply makes the actor READY.
+                self.cw.flush_borrows()
+                return instance
 
             self.actor.instance = await self._loop.run_in_executor(
                 self.exec_pool, construct)
@@ -243,8 +306,27 @@ class WorkerServer:
                              + traceback.format_exc()}
 
     async def rpc_push_actor_task(self, conn, spec):
+        if spec.get("method") == "raytpu_probe":
+            # Out-of-band liveness + load probe: answered on the server
+            # loop, NEVER queued behind user method slots (reference:
+            # control concurrency group for health checks / metrics,
+            # concurrency_group_manager.cc).  pending counts requests
+            # from arrival to completion (running + queued).
+            ser = serialization.serialize(
+                {"ok": True, "pending": self._actor_pending,
+                 "actor_id": self.actor.actor_id})
+            oid = ObjectID.for_return(TaskID(spec["task_id"]), 1)
+            return {"returns": [{"oid": oid.binary(),
+                                 "d": ser.to_bytes()}]}
         if self.actor.instance is None:
             raise RuntimeError("not an actor worker")
+        self._actor_pending += 1
+        try:
+            return await self._push_actor_task_ordered(conn, spec)
+        finally:
+            self._actor_pending -= 1
+
+    async def _push_actor_task_ordered(self, conn, spec):
         caller = spec["caller"]
         seqno = spec["seqno"]
         if self.actor.max_concurrency == 1:
@@ -310,6 +392,40 @@ class WorkerServer:
                                 fut.set_exception(e)
 
                     self._loop.create_task(run_buffered())
+
+    async def rpc_release_return_pins(self, conn, payload):
+        """Caller confirmed it pinned the refs embedded in our returns."""
+        self.cw.release_return_pins(payload["task_id"])
+        return True
+
+    async def rpc_cancel_task(self, conn, payload):
+        """Cancel a task on this worker (reference:
+        CoreWorker::HandleCancelTask — interrupt delivery to the executing
+        thread; force kills the process).  Not-yet-started tasks are
+        marked so they fail before user code runs."""
+        if payload.get("force"):
+            self._loop.call_later(0.05, os._exit, 1)
+            return True
+        task_id = payload["task_id"]
+        import ctypes
+
+        with self._cancel_lock:
+            tid = self._running_tasks.get(task_id)
+            if tid is None:
+                # Not running: either finished, or queued/buffered here —
+                # mark it so it dies at start if it ever runs.
+                self._cancelled_pending.add(task_id)
+                return False
+            # The CPython analog of the reference's async
+            # KeyboardInterrupt delivery into user code.  Under
+            # _cancel_lock the target thread cannot move on to another
+            # task between the lookup and the delivery.
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid),
+                ctypes.py_object(exceptions.TaskCancelledError))
+            if n == 1:
+                self._cancel_delivered.add(task_id)
+            return n == 1
 
     async def rpc_exit(self, conn, payload):
         self._loop.call_later(0.05, os._exit, 0)

@@ -430,15 +430,19 @@ uint64_t os_capacity(void* sp) { return static_cast<Store*>(sp)->h->capacity; }
 
 // Create an object (state CREATED, pinned by creator). Returns payload
 // offset (>0) or negative error. Total payload = data_size + meta_size.
-int64_t os_obj_create(void* sp, const uint8_t* id, uint64_t data_size,
-                      uint64_t meta_size) {
+// allow_evict=0 returns OS_ERR_FULL instead of silently evicting LRU
+// objects, so the client can spill victims to disk first (reference:
+// plasma prefers SpillObjectsOfSize over eviction when spilling is
+// configured, local_object_manager.h:206 / create_request_queue.cc).
+int64_t os_obj_create2(void* sp, const uint8_t* id, uint64_t data_size,
+                       uint64_t meta_size, int allow_evict) {
   auto* s = static_cast<Store*>(sp);
   Guard g(&s->h->mu);
   if (lookup(s, id) != kNil) return OS_ERR_EXISTS;
   uint32_t idx = entry_alloc(s);
   while (idx == kNil) {  // entry table exhausted: evict to reclaim entries
     uint32_t victim = s->h->lru_head;
-    if (victim == kNil) return OS_ERR_FULL;
+    if (victim == kNil || !allow_evict) return OS_ERR_FULL;
     lru_remove(s, victim);
     delete_entry_locked(s, victim);
     s->h->evictions.fetch_add(1);
@@ -446,7 +450,8 @@ int64_t os_obj_create(void* sp, const uint8_t* id, uint64_t data_size,
   }
   uint64_t need = data_size + meta_size;
   if (need == 0) need = 1;  // zero-size objects still get a slot
-  uint64_t off = alloc_with_eviction(s, need);
+  uint64_t off = allow_evict ? alloc_with_eviction(s, need)
+                             : heap_alloc(s, need);
   if (off == 0) { entry_release(s, idx); return OS_ERR_FULL; }
   Entry* e = &entries(s)[idx];
   memcpy(e->id, id, kIdSize);
@@ -460,6 +465,11 @@ int64_t os_obj_create(void* sp, const uint8_t* id, uint64_t data_size,
   s->h->bytes_used.fetch_add(data_size + meta_size);
   s->h->num_objects.fetch_add(1);
   return (int64_t)off;
+}
+
+int64_t os_obj_create(void* sp, const uint8_t* id, uint64_t data_size,
+                      uint64_t meta_size) {
+  return os_obj_create2(sp, id, data_size, meta_size, 1);
 }
 
 // Seal: object becomes immutable & readable; creator pin is dropped.
@@ -579,6 +589,31 @@ int64_t os_evict(void* sp, uint64_t nbytes) {
     s->h->evictions.fetch_add(1);
   }
   return (int64_t)freed;
+}
+
+// List LRU unpinned sealed object ids (oldest first) totaling >= nbytes,
+// WITHOUT deleting them.  Fills out_ids (max_out * kIdSize bytes) and
+// out_sizes; returns the count.  The caller spills them to disk and then
+// deletes — the spill analog of os_evict (reference: the raylet picks
+// spill victims from plasma's eviction order, local_object_manager.h:206
+// SpillObjectsOfSize).
+int64_t os_lru_candidates(void* sp, uint64_t nbytes, uint8_t* out_ids,
+                          uint64_t* out_sizes, int64_t max_out) {
+  auto* s = static_cast<Store*>(sp);
+  Guard g(&s->h->mu);
+  uint64_t acc = 0;
+  int64_t n = 0;
+  uint32_t cur = s->h->lru_head;
+  while (cur != kNil && n < max_out && acc < nbytes) {
+    Entry* e = &entries(s)[cur];
+    memcpy(out_ids + n * kIdSize, e->id, kIdSize);
+    uint64_t sz = e->data_size + e->meta_size;
+    out_sizes[n] = sz;
+    acc += sz;
+    n++;
+    cur = e->lru_next;
+  }
+  return n;
 }
 
 void os_stats(void* sp, uint64_t* bytes_used, uint64_t* num_objects,

@@ -1,0 +1,90 @@
+"""Task cancellation tests (reference: python/ray/tests/test_cancel.py;
+API parity with worker.py:2552 ray.cancel)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def test_cancel_queued_task(ray_start_regular):
+    @ray_tpu.remote
+    def busy():
+        time.sleep(3)
+        return 1
+
+    @ray_tpu.remote
+    def victim():
+        return 2
+
+    # Fill all 4 CPUs so the victim stays queued.
+    blockers = [busy.remote() for _ in range(4)]
+    time.sleep(0.3)
+    v = victim.remote()
+    ray_tpu.cancel(v)
+    with pytest.raises(exceptions.TaskCancelledError):
+        ray_tpu.get(v, timeout=10)
+    assert ray_tpu.get(blockers) == [1] * 4
+
+
+def test_cancel_running_task(ray_start_regular):
+    @ray_tpu.remote
+    def spin():
+        # Interruptible loop: async-exc delivery lands between bytecodes.
+        for _ in range(2000):
+            time.sleep(0.01)
+        return "done"
+
+    ref = spin.remote()
+    time.sleep(0.5)  # let it start
+    ray_tpu.cancel(ref)
+    with pytest.raises(exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_force_kills_worker(ray_start_regular):
+    @ray_tpu.remote
+    def hang():
+        time.sleep(600)
+
+    ref = hang.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+
+
+def test_cancel_finished_task_is_noop(ray_start_regular):
+    @ray_tpu.remote
+    def quick():
+        return 42
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref) == 42
+    ray_tpu.cancel(ref)  # no-op, no error
+    assert ray_tpu.get(ref) == 42
+
+
+def test_cancel_running_actor_task(ray_start_regular):
+    @ray_tpu.remote
+    class Spinner:
+        def spin(self):
+            for _ in range(2000):
+                time.sleep(0.01)
+            return "done"
+
+        def ping(self):
+            return "pong"
+
+    a = Spinner.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ref = a.spin.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref)
+    with pytest.raises(exceptions.TaskCancelledError):
+        ray_tpu.get(ref, timeout=15)
+    # actor survives the cancellation
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(a)
