@@ -1,0 +1,17 @@
+"""Cluster autoscaling: demand-driven node launch + idle scale-down.
+
+Role-equivalent of the reference autoscaler (reference
+``python/ray/autoscaler/_private/autoscaler.py:162 StandardAutoscaler``,
+``:353 update``; plugin interface ``autoscaler/node_provider.py:13``;
+bin-packing ``_private/resource_demand_scheduler.py``).
+"""
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.fake_provider import FakeNodeProvider
+from ray_tpu.autoscaler.autoscaling_cluster import AutoscalingCluster
+
+__all__ = [
+    "NodeProvider", "FakeNodeProvider", "NodeTypeConfig",
+    "StandardAutoscaler", "AutoscalingCluster",
+]

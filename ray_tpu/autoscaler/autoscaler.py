@@ -1,0 +1,222 @@
+"""StandardAutoscaler: one update() pass = read demand, bin-pack, launch,
+scale down idle nodes.
+
+Role-equivalent of the reference's ``_private/autoscaler.py:162
+StandardAutoscaler`` (``:353 update``) with the bin-packing demand
+scheduler (``_private/resource_demand_scheduler.py``) collapsed into the
+same class: demand shapes come from the GCS (queued lease shapes reported
+on node heartbeats + recently-unschedulable shapes from failed spillback
+picks), are packed first onto existing nodes' availability, and the
+remainder onto the cheapest feasible node type.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    name: str
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class _Launch:
+    """A node we asked the provider for that hasn't registered yet."""
+    provider_id: str
+    node_type: str
+    resources: Dict[str, float]
+    at: float = field(default_factory=time.monotonic)
+
+
+def _fits(avail: Dict[str, float], shape: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in shape.items())
+
+
+def _sub(avail: Dict[str, float], shape: Dict[str, float]) -> None:
+    for k, v in shape.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    def __init__(self, gcs_call, provider: NodeProvider,
+                 node_types: List[NodeTypeConfig], *,
+                 idle_timeout_s: float = 60.0,
+                 launch_timeout_s: float = 120.0,
+                 max_total_workers: int = 64):
+        """gcs_call(method, payload) -> result: a synchronous GCS RPC
+        facade (the monitor wires one up; tests may stub it)."""
+        self.gcs_call = gcs_call
+        self.provider = provider
+        self.node_types = {t.name: t for t in node_types}
+        self.idle_timeout_s = idle_timeout_s
+        self.launch_timeout_s = launch_timeout_s
+        self.max_total_workers = max_total_workers
+        self._pending: List[_Launch] = []
+        self._idle_since: Dict[bytes, float] = {}
+        self._beacon()
+
+    def _beacon(self) -> None:
+        """Liveness marker in GCS KV: node managers hold infeasible
+        leases for the launch-grace window only while this is fresh."""
+        try:
+            self.gcs_call("kv_put", {
+                "key": "__autoscaler_alive",
+                "value": str(time.time()).encode()})
+        except Exception:  # noqa: BLE001 - stubbed GCS in unit tests
+            pass
+
+    # -- one reconcile pass ------------------------------------------------
+
+    def update(self) -> dict:
+        """Returns a summary dict (launched/terminated/...) for logging
+        and tests (reference: StandardAutoscaler.update, :353)."""
+        self._beacon()
+        demand = self.gcs_call("autoscaler_demand", {}) or {}
+        nodes = self.gcs_call("node_list", {}) or []
+        alive = [n for n in nodes if n["alive"]]
+        self._reap_registered_launches(alive)
+
+        shapes = [d for d in demand.get("pending", [])] + \
+                 [d for d in demand.get("infeasible", [])]
+        launched = self._scale_up(shapes, alive)
+        terminated = self._scale_down(alive, shapes)
+        return {"launched": launched, "terminated": terminated,
+                "pending_launches": len(self._pending),
+                "demand_shapes": len(shapes)}
+
+    def _reap_registered_launches(self, alive: List[dict]) -> None:
+        """Drop pending launches that registered (joined the cluster) or
+        timed out."""
+        alive_ids = {n["node_id"] for n in alive}
+        still: List[_Launch] = []
+        for l in self._pending:
+            internal = self.provider.internal_id(l.provider_id)
+            if internal is not None and internal in alive_ids:
+                continue  # joined
+            if time.monotonic() - l.at > self.launch_timeout_s:
+                logger.warning("autoscaler: launch %s timed out", l.provider_id)
+                try:
+                    self.provider.terminate_node(l.provider_id)
+                except Exception:  # noqa: BLE001
+                    pass
+                continue
+            still.append(l)
+        self._pending = still
+
+    def _scale_up(self, shapes: List[Dict[str, float]],
+                  alive: List[dict]) -> int:
+        # Pack demand onto existing availability + already-pending launches
+        # first; only the remainder justifies new nodes.
+        bins = [dict(n["resources_available"]) for n in alive]
+        bins += [dict(l.resources) for l in self._pending]
+        to_launch: Dict[str, int] = {}
+        planned: List[Dict[str, float]] = []
+        for shape in shapes:
+            if not shape:
+                continue
+            placed = False
+            for b in bins + planned:
+                if _fits(b, shape):
+                    _sub(b, shape)
+                    placed = True
+                    break
+            if placed:
+                continue
+            t = self._pick_node_type(shape)
+            if t is None:
+                logger.warning("autoscaler: no node type fits %s", shape)
+                continue
+            if not self._under_limits(t, alive, to_launch):
+                continue
+            to_launch[t.name] = to_launch.get(t.name, 0) + 1
+            b = dict(t.resources)
+            _sub(b, shape)
+            planned.append(b)
+        launched = 0
+        for name, count in to_launch.items():
+            t = self.node_types[name]
+            try:
+                ids = self.provider.create_node(name, t.resources, count)
+            except Exception as e:  # noqa: BLE001 - provider failure
+                logger.error("autoscaler: create_node(%s) failed: %s", name, e)
+                continue
+            for pid in ids:
+                self._pending.append(_Launch(pid, name, t.resources))
+            launched += len(ids)
+        return launched
+
+    def _pick_node_type(self, shape: Dict[str, float]
+                        ) -> Optional[NodeTypeConfig]:
+        """Smallest (by total resources) type that fits the shape."""
+        feasible = [t for t in self.node_types.values()
+                    if _fits(dict(t.resources), shape)]
+        if not feasible:
+            return None
+        return min(feasible, key=lambda t: sum(t.resources.values()))
+
+    def _under_limits(self, t: NodeTypeConfig, alive: List[dict],
+                      to_launch: Dict[str, int]) -> bool:
+        provider_nodes = self.provider.non_terminated_nodes()
+        of_type = sum(1 for pid in provider_nodes
+                      if self.provider.node_type(pid) == t.name)
+        if of_type + to_launch.get(t.name, 0) >= t.max_workers:
+            return False
+        total = len(provider_nodes) + sum(to_launch.values())
+        return total < self.max_total_workers
+
+    def _scale_down(self, alive: List[dict],
+                    shapes: List[Dict[str, float]]) -> int:
+        """Terminate provider nodes idle past the timeout (all resources
+        free, no pending demand anywhere), respecting min_workers."""
+        if shapes or self._pending:
+            self._idle_since.clear()
+            return 0
+        now = time.monotonic()
+        by_internal: Dict[bytes, str] = {}
+        for pid in self.provider.non_terminated_nodes():
+            internal = self.provider.internal_id(pid)
+            if internal is not None:
+                by_internal[internal] = pid
+        terminated = 0
+        for n in alive:
+            pid = by_internal.get(n["node_id"])
+            if pid is None:
+                continue  # not ours (head / static node)
+            # Idle = resources all free AND no live leased/actor workers —
+            # zero-resource actors (controllers, job supervisors) hold no
+            # resources but must keep their node.
+            idle = (n["resources_available"] == n["resources_total"]
+                    and n.get("num_busy_workers", 0) == 0)
+            if not idle:
+                self._idle_since.pop(n["node_id"], None)
+                continue
+            t0 = self._idle_since.setdefault(n["node_id"], now)
+            if now - t0 < self.idle_timeout_s:
+                continue
+            tname = self.provider.node_type(pid)
+            t = self.node_types.get(tname)
+            if t is not None:
+                of_type = sum(
+                    1 for p in self.provider.non_terminated_nodes()
+                    if self.provider.node_type(p) == tname)
+                if of_type <= t.min_workers:
+                    continue
+            logger.info("autoscaler: terminating idle node %s", pid)
+            try:
+                self.provider.terminate_node(pid)
+                terminated += 1
+            except Exception as e:  # noqa: BLE001
+                logger.error("terminate_node(%s) failed: %s", pid, e)
+            self._idle_since.pop(n["node_id"], None)
+        return terminated

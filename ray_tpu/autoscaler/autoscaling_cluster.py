@@ -1,0 +1,95 @@
+"""AutoscalingCluster: an in-process cluster whose worker nodes come and
+go under autoscaler control — the no-cloud test harness.
+
+Role-equivalent of the reference's ``cluster_utils.py:24
+AutoscalingCluster`` (fake provider + monitor without real machines).
+The monitor thread is the in-process analog of the head-node monitor
+daemon (reference ``autoscaler/_private/monitor.py:125 class Monitor``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import List, Optional
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig, StandardAutoscaler
+from ray_tpu.autoscaler.fake_provider import FakeNodeProvider
+from ray_tpu.cluster_utils import Cluster
+
+
+class _GcsFacade:
+    """Synchronous gcs_call facade over its own connection + loop."""
+
+    def __init__(self, gcs_address: str):
+        self.address = gcs_address
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="autoscaler-gcs")
+        self._thread.start()
+        self._conn = self._submit(self._connect())
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+
+    def _submit(self, coro, timeout=30):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    async def _connect(self):
+        from ray_tpu._private import protocol
+
+        if self.address.startswith("/"):
+            return await protocol.connect_unix(self.address)
+        host, port = self.address.rsplit(":", 1)
+        return await protocol.connect_tcp(host, int(port))
+
+    def __call__(self, method: str, payload):
+        return self._submit(self._conn.call(method, payload))
+
+    def close(self):
+        try:
+            self._submit(self._conn.close(), timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        self._loop.call_soon_threadsafe(self._loop.stop)
+
+
+class AutoscalingCluster:
+    def __init__(self, node_types: Optional[List[NodeTypeConfig]] = None, *,
+                 head_num_cpus: int = 0, idle_timeout_s: float = 5.0,
+                 update_interval_s: float = 0.5, **autoscaler_kw):
+        self.cluster = Cluster(head_num_cpus=head_num_cpus)
+        self.provider = FakeNodeProvider(self.cluster)
+        self.gcs = _GcsFacade(self.cluster.gcs_address)
+        self.autoscaler = StandardAutoscaler(
+            self.gcs, self.provider,
+            node_types or [NodeTypeConfig("cpu-2", {"CPU": 2.0})],
+            idle_timeout_s=idle_timeout_s, **autoscaler_kw)
+        self._interval = update_interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="autoscaler-monitor")
+        self._thread.start()
+
+    def _monitor(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.autoscaler.update()
+            except Exception:  # noqa: BLE001 - keep the loop alive
+                import logging
+
+                logging.getLogger(__name__).exception("autoscaler update")
+
+    def connect(self, **kw):
+        return self.cluster.connect(**kw)
+
+    @property
+    def gcs_address(self) -> str:
+        return self.cluster.gcs_address
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.gcs.close()
+        self.cluster.shutdown()

@@ -1,0 +1,67 @@
+"""In-process fake NodeProvider: "launching a node" starts a real extra
+node (own node manager + shm store) in this process.
+
+Role-equivalent of the reference's fake multi-node provider used by
+autoscaler tests without a cloud (reference
+``autoscaler/_private/fake_multi_node/node_provider.py:36``; test pattern
+``python/ray/tests/test_autoscaler_fake_multinode.py``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class FakeNodeProvider(NodeProvider):
+    def __init__(self, cluster):
+        """cluster: ray_tpu.cluster_utils.Cluster to attach nodes to."""
+        self.cluster = cluster
+        self._nodes: Dict[str, object] = {}  # provider id -> Node
+        self._types: Dict[str, str] = {}
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    count: int) -> List[str]:
+        out = []
+        for _ in range(count):
+            res = dict(resources)
+            num_cpus = int(res.pop("CPU", 0))
+            num_tpus = int(res.pop("TPU", 0))
+            node = self.cluster.add_node(num_cpus=num_cpus,
+                                         num_tpus=num_tpus,
+                                         resources=res or None)
+            pid = f"fake-{next(self._ids)}"
+            with self._lock:
+                self._nodes[pid] = node
+                self._types[pid] = node_type
+            out.append(pid)
+        return out
+
+    def terminate_node(self, provider_id: str) -> None:
+        with self._lock:
+            node = self._nodes.pop(provider_id, None)
+            self._types.pop(provider_id, None)
+        if node is not None:
+            self.cluster.remove_node(node)
+
+    def node_resources(self, provider_id: str) -> Dict[str, float]:
+        node = self._nodes.get(provider_id)
+        return dict(node.resources) if node is not None else {}
+
+    def node_type(self, provider_id: str) -> Optional[str]:
+        return self._types.get(provider_id)
+
+    def internal_id(self, provider_id: str) -> Optional[bytes]:
+        node = self._nodes.get(provider_id)
+        if node is None:
+            return None
+        return node.node_id.binary()

@@ -1,0 +1,42 @@
+"""NodeProvider: the pluggable cloud interface of the autoscaler.
+
+Role-equivalent of the reference's ``autoscaler/node_provider.py:13
+class NodeProvider`` (create/terminate/list nodes; cloud-specific
+subclasses).  The TPU build keeps the same contract so a GCE/TPU-pod
+provider slots in next to the in-process fake used by tests (reference:
+``autoscaler/_private/fake_multi_node/node_provider.py:36``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Interface to whatever launches machines.
+
+    Node ids are provider-scoped opaque strings.  Implementations must be
+    safe to call from the autoscaler's update thread.
+    """
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    count: int) -> List[str]:
+        """Launch ``count`` nodes of ``node_type``; returns provider ids."""
+        raise NotImplementedError
+
+    def terminate_node(self, provider_id: str) -> None:
+        raise NotImplementedError
+
+    def node_resources(self, provider_id: str) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def node_type(self, provider_id: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def internal_id(self, provider_id: str) -> Optional[bytes]:
+        """The cluster NodeID this provider node registered as (once
+        known), for joining provider state with GCS state."""
+        raise NotImplementedError

@@ -93,6 +93,48 @@ class DashboardActor:
             "actors": state.summarize_actors(),
             "nodes": len(state.list_nodes())}))
         app.router.add_get("/metrics", metrics)
+
+        # Job submission REST (reference: dashboard/modules/job routes).
+        from dataclasses import asdict
+
+        from ray_tpu import job as job_api
+
+        async def jobs_submit(req):
+            body = await req.json()
+            jid = await loop.run_in_executor(
+                None, lambda: job_api.submit_job(
+                    body["entrypoint"],
+                    runtime_env=body.get("runtime_env"),
+                    metadata=body.get("metadata"),
+                    job_id=body.get("job_id")))
+            return web.json_response({"job_id": jid})
+
+        async def jobs_list(_req):
+            jobs = await loop.run_in_executor(None, job_api.list_jobs)
+            return web.json_response([asdict(i) for i in jobs])
+
+        async def jobs_status(req):
+            info = await loop.run_in_executor(
+                None, lambda: job_api.get_job_info(
+                    req.match_info["job_id"]))
+            return web.json_response(asdict(info))
+
+        async def jobs_logs(req):
+            text = await loop.run_in_executor(
+                None, lambda: job_api.get_job_logs(
+                    req.match_info["job_id"]))
+            return web.json_response({"logs": text})
+
+        async def jobs_stop(req):
+            ok = await loop.run_in_executor(
+                None, lambda: job_api.stop_job(req.match_info["job_id"]))
+            return web.json_response({"stopped": ok})
+
+        app.router.add_post("/api/jobs", jobs_submit)
+        app.router.add_get("/api/jobs", jobs_list)
+        app.router.add_get("/api/jobs/{job_id}", jobs_status)
+        app.router.add_get("/api/jobs/{job_id}/logs", jobs_logs)
+        app.router.add_post("/api/jobs/{job_id}/stop", jobs_stop)
         runner = web.AppRunner(app)
         loop.run_until_complete(runner.setup())
         site = web.TCPSite(runner, self.host, self.port)

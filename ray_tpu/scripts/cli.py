@@ -175,6 +175,27 @@ def main(argv=None) -> int:
     p_tl.add_argument("--out", default="timeline.json")
     p_tl.set_defaults(fn=cmd_timeline)
 
+    # Job submission (reference: dashboard/modules/job/cli.py +
+    # `ray job submit/status/logs/stop/list`).
+    p_submit = sub.add_parser("submit", help="submit a job to the cluster")
+    p_submit.add_argument("--address", default="")
+    p_submit.add_argument("--working-dir", default="", dest="working_dir")
+    p_submit.add_argument("--env", action="append", default=[],
+                          help="KEY=VALUE env var for the job")
+    p_submit.add_argument("--no-wait", action="store_true", dest="no_wait")
+    p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                          help="command to run (prefix with --)")
+    p_submit.set_defaults(fn=cmd_submit)
+
+    p_job = sub.add_parser("job", help="job operations")
+    job_sub = p_job.add_subparsers(dest="job_cmd", required=True)
+    for name in ("list", "status", "logs", "stop"):
+        pj = job_sub.add_parser(name)
+        pj.add_argument("--address", default="")
+        if name != "list":
+            pj.add_argument("job_id")
+        pj.set_defaults(fn=cmd_job, job_cmd=name)
+
     args = parser.parse_args(argv)
     return args.fn(args)
 
@@ -223,6 +244,52 @@ def cmd_timeline(args) -> int:
     with _attached(args):
         events = ray_tpu.timeline(args.out)
     print(f"wrote {len(events)} events to {args.out}")
+    return 0
+
+
+def cmd_submit(args) -> int:
+    import shlex
+
+    from ray_tpu import job as job_api
+
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":  # drop only the leading separator
+        entry = entry[1:]
+    if not entry:
+        raise SystemExit("no entrypoint; usage: ray_tpu submit -- cmd ...")
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    if args.env:
+        runtime_env["env_vars"] = dict(e.split("=", 1) for e in args.env)
+    with _attached(args):
+        jid = job_api.submit_job(shlex.join(entry),
+                                 runtime_env=runtime_env or None)
+        print(f"submitted job {jid}")
+        if not args.no_wait:
+            info = job_api.wait_job(jid, timeout=24 * 3600)
+            print(job_api.get_job_logs(jid))
+            print(f"job {jid} finished: {info.status} {info.message}")
+            return 0 if info.status == "SUCCEEDED" else 1
+    return 0
+
+
+def cmd_job(args) -> int:
+    from dataclasses import asdict
+
+    from ray_tpu import job as job_api
+
+    with _attached(args):
+        if args.job_cmd == "list":
+            print(json.dumps([asdict(j) for j in job_api.list_jobs()],
+                             indent=2))
+        elif args.job_cmd == "status":
+            print(json.dumps(asdict(job_api.get_job_info(args.job_id)),
+                             indent=2))
+        elif args.job_cmd == "logs":
+            print(job_api.get_job_logs(args.job_id))
+        elif args.job_cmd == "stop":
+            print(job_api.stop_job(args.job_id))
     return 0
 
 

@@ -1,0 +1,132 @@
+"""Autoscaler tests: fake provider, demand-driven scale-up, idle
+scale-down (reference patterns: test_autoscaler_fake_multinode.py,
+test_autoscaler_fake_scaledown.py; pure-unit test_autoscaler.py)."""
+
+import time
+from typing import Dict, List
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalingCluster, FakeNodeProvider,
+                                NodeTypeConfig, StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+# ---- pure-unit: mocked provider + mocked GCS ------------------------------
+
+class MockProvider(NodeProvider):
+    def __init__(self):
+        self.created: List[tuple] = []
+        self.terminated: List[str] = []
+        self._n = 0
+        self._types: Dict[str, str] = {}
+
+    def non_terminated_nodes(self):
+        return [p for p in self._types if p not in self.terminated]
+
+    def create_node(self, node_type, resources, count):
+        ids = []
+        for _ in range(count):
+            pid = f"m{self._n}"
+            self._n += 1
+            self._types[pid] = node_type
+            ids.append(pid)
+        self.created.append((node_type, count))
+        return ids
+
+    def terminate_node(self, pid):
+        self.terminated.append(pid)
+
+    def node_type(self, pid):
+        return self._types.get(pid)
+
+    def node_resources(self, pid):
+        return {}
+
+    def internal_id(self, pid):
+        return None
+
+
+def _gcs_stub(demand, nodes):
+    def call(method, payload):
+        if method == "autoscaler_demand":
+            return demand
+        if method == "node_list":
+            return nodes
+        raise AssertionError(method)
+    return call
+
+
+def test_unit_scale_up_bin_packs():
+    provider = MockProvider()
+    a = StandardAutoscaler(
+        _gcs_stub({"pending": [{"CPU": 1.0}] * 5, "infeasible": []}, []),
+        provider, [NodeTypeConfig("cpu-4", {"CPU": 4.0}, max_workers=8)])
+    out = a.update()
+    # 5 one-CPU tasks pack into two 4-CPU nodes, not five.
+    assert out["launched"] == 2
+    assert provider.created == [("cpu-4", 2)]
+
+
+def test_unit_no_feasible_type_no_launch():
+    provider = MockProvider()
+    a = StandardAutoscaler(
+        _gcs_stub({"pending": [{"TPU": 8.0}], "infeasible": []}, []),
+        provider, [NodeTypeConfig("cpu-4", {"CPU": 4.0})])
+    assert a.update()["launched"] == 0
+
+
+def test_unit_max_workers_cap():
+    provider = MockProvider()
+    a = StandardAutoscaler(
+        _gcs_stub({"pending": [{"CPU": 4.0}] * 10, "infeasible": []}, []),
+        provider, [NodeTypeConfig("cpu-4", {"CPU": 4.0}, max_workers=3)])
+    assert a.update()["launched"] == 3
+
+
+def test_unit_existing_capacity_absorbs_demand():
+    provider = MockProvider()
+    nodes = [{"node_id": b"n1", "alive": True,
+              "resources_total": {"CPU": 8.0},
+              "resources_available": {"CPU": 8.0}}]
+    a = StandardAutoscaler(
+        _gcs_stub({"pending": [{"CPU": 2.0}] * 4, "infeasible": []}, nodes),
+        provider, [NodeTypeConfig("cpu-4", {"CPU": 4.0})])
+    assert a.update()["launched"] == 0
+
+
+# ---- end-to-end: fake provider, real cluster ------------------------------
+
+@pytest.fixture
+def autoscaling_cluster():
+    c = AutoscalingCluster(
+        [NodeTypeConfig("cpu-2", {"CPU": 2.0}, max_workers=4)],
+        idle_timeout_s=3.0, update_interval_s=0.3)
+    c.connect()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_e2e_tasks_trigger_scale_up_then_down(autoscaling_cluster):
+    """Queued CPU tasks on a 0-CPU cluster make the fake provider add
+    nodes; the tasks then run; idle nodes are later reclaimed (VERDICT r2
+    item 3 done-criterion)."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def work(i):
+        time.sleep(0.2)
+        return i * 2
+
+    refs = [work.remote(i) for i in range(6)]
+    assert ray_tpu.get(refs, timeout=120) == [0, 2, 4, 6, 8, 10]
+    provider = autoscaling_cluster.provider
+    assert provider.non_terminated_nodes(), "no nodes were launched"
+    # Idle scale-down: demand is gone; nodes must drain away.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes(), "idle nodes not reclaimed"

@@ -1,0 +1,98 @@
+"""Job submission + runtime env tests (reference:
+dashboard/modules/job/tests; runtime env: test_runtime_env_working_dir)."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu import job as job_api
+
+
+@pytest.fixture(scope="module")
+def job_cluster():
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_submit_job_runs_and_streams_logs(job_cluster):
+    jid = job_api.submit_job(
+        f"{sys.executable} -c \"print('hello from job'); print(6*7)\"")
+    info = job_api.wait_job(jid, timeout=120)
+    assert info.status == job_api.JobStatus.SUCCEEDED, info.message
+    logs = job_api.get_job_logs(jid)
+    assert "hello from job" in logs
+    assert "42" in logs
+    jobs = job_api.list_jobs()
+    assert any(j.job_id == jid for j in jobs)
+
+
+def test_job_failure_reported(job_cluster):
+    jid = job_api.submit_job(f"{sys.executable} -c 'raise SystemExit(3)'")
+    info = job_api.wait_job(jid, timeout=120)
+    assert info.status == job_api.JobStatus.FAILED
+    assert "3" in info.message
+
+
+def test_job_env_vars(job_cluster):
+    jid = job_api.submit_job(
+        f"{sys.executable} -c \"import os; print('V=' + os.environ['MYVAR'])\"",
+        runtime_env={"env_vars": {"MYVAR": "tpu-rules"}})
+    info = job_api.wait_job(jid, timeout=120)
+    assert info.status == job_api.JobStatus.SUCCEEDED, info.message
+    assert "V=tpu-rules" in job_api.get_job_logs(jid)
+
+
+def test_job_working_dir(job_cluster, tmp_path):
+    (tmp_path / "mymod.py").write_text("MAGIC = 'wd-works'\n")
+    (tmp_path / "main.py").write_text(textwrap.dedent("""
+        import mymod
+        print("MAGIC:" + mymod.MAGIC)
+    """))
+    jid = job_api.submit_job(
+        f"{sys.executable} main.py",
+        runtime_env={"working_dir": str(tmp_path)})
+    info = job_api.wait_job(jid, timeout=120)
+    assert info.status == job_api.JobStatus.SUCCEEDED, info.message
+    assert "MAGIC:wd-works" in job_api.get_job_logs(jid)
+
+
+def test_job_can_use_cluster(job_cluster):
+    """A submitted script attaches to THIS cluster via RAYTPU_ADDRESS and
+    runs tasks on it."""
+    script = textwrap.dedent("""
+        import ray_tpu
+        ray_tpu.init(address="auto")
+
+        @ray_tpu.remote
+        def f(x):
+            return x * 10
+
+        print("RESULT:" + str(ray_tpu.get(f.remote(4))))
+    """).replace("\n", "; ").replace(";  ", "\n")
+    jid = job_api.submit_job(
+        f"{sys.executable} -c \"import ray_tpu\n"
+        "ray_tpu.init(address='auto')\n"
+        "f = ray_tpu.remote(lambda x: x * 10)\n"
+        "print('RESULT:' + str(ray_tpu.get(f.remote(4))))\"")
+    info = job_api.wait_job(jid, timeout=180)
+    assert info.status == job_api.JobStatus.SUCCEEDED, \
+        (info.message, job_api.get_job_logs(jid))
+    assert "RESULT:40" in job_api.get_job_logs(jid)
+
+
+def test_stop_job(job_cluster):
+    jid = job_api.submit_job(
+        f"{sys.executable} -c 'import time; time.sleep(600)'")
+    import time
+
+    deadline = time.monotonic() + 60
+    while job_api.get_job_status(jid) == job_api.JobStatus.PENDING:
+        assert time.monotonic() < deadline
+        time.sleep(0.2)
+    assert job_api.stop_job(jid)
+    info = job_api.wait_job(jid, timeout=60)
+    assert info.status == job_api.JobStatus.STOPPED

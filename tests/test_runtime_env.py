@@ -1,0 +1,59 @@
+"""Runtime env unit tests (reference: plugin.py:24 RuntimeEnvPlugin)."""
+
+import os
+
+import pytest
+
+from ray_tpu import runtime_env as re_mod
+
+
+def test_validate_rejects_unknown_field():
+    with pytest.raises(ValueError):
+        re_mod.validate({"bogus": 1})
+
+
+def test_validate_env_vars_typed():
+    with pytest.raises(ValueError):
+        re_mod.validate({"env_vars": {"A": 1}})
+    assert re_mod.validate({"env_vars": {"A": "1"}})
+
+
+def test_pack_and_materialize_roundtrip(tmp_path):
+    src = tmp_path / "pkg"
+    src.mkdir()
+    (src / "data.txt").write_text("payload")
+    (src / "mod.py").write_text("X = 5")
+    kv = {}
+    packed = re_mod.pack({"working_dir": str(src)},
+                         lambda k, v: kv.__setitem__(k, v))
+    assert packed["working_dir"].startswith("gcs://runtimeenv/")
+    cache = tmp_path / "cache"
+    ctx = re_mod.materialize(packed, kv.get, str(cache))
+    assert ctx.cwd and os.path.isfile(os.path.join(ctx.cwd, "data.txt"))
+    env = {}
+    cwd = ctx.apply(env)
+    assert cwd == ctx.cwd
+    assert env["PYTHONPATH"].startswith(ctx.cwd)
+
+
+def test_env_hash_stable():
+    a = re_mod.env_hash({"env_vars": {"A": "1", "B": "2"}})
+    b = re_mod.env_hash({"env_vars": {"B": "2", "A": "1"}})
+    assert a == b
+
+
+def test_custom_plugin(tmp_path):
+    calls = []
+
+    def my_plugin(value, ctx, kv_get, cache_dir):
+        calls.append(value)
+        ctx.env_vars["PLUGGED"] = str(value)
+
+    re_mod.register_plugin("myfield", my_plugin)
+    try:
+        ctx = re_mod.materialize({"myfield": 7}, lambda k: None,
+                                 str(tmp_path))
+        assert calls == [7]
+        assert ctx.env_vars["PLUGGED"] == "7"
+    finally:
+        re_mod.PLUGINS.pop("myfield", None)
