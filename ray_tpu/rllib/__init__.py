@@ -4,11 +4,14 @@ TorchPolicy/SampleBatch learner path gets a JAX policy so PPO/IMPALA
 learners run on TPU while rollout workers stay CPU actors")."""
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sample_batch import SampleBatch
-from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.rollout_worker import RolloutWorker, TrajectoryWorker
 from ray_tpu.rllib.worker_set import WorkerSet
 
-__all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker", "WorkerSet",
-           "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig"]
+__all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
+           "TrajectoryWorker", "WorkerSet", "Algorithm",
+           "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
+           "IMPALAConfig", "vtrace"]

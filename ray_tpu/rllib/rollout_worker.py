@@ -104,3 +104,45 @@ class RolloutWorker:
         out = self.episode_returns
         self.episode_returns = []
         return out
+
+
+class TrajectoryWorker(RolloutWorker):
+    """Rollout worker emitting raw time-major fragments for off-policy
+    learners (IMPALA): no GAE — v-trace runs on the learner with ITS
+    values (reference: rollout collection for impala.py's vtrace path)."""
+
+    def sample_trajectory(self) -> Dict[str, np.ndarray]:
+        n_env = len(self.envs)
+        T = self.fragment
+        obs_buf = np.zeros((T, n_env) + np.shape(self._obs[0]), np.float32)
+        act_buf = np.zeros((T, n_env), np.int64)
+        rew_buf = np.zeros((T, n_env), np.float32)
+        done_buf = np.zeros((T, n_env), np.bool_)
+        logp_buf = np.zeros((T, n_env), np.float32)
+
+        for t in range(T):
+            obs = np.stack(self._obs).astype(np.float32)
+            actions, logp, _ = self.policy.compute_actions(obs)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            logp_buf[t] = logp
+            for i, env in enumerate(self.envs):
+                o2, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self._ep_rewards[i] += r
+                if trunc and not term:
+                    v_boot = float(self.policy.value(
+                        np.asarray(o2, np.float32)[None])[0])
+                    rew_buf[t, i] += self.gamma * v_boot
+                done_buf[t, i] = term or trunc
+                if term or trunc:
+                    self.episode_returns.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    o2 = env.reset()[0]
+                self._obs[i] = o2
+
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "dones": done_buf, "behaviour_logp": logp_buf,
+            "last_obs": np.stack(self._obs).astype(np.float32),
+        }

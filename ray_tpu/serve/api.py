@@ -21,6 +21,7 @@ class Deployment:
     num_replicas: int = 1
     ray_actor_options: Optional[Dict[str, Any]] = None
     max_concurrent_queries: int = 8
+    autoscaling_config: Optional[Dict[str, Any]] = None
     route_prefix: Optional[str] = None
     init_args: tuple = ()
     init_kwargs: Optional[Dict[str, Any]] = None
@@ -37,8 +38,13 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas: int = 1,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                max_concurrent_queries: int = 8,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
                route_prefix: Optional[str] = None):
-    """@serve.deployment decorator."""
+    """@serve.deployment decorator.  autoscaling_config (reference:
+    serve autoscaling, _private/autoscaling_policy.py): dict with
+    min_replicas / max_replicas / target_ongoing_requests /
+    upscale_delay_s / downscale_delay_s — replica count then tracks
+    queue depth instead of num_replicas."""
 
     def wrap(target):
         return Deployment(
@@ -46,6 +52,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             num_replicas=num_replicas,
             ray_actor_options=ray_actor_options,
             max_concurrent_queries=max_concurrent_queries,
+            autoscaling_config=autoscaling_config,
             route_prefix=route_prefix)
 
     return wrap(_func_or_class) if _func_or_class is not None else wrap
@@ -78,6 +85,7 @@ def run(target: Deployment, *, route_prefix: Optional[str] = None,
         num_replicas=target.num_replicas,
         ray_actor_options=target.ray_actor_options,
         max_concurrent_queries=target.max_concurrent_queries,
+        autoscaling_config=target.autoscaling_config,
         route_prefix=prefix), timeout=120)
     if http:
         start_http_proxy(port=http_port)

@@ -34,19 +34,30 @@ class ServeController:
                init_kwargs: Dict[str, Any], *, num_replicas: int = 1,
                ray_actor_options: Optional[Dict[str, Any]] = None,
                max_concurrent_queries: int = 8,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
                route_prefix: Optional[str] = None) -> bool:
+        if autoscaling_config:
+            ac = {"min_replicas": 1, "max_replicas": 8,
+                  "target_ongoing_requests": 2.0,
+                  "upscale_delay_s": 0.0, "downscale_delay_s": 10.0}
+            ac.update(autoscaling_config)
+            num_replicas = max(num_replicas, ac["min_replicas"])
+        else:
+            ac = None
         with self._lock:
             old = self.deployments.get(name)
             cfg = {"serialized_def": serialized_def,
                    "init_args": init_args, "init_kwargs": init_kwargs,
                    "num_replicas": num_replicas,
                    "actor_options": ray_actor_options or {},
-                   "max_concurrent_queries": max_concurrent_queries}
+                   "max_concurrent_queries": max_concurrent_queries,
+                   "autoscaling": ac}
             version = (old["version"] + 1) if old else 1
             replicas = [self._start_replica(name, cfg)
                         for _ in range(num_replicas)]
             self.deployments[name] = {"config": cfg, "replicas": replicas,
-                                      "version": version}
+                                      "version": version,
+                                      "scale_pending_since": None}
             if route_prefix:
                 self.routes[route_prefix] = name
             if old:
@@ -109,9 +120,17 @@ class ServeController:
                 deps = {n: list(d["replicas"])
                         for n, d in self.deployments.items()}
             for name, replicas in deps.items():
+                loads: Dict[Any, float] = {}
                 for r in replicas:
                     try:
-                        ray_tpu.get(r.ping.remote(), timeout=5)
+                        # Out-of-band probe: liveness + queue depth in one
+                        # call, answered on the worker's server loop so a
+                        # replica saturated with user requests still
+                        # reports (reference: health checks on the control
+                        # concurrency group).
+                        info = ray_tpu.get(r.raytpu_probe.remote(),
+                                           timeout=5)
+                        loads[r] = float(info.get("pending", 0))
                     except Exception:  # noqa: BLE001 - replica dead
                         with self._lock:
                             dep = self.deployments.get(name)
@@ -124,6 +143,81 @@ class ServeController:
                                                         dep["config"]))
                             except Exception:  # noqa: BLE001
                                 pass
+                self._autoscale_one(name, loads)
+
+    def _autoscale_one(self, name: str,
+                       loads: Optional[Dict[Any, float]] = None) -> None:
+        """Queue-depth-driven replica scaling (reference:
+        autoscaling_policy.py:93 calculate_desired_num_replicas — desired
+        = ceil(total_ongoing / target) — and :127's upscale/downscale
+        delay smoothing).  ``loads``: per-replica pending counts from the
+        reconcile probe (running + queued)."""
+        import math
+
+        with self._lock:
+            dep = self.deployments.get(name)
+            if dep is None or not dep["config"].get("autoscaling"):
+                return
+            ac = dep["config"]["autoscaling"]
+        total = sum((loads or {}).values())
+        desired = max(ac["min_replicas"],
+                      min(ac["max_replicas"],
+                          math.ceil(total / ac["target_ongoing_requests"])
+                          if total > 0 else ac["min_replicas"]))
+        now = time.monotonic()
+        with self._lock:
+            dep = self.deployments.get(name)
+            if dep is None:
+                return
+            cur = len(dep["replicas"])
+            if desired == cur:
+                dep["scale_pending_since"] = None
+                return
+            delay = ac["upscale_delay_s"] if desired > cur else \
+                ac["downscale_delay_s"]
+            since = dep["scale_pending_since"]
+            if since is None:
+                dep["scale_pending_since"] = now
+                if delay > 0:
+                    return
+            elif now - since < delay:
+                return
+            dep["scale_pending_since"] = None
+            if desired > cur:
+                for _ in range(desired - cur):
+                    try:
+                        dep["replicas"].append(
+                            self._start_replica(name, dep["config"]))
+                    except Exception:  # noqa: BLE001
+                        break
+            else:
+                # Prefer least-loaded victims; stop routing to them now
+                # (removed from the table), then drain before killing so
+                # in-flight requests finish (reference: graceful replica
+                # shutdown in deployment_state reconciliation).
+                ordered = sorted(dep["replicas"],
+                                 key=lambda r: (loads or {}).get(r, 0.0))
+                victims = ordered[:cur - desired]
+                dep["replicas"] = [r for r in dep["replicas"]
+                                   if r not in victims]
+        for r in victims if desired < cur else ():
+            threading.Thread(target=self._drain_and_kill, args=(r,),
+                             daemon=True).start()
+
+    def _drain_and_kill(self, replica, timeout: float = 30.0) -> None:
+        import ray_tpu
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                info = ray_tpu.get(replica.raytpu_probe.remote(),
+                                   timeout=5)
+                if info.get("pending", 0) == 0:
+                    break
+            except Exception:  # noqa: BLE001 - already dead
+                break
+            time.sleep(0.5)
+        self._kill_replica(replica)
 
     def shutdown(self) -> bool:
         self._stop = True

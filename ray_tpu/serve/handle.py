@@ -22,6 +22,9 @@ class DeploymentHandle:
         self._controller = controller
         self._replicas: List = []
         self._inflight: Dict[Any, int] = {}
+        #: (ref, replica) of requests whose completion hasn't been
+        #: observed yet — reaped (decrementing _inflight) on every route.
+        self._outstanding: List = []
         self._fetched_at = 0.0
 
     def _refresh(self, force: bool = False) -> None:
@@ -33,11 +36,34 @@ class DeploymentHandle:
         self._replicas = ray_tpu.get(
             self._controller.get_replicas.remote(self.deployment_name),
             timeout=30)
-        # reset the load counters each refresh window: they approximate
-        # RECENT load for the power-of-two picker, not lifetime totals
-        # (which would flood any freshly restarted replica)
+        # Reset counters on membership refresh (a freshly restarted
+        # replica must not inherit stale load) and drop the matching
+        # outstanding entries so they can't decrement the fresh counters.
         self._inflight = {r: 0 for r in self._replicas}
+        self._outstanding = []
         self._fetched_at = time.monotonic()
+
+    def _reap(self) -> None:
+        """Decrement in-flight counts for completed requests (the router
+        equivalent of the reference's completion callback decrementing
+        num_queued_queries, _private/router.py:261)."""
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.maybe_core_worker()
+        if cw is None or not self._outstanding:
+            return
+        still = []
+        for ref, replica in self._outstanding:
+            try:
+                done = cw.is_ready(ref._info)
+            except Exception:  # noqa: BLE001 - store closing
+                done = True
+            if done:
+                self._inflight[replica] = max(
+                    0, self._inflight.get(replica, 0) - 1)
+            else:
+                still.append((ref, replica))
+        self._outstanding = still
 
     def _pick(self):
         if not self._replicas:
@@ -52,13 +78,19 @@ class DeploymentHandle:
     def remote(self, *args, _serve_method: str = "__call__", **kwargs):
         """Route one request; returns an ObjectRef."""
         self._refresh()
+        self._reap()
         replica = self._pick()
         self._inflight[replica] = self._inflight.get(replica, 0) + 1
         ref = replica.handle_request.remote(
             *args, _serve_method=_serve_method, **kwargs)
-        # in-flight decay: without completion callbacks, age counts down
-        # on the next refresh (coarse but keeps the picker balanced)
+        self._outstanding.append((ref, replica))
         return ref
+
+    def queue_len(self) -> int:
+        """Unfinished requests routed through this handle (autoscaling
+        signal)."""
+        self._reap()
+        return sum(self._inflight.values())
 
     def call(self, *args, timeout: float = 60.0, **kwargs):
         """Convenience: route + block for the result, with one retry

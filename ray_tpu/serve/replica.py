@@ -8,6 +8,7 @@ intra-replica parallelism, the analog of max_concurrent_queries).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -24,19 +25,33 @@ class RayServeReplica:
         else:
             self.callable = target
         self.num_requests = 0
+        self._ongoing = 0
+        self._mu = threading.Lock()
         self.started_at = time.time()
 
     def handle_request(self, *args, _serve_method: str = "__call__",
                        **kwargs):
-        self.num_requests += 1
-        fn = self.callable if _serve_method == "__call__" and \
-            callable(self.callable) else getattr(self.callable,
-                                                 _serve_method)
-        return fn(*args, **kwargs)
+        with self._mu:
+            self.num_requests += 1
+            self._ongoing += 1
+        try:
+            fn = self.callable if _serve_method == "__call__" and \
+                callable(self.callable) else getattr(self.callable,
+                                                     _serve_method)
+            return fn(*args, **kwargs)
+        finally:
+            with self._mu:
+                self._ongoing -= 1
+
+    def ongoing_requests(self) -> int:
+        """Autoscaling signal (reference: replica queue metrics feeding
+        autoscaling_policy.py:127)."""
+        return self._ongoing
 
     def stats(self) -> Dict[str, Any]:
         return {"deployment": self.deployment_name,
                 "num_requests": self.num_requests,
+                "ongoing": self._ongoing,
                 "uptime_s": time.time() - self.started_at}
 
     def ping(self) -> bool:

@@ -3,6 +3,7 @@ python/ray/tune — Tuner.fit → TrialRunner event loop over trial actors,
 searchers + schedulers)."""
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining,
                                      TrialScheduler)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
                                  uniform)
@@ -13,4 +14,5 @@ __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "PopulationBasedTraining",
 ]

@@ -13,9 +13,15 @@ from typing import Any, Dict, List, Optional
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+#: restart the trial's actor with trial.config + trial.restore_checkpoint
+#: (PBT exploitation).
+RESTART = "RESTART"
 
 
 class TrialScheduler:
+    def set_trials(self, trials) -> None:
+        """Runner hands the full population to schedulers that need it."""
+
     def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
         return CONTINUE
 
@@ -72,3 +78,107 @@ class ASHAScheduler(TrialScheduler):
                     if not good:
                         decision = STOP
         return decision
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py PopulationBasedTraining).
+
+    Every ``perturbation_interval`` iterations a trial compares itself to
+    the population: if it sits in the bottom quantile it EXPLOITS a top-
+    quantile trial (clone its latest checkpoint) and EXPLORES its config
+    (resample or perturb each mutable hyperparameter).  The runner
+    restarts the trial's actor with the new config + donor checkpoint.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min", *,
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 perturbation_factors=(0.8, 1.2),
+                 seed: Optional[int] = None):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min or max")
+        if not hyperparam_mutations:
+            raise ValueError("hyperparam_mutations is required")
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.factors = perturbation_factors
+        import random as _random
+
+        self._rng = _random.Random(seed)
+        self._trials: List = []
+        self._last_perturb: Dict[str, int] = {}
+        self.num_exploits = 0  # observability / tests
+
+    def set_trials(self, trials) -> None:
+        self._trials = list(trials)
+
+    def _score(self, trial) -> Optional[float]:
+        if not trial.last_result:
+            return None
+        v = trial.last_result.get(self.metric)
+        return None if v is None else float(v)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            resample = self._rng.random() < self.resample_p
+            if callable(spec):
+                if resample or key not in out:
+                    out[key] = spec()
+                    continue
+                spec_choices = None
+            elif isinstance(spec, (list, tuple)):
+                spec_choices = list(spec)
+            else:
+                raise ValueError(
+                    f"mutation for {key!r} must be a list or callable")
+            cur = out.get(key)
+            if spec_choices is not None:
+                if resample or cur not in spec_choices:
+                    out[key] = self._rng.choice(spec_choices)
+                else:
+                    # shift one step within the sorted choice list
+                    idx = spec_choices.index(cur)
+                    idx += self._rng.choice((-1, 1))
+                    out[key] = spec_choices[max(0, min(len(spec_choices)
+                                                       - 1, idx))]
+            elif isinstance(cur, (int, float)):
+                f = self._rng.choice(self.factors)
+                out[key] = type(cur)(cur * f)
+        return out
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get("training_iteration", trial.iteration)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        scored = [(s, tr) for tr in self._trials
+                  if (s := self._score(tr)) is not None]
+        if len(scored) < 2:
+            # Nothing to compare against yet (population still starting) —
+            # keep the perturbation slot so the comparison happens as soon
+            # as a peer reports, not a full interval later.
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scored.sort(key=lambda x: x[0], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        top = [tr for _, tr in scored[:k]]
+        bottom = {tr.trial_id for _, tr in scored[-k:]}
+        if trial.trial_id not in bottom or trial in top:
+            return CONTINUE
+        donors = [tr for tr in top
+                  if tr.checkpoint is not None
+                  and tr.trial_id != trial.trial_id]
+        if not donors:
+            return CONTINUE
+        donor = self._rng.choice(donors)
+        trial.config = self._explore(donor.config)
+        trial.restore_checkpoint = donor.checkpoint
+        self.num_exploits += 1
+        return RESTART

@@ -23,6 +23,9 @@ class Trial:
         default_factory=list)
     last_result: Optional[Dict[str, Any]] = None
     checkpoint: Optional[Any] = None
+    #: checkpoint to restore from at (re)launch — set by experiment
+    #: resume and by PBT exploitation.
+    restore_checkpoint: Optional[Any] = None
     error: Optional[BaseException] = None
     iteration: int = 0
 

@@ -12,14 +12,18 @@ records checkpoints.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.tune import trial as trial_mod
-from ray_tpu.tune.schedulers import CONTINUE, STOP, TrialScheduler
+from ray_tpu.tune.schedulers import (CONTINUE, RESTART, STOP,
+                                     TrialScheduler)
 from ray_tpu.tune.trial import Trial
 
 logger = logging.getLogger(__name__)
+
+_STATE_FILE = "experiment_state.pkl"
 
 
 class TrialRunner:
@@ -27,12 +31,15 @@ class TrialRunner:
                  scheduler: Optional[TrialScheduler] = None,
                  max_concurrent: int = 0,
                  stop: Optional[Dict[str, Any]] = None,
-                 resources_per_trial: Optional[Dict[str, float]] = None):
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 experiment_dir: Optional[str] = None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
+        self.scheduler.set_trials(self.trials)
         self.stop_criteria = stop or {}
         self.resources = resources_per_trial or {"CPU": 1.0}
+        self.experiment_dir = experiment_dir
         if max_concurrent <= 0:
             cpus = ray_tpu.cluster_resources().get("CPU", 1)
             per = self.resources.get("CPU", 1.0) or 1.0
@@ -41,9 +48,66 @@ class TrialRunner:
         self._actors: Dict[str, Any] = {}     # trial_id -> worker actor
         self._inflight: Dict[Any, Trial] = {}  # next_result ref -> trial
 
+    # -- experiment-level checkpoint/resume -------------------------------
+    # (reference: trial_runner.py save/restore + Tuner.restore)
+
+    def save_state(self, force: bool = False) -> None:
+        if not self.experiment_dir:
+            return
+        # Throttle: a full-experiment snapshot per report would serialize
+        # every trial's whole history on the hot result loop (reference
+        # throttles experiment checkpoints the same way).
+        import time as _time
+
+        now = _time.monotonic()
+        if not force and now - getattr(self, "_last_save", 0.0) < 5.0:
+            return
+        self._last_save = now
+        import cloudpickle
+
+        os.makedirs(self.experiment_dir, exist_ok=True)
+        snap = []
+        for t in self.trials:
+            snap.append({
+                "config": t.config, "trial_id": t.trial_id,
+                "status": t.status,
+                "metrics_history": t.metrics_history,
+                "last_result": t.last_result, "checkpoint": t.checkpoint,
+                "iteration": t.iteration,
+                "error": repr(t.error) if t.error else None,
+            })
+        tmp = os.path.join(self.experiment_dir, _STATE_FILE + ".tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(snap, f)
+        os.replace(tmp, os.path.join(self.experiment_dir, _STATE_FILE))
+
+    @staticmethod
+    def load_trials(experiment_dir: str) -> List[Trial]:
+        """Rebuild the trial table from a saved experiment.  Unfinished
+        trials come back PENDING with restore_checkpoint set to their
+        last checkpoint, so run() re-executes only them."""
+        import cloudpickle
+
+        with open(os.path.join(experiment_dir, _STATE_FILE), "rb") as f:
+            snap = cloudpickle.load(f)
+        out = []
+        for s in snap:
+            t = Trial(config=s["config"], trial_id=s["trial_id"])
+            t.metrics_history = s["metrics_history"]
+            t.last_result = s["last_result"]
+            t.checkpoint = s["checkpoint"]
+            t.iteration = s["iteration"]
+            if s["status"] in (trial_mod.TERMINATED, trial_mod.STOPPED):
+                t.status = s["status"]
+            else:  # PENDING/RUNNING/ERROR -> rerun from last checkpoint
+                t.status = trial_mod.PENDING
+                t.restore_checkpoint = s["checkpoint"]
+            out.append(t)
+        return out
+
     # -- lifecycle --------------------------------------------------------
     def run(self) -> List[Trial]:
-        pending = list(self.trials)
+        pending = [t for t in self.trials if not t.is_finished]
         try:
             while pending or self._inflight:
                 while pending and len(self._actors) < self.max_concurrent:
@@ -63,6 +127,7 @@ class TrialRunner:
                                  else trial_mod.ERROR,
                                  trial.error or RuntimeError(
                                      "experiment aborted"))
+            self.save_state(force=True)
         return self.trials
 
     def _launch(self, trial: Trial) -> None:
@@ -72,10 +137,12 @@ class TrialRunner:
         if self.resources.get("TPU"):
             opts["num_tpus"] = self.resources["TPU"]
         actor = ray_tpu.remote(**opts)(RayTrainWorker).remote()
+        ckpt = trial.restore_checkpoint
+        trial.restore_checkpoint = None
         ray_tpu.get([actor.init_session.remote(
             world_rank=0, local_rank=0, world_size=1,
             trial_name=f"trial_{trial.trial_id}", trial_id=trial.trial_id,
-            config=trial.config, dataset_shards={}, checkpoint=None)],
+            config=trial.config, dataset_shards={}, checkpoint=ckpt)],
             timeout=60)
         ray_tpu.get([actor.start_training.remote(self.trainable)],
                     timeout=60)
@@ -121,10 +188,23 @@ class TrialRunner:
         trial.last_result = metrics
         if res.checkpoint is not None:
             trial.checkpoint = res.checkpoint
+        self.save_state()
 
-        if self._should_stop(metrics) or \
-                self.scheduler.on_trial_result(trial, metrics) == STOP:
+        decision = CONTINUE if self._should_stop(metrics) is False else STOP
+        if decision is CONTINUE:
+            decision = self.scheduler.on_trial_result(trial, metrics)
+        if decision == STOP:
             self._finish(trial, trial_mod.STOPPED)
+            return
+        if decision == RESTART:
+            # PBT exploitation: replace the trial's actor with one running
+            # the (mutated) config from the donor's checkpoint (reference:
+            # pbt.py _exploit -> trial restart).
+            self._finish(trial, trial_mod.PENDING)
+            try:
+                self._launch(trial)
+            except Exception as e:  # noqa: BLE001
+                self._finish(trial, trial_mod.ERROR, e)
             return
         actor = self._actors[trial.trial_id]
         self._inflight[actor.next_result.remote()] = trial

@@ -75,11 +75,25 @@ class Tuner:
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
 
+    def _experiment_dir(self) -> Optional[str]:
+        if not self.run_config.storage_path:
+            return None
+        import os
+
+        return os.path.join(self.run_config.storage_path,
+                            self.run_config.name or "tune_experiment")
+
     def fit(self) -> ResultGrid:
-        gen = BasicVariantGenerator(self.param_space,
-                                    num_samples=self.tune_config.num_samples,
-                                    seed=self.tune_config.seed)
-        trials = [Trial(config=c) for c in gen.variants()]
+        trials = getattr(self, "_restored_trials", None)
+        if trials is None:
+            gen = BasicVariantGenerator(
+                self.param_space,
+                num_samples=self.tune_config.num_samples,
+                seed=self.tune_config.seed)
+            trials = [Trial(config=c) for c in gen.variants()]
+        return self._run(trials)
+
+    def _run(self, trials: List[Trial]) -> ResultGrid:
         stop = self.run_config.stop if isinstance(self.run_config.stop,
                                                   dict) else None
         runner = TrialRunner(
@@ -87,9 +101,26 @@ class Tuner:
             scheduler=self.tune_config.scheduler,
             max_concurrent=self.tune_config.max_concurrent_trials,
             stop=stop,
-            resources_per_trial=self.resources_per_trial)
+            resources_per_trial=self.resources_per_trial,
+            experiment_dir=self._experiment_dir())
         runner.run()
         return ResultGrid(trials)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                **tuner_kwargs) -> "Tuner":
+        """Resume an interrupted experiment from its storage dir
+        (reference: tune/tuner.py Tuner.restore + trial_runner
+        save/restore).  Finished trials keep their results; calling
+        .fit() re-runs only the unfinished ones, each from its last
+        checkpoint."""
+        import os
+
+        tuner = cls(trainable, **tuner_kwargs)
+        tuner.run_config.storage_path = os.path.dirname(path) or "."
+        tuner.run_config.name = os.path.basename(path)
+        tuner._restored_trials = TrialRunner.load_trials(path)
+        return tuner
 
 
 def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,

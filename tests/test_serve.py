@@ -108,3 +108,66 @@ def test_jax_model_serving(serve_cluster):
     handle = serve.run(Model.bind())
     out = handle.call({"x": [[1, 2, 3, 4], [4, 3, 2, 1]]})
     assert len(out) == 2 and all(o in (0, 1) for o in out)
+
+
+def test_handle_inflight_decrements_on_completion(serve_cluster):
+    """Round-2 weak #2: the power-of-two router's in-flight counter must
+    decrement when requests finish, not just decay on refresh."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    def echo(x):
+        return x
+
+    h = serve.run(echo)
+    for i in range(8):
+        assert h.call(i, timeout=60) == i
+    # all completed -> queue length must reap back to zero
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and h.queue_len() > 0:
+        time.sleep(0.1)
+    assert h.queue_len() == 0
+    serve.delete("echo")
+
+
+def test_serve_autoscales_up_and_down(serve_cluster):
+    """Queue depth grows -> controller adds replicas (reference:
+    autoscaling_policy.py:93,127); drain -> shrinks to min."""
+    from ray_tpu import serve
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0, "upscale_delay_s": 0.0,
+        "downscale_delay_s": 2.0}, max_concurrent_queries=2)
+    def slow(x):
+        time.sleep(1.5)
+        return x
+
+    h = serve.run(slow)
+    controller = h._controller
+    assert len(ray_tpu.get(
+        controller.get_replicas.remote("slow"), timeout=30)) == 1
+    refs = [h.remote(i) for i in range(8)]  # pile up queue depth
+    deadline = time.monotonic() + 60
+    grew = False
+    while time.monotonic() < deadline:
+        n = len(ray_tpu.get(controller.get_replicas.remote("slow"),
+                            timeout=30))
+        if n >= 2:
+            grew = True
+            break
+        time.sleep(0.5)
+    assert grew, "autoscaler never scaled up"
+    assert ray_tpu.get(refs, timeout=120) == list(range(8))
+    # drain: should come back down to min_replicas
+    deadline = time.monotonic() + 60
+    shrunk = False
+    while time.monotonic() < deadline:
+        n = len(ray_tpu.get(controller.get_replicas.remote("slow"),
+                            timeout=30))
+        if n == 1:
+            shrunk = True
+            break
+        time.sleep(0.5)
+    assert shrunk, "autoscaler never scaled back down"
+    serve.delete("slow")

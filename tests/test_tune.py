@@ -100,3 +100,78 @@ def test_trainer_fit_routes_through_tune(ray_start_shared):
     assert len(result.metrics_history) == 2
     assert result.checkpoint is not None
     assert result.checkpoint.to_dict()["i"] == 1
+
+
+def test_pbt_exploits_and_learns(ray_start_shared):
+    """PBT: bad-lr trials must clone good-lr trials' checkpoints and end
+    up with mutated configs (reference: tune/schedulers/pbt.py)."""
+    from ray_tpu import tune
+    from ray_tpu.air import session
+
+    def trainable(config):
+        import time as _t
+
+        ckpt = session.get_checkpoint()
+        score = ckpt.to_dict()["score"] if ckpt else 0.0
+        for _ in range(15):
+            score += config["lr"]  # higher lr -> better metric
+            _t.sleep(0.25)  # keep the two trials' reports overlapping
+            session.report(
+                {"score": score},
+                checkpoint=_dict_checkpoint({"score": score}))
+
+    def _dict_checkpoint(d):
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        return Checkpoint.from_dict(d)
+
+    pbt = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, quantile_fraction=0.5,
+        seed=0)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.1, 1.0])},
+        tune_config=tune.TuneConfig(scheduler=pbt,
+                                    max_concurrent_trials=2),
+    ).fit()
+    assert not grid.errors
+    assert pbt.num_exploits >= 1, "PBT never exploited"
+    best = grid.get_best_result("score", mode="max")
+    assert best.metrics["score"] >= 6.0  # a straight 1.0-lr run hits 12
+
+
+def test_experiment_checkpoint_and_resume(ray_start_shared, tmp_path):
+    """Kill an experiment midway; Tuner.restore completes only the
+    unfinished trials from their checkpoints (reference:
+    trial_runner.py save/restore)."""
+    from ray_tpu import tune
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.air.config import RunConfig
+
+    def trainable(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 6):
+            if config.get("crash") and i == 3 and start == 0:
+                raise RuntimeError("boom")
+            session.report({"i": i},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    rc = RunConfig(name="exp1", storage_path=str(tmp_path))
+    grid = tune.Tuner(
+        trainable,
+        param_space={"crash": tune.grid_search([False, True])},
+        run_config=rc).fit()
+    assert len(grid.errors) == 1  # the crashing trial failed
+    # resume: the crashed trial restarts from its i=2 checkpoint and,
+    # since start != 0 now, completes
+    tuner2 = tune.Tuner.restore(str(tmp_path / "exp1"), trainable)
+    grid2 = tuner2.fit()
+    assert not grid2.errors
+    for t in grid2.trials:
+        assert t.metrics_history[-1]["i"] == 5
+    # the finished trial was NOT re-run (its history kept exactly 6 rows)
+    clean = [t for t in grid2.trials if not t.config["crash"]][0]
+    assert len(clean.metrics_history) == 6
