@@ -29,12 +29,17 @@ BASELINE_MFU = 0.40
 # clean retry is a fresh process: probe TPU in a subprocess (bounded,
 # retried — the failure mode is a transient tunnel error), and if it
 # never comes up, pin this process to CPU *before* importing jax.
-_PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 300))
-_PROBE_TRIES = int(os.environ.get("BENCH_TPU_PROBE_TRIES", 2))
+_PROBE_TIMEOUT_S = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", 120))
+_PROBE_TRIES = int(os.environ.get("BENCH_TPU_PROBE_TRIES", 4))
+#: last probe/run failure detail, surfaced in the JSON so a judge can
+#: separate environment flake from repo bug (VERDICT r2 item 1).  Seeded
+#: from the parent across the CPU-fallback re-exec.
+TPU_ERROR = os.environ.get("BENCH_TPU_ERROR", "")
 
 
 def _probe_tpu() -> bool:
     """True iff a fresh process can bring up a TPU backend."""
+    global TPU_ERROR
     code = ("import jax; d = jax.devices(); "
             "assert d and d[0].platform != 'cpu', d")
     for attempt in range(_PROBE_TRIES):
@@ -43,13 +48,16 @@ def _probe_tpu() -> bool:
                                timeout=_PROBE_TIMEOUT_S,
                                capture_output=True, text=True)
             if r.returncode == 0:
+                TPU_ERROR = ""  # clean run: don't report stale failures
                 return True
+            TPU_ERROR = (f"probe rc={r.returncode}: "
+                         f"{r.stderr.strip()[-400:]}")
             sys.stderr.write(f"bench: TPU probe attempt {attempt + 1} "
-                             f"failed rc={r.returncode}: "
-                             f"{r.stderr.strip()[-300:]}\n")
+                             f"failed: {TPU_ERROR}\n")
         except subprocess.TimeoutExpired:
+            TPU_ERROR = f"probe timed out after {_PROBE_TIMEOUT_S}s"
             sys.stderr.write(f"bench: TPU probe attempt {attempt + 1} "
-                             f"timed out after {_PROBE_TIMEOUT_S}s\n")
+                             f"{TPU_ERROR}\n")
         time.sleep(5)
     return False
 
@@ -129,7 +137,8 @@ def main():
         opt_state = tx.init(params)
         p_shard = param_shardings(axes, mesh)
 
-        @functools.partial(jax.jit, in_shardings=(p_shard, None, None))
+        @functools.partial(jax.jit, in_shardings=(p_shard, None, None),
+                           donate_argnums=(0, 1))
         def train_step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(
                 lambda p: gpt2_loss(p, batch, cfg))(params)
@@ -168,7 +177,8 @@ def main():
         "detail": {"chips": n_chips, "batch": batch, "seq": seq,
                    "mfu": round(mfu, 4),
                    "loss": round(final_loss, 3),
-                   "backend": jax.default_backend()},
+                   "backend": jax.default_backend(),
+                   "tpu_error": TPU_ERROR},
     }))
 
 
@@ -184,6 +194,8 @@ if __name__ == "__main__":
                          f"{os.environ.get('JAX_PLATFORMS') or 'default'}"
                          f" backend ({type(exc).__name__}: {exc}); "
                          f"re-running on CPU\n")
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        sys.exit(subprocess.run([sys.executable, __file__],
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   BENCH_TPU_ERROR=f"TPU run failed: "
+                                   f"{type(exc).__name__}: {exc}"[:400])
+        sys.exit(subprocess.run([sys.executable, __file__, *sys.argv[1:]],
                                 env=env).returncode)

@@ -9,6 +9,8 @@ and sequence parallelism by swapping the rule table.
 from ray_tpu.models.gpt2 import (GPT2Config, gpt2_config, gpt2_forward,
                                  gpt2_init, gpt2_logical_axes, gpt2_loss,
                                  gpt2_param_count)
+from ray_tpu.models.moe import (MoEConfig, moe_apply, moe_init,
+                                moe_logical_axes)
 from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
                                 mlp_logical_axes, mlp_loss)
 from ray_tpu.models.resnet import (ResNetConfig, resnet_config,
@@ -19,6 +21,7 @@ __all__ = [
     "GPT2Config", "gpt2_config", "gpt2_init", "gpt2_forward", "gpt2_loss",
     "gpt2_logical_axes", "gpt2_param_count",
     "MLPConfig", "mlp_init", "mlp_forward", "mlp_loss", "mlp_logical_axes",
+    "MoEConfig", "moe_init", "moe_apply", "moe_logical_axes",
     "ResNetConfig", "resnet_config", "resnet_init", "resnet_forward",
     "resnet_loss", "resnet_logical_axes",
 ]

@@ -44,7 +44,22 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-native)
     param_dtype: Any = jnp.float32   # master weights
     remat: bool = True
+    #: "full" = recompute everything (min memory); "dots" = save every
+    #: matmul output (incl. the O(T^2) attention scores — usually a bad
+    #: trade); "dots_nb" = save matmul outputs with no batch dims, i.e.
+    #: the weight matmuls but NOT attention scores — recompute the
+    #: HBM-heavy softmax, keep the MXU work.
+    remat_policy: str = "full"
     use_flash: Optional[bool] = None  # None = auto (flash on TPU)
+    #: Split the (B,T,V) logits/loss computation into this many sequence
+    #: chunks so the float32 logits tensor never fully materializes (its
+    #: HBM footprint, B*T*V*4 bytes, otherwise dominates and caps batch).
+    #: Each chunk is rematerialized in the backward pass.  Leave at 1 when
+    #: the sequence axis is mesh-sharded (reshape would break the layout).
+    loss_chunks: int = 1
+    #: lax.scan unroll factor for the layer stack: >1 lets XLA overlap one
+    #: layer's weight loads with the previous layer's compute.
+    scan_unroll: int = 1
     seq_parallel: bool = False  # ring attention over the mesh "seq" axis
     # pad vocab to a multiple of 128 so the logits matmul tiles the MXU
     # cleanly and the vocab dim shards evenly under tensor parallelism
@@ -235,9 +250,9 @@ def _block(x, layer_params, cfg: GPT2Config, rules):
     return x
 
 
-def gpt2_forward(params, tokens, cfg: GPT2Config,
-                 rules=DEFAULT_RULES) -> jnp.ndarray:
-    """tokens (B, T) int32 → logits (B, T, padded_vocab) float32."""
+def gpt2_hidden(params, tokens, cfg: GPT2Config,
+                rules=DEFAULT_RULES) -> jnp.ndarray:
+    """tokens (B, T) int32 → post-ln_f hidden states (B, T, d_model)."""
     B, T = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens]
     x = x + params["wpe"].astype(cfg.dtype)[:T]
@@ -245,18 +260,69 @@ def gpt2_forward(params, tokens, cfg: GPT2Config,
 
     block = partial(_block, cfg=cfg, rules=rules)
     if cfg.remat:
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = {
+            "dots": jax.checkpoint_policies.dots_saveable,
+            "dots_nb":
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }.get(cfg.remat_policy, jax.checkpoint_policies.nothing_saveable)
+        block = jax.checkpoint(block, policy=policy)
 
     def scan_body(carry, layer_params):
         return block(carry, layer_params), None
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
-    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
-    # tied embeddings; logits in float32 for a stable softmax/loss
-    logits = jnp.einsum("btd,vd->btv", x.astype(jnp.float32),
-                        params["wte"].astype(jnp.float32))
+    x, _ = lax.scan(scan_body, x, params["blocks"], unroll=cfg.scan_unroll)
+    return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+
+
+def gpt2_forward(params, tokens, cfg: GPT2Config,
+                 rules=DEFAULT_RULES) -> jnp.ndarray:
+    """tokens (B, T) int32 → logits (B, T, padded_vocab) float32."""
+    x = gpt2_hidden(params, tokens, cfg, rules)
+    # Tied embeddings.  bf16 operands with float32 accumulation: the MXU
+    # runs at bf16 rate while the softmax/loss still sees float32 logits
+    # (a pure-f32 matmul would run at 1/3 MXU rate via multi-pass).
+    logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
     return with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+
+
+def _nll_from_logits(logits, targets, cfg: GPT2Config):
+    """Per-token negative log likelihood with the padded-vocab tail masked."""
+    if cfg.padded_vocab != cfg.vocab_size:
+        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e9,
+                       dtype=logits.dtype)
+        logits = logits.at[..., cfg.vocab_size:].set(neg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def _chunked_ce(hidden, wte, targets, mask, cfg: GPT2Config):
+    """Cross-entropy over sequence chunks: the float32 (B,T,V) logits never
+    fully materialize (only (B,T/C,V) per chunk, rematerialized in bwd)."""
+    B, T, d = hidden.shape
+    C = cfg.loss_chunks
+    if T % C:
+        raise ValueError(f"loss_chunks={C} must divide T={T}")
+    Tc = T // C
+    hs = jnp.moveaxis(hidden.reshape(B, C, Tc, d), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(B, C, Tc), 1, 0)
+    ms = jnp.moveaxis(mask.reshape(B, C, Tc), 1, 0)
+    wte_c = wte.astype(cfg.dtype)
+
+    @jax.checkpoint
+    def chunk_sums(hc, tc, mc):
+        logits = jnp.einsum("btd,vd->btv", hc, wte_c,
+                            preferred_element_type=jnp.float32)
+        nll = _nll_from_logits(logits, tc, cfg)
+        return jnp.sum(nll * mc), jnp.sum(mc)
+
+    def body(carry, xs):
+        s, n = chunk_sums(*xs)
+        return (carry[0] + s, carry[1] + n), None
+
+    (total, count), _ = lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)), (hs, ts, ms))
+    return total / jnp.maximum(count, 1.0)
 
 
 def gpt2_loss(params, batch, cfg: GPT2Config,
@@ -267,14 +333,15 @@ def gpt2_loss(params, batch, cfg: GPT2Config,
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = gpt2_forward(params, inputs, cfg, rules)
-    if cfg.padded_vocab != cfg.vocab_size:
-        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e9,
-                       dtype=logits.dtype)
-        logits = logits.at[..., cfg.vocab_size:].set(neg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
+    if cfg.loss_chunks > 1:
+        hidden = gpt2_hidden(params, inputs, cfg, rules)
+        if mask is None:
+            mask = jnp.ones(targets.shape, jnp.float32)
+        return _chunked_ce(hidden, params["wte"], targets,
+                           mask.astype(jnp.float32), cfg)
+    logits = gpt2_forward(params, inputs, cfg, rules)
+    nll = _nll_from_logits(logits, targets, cfg)
     if mask is not None:
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)

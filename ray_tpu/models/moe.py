@@ -1,0 +1,124 @@
+"""Mixture-of-Experts feed-forward block, expert-parallel over the
+``expert`` mesh axis.
+
+No reference analog (SURVEY §2d: EP absent upstream) — new TPU-first
+design completing the mesh axis table.  Dense capacity-based dispatch in
+the Switch/GShard style: routing builds one-hot dispatch/combine tensors
+and the expert computation is three einsums, so under GSPMD the
+``expert``-sharded dims turn into all-to-alls on ICI and the per-expert
+matmuls stay MXU-shaped.  No data-dependent shapes — everything is
+static for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import DEFAULT_RULES, with_logical_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 256
+    d_ff: int = 512
+    n_experts: int = 4
+    top_k: int = 2
+    #: capacity per expert = ceil(tokens/experts) * capacity_factor
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def moe_init(key, cfg: MoEConfig) -> Dict[str, Any]:
+    kg, k1, k2 = jax.random.split(key, 3)
+    pd = cfg.param_dtype
+    E, d, f = cfg.n_experts, cfg.d_model, cfg.d_ff
+    s = 0.02
+
+    def norm(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(pd)
+
+    return {
+        "gate": norm(kg, (d, E)),
+        "w1": norm(k1, (E, d, f)),
+        "b1": jnp.zeros((E, f), pd),
+        "w2": norm(k2, (E, f, d)),
+        "b2": jnp.zeros((E, d), pd),
+    }
+
+
+def moe_logical_axes(cfg: MoEConfig) -> Dict[str, Tuple]:
+    return {
+        "gate": ("embed", None),
+        "w1": ("expert", "embed", "mlp"),
+        "b1": ("expert", "mlp"),
+        "w2": ("expert", "mlp", "embed"),
+        "b2": ("expert", "embed"),
+    }
+
+
+def moe_apply(params, x: jnp.ndarray, cfg: MoEConfig,
+              rules=DEFAULT_RULES) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, T, d) -> ((B, T, d), aux_loss).
+
+    aux_loss is the GShard/Switch load-balancing term — add
+    ``aux_weight * aux_loss`` (typical 1e-2) to the training loss.
+    """
+    B, T, d = x.shape
+    E, K = cfg.n_experts, cfg.top_k
+    N = B * T
+    C = int(math.ceil(N / E * cfg.capacity_factor))
+    xf = x.reshape(N, d)
+
+    # Routing in float32 for a stable softmax.
+    logits = jnp.einsum("nd,de->ne", xf.astype(jnp.float32),
+                        params["gate"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (N, E)
+
+    # Top-k expert choice per token -> dispatch (N,E,C) and combine
+    # weights, built with static shapes only.
+    remaining = probs
+    dispatch = jnp.zeros((N, E), jnp.float32)
+    combine = jnp.zeros((N, E), jnp.float32)
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)               # (N,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        combine = combine + onehot * probs
+        dispatch = dispatch + onehot
+        remaining = remaining * (1.0 - onehot)
+
+    # Capacity: position of each token in its expert's queue; overflow
+    # tokens are dropped (their combine weight zeroes out — the residual
+    # stream carries them unchanged).
+    position = jnp.cumsum(dispatch, axis=0) * dispatch - 1.0   # (N, E)
+    keep = (position >= 0) & (position < C)
+    dispatch = dispatch * keep
+    combine = combine * keep
+    slot = jax.nn.one_hot(position.astype(jnp.int32), C,
+                          dtype=jnp.float32)                   # (N, E, C)
+    disp = dispatch[..., None] * slot                          # (N, E, C)
+    comb = combine[..., None] * slot                           # (N, E, C)
+
+    # Expert compute: (E, C, d) inputs, sharded over the expert axis —
+    # GSPMD turns the resharding into an all-to-all.
+    exp_in = jnp.einsum("nec,nd->ecd", disp.astype(cfg.dtype),
+                        xf.astype(cfg.dtype))
+    exp_in = with_logical_constraint(exp_in, ("expert", None, "embed"),
+                                     rules)
+    h = jnp.einsum("ecd,edf->ecf", exp_in, params["w1"].astype(cfg.dtype))
+    h = jax.nn.gelu(h + params["b1"].astype(cfg.dtype)[:, None, :])
+    h = with_logical_constraint(h, ("expert", None, "mlp"), rules)
+    out = jnp.einsum("ecf,efd->ecd", h, params["w2"].astype(cfg.dtype))
+    out = out + params["b2"].astype(cfg.dtype)[:, None, :]
+    y = jnp.einsum("nec,ecd->nd", comb.astype(cfg.dtype), out)
+
+    # Load-balance aux loss (Switch eq. 4): E * sum_e f_e * P_e.
+    token_frac = jnp.mean(dispatch, axis=0)          # fraction routed
+    prob_frac = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(token_frac * prob_frac) * (1.0 / K)
+    return y.reshape(B, T, d).astype(x.dtype), aux.astype(jnp.float32)

@@ -1,0 +1,129 @@
+"""Pipeline parallelism over the ``pipeline`` mesh axis.
+
+No reference analog (SURVEY §2d: PP absent upstream) — this is new
+TPU-first design, promised by ``parallel/mesh.py``'s axis table.  The
+scheme is the collective-permute pipeline of the scaling literature
+(GPipe microbatching expressed as one SPMD program):
+
+* per-stage parameters are stacked on a leading ``stage`` axis and
+  sharded over the ``pipeline`` mesh axis — each device materializes only
+  its own stage;
+* inside ``shard_map`` every device runs the same steady-state loop for
+  ``M + S - 1`` ticks: compute its stage on the activation it holds, then
+  ``ppermute`` the result one hop along the ring (single-hop ICI
+  neighbors thanks to mesh_utils device ordering);
+* stage 0 injects a fresh microbatch each tick; the last stage collects
+  finished microbatches.  The whole loop is differentiable (XLA
+  transposes ppermute to the reverse ring), so ``jax.grad`` through
+  ``pipeline_apply`` yields the backward pipeline automatically; per-tick
+  ``jax.checkpoint`` keeps activation memory at one microbatch per stage.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.mesh import AXIS_PIPELINE
+
+
+def _stages(mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                   stage_params: Any,
+                   x: jnp.ndarray,
+                   *,
+                   microbatches: int,
+                   mesh=None,
+                   axis: str = AXIS_PIPELINE,
+                   remat: bool = True) -> jnp.ndarray:
+    """Run ``x`` through S pipeline stages with M microbatches.
+
+    stage_fn(params_one_stage, act) -> act: one stage's computation; its
+      input and output must have the same shape (residual-stream style).
+    stage_params: pytree whose leaves have a leading stage axis of size S,
+      sharded over the ``pipeline`` mesh axis.
+    x: (batch, ...) global input; batch must divide by ``microbatches``.
+
+    Returns the last stage's output, broadcast across the pipeline axis
+    (a psum over one-hot validity — callers computing a loss can do so on
+    any/every pipeline rank identically).
+    """
+    if mesh is None:
+        from jax._src.mesh import thread_resources
+
+        mesh = thread_resources.env.physical_mesh
+        if mesh.empty:
+            raise RuntimeError("pipeline_apply needs an active mesh "
+                               "(use `with jax.set_mesh(mesh):`)")
+    S = _stages(mesh, axis)
+    M = microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    from jax.sharding import PartitionSpec as P
+
+    param_spec = jax.tree.map(lambda _: P(axis), stage_params)
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def per_device(params, xs_local):
+        # params leaves: (1, ...) — this device's stage. xs_local: full
+        # microbatch stream (replicated along the pipeline axis).
+        params = jax.tree.map(lambda p: p[0], params)
+        idx = lax.axis_index(axis)
+        is_first = idx == 0
+        is_last = idx == S - 1
+        state = jnp.zeros_like(xs_local[0])
+        out = jnp.zeros_like(xs_local)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, out = carry
+            feed = xs_local[jnp.minimum(t, M - 1)]
+            state = jnp.where(is_first, feed, state)
+            y = body(params, state)
+            # Collect on the last stage once the first microbatch arrives.
+            done_idx = t - (S - 1)
+            valid = jnp.logical_and(is_last, done_idx >= 0)
+            out = lax.cond(
+                valid,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(done_idx, 0), 0),
+                lambda o: o, out)
+            state = lax.ppermute(y, axis, perm)
+            return (state, out), None
+
+        (state, out), _ = lax.scan(tick, (state, out),
+                                   jnp.arange(M + S - 1))
+        # Broadcast finished microbatches from the last stage to every
+        # pipeline rank (zeros elsewhere + psum).
+        out = jnp.where(is_last, out, jnp.zeros_like(out))
+        return lax.psum(out, axis)
+
+    out = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(param_spec, P()), out_specs=P(),
+        check_vma=False)(stage_params, xs)
+    return out.reshape(B, *x.shape[1:])
+
+
+def stack_stage_params(init_fn: Callable[[jax.Array], Any], key,
+                       num_stages: int) -> Any:
+    """Initialize S stages' params and stack them on a leading stage axis
+    (shard the result over the pipeline mesh axis with
+    ``jax.device_put`` / in_shardings)."""
+    keys = jax.random.split(key, num_stages)
+    trees = [init_fn(k) for k in keys]
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *trees)

@@ -1,0 +1,100 @@
+"""Pipeline parallelism tests (ray_tpu.ops.pipeline) on a virtual mesh.
+
+Done-criterion from VERDICT r2 item 6: multi-device CPU tests show loss
+parity with the non-PP model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.pipeline import pipeline_apply, stack_stage_params
+from ray_tpu.parallel import MeshSpec, make_mesh
+
+
+def _mesh(pp):
+    return make_mesh(MeshSpec(pipeline=pp, data=-1),
+                     devices=jax.devices()[:8])
+
+
+def _stage_init(key):
+    k1, k2 = jax.random.split(key)
+    return {"w": jax.random.normal(k1, (16, 16)) * 0.3,
+            "b": jax.random.normal(k2, (16,)) * 0.1}
+
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _sequential(params, x):
+    S = jax.tree.leaves(params)[0].shape[0]
+    for s in range(S):
+        x = _stage_fn(jax.tree.map(lambda l: l[s], params), x)
+    return x
+
+
+@pytest.mark.parametrize("pp,mb", [(2, 4), (4, 2), (4, 8)])
+def test_pipeline_matches_sequential(pp, mb):
+    mesh = _mesh(pp)
+    params = stack_stage_params(_stage_init, jax.random.PRNGKey(0), pp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 16))
+    want = _sequential(params, x)
+    with jax.set_mesh(mesh):
+        got = jax.jit(lambda p, x: pipeline_apply(
+            _stage_fn, p, x, microbatches=mb, mesh=mesh))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pipeline_grad_matches_sequential():
+    pp, mb = 4, 4
+    mesh = _mesh(pp)
+    params = stack_stage_params(_stage_init, jax.random.PRNGKey(0), pp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8, 16))
+
+    def loss_seq(p):
+        return jnp.mean((_sequential(p, x) - tgt) ** 2)
+
+    def loss_pp(p):
+        return jnp.mean((pipeline_apply(
+            _stage_fn, p, x, microbatches=mb, mesh=mesh) - tgt) ** 2)
+
+    want = jax.grad(loss_seq)(params)
+    with jax.set_mesh(mesh):
+        got = jax.jit(jax.grad(loss_pp))(params)
+    for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_pipeline_trains():
+    """A 2-stage pipelined MLP fits a toy regression (loss decreases)."""
+    import optax
+
+    pp, mb = 2, 4
+    mesh = _mesh(pp)
+    params = stack_stage_params(_stage_init, jax.random.PRNGKey(0), pp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    tgt = jnp.sin(x)
+    tx = optax.adam(1e-2)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o):
+        def loss(p):
+            y = pipeline_apply(_stage_fn, p, x, microbatches=mb, mesh=mesh)
+            return jnp.mean((y - tgt) ** 2)
+
+        l, g = jax.value_and_grad(loss)(p)
+        up, o = tx.update(g, o)
+        return optax.apply_updates(p, up), o, l
+
+    with jax.set_mesh(mesh):
+        losses = []
+        for _ in range(30):
+            params, opt, l = step(params, opt)
+            losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
