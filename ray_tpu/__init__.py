@@ -51,6 +51,7 @@ def init(address: Optional[str] = None, *,
          ignore_reinit_error: bool = False,
          namespace: Optional[str] = None,
          log_to_driver: bool = True,
+         storage: Optional[str] = None,
          _system_config: Optional[Dict[str, Any]] = None,
          **kwargs):
     """Start (or connect to) a cluster.
@@ -120,8 +121,42 @@ def init(address: Optional[str] = None, *,
         job = cw.io.run(cw.gcs.call("job_register", {}))
         cw.job_id = JobID(job["job_id"])
         worker_context.set_core_worker(cw, node=node, mode="driver")
+        if storage:
+            from ray_tpu._private.storage import _announce
+
+            _announce(cw, storage)
+        if log_to_driver:
+            _start_log_streaming(cw)
+        if node.head:
+            from ray_tpu._private.usage_lib import start_usage_reporter
+
+            start_usage_reporter(cw, node.session_dir)
         atexit.register(shutdown)
         return _client_info()
+
+
+def _start_log_streaming(cw) -> None:
+    """Print worker stdout/stderr lines on the driver (reference:
+    log_to_driver via the LogMonitor -> GCS pubsub pipeline,
+    _private/log_monitor.py:100).
+
+    Known divergence from the reference: workers are pooled across jobs
+    here, so the "logs" channel is cluster-wide — with several drivers
+    attached to one cluster, each sees all workers' output, not only its
+    own job's.  Pass log_to_driver=False to opt out.
+    """
+    import sys
+
+    def on_logs(msg):
+        prefix = f"({msg.get('worker', '?')[:8]}, " \
+                 f"node={msg.get('node', '?')}) "
+        for line in msg.get("lines", []):
+            print(prefix + line, file=sys.stderr)
+
+    try:
+        cw.subscribe("logs", on_logs)
+    except Exception:  # noqa: BLE001 - streaming is best-effort
+        logger.debug("log streaming unavailable", exc_info=True)
 
 
 def _client_info():

@@ -996,9 +996,13 @@ class CoreWorker:
         for oid in return_ids:
             self._ensure_entry(oid)
         skey = self._scheduling_key(resources, pg)
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.maybe_inject("task", name) \
+            if tracing.is_enabled() else None
         self.io.post(self._submit_on_loop(
             skey, task_id, fid, name, args, kwargs, num_returns,
-            resources, pg, max_retries))
+            resources, pg, max_retries, trace_ctx))
         return [ObjectRefInfo(oid, self.worker_id.binary(), self.node_address)
                 for oid in return_ids]
 
@@ -1007,7 +1011,8 @@ class CoreWorker:
         return hashlib.sha1(repr(items).encode()).digest()
 
     async def _submit_on_loop(self, skey, task_id, fid, name, args, kwargs,
-                              num_returns, resources, pg, max_retries):
+                              num_returns, resources, pg, max_retries,
+                              trace_ctx=None):
         spec = {
             "task_id": task_id.binary(),
             "job_id": self.job_id.binary(),
@@ -1018,6 +1023,8 @@ class CoreWorker:
             "caller_addr": self.node_address,
             "retries_left": max_retries,
         }
+        if trace_ctx:
+            spec["trace_ctx"] = trace_ctx
         pins: List[ObjectRefInfo] = []
         try:
             dep_error = await self._async_resolve_deps(args, kwargs)
@@ -1362,6 +1369,12 @@ class CoreWorker:
             "caller": self.worker_id.binary(),
             "caller_addr": self.node_address,
         }
+        from ray_tpu.util import tracing
+
+        if tracing.is_enabled() and method != "raytpu_probe":
+            ctx = tracing.maybe_inject("actor", method)
+            if ctx:
+                spec["trace_ctx"] = ctx
         return_ids = [ObjectID.for_return(task_id, i + 1).binary()
                       for i in range(num_returns)]
         for oid in return_ids:

@@ -162,6 +162,72 @@ class NodeManager:
         })
         self._heartbeat_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop())
+        self._log_monitor_task = asyncio.get_running_loop().create_task(
+            self._log_monitor_loop())
+
+    async def _log_monitor_loop(self):
+        """Tail this node's worker log files and publish new lines to the
+        GCS "logs" channel so drivers can print them (reference:
+        _private/log_monitor.py:100 LogMonitor -> GCS pubsub ->
+        log_to_driver)."""
+        offsets: Dict[str, int] = {}
+        log_dir = os.path.join(self.session_dir, "logs")
+        short = self.node_id.hex()[:8]
+        while not self._closing:
+            await asyncio.sleep(0.5)
+            try:
+                files = [f for f in os.listdir(log_dir)
+                         if f.startswith("worker-")] \
+                    if os.path.isdir(log_dir) else []
+            except OSError:
+                continue
+            for fname in files:
+                path = os.path.join(log_dir, fname)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                off = offsets.get(fname, 0)
+                if size <= off:
+                    continue
+                cap = 256 * 1024
+                try:
+                    with open(path, "rb") as f:
+                        f.seek(off)
+                        chunk = f.read(min(size - off, cap))
+                except OSError:
+                    continue
+                # only publish complete lines; carry partials forward —
+                # except a single line larger than the read cap, which is
+                # force-flushed (truncated) so tailing can't stall on it
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    if len(chunk) < cap:
+                        continue  # partial line still being written
+                    cut = len(chunk) - 1
+                lines = chunk[:cut + 1].decode("utf-8",
+                                               "replace").splitlines()
+                if not lines:
+                    offsets[fname] = off + cut + 1
+                    continue
+                # bound the batch WITHOUT skipping: advance the offset
+                # only past what is actually published
+                if len(lines) > 200:
+                    lines = lines[:200]
+                    pos = -1
+                    for _ in range(200):  # byte offset of 200th newline
+                        pos = chunk.find(b"\n", pos + 1)
+                    offsets[fname] = off + pos + 1
+                else:
+                    offsets[fname] = off + cut + 1
+                try:
+                    await self.gcs_conn.call("sub_publish", {
+                        "channel": "logs",
+                        "message": {"worker": fname[len("worker-"):-4],
+                                    "node": short,
+                                    "lines": lines}}, timeout=5.0)
+                except Exception:  # noqa: BLE001 - GCS hiccup; retry next tick
+                    offsets[fname] = off  # re-send
 
     async def _heartbeat_loop(self):
         while not self._closing:
@@ -200,6 +266,8 @@ class NodeManager:
         self._closing = True
         if self._heartbeat_task:
             self._heartbeat_task.cancel()
+        if getattr(self, "_log_monitor_task", None):
+            self._log_monitor_task.cancel()
         # Fail queued lease requests so their handler coroutines (and the
         # remote submitters awaiting them) unwind instead of hanging.
         for req in self._lease_queue:

@@ -195,7 +195,14 @@ class WorkerServer:
             args = [self._resolve_arg(a) for a in spec["args"]]
             kwargs = {k: self._resolve_arg(v)
                       for k, v in spec["kwargs"].items()}
-            result = fn(*args, **kwargs)
+            trace_ctx = spec.get("trace_ctx")
+            if trace_ctx:
+                from ray_tpu.util import tracing
+
+                with tracing.task_span(ev["name"], trace_ctx):
+                    result = fn(*args, **kwargs)
+            else:
+                result = fn(*args, **kwargs)
             if num_returns == 0:
                 return []
             values = (result,) if num_returns == 1 else tuple(result)

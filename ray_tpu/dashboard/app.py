@@ -61,6 +61,18 @@ class DashboardActor:
         lines.append("# TYPE raytpu_task_execution_seconds_total counter")
         lines.append(f"raytpu_task_execution_seconds_total "
                      f"{tasks['total_execution_s']}")
+        # Application-defined metrics published by every process
+        # (ray_tpu.util.metrics -> GCS KV snapshots).
+        from ray_tpu._private import worker_context
+        from ray_tpu.util.metrics import collect_cluster_metrics
+
+        cw = worker_context.maybe_core_worker()
+        if cw is not None:
+            try:
+                lines.extend(collect_cluster_metrics(cw.kv_get,
+                                                     cw.kv_keys))
+            except Exception:  # noqa: BLE001 - metrics must not 500
+                pass
         return "\n".join(lines) + "\n"
 
     def _serve(self):

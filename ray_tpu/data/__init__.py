@@ -7,13 +7,18 @@ data/_internal/plan.py:59,368, done eagerly-on-demand instead of via a
 separate optimizer pass).
 """
 
-from ray_tpu.data.dataset import (Dataset, from_arrow, from_items,
-                                  from_numpy, from_pandas, range as range_,
-                                  read_csv, read_parquet)
+from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
+                                  DatasetPipeline, GroupedDataset,
+                                  from_arrow, from_items, from_numpy,
+                                  from_pandas, range as range_, read_csv,
+                                  read_json, read_numpy, read_parquet,
+                                  read_text)
 
 # `range` shadows the builtin only inside this namespace, as in the
 # reference's ray.data.range
 range = range_
 
-__all__ = ["Dataset", "from_items", "from_numpy", "from_pandas",
-           "from_arrow", "range", "read_parquet", "read_csv"]
+__all__ = ["Dataset", "DatasetPipeline", "GroupedDataset",
+           "ActorPoolStrategy", "from_items", "from_numpy",
+           "from_pandas", "from_arrow", "range", "read_parquet",
+           "read_csv", "read_json", "read_text", "read_numpy"]

@@ -34,16 +34,48 @@ def _run_stages(table, stages):
     return _fused_apply(table, stages)
 
 
+class ActorPoolStrategy:
+    """Run a dataset's fused stage chain on a pool of long-lived actors
+    instead of one task per block (reference:
+    data/_internal/compute.py:173 ActorPoolStrategy — the right choice
+    when stages carry expensive setup such as model weights)."""
+
+    def __init__(self, size: int = 2, num_cpus: float = 1.0,
+                 num_tpus: float = 0.0):
+        self.size = size
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+
+
+class _StageActor:
+    def __init__(self):
+        self._stages_cache: Dict[bytes, Any] = {}
+
+    def run(self, table, stages_ser: bytes):
+        import cloudpickle
+
+        stages = self._stages_cache.get(stages_ser)
+        if stages is None:
+            stages = cloudpickle.loads(stages_ser)
+            self._stages_cache[stages_ser] = stages
+        return _fused_apply(table, stages)
+
+
 class Dataset:
     """A list of block ObjectRefs + pending (unfused) stages."""
 
-    def __init__(self, block_refs: List, stages: Optional[List] = None):
+    def __init__(self, block_refs: List, stages: Optional[List] = None,
+                 compute: Optional[ActorPoolStrategy] = None):
         self._block_refs = list(block_refs)
         self._stages: List[Callable] = list(stages or [])
+        self._compute = compute
 
     # -- plan -------------------------------------------------------------
-    def _with_stage(self, fn: Callable) -> "Dataset":
-        return Dataset(self._block_refs, self._stages + [fn])
+    def _with_stage(self, fn: Callable,
+                    compute: Optional[ActorPoolStrategy] = None
+                    ) -> "Dataset":
+        return Dataset(self._block_refs, self._stages + [fn],
+                       compute or self._compute)
 
     def materialize(self) -> "Dataset":
         """Execute pending stages: one fused task per block (the stage-
@@ -52,11 +84,39 @@ class Dataset:
         iter_batches(), ...) never re-runs the pipeline."""
         if not self._stages:
             return self
-        refs = [_run_stages.remote(b, self._stages)
-                for b in self._block_refs]
+        if self._compute is not None:
+            refs = self._materialize_on_actors()
+        else:
+            refs = [_run_stages.remote(b, self._stages)
+                    for b in self._block_refs]
         self._block_refs = refs
         self._stages = []
+        self._compute = None
         return self
+
+    def _materialize_on_actors(self) -> List:
+        import cloudpickle
+
+        strat = self._compute
+        cls = ray_tpu.remote(num_cpus=strat.num_cpus,
+                             num_tpus=strat.num_tpus)(_StageActor)
+        pool = [cls.remote() for _ in builtins.range(strat.size)]
+        ser = cloudpickle.dumps(self._stages)
+        refs = [pool[i % len(pool)].run.remote(b, ser)
+                for i, b in enumerate(self._block_refs)]
+        # Block until EVERY block finished, then retire the pool (the
+        # results live in the object store independently of the actors —
+        # but killing mid-execution would destroy in-flight blocks).
+        remaining = list(refs)
+        while remaining:
+            _, remaining = ray_tpu.wait(
+                remaining, num_returns=len(remaining), timeout=60.0)
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass
+        return refs
 
     def _tables(self) -> List:
         ds = self.materialize()
@@ -64,12 +124,27 @@ class Dataset:
 
     # -- transforms (lazy) ------------------------------------------------
     def map_batches(self, fn: Callable, *, batch_format: str = "numpy",
+                    compute: Optional[ActorPoolStrategy] = None,
                     **_unused) -> "Dataset":
-        def stage(table):
-            batch = block_util.format_batch(table, batch_format)
-            return block_util.to_table(fn(batch))
+        """fn over whole blocks.  compute=ActorPoolStrategy(...) runs the
+        stage chain on a pool of long-lived actors (amortizes expensive
+        fn setup; reference _internal/compute.py:173).  Callable-class
+        fns are constructed once per actor."""
+        if isinstance(fn, type):
+            holder: Dict[str, Any] = {}
 
-        return self._with_stage(stage)
+            def stage(table, _cls=fn):
+                inst = holder.get("i")
+                if inst is None:
+                    inst = holder["i"] = _cls()
+                batch = block_util.format_batch(table, batch_format)
+                return block_util.to_table(inst(batch))
+        else:
+            def stage(table):
+                batch = block_util.format_batch(table, batch_format)
+                return block_util.to_table(fn(batch))
+
+        return self._with_stage(stage, compute)
 
     def map(self, fn: Callable) -> "Dataset":
         def stage(table):
@@ -137,22 +212,38 @@ class Dataset:
         return Dataset(refs)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        tables = self._tables()
-        big = block_util.concat_tables(tables)
-        rng = np.random.RandomState(seed)
-        perm = rng.permutation(big.num_rows)
-        shuffled = big.take(perm)
-        k = max(1, len(self._block_refs))
-        out = Dataset([ray_tpu.put(shuffled)]).repartition(k)
-        return out
+        """Distributed two-phase shuffle: rows mix across blocks via
+        multi-return map tasks + per-partition reduce tasks; no block
+        ever rides through the driver (reference:
+        _internal/push_based_shuffle.py)."""
+        from ray_tpu.data import shuffle as shuffle_mod
+
+        ds = self.materialize()
+        n = max(1, len(ds._block_refs))
+        # local permutation pass so rows also mix WITHIN output blocks
+        shuffled = shuffle_mod.shuffle_blocks(ds._block_refs, n, seed)
+
+        def perm_stage(table, _seed=seed):
+            rng = np.random.RandomState(_seed)
+            return table.take(rng.permutation(table.num_rows))
+
+        return Dataset(shuffled, [perm_stage]).materialize()
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        tables = self._tables()
-        big = block_util.concat_tables(tables)
-        order = "descending" if descending else "ascending"
-        big = big.sort_by([(key, order)])
-        return Dataset([ray_tpu.put(big)]).repartition(
-            max(1, len(self._block_refs)))
+        """Distributed sample-partitioned sort (reference: data sort_impl
+        boundary sampling + per-range reduce)."""
+        from ray_tpu.data import shuffle as shuffle_mod
+
+        ds = self.materialize()
+        refs = shuffle_mod.sort_blocks(
+            ds._block_refs, key, descending,
+            max(1, len(ds._block_refs)))
+        return Dataset(refs)
+
+    def groupby(self, key: str) -> "GroupedDataset":
+        """Hash-partitioned groupby (reference: data groupby —
+        equal keys land in one block, aggregates run per block)."""
+        return GroupedDataset(self, key)
 
     # -- consumption ------------------------------------------------------
     def count(self) -> int:
@@ -217,9 +308,125 @@ class Dataset:
         for i, t in enumerate(self._tables()):
             pq.write_table(t, os.path.join(path, f"part-{i:05d}.parquet"))
 
+    # -- pipelining -------------------------------------------------------
+    def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
+        """Stream execution window-by-window (reference:
+        data/dataset_pipeline.py — bounds memory to one window of
+        blocks instead of the whole dataset)."""
+        wins = [Dataset(self._block_refs[i:i + blocks_per_window],
+                        list(self._stages), self._compute)
+                for i in builtins.range(0, len(self._block_refs),
+                                        blocks_per_window)]
+        return DatasetPipeline(wins)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        return DatasetPipeline([self], repeat=times)
+
     def __repr__(self):
         return (f"Dataset(num_blocks={self.num_blocks}, "
                 f"pending_stages={len(self._stages)})")
+
+
+class DatasetPipeline:
+    """A sequence of Dataset windows executed lazily, one window at a
+    time (reference: data/dataset_pipeline.py DatasetPipeline)."""
+
+    def __init__(self, windows: List[Dataset],
+                 repeat: Optional[int] = 1):
+        self._windows = windows
+        self._repeat = repeat  # None = infinite
+
+    def map_batches(self, fn, **kw) -> "DatasetPipeline":
+        return DatasetPipeline([w.map_batches(fn, **kw)
+                                for w in self._windows], self._repeat)
+
+    def foreach_window(self, fn: Callable[[Dataset], Dataset]
+                       ) -> "DatasetPipeline":
+        return DatasetPipeline([fn(w) for w in self._windows],
+                               self._repeat)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        return DatasetPipeline(self._windows, times)
+
+    def iter_batches(self, **kw) -> Iterator:
+        epoch = 0
+        while self._repeat is None or epoch < self._repeat:
+            for w in self._windows:
+                # copy: window stages re-run each epoch only if unfused
+                yield from Dataset(w._block_refs, list(w._stages),
+                                   w._compute).iter_batches(**kw)
+            epoch += 1
+
+    def iter_epochs(self) -> Iterator[List[Dataset]]:
+        epoch = 0
+        while self._repeat is None or epoch < self._repeat:
+            yield list(self._windows)
+            epoch += 1
+
+    def __repr__(self):
+        return (f"DatasetPipeline(windows={len(self._windows)}, "
+                f"repeat={self._repeat})")
+
+
+class GroupedDataset:
+    """Aggregations over hash-partitioned groups (reference:
+    data/grouped_dataset.py)."""
+
+    _AGGS = {
+        "count": lambda v: len(v),
+        "sum": lambda v: v.sum(),
+        "mean": lambda v: v.mean(),
+        "min": lambda v: v.min(),
+        "max": lambda v: v.max(),
+        "std": lambda v: v.std(),
+    }
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, agg: str, on: Optional[str]) -> Dataset:
+        from ray_tpu.data import shuffle as shuffle_mod
+
+        ds = self._ds.materialize()
+        n = max(1, len(ds._block_refs))
+        parts = shuffle_mod.hash_partition_blocks(ds._block_refs,
+                                                  self._key, n)
+        key, fn = self._key, self._AGGS[agg]
+        out_col = f"{agg}({on})" if on else agg
+
+        def stage(table, _key=key, _on=on, _fn=fn, _out=out_col):
+            rows: Dict[Any, List] = {}
+            keys_col = table.column(_key).to_pylist()
+            vals_col = table.column(_on).to_numpy(
+                zero_copy_only=False) if _on else np.zeros(len(keys_col))
+            for k_, v_ in zip(keys_col, vals_col):
+                rows.setdefault(k_, []).append(v_)
+            return block_util.to_table({
+                _key: list(rows),
+                _out: [float(_fn(np.asarray(v)))
+                       for v in rows.values()],
+            })
+
+        return Dataset(parts, [stage]).materialize()
+
+    def count(self) -> Dataset:
+        return self._aggregate("count", None)
+
+    def sum(self, on: str) -> Dataset:
+        return self._aggregate("sum", on)
+
+    def mean(self, on: str) -> Dataset:
+        return self._aggregate("mean", on)
+
+    def min(self, on: str) -> Dataset:
+        return self._aggregate("min", on)
+
+    def max(self, on: str) -> Dataset:
+        return self._aggregate("max", on)
+
+    def std(self, on: str) -> Dataset:
+        return self._aggregate("std", on)
 
 
 # -- creation APIs ---------------------------------------------------------
@@ -294,4 +501,43 @@ def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
     if not files:
         raise FileNotFoundError(f"no csv files under {path}")
     refs = [ray_tpu.put(pa_csv.read_csv(f)) for f in files]
+    return Dataset(refs)
+
+
+def _list_files(path: str, suffix: str) -> List[str]:
+    import glob
+    import os
+
+    files = sorted(glob.glob(os.path.join(path, f"*{suffix}"))) \
+        if os.path.isdir(path) else [path]
+    if not files:
+        raise FileNotFoundError(f"no {suffix} files under {path}")
+    return files
+
+
+def read_json(path: str, *, parallelism: int = 8) -> Dataset:
+    """Newline-delimited JSON records (reference: read_json)."""
+    from pyarrow import json as pa_json
+
+    refs = [ray_tpu.put(pa_json.read_json(f))
+            for f in _list_files(path, ".json")]
+    return Dataset(refs)
+
+
+def read_text(path: str, *, parallelism: int = 8) -> Dataset:
+    """One row per line, column "text" (reference: read_text)."""
+    refs = []
+    for f in _list_files(path, ".txt"):
+        with open(f, "r") as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+        refs.append(ray_tpu.put(block_util.to_table({"text": lines})))
+    return Dataset(refs)
+
+
+def read_numpy(path: str, *, parallelism: int = 8) -> Dataset:
+    """.npy files, column "value" (reference: read_numpy)."""
+    refs = []
+    for f in _list_files(path, ".npy"):
+        arr = np.load(f)
+        refs.append(ray_tpu.put(block_util.to_table({"value": arr})))
     return Dataset(refs)

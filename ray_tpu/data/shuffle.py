@@ -1,0 +1,151 @@
+"""Distributed two-phase shuffle over object-store blocks.
+
+Role-equivalent of the reference's push-based shuffle
+(``python/ray/data/_internal/push_based_shuffle.py``): a map phase
+partitions every block into N sub-blocks (one multi-return remote task
+per block — sub-blocks flow through the object store, never the
+driver), and a reduce phase builds each output block from its N_map
+parts.  The driver only routes ObjectRefs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_util
+
+
+def _partition_random(table, n_out: int, seed: int):
+    rng = np.random.RandomState(seed)
+    assign = rng.randint(0, n_out, size=table.num_rows)
+    return [table.take(np.nonzero(assign == p)[0]) for p in range(n_out)]
+
+
+def _partition_range(table, key: str, cuts, descending: bool):
+    col = table.column(key).to_numpy(zero_copy_only=False)
+    idx = np.searchsorted(cuts, col, side="right")
+    if descending:
+        idx = len(cuts) - idx
+        idx = np.clip(idx, 0, len(cuts))
+    return [table.take(np.nonzero(idx == p)[0])
+            for p in range(len(cuts) + 1)]
+
+
+def _stable_hash(x) -> int:
+    """Deterministic across processes — Python's hash() is salted per
+    process (PYTHONHASHSEED), which would scatter equal string keys into
+    different partitions on different workers."""
+    import zlib
+
+    return zlib.crc32(repr(x).encode())
+
+
+def _partition_hash(table, key: str, n_out: int):
+    col = table.column(key).to_numpy(zero_copy_only=False)
+    hashes = np.array([_stable_hash(x) % n_out for x in col.tolist()])
+    return [table.take(np.nonzero(hashes == p)[0]) for p in range(n_out)]
+
+
+@ray_tpu.remote
+def _reduce_concat(*parts):
+    live = [p for p in parts if p.num_rows]
+    if not live:
+        return parts[0]
+    return block_util.concat_tables(live)
+
+
+@ray_tpu.remote
+def _reduce_sorted(key, descending, *parts):
+    live = [p for p in parts if p.num_rows] or [parts[0]]
+    big = block_util.concat_tables(live)
+    order = "descending" if descending else "ascending"
+    return big.sort_by([(key, order)])
+
+
+def _two_phase(block_refs: List, n_out: int, map_remote,
+               reduce_remote, reduce_args=()) -> List:
+    """map: block -> n_out parts (multi-return); reduce: column of parts
+    -> one output block."""
+    maps = [map_remote.options(num_returns=n_out).remote(b)
+            for b in block_refs]
+    if n_out == 1:
+        maps = [[m] for m in maps]
+    return [reduce_remote.remote(*reduce_args,
+                                 *[maps[m][p] for m in range(len(maps))])
+            for p in range(n_out)]
+
+
+def shuffle_blocks(block_refs: List, n_out: int,
+                   seed: Optional[int] = None) -> List:
+    """Random shuffle: every output block gets rows from every input."""
+    base = np.random.RandomState(seed).randint(0, 2**31) \
+        if seed is not None else np.random.randint(0, 2**31)
+
+    part_fns = []
+    for i in range(len(block_refs)):
+        @ray_tpu.remote
+        def _map(table, _s=base + i, _n=n_out):
+            return tuple(_partition_random(table, _n, _s)) \
+                if _n > 1 else table
+
+        part_fns.append(_map)
+    maps = [part_fns[i].options(num_returns=n_out).remote(b)
+            for i, b in enumerate(block_refs)]
+    if n_out == 1:
+        maps = [[m] for m in maps]
+    return [_reduce_concat.remote(*[maps[m][p]
+                                    for m in range(len(maps))])
+            for p in range(n_out)]
+
+
+def sort_blocks(block_refs: List, key: str, descending: bool,
+                n_out: int) -> List:
+    """Sample-based range-partitioned distributed sort (reference:
+    sort_impl's boundary sampling)."""
+    @ray_tpu.remote
+    def _sample(table):
+        col = table.column(key).to_numpy(zero_copy_only=False)
+        if len(col) == 0:
+            return col
+        k = min(64, len(col))
+        idx = np.random.RandomState(0).choice(len(col), size=k,
+                                              replace=False)
+        return col[idx]
+
+    samples = np.concatenate(
+        [s for s in ray_tpu.get([_sample.remote(b) for b in block_refs],
+                                timeout=300) if len(s)] or
+        [np.array([0.0])])
+    samples = np.sort(samples)
+    cuts = [samples[int(len(samples) * (i + 1) / n_out)]
+            for i in range(n_out - 1)] if n_out > 1 else []
+    cuts_arr = np.asarray(sorted(set(cuts))) if cuts else np.asarray([])
+    n_parts = len(cuts_arr) + 1
+
+    @ray_tpu.remote
+    def _map(table):
+        parts = _partition_range(table, key, cuts_arr, descending)
+        return tuple(parts) if n_parts > 1 else parts[0]
+
+    maps = [_map.options(num_returns=n_parts).remote(b)
+            for b in block_refs]
+    if n_parts == 1:
+        maps = [[m] for m in maps]
+    # descending partitions are already emitted highest-first by
+    # _partition_range's index flip
+    return [_reduce_sorted.remote(key, descending,
+                                  *[maps[m][p] for m in range(len(maps))])
+            for p in range(n_parts)]
+
+
+def hash_partition_blocks(block_refs: List, key: str, n_out: int) -> List:
+    """Co-locate equal keys in the same output block (groupby basis)."""
+    @ray_tpu.remote
+    def _map(table):
+        parts = _partition_hash(table, key, n_out)
+        return tuple(parts) if n_out > 1 else parts[0]
+
+    return _two_phase(block_refs, n_out, _map, _reduce_concat)

@@ -72,7 +72,9 @@ def moe_apply(params, x: jnp.ndarray, cfg: MoEConfig,
     B, T, d = x.shape
     E, K = cfg.n_experts, cfg.top_k
     N = B * T
-    C = int(math.ceil(N / E * cfg.capacity_factor))
+    # Each token makes K assignments, so balanced load is K*N/E per
+    # expert (GShard capacity definition).
+    C = int(math.ceil(N * K / E * cfg.capacity_factor))
     xf = x.reshape(N, d)
 
     # Routing in float32 for a stable softmax.

@@ -7,4 +7,9 @@ from ray_tpu.util.scheduling_strategies import (
 
 __all__ = ["PlacementGroup", "placement_group", "remove_placement_group",
            "PlacementGroupSchedulingStrategy",
-           "NodeAffinitySchedulingStrategy"]
+           "NodeAffinitySchedulingStrategy",
+           # submodules with import-time side effects stay lazy:
+           # ray_tpu.util.metrics, .iter, .tracing, .joblib_backend,
+           # .dask_scheduler, .actor_pool, .queue, .multiprocessing,
+           # .state
+           ]

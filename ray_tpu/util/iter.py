@@ -1,0 +1,179 @@
+"""ParallelIterator: actor-sharded lazy iterators.
+
+Role-equivalent of the reference's ``python/ray/util/iter.py:132
+ParallelIterator`` (``:1136 ParallelIteratorWorker``): a list of item
+shards hosted by actors, transformed lazily (for_each/filter/batch),
+consumed synchronously or asynchronously on the driver.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class ParallelIteratorWorker:
+    """Actor hosting one shard's (possibly infinite) item stream
+    (reference: util/iter.py:1136)."""
+
+    def __init__(self, items, repeat: bool = False):
+        self._base = items
+        self._repeat = repeat
+        self._transforms: List = []
+        self._it: Optional[Iterator] = None
+
+    def add_transform(self, fn_ser: bytes) -> bool:
+        import cloudpickle
+
+        self._transforms.append(cloudpickle.loads(fn_ser))
+        self._it = None  # restart with the new pipeline
+        return True
+
+    def _build(self) -> Iterator:
+        base = self._base() if callable(self._base) else self._base
+
+        def gen():
+            while True:
+                for x in (base() if callable(base) else list(base)):
+                    yield x
+                if not self._repeat:
+                    return
+
+        it: Iterator = gen()
+        for t in self._transforms:
+            it = t(it)
+        return it
+
+    def next_batch(self, n: int = 1):
+        """Up to n items; [] = exhausted (StopIteration sentinel)."""
+        if self._it is None:
+            self._it = self._build()
+        return list(itertools.islice(self._it, n))
+
+
+class LocalIterator:
+    """Driver-side view over gathered results (reference: the
+    gather_sync return type)."""
+
+    def __init__(self, gen_factory: Callable[[], Iterator]):
+        self._factory = gen_factory
+
+    def __iter__(self):
+        return self._factory()
+
+    def take(self, n: int) -> List[Any]:
+        return list(itertools.islice(iter(self), n))
+
+
+class ParallelIterator:
+    def __init__(self, actors: List, batch_fetch: int = 16):
+        self.actors = actors
+        self._batch_fetch = batch_fetch
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def from_items(items: List[Any], num_shards: int = 2,
+                   repeat: bool = False) -> "ParallelIterator":
+        shards = [items[i::num_shards] for i in range(num_shards)]
+        return ParallelIterator.from_iterators(shards, repeat=repeat)
+
+    @staticmethod
+    def from_range(n: int, num_shards: int = 2,
+                   repeat: bool = False) -> "ParallelIterator":
+        return ParallelIterator.from_items(list(range(n)), num_shards,
+                                           repeat)
+
+    @staticmethod
+    def from_iterators(generators: List[Iterable],
+                       repeat: bool = False) -> "ParallelIterator":
+        cls = ray_tpu.remote(num_cpus=0.1)(ParallelIteratorWorker)
+        actors = [cls.remote(g, repeat) for g in generators]
+        return ParallelIterator(actors)
+
+    # -- lazy transforms ---------------------------------------------------
+
+    def _with_transform(self, make_t) -> "ParallelIterator":
+        import cloudpickle
+
+        ser = cloudpickle.dumps(make_t)
+        ray_tpu.get([a.add_transform.remote(ser) for a in self.actors],
+                    timeout=60)
+        return self
+
+    def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
+        return self._with_transform(lambda it: map(fn, it))
+
+    def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
+        return self._with_transform(lambda it: (x for x in it if fn(x)))
+
+    def batch(self, n: int) -> "ParallelIterator":
+        def t(it):
+            while True:
+                b = list(itertools.islice(it, n))
+                if not b:
+                    return
+                yield b
+
+        return self._with_transform(t)
+
+    def flatten(self) -> "ParallelIterator":
+        return self._with_transform(
+            lambda it: (y for x in it for y in x))
+
+    # -- consumption -------------------------------------------------------
+
+    def num_shards(self) -> int:
+        return len(self.actors)
+
+    def gather_sync(self) -> LocalIterator:
+        """Round-robin over shards, in order (reference:
+        iter.py gather_sync)."""
+        fetch = self._batch_fetch
+
+        def gen():
+            live = list(self.actors)
+            buffers = {a: [] for a in live}
+            while live:
+                for a in list(live):
+                    if not buffers[a]:
+                        buffers[a] = ray_tpu.get(
+                            a.next_batch.remote(fetch), timeout=300)
+                        if not buffers[a]:
+                            live.remove(a)
+                            continue
+                    yield buffers[a].pop(0)
+
+        return LocalIterator(gen)
+
+    def gather_async(self) -> LocalIterator:
+        """Items in completion order across shards (reference:
+        iter.py gather_async)."""
+        fetch = self._batch_fetch
+
+        def gen():
+            inflight = {a.next_batch.remote(fetch): a
+                        for a in self.actors}
+            while inflight:
+                ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                        timeout=300)
+                for ref in ready:
+                    actor = inflight.pop(ref)
+                    batch = ray_tpu.get(ref, timeout=60)
+                    if batch:
+                        inflight[actor.next_batch.remote(fetch)] = actor
+                        yield from batch
+
+        return LocalIterator(gen)
+
+    def take(self, n: int) -> List[Any]:
+        return self.gather_sync().take(n)
+
+    def stop(self) -> None:
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,105 @@
+"""joblib backend: scikit-learn style Parallel() on ray_tpu actors/tasks.
+
+Role-equivalent of the reference's joblib integration (reference
+``python/ray/util/joblib/ray_backend.py`` — a ParallelBackendBase whose
+apply_async submits remote tasks).  Usage:
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+    import joblib
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+def register_ray_tpu() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+class _ResultHandle:
+    """joblib future surface over an ObjectRef."""
+
+    def __init__(self, ref, callback: Optional[Callable]):
+        self._ref = ref
+        self._callback = callback
+        self._done = False
+        self._value: Any = None
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        if not self._done:
+            self._value = ray_tpu.get(self._ref, timeout=timeout)
+            self._done = True
+        return self._value
+
+
+def _make_backend_cls():
+    from joblib.parallel import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **_kw):
+            import ray_tpu
+
+            ray_tpu._auto_init()
+            self.parallel = parallel
+            self._n_jobs = self.effective_n_jobs(n_jobs)
+            return self._n_jobs
+
+        def effective_n_jobs(self, n_jobs):
+            import ray_tpu
+
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            cpus = int(ray_tpu.cluster_resources().get("CPU", 1))
+            if n_jobs is None or n_jobs < 0:
+                return max(1, cpus)
+            return n_jobs
+
+        def apply_async(self, func, callback=None):
+            import ray_tpu
+
+            @ray_tpu.remote(num_cpus=1)
+            def _run_joblib_batch(f):
+                return f()
+
+            ref = _run_joblib_batch.remote(func)
+            handle = _ResultHandle(ref, callback)
+            if callback is not None:
+                # joblib drives completion through callbacks; resolve on
+                # a helper thread so Parallel() keeps dispatching.
+                import threading
+
+                def waiter():
+                    try:
+                        handle.get()
+                    except Exception:  # noqa: BLE001 - surfaced by get
+                        pass
+                    callback(handle)
+
+                threading.Thread(target=waiter, daemon=True).start()
+            return handle
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self._n_jobs,
+                               parallel=self.parallel)
+
+    return RayTpuBackend
+
+
+try:
+    RayTpuBackend = _make_backend_cls()
+except ImportError:  # joblib not installed: register_ray_tpu will raise
+    RayTpuBackend = None  # type: ignore[assignment]

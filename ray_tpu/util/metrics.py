@@ -1,0 +1,185 @@
+"""Application-defined metrics: Counter / Gauge / Histogram.
+
+Role-equivalent of the reference's custom-metrics API
+(``python/ray/util/metrics.py`` over the Cython metric shim and the
+per-node OpenCensus→Prometheus agent, ``_private/metrics_agent.py:93``).
+Collapsed TPU-build design: each process keeps a local registry and a
+background publisher flushes snapshots into GCS KV
+(``metrics:<worker_id>``); the dashboard's ``/metrics`` endpoint merges
+every live snapshot into one Prometheus text page.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_KV_PREFIX = "metrics:"
+_PUBLISH_INTERVAL_S = 5.0
+
+
+class _Registry:
+    def __init__(self):
+        self.metrics: Dict[str, "Metric"] = {}
+        self._lock = threading.Lock()
+        self._publisher: Optional[threading.Thread] = None
+
+    def register(self, metric: "Metric") -> None:
+        with self._lock:
+            self.metrics[metric.name] = metric
+        self._ensure_publisher()
+
+    def _ensure_publisher(self) -> None:
+        with self._lock:
+            if self._publisher is not None:
+                return
+            self._publisher = threading.Thread(
+                target=self._publish_loop, daemon=True,
+                name="raytpu-metrics")
+            self._publisher.start()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {name: m._dump() for name, m in self.metrics.items()}
+
+    def _publish_loop(self) -> None:
+        from ray_tpu._private import worker_context
+
+        while True:
+            time.sleep(_PUBLISH_INTERVAL_S)
+            cw = worker_context.maybe_core_worker()
+            if cw is None:
+                continue
+            try:
+                import msgpack
+
+                cw.kv_put(
+                    _KV_PREFIX + cw.worker_id.hex(),
+                    msgpack.packb({"ts": time.time(),
+                                   "metrics": self.snapshot()}))
+            except Exception:  # noqa: BLE001 - shutdown race
+                pass
+
+
+_registry = _Registry()
+
+
+class Metric:
+    """Base: name, help text, tag keys; values tracked per tag-tuple."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name.replace("_", "").isalnum():
+            raise ValueError(f"invalid metric name {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        _registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> Tuple:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        return tuple(sorted(merged.items()))
+
+    def _dump(self) -> dict:
+        with self._lock:
+            return {"kind": self.kind, "desc": self.description,
+                    "values": [(list(k), v)
+                               for k, v in self._values.items()]}
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[self._key(tags)] = float(value)
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (values stored as per-bucket counters +
+    sum/count, Prometheus-style)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (), tag_keys: Sequence[str] = ()):
+        if not boundaries:
+            raise ValueError("histogram needs bucket boundaries")
+        self.boundaries = sorted(float(b) for b in boundaries)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        base = self._key(tags)
+        with self._lock:
+            for b in self.boundaries:
+                if value <= b:
+                    k = base + (("le", str(b)),)
+                    self._values[k] = self._values.get(k, 0.0) + 1
+                    break
+            else:
+                k = base + (("le", "+Inf"),)
+                self._values[k] = self._values.get(k, 0.0) + 1
+            s = base + (("_stat", "sum"),)
+            c = base + (("_stat", "count"),)
+            self._values[s] = self._values.get(s, 0.0) + value
+            self._values[c] = self._values.get(c, 0.0) + 1
+
+
+def collect_cluster_metrics(kv_get, kv_keys, max_age_s: float = 60.0
+                            ) -> List[str]:
+    """Merge every process's published snapshot into Prometheus text
+    lines (used by the dashboard /metrics endpoint)."""
+    import msgpack
+
+    lines: List[str] = []
+    seen_help: set = set()
+    now = time.time()
+    for key in kv_keys(_KV_PREFIX):
+        raw = kv_get(key)
+        if not raw:
+            continue
+        try:
+            snap = msgpack.unpackb(raw, raw=False)
+        except Exception:  # noqa: BLE001
+            continue
+        if now - snap.get("ts", 0) > max_age_s:
+            continue
+        wid = key[len(_KV_PREFIX):][:12]
+        for name, m in snap.get("metrics", {}).items():
+            full = f"raytpu_app_{name}"
+            if full not in seen_help:
+                seen_help.add(full)
+                kind = "counter" if m["kind"] == "counter" else "gauge"
+                lines.append(f"# HELP {full} {m.get('desc', '')}")
+                lines.append(f"# TYPE {full} {kind}")
+            for tag_list, value in m.get("values", []):
+                tags = [f'worker="{wid}"'] + [
+                    f'{k}="{v}"' for k, v in tag_list]
+                lines.append(f"{full}{{{','.join(tags)}}} {value}")
+    return lines

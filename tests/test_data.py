@@ -92,3 +92,111 @@ def test_dataset_feeds_trainer(ray_start_shared):
     result = trainer.fit()
     assert result.error is None, result.error
     assert result.metrics["rows"] == 32
+
+
+def test_distributed_random_shuffle(ray_start_shared):
+    from ray_tpu import data
+
+    ds = data.range(1000, parallelism=4)
+    out = ds.random_shuffle(seed=0)
+    ids = [r["id"] for r in out.take_all()]
+    assert sorted(ids) == list(range(1000))
+    assert ids[:50] != list(range(50))  # actually shuffled
+    assert out.num_blocks == 4  # stays distributed
+
+
+def test_distributed_sort(ray_start_shared):
+    import numpy as np
+
+    from ray_tpu import data
+
+    rng = np.random.default_rng(1)
+    vals = rng.permutation(500).astype("int64")
+    ds = data.from_numpy({"v": vals}, parallelism=4).sort("v")
+    got = [r["v"] for r in ds.take_all()]
+    assert got == sorted(vals.tolist())
+    ds_desc = data.from_numpy({"v": vals}, parallelism=4).sort(
+        "v", descending=True)
+    got = [r["v"] for r in ds_desc.take_all()]
+    assert got == sorted(vals.tolist(), reverse=True)
+
+
+def test_groupby_aggregates(ray_start_shared):
+    from ray_tpu import data
+
+    rows = [{"k": i % 3, "v": float(i)} for i in range(30)]
+    ds = data.from_items(rows, parallelism=4)
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    want = {}
+    for r in rows:
+        want[r["k"]] = want.get(r["k"], 0.0) + r["v"]
+    assert out == want
+    counts = {r["k"]: r["count"] for r in
+              ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+
+
+def test_map_batches_actor_pool(ray_start_shared):
+    from ray_tpu import data
+
+    class AddModel:
+        def __init__(self):
+            self.offset = 100  # "expensive" setup happens once per actor
+
+        def __call__(self, batch):
+            batch["id"] = batch["id"] + self.offset
+            return batch
+
+    ds = data.range(64, parallelism=4).map_batches(
+        AddModel, compute=data.ActorPoolStrategy(size=2, num_cpus=0.5))
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == [i + 100 for i in range(64)]
+
+
+def test_dataset_pipeline_windows_and_repeat(ray_start_shared):
+    from ray_tpu import data
+
+    pipe = data.range(40, parallelism=4).window(blocks_per_window=2)
+    seen = [b["id"] for b in pipe.iter_batches(batch_size=10)]
+    assert sorted(x for b in seen for x in b.tolist()) == list(range(40))
+    pipe2 = data.range(10, parallelism=2).repeat(3)
+    total = sum(len(b["id"]) for b in pipe2.iter_batches(batch_size=5))
+    assert total == 30
+
+
+def test_read_json_text_numpy(ray_start_shared, tmp_path):
+    import json as json_mod
+
+    import numpy as np
+
+    from ray_tpu import data
+
+    jpath = tmp_path / "rows.json"
+    jpath.write_text("\n".join(
+        json_mod.dumps({"a": i}) for i in range(5)))
+    assert sorted(r["a"] for r in
+                  data.read_json(str(jpath)).take_all()) == list(range(5))
+
+    tpath = tmp_path / "doc.txt"
+    tpath.write_text("alpha\nbeta\n")
+    assert [r["text"] for r in data.read_text(str(tpath)).take_all()] == \
+        ["alpha", "beta"]
+
+    npath = tmp_path / "arr.npy"
+    np.save(npath, np.arange(4))
+    assert [r["value"] for r in
+            data.read_numpy(str(npath)).take_all()] == [0, 1, 2, 3]
+
+
+def test_groupby_string_keys_cross_worker(ray_start_shared):
+    """String keys must aggregate to ONE row per key even when map tasks
+    run in different worker processes (per-process hash() salting must
+    not leak into partitioning)."""
+    from ray_tpu import data
+
+    rows = [{"name": n, "v": 1.0} for n in
+            ("alpha", "beta", "gamma") * 20]
+    out = data.from_items(rows, parallelism=6).groupby("name").sum("v")
+    table = {r["name"]: r["sum(v)"] for r in out.take_all()}
+    assert table == {"alpha": 20.0, "beta": 20.0, "gamma": 20.0}
+    assert len(out.take_all()) == 3  # no duplicate partial rows
