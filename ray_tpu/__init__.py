@@ -175,6 +175,9 @@ def _auto_init():
 
 def shutdown():
     with _init_lock:
+        from ray_tpu._private.usage_lib import stop_usage_reporter
+
+        stop_usage_reporter()
         cw = worker_context.maybe_core_worker()
         node = worker_context.node()
         worker_context.clear()

@@ -42,6 +42,30 @@ def detect_num_tpus(config: Config) -> int:
         return 0
 
 
+def _gcs_is_local(gcs_address: str) -> bool:
+    if gcs_address.startswith("/"):
+        return True
+    host = gcs_address.rsplit(":", 1)[0]
+    return host in ("127.0.0.1", "localhost", "::1")
+
+
+def _local_ip_toward(gcs_address: str) -> str:
+    """This machine's IP on the route to the GCS (the address other
+    nodes should dial us at)."""
+    import socket
+
+    host = gcs_address.rsplit(":", 1)[0]
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((host, 1))  # no traffic; just picks the interface
+            return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
 class Node:
     """One framework node. With ``head=True`` also hosts the GCS."""
 
@@ -99,9 +123,18 @@ class Node:
                 host, port = self.gcs_address.rsplit(":", 1)
                 real = self.io.run(self.gcs.start_tcp(host, int(port)))
                 self.gcs_address = f"{host}:{real}"
+        # Transport selection: unix sockets when the whole cluster lives
+        # on this machine (GCS on a unix path or loopback); TCP when the
+        # GCS is remote — a node manager advertising a unix path could
+        # never be dialed by other machines for spillback leases or
+        # chunked object pulls.
+        node_address = ""
+        if not _gcs_is_local(self.gcs_address):
+            node_address = f"{_local_ip_toward(self.gcs_address)}:0"
         self.node_manager = NodeManager(
             self.node_id, self.session_dir, self.config,
-            dict(self.resources), self.shm_name, self.gcs_address)
+            dict(self.resources), self.shm_name, self.gcs_address,
+            node_address=node_address)
         self.io.run(self.node_manager.start())
         self._started = True
         return self

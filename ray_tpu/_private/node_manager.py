@@ -205,21 +205,23 @@ class NodeManager:
                     if len(chunk) < cap:
                         continue  # partial line still being written
                     cut = len(chunk) - 1
-                lines = chunk[:cut + 1].decode("utf-8",
-                                               "replace").splitlines()
-                if not lines:
+                # Split on \n ONLY (splitlines would also split \r/\v/\f
+                # and desync the byte-offset bookkeeping, e.g. on tqdm
+                # \r-progress output).
+                raw_lines = chunk[:cut].split(b"\n")
+                if not raw_lines:
                     offsets[fname] = off + cut + 1
                     continue
                 # bound the batch WITHOUT skipping: advance the offset
                 # only past what is actually published
-                if len(lines) > 200:
-                    lines = lines[:200]
-                    pos = -1
-                    for _ in range(200):  # byte offset of 200th newline
-                        pos = chunk.find(b"\n", pos + 1)
-                    offsets[fname] = off + pos + 1
+                if len(raw_lines) > 200:
+                    raw_lines = raw_lines[:200]
+                    consumed = sum(len(l) + 1 for l in raw_lines)
+                    offsets[fname] = off + consumed
                 else:
                     offsets[fname] = off + cut + 1
+                lines = [l.decode("utf-8", "replace")
+                         for l in raw_lines]
                 try:
                     await self.gcs_conn.call("sub_publish", {
                         "channel": "logs",
@@ -335,6 +337,11 @@ class NodeManager:
             env["TPU_VISIBLE_DEVICES"] = csv
         env["RAYTPU_TPU_GRANT"] = str(tpu_grant)
         env["RAYTPU_NODE_ADDRESS"] = self.node_address
+        if not self.node_address.startswith("/"):
+            # TCP cluster: the worker serves task pushes on this node's
+            # externally-dialable interface.
+            env["RAYTPU_WORKER_BIND_HOST"] = \
+                self.node_address.rsplit(":", 1)[0]
         env["RAYTPU_GCS_ADDRESS"] = self.gcs_address
         env["RAYTPU_SESSION_DIR"] = self.session_dir
         env["RAYTPU_OBJECT_STORE"] = self.object_store_name
@@ -847,6 +854,11 @@ class NodeManager:
     # ---- introspection ---------------------------------------------------
 
     async def rpc_node_stats(self, conn, payload):
+        try:
+            store_stats = self._store().stats()
+            spilled = self._spill.list()
+        except Exception:  # noqa: BLE001 - store mid-teardown
+            store_stats, spilled = {}, []
         return {
             "node_id": self.node_id.binary(),
             "resources_total": self.resources.total,
@@ -854,8 +866,26 @@ class NodeManager:
             "num_workers": len(self.workers),
             "num_idle": len(self.idle_workers),
             "pending_leases": len(self._lease_queue),
+            "object_store": store_stats,
+            "spilled_objects": len(spilled),
+            "spilled_bytes": sum(s for _, s in spilled),
             "bundles": [
                 {"pg_id": k[0], "index": k[1], "resources": v.total,
                  "committed": self._bundle_committed.get(k, False)}
                 for k, v in self.bundles.items()],
         }
+
+    async def rpc_shutdown_node(self, conn, payload):
+        """Kill this node (chaos tooling: the reference's
+        `ray kill-random-node`, scripts.py:1269).  SIGKILL-style: worker
+        processes die; the GCS notices via disconnect/heartbeat."""
+        asyncio.get_running_loop().call_later(0.05, self._die,
+                                              payload.get("exit", True))
+        return True
+
+    def _die(self, hard_exit: bool):
+        for w in list(self.workers.values()):
+            self._kill_worker_process(w)
+        if hard_exit and os.environ.get("RAYTPU_NODE_PROCESS"):
+            os._exit(1)
+        asyncio.get_running_loop().create_task(self.close())

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, Optional
 
 _reporter: Optional[Callable[[Dict[str, Any]], None]] = None
 _thread: Optional[threading.Thread] = None
+_stop = threading.Event()
 _REPORT_INTERVAL_S = 60.0
 
 
@@ -55,13 +56,22 @@ def collect(cw) -> Dict[str, Any]:
                                     if n.get("alive", True)])
     except Exception:  # noqa: BLE001 - cluster mid-shutdown
         pass
-    try:
-        import jax
+    if "jax" in sys.modules:
+        try:
+            import jax
 
-        payload["jax_version"] = jax.__version__
-        payload["device_kind"] = jax.devices()[0].device_kind
-    except Exception:  # noqa: BLE001 - jax not initialized
-        pass
+            payload["jax_version"] = jax.__version__
+            # Only read devices if a backend ALREADY exists: calling
+            # jax.devices() here would initialize the TPU runtime (and
+            # take libtpu's exclusive chip lock) as a telemetry side
+            # effect, breaking workers that own the chips.
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:
+                payload["device_kind"] = \
+                    jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - jax internals moved
+            pass
     # Which ray_tpu libraries were imported (the reference tracks
     # library_usages the same way).
     libs = []
@@ -84,20 +94,31 @@ def _default_reporter(session_dir: str) -> Callable[[Dict[str, Any]], None]:
 
 
 def start_usage_reporter(cw, session_dir: str) -> None:
-    """Start the periodic reporter thread (no-op when opted out)."""
+    """Start the periodic reporter thread (no-op when opted out).
+    Re-entrant across shutdown()/init() cycles in one process."""
     global _thread
-    if not usage_stats_enabled() or _thread is not None:
+    if not usage_stats_enabled():
         return
+    stop_usage_reporter()
+    _stop.clear()
     reporter = _reporter or _default_reporter(session_dir)
 
     def loop():
-        while True:
+        while not _stop.is_set():
             try:
                 reporter(collect(cw))
             except Exception:  # noqa: BLE001 - never disturb the app
                 pass
-            time.sleep(_REPORT_INTERVAL_S)
+            _stop.wait(_REPORT_INTERVAL_S)
 
     _thread = threading.Thread(target=loop, daemon=True,
                                name="raytpu-usage")
     _thread.start()
+
+
+def stop_usage_reporter() -> None:
+    global _thread
+    if _thread is not None:
+        _stop.set()
+        _thread.join(timeout=2)
+        _thread = None

@@ -101,7 +101,18 @@ class WorkerServer:
 
     async def run(self):
         self._loop = asyncio.get_running_loop()
-        await self.server.start_unix(self.address)
+        # Transport matches the node's: unix sockets on a single host,
+        # TCP when the node manager itself is TCP (multi-host cluster) —
+        # submitters on OTHER machines must be able to dial this worker
+        # for direct task push (reference: workers serve
+        # CoreWorkerService on ip:port).
+        if self.node_address.startswith("/"):
+            await self.server.start_unix(self.address)
+        else:
+            host = os.environ.get("RAYTPU_WORKER_BIND_HOST") or \
+                self.node_address.rsplit(":", 1)[0]
+            port = await self.server.start_tcp(host, 0)
+            self.address = f"{host}:{port}"
         # The CoreWorker runs its own io thread; sync facades work from the
         # execution threads exactly as they do on the driver.
         self.cw = CoreWorker(

@@ -78,22 +78,19 @@ def _two_phase(block_refs: List, n_out: int, map_remote,
             for p in range(n_out)]
 
 
+@ray_tpu.remote
+def _shuffle_map(table, seed: int, n_out: int):
+    return tuple(_partition_random(table, n_out, seed)) \
+        if n_out > 1 else table
+
+
 def shuffle_blocks(block_refs: List, n_out: int,
                    seed: Optional[int] = None) -> List:
     """Random shuffle: every output block gets rows from every input."""
     base = np.random.RandomState(seed).randint(0, 2**31) \
         if seed is not None else np.random.randint(0, 2**31)
-
-    part_fns = []
-    for i in range(len(block_refs)):
-        @ray_tpu.remote
-        def _map(table, _s=base + i, _n=n_out):
-            return tuple(_partition_random(table, _n, _s)) \
-                if _n > 1 else table
-
-        part_fns.append(_map)
-    maps = [part_fns[i].options(num_returns=n_out).remote(b)
-            for i, b in enumerate(block_refs)]
+    maps = [_shuffle_map.options(num_returns=n_out).remote(
+        b, base + i, n_out) for i, b in enumerate(block_refs)]
     if n_out == 1:
         maps = [[m] for m in maps]
     return [_reduce_concat.remote(*[maps[m][p]

@@ -61,6 +61,9 @@ def cmd_start(args) -> int:
         node.start()
         print(f"node {node.node_id.hex()[:12]} joined {address}")
 
+    # Mark this node as process-backed: shutdown_node (chaos tooling)
+    # hard-exits instead of just closing the in-process server.
+    os.environ["RAYTPU_NODE_PROCESS"] = "1"
     pidfile = _write_pidfile("head" if args.head else "node")
     stop = {"flag": False}
 
@@ -177,6 +180,17 @@ def main(argv=None) -> int:
 
     # Job submission (reference: dashboard/modules/job/cli.py +
     # `ray job submit/status/logs/stop/list`).
+    p_mem = sub.add_parser(
+        "memory", help="per-node object store + spill usage")
+    p_mem.add_argument("--address", default="")
+    p_mem.set_defaults(fn=cmd_memory)
+
+    p_krn = sub.add_parser(
+        "kill-random-node",
+        help="chaos: kill a random non-head worker node")
+    p_krn.add_argument("--address", default="")
+    p_krn.set_defaults(fn=cmd_kill_random_node)
+
     p_submit = sub.add_parser("submit", help="submit a job to the cluster")
     p_submit.add_argument("--address", default="")
     p_submit.add_argument("--working-dir", default="", dest="working_dir")
@@ -244,6 +258,105 @@ def cmd_timeline(args) -> int:
     with _attached(args):
         events = ray_tpu.timeline(args.out)
     print(f"wrote {len(events)} events to {args.out}")
+    return 0
+
+
+def _each_node_stats(timeout: float = 10.0):
+    """Dial every alive node manager and fetch node_stats."""
+    import asyncio
+
+    from ray_tpu._private import protocol, worker_context
+
+    cw = worker_context.core_worker()
+    nodes = [n for n in cw.nodes() if n["alive"]]
+
+    async def fetch(addr):
+        if addr.startswith("/"):
+            conn = await protocol.connect_unix(addr)
+        else:
+            host, port = addr.rsplit(":", 1)
+            conn = await protocol.connect_tcp(host, int(port))
+        try:
+            return await conn.call("node_stats", {}, timeout=timeout)
+        finally:
+            await conn.close()
+
+    for n in nodes:
+        try:
+            yield n, cw.io.run(fetch(n["address"]), timeout=timeout + 2)
+        except Exception as e:  # noqa: BLE001 - node mid-death
+            yield n, {"error": str(e)}
+
+
+def cmd_memory(args) -> int:
+    """Reference analog: `ray memory` (scripts.py memory command)."""
+    with _attached(args):
+        out = []
+        for n, stats in _each_node_stats():
+            store = stats.get("object_store", {})
+            out.append({
+                "node_id": n["node_id"].hex()[:16],
+                "address": n["address"],
+                "store_bytes_used": store.get("bytes_used"),
+                "store_capacity": store.get("capacity"),
+                "store_objects": store.get("num_objects"),
+                "evictions": store.get("evictions"),
+                "spilled_objects": stats.get("spilled_objects"),
+                "spilled_bytes": stats.get("spilled_bytes"),
+                "error": stats.get("error"),
+            })
+        print(json.dumps(out, indent=2))
+    return 0
+
+
+def cmd_kill_random_node(args) -> int:
+    """Reference analog: `ray kill-random-node` (scripts.py:1269)."""
+    import random
+
+    from ray_tpu._private import protocol, worker_context
+
+    import socket
+
+    with _attached(args):
+        cw = worker_context.core_worker()
+        gcs_host = (args.address or _read_addr()).rsplit(":", 1)[0]
+        try:  # hostnames must compare as IPs against node addresses
+            gcs_ips = {ai[4][0] for ai in socket.getaddrinfo(
+                gcs_host, None)}
+        except OSError:
+            gcs_ips = {gcs_host}
+        gcs_ips |= {gcs_host, "127.0.0.1", "localhost"}
+
+        def is_head(n) -> bool:
+            addr = n["address"]
+            if addr.startswith("/"):
+                return True  # same-host unix node: could host the GCS
+            return addr.rsplit(":", 1)[0] in gcs_ips
+
+        candidates = [n for n in cw.nodes()
+                      if n["alive"] and not is_head(n)]
+        if not candidates:
+            print("no safely-killable worker nodes (refusing to risk "
+                  "the head)")
+            return 1
+        victim = random.choice(candidates)
+
+        async def kill(addr):
+            host, port = addr.rsplit(":", 1)
+            conn = await protocol.connect_tcp(host, int(port)) \
+                if not addr.startswith("/") else \
+                await protocol.connect_unix(addr)
+            try:
+                await conn.call("shutdown_node", {}, timeout=5)
+            finally:
+                await conn.close()
+
+        try:
+            cw.io.run(kill(victim["address"]), timeout=10)
+        except Exception:  # noqa: BLE001 - it died mid-reply: success
+            pass
+        print(f"killed node {victim['node_id'].hex()[:16]} "
+              f"at {victim['address']}")
     return 0
 
 

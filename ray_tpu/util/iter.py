@@ -4,6 +4,13 @@ Role-equivalent of the reference's ``python/ray/util/iter.py:132
 ParallelIterator`` (``:1136 ParallelIteratorWorker``): a list of item
 shards hosted by actors, transformed lazily (for_each/filter/batch),
 consumed synchronously or asynchronously on the driver.
+
+Transforms are value-like: each ``for_each``/``filter``/... returns a
+NEW ParallelIterator carrying its own transform chain; the chain is
+shipped to the shard actors only at consumption time, so branching one
+iterator into several pipelines never contaminates siblings.  (The
+shard ACTORS are shared between branches — consume branches
+sequentially, and note that generator-backed shards are single-shot.)
 """
 
 from __future__ import annotations
@@ -15,7 +22,7 @@ import ray_tpu
 
 
 class ParallelIteratorWorker:
-    """Actor hosting one shard's (possibly infinite) item stream
+    """Actor hosting one shard's (possibly repeating) item stream
     (reference: util/iter.py:1136)."""
 
     def __init__(self, items, repeat: bool = False):
@@ -24,21 +31,25 @@ class ParallelIteratorWorker:
         self._transforms: List = []
         self._it: Optional[Iterator] = None
 
-    def add_transform(self, fn_ser: bytes) -> bool:
+    def set_transforms(self, fns_ser: bytes) -> bool:
         import cloudpickle
 
-        self._transforms.append(cloudpickle.loads(fn_ser))
+        self._transforms = cloudpickle.loads(fns_ser)
         self._it = None  # restart with the new pipeline
         return True
 
     def _build(self) -> Iterator:
-        base = self._base() if callable(self._base) else self._base
+        base = self._base
 
         def gen():
             while True:
+                produced = False
                 for x in (base() if callable(base) else list(base)):
+                    produced = True
                     yield x
-                if not self._repeat:
+                # an exhausted/empty source must END even under repeat —
+                # otherwise this loop would spin forever yielding nothing
+                if not self._repeat or not produced:
                     return
 
         it: Iterator = gen()
@@ -68,8 +79,10 @@ class LocalIterator:
 
 
 class ParallelIterator:
-    def __init__(self, actors: List, batch_fetch: int = 16):
+    def __init__(self, actors: List, transforms: Optional[List] = None,
+                 batch_fetch: int = 16):
         self.actors = actors
+        self._transforms: List[Callable] = list(transforms or [])
         self._batch_fetch = batch_fetch
 
     # -- constructors ------------------------------------------------------
@@ -93,26 +106,24 @@ class ParallelIterator:
         actors = [cls.remote(g, repeat) for g in generators]
         return ParallelIterator(actors)
 
-    # -- lazy transforms ---------------------------------------------------
+    # -- lazy transforms (value-like: new iterator per call) ---------------
 
-    def _with_transform(self, make_t) -> "ParallelIterator":
-        import cloudpickle
-
-        ser = cloudpickle.dumps(make_t)
-        ray_tpu.get([a.add_transform.remote(ser) for a in self.actors],
-                    timeout=60)
-        return self
+    def _with_transform(self, t: Callable) -> "ParallelIterator":
+        return ParallelIterator(self.actors, self._transforms + [t],
+                                self._batch_fetch)
 
     def for_each(self, fn: Callable[[Any], Any]) -> "ParallelIterator":
-        return self._with_transform(lambda it: map(fn, it))
+        return self._with_transform(
+            lambda it, _fn=fn: map(_fn, it))
 
     def filter(self, fn: Callable[[Any], bool]) -> "ParallelIterator":
-        return self._with_transform(lambda it: (x for x in it if fn(x)))
+        return self._with_transform(
+            lambda it, _fn=fn: (x for x in it if _fn(x)))
 
     def batch(self, n: int) -> "ParallelIterator":
-        def t(it):
+        def t(it, _n=n):
             while True:
-                b = list(itertools.islice(it, n))
+                b = list(itertools.islice(it, _n))
                 if not b:
                     return
                 yield b
@@ -128,12 +139,20 @@ class ParallelIterator:
     def num_shards(self) -> int:
         return len(self.actors)
 
+    def _install(self) -> None:
+        import cloudpickle
+
+        ser = cloudpickle.dumps(self._transforms)
+        ray_tpu.get([a.set_transforms.remote(ser) for a in self.actors],
+                    timeout=60)
+
     def gather_sync(self) -> LocalIterator:
         """Round-robin over shards, in order (reference:
         iter.py gather_sync)."""
         fetch = self._batch_fetch
 
         def gen():
+            self._install()
             live = list(self.actors)
             buffers = {a: [] for a in live}
             while live:
@@ -154,6 +173,7 @@ class ParallelIterator:
         fetch = self._batch_fetch
 
         def gen():
+            self._install()
             inflight = {a.next_batch.remote(fetch): a
                         for a in self.actors}
             while inflight:

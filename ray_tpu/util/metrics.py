@@ -137,14 +137,14 @@ class Histogram(Metric):
                 tags: Optional[Dict[str, str]] = None) -> None:
         base = self._key(tags)
         with self._lock:
+            # Prometheus histograms are CUMULATIVE: an observation
+            # increments every bucket whose bound >= value, plus +Inf.
             for b in self.boundaries:
                 if value <= b:
                     k = base + (("le", str(b)),)
                     self._values[k] = self._values.get(k, 0.0) + 1
-                    break
-            else:
-                k = base + (("le", "+Inf"),)
-                self._values[k] = self._values.get(k, 0.0) + 1
+            k = base + (("le", "+Inf"),)
+            self._values[k] = self._values.get(k, 0.0) + 1
             s = base + (("_stat", "sum"),)
             c = base + (("_stat", "count"),)
             self._values[s] = self._values.get(s, 0.0) + value
@@ -173,13 +173,28 @@ def collect_cluster_metrics(kv_get, kv_keys, max_age_s: float = 60.0
         wid = key[len(_KV_PREFIX):][:12]
         for name, m in snap.get("metrics", {}).items():
             full = f"raytpu_app_{name}"
+            kind = m["kind"]
             if full not in seen_help:
                 seen_help.add(full)
-                kind = "counter" if m["kind"] == "counter" else "gauge"
+                ptype = {"counter": "counter",
+                         "histogram": "histogram"}.get(kind, "gauge")
                 lines.append(f"# HELP {full} {m.get('desc', '')}")
-                lines.append(f"# TYPE {full} {kind}")
+                lines.append(f"# TYPE {full} {ptype}")
             for tag_list, value in m.get("values", []):
-                tags = [f'worker="{wid}"'] + [
-                    f'{k}="{v}"' for k, v in tag_list]
-                lines.append(f"{full}{{{','.join(tags)}}} {value}")
+                tags = dict(tag_list)
+                stat = tags.pop("_stat", None)
+                series = full
+                if kind == "histogram":
+                    # Prometheus exposition: <name>_bucket{le=...},
+                    # <name>_sum, <name>_count.
+                    if stat == "sum":
+                        series = full + "_sum"
+                    elif stat == "count":
+                        series = full + "_count"
+                    elif "le" in tags:
+                        series = full + "_bucket"
+                label_str = ",".join(
+                    [f'worker="{wid}"'] +
+                    [f'{k}="{v}"' for k, v in sorted(tags.items())])
+                lines.append(f"{series}{{{label_str}}} {value}")
     return lines

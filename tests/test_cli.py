@@ -68,3 +68,31 @@ def test_cli_cluster_end_to_end(tmp_path):
                     p.wait(timeout=15)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+def test_cli_memory_and_dashboard_index(tmp_path):
+    """`ray_tpu memory` reports per-node store stats; the dashboard
+    serves its HTML frontend at /."""
+    import json as json_mod
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu.scripts import cli
+
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        ray_tpu.get(ray_tpu.put(b"x" * 200_000))  # populate the store
+        stats = list(cli._each_node_stats())
+        assert stats and stats[0][1]["object_store"]["capacity"] > 0
+
+        from ray_tpu.dashboard.app import start_dashboard
+
+        url = start_dashboard(port=18266)
+        with urllib.request.urlopen(url + "/", timeout=30) as r:
+            html = r.read().decode()
+        assert "ray_tpu dashboard" in html
+        with urllib.request.urlopen(url + "/api/nodes", timeout=30) as r:
+            nodes = json_mod.loads(r.read())
+        assert nodes and nodes[0]["alive"]
+    finally:
+        ray_tpu.shutdown()

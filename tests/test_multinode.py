@@ -138,3 +138,49 @@ def test_cross_node_chunked_transfer(cluster):
     got_sum, got_len = ray_tpu.get(digest.remote(ref), timeout=300)
     assert got_len == arr.shape[0]
     assert got_sum == expect
+
+
+def test_tcp_transport_cluster():
+    """A cluster whose GCS is on a non-loopback address runs node
+    managers AND workers over TCP — the transport real multi-host
+    deployments need (unix socket paths cannot be dialed across
+    machines)."""
+    import socket
+
+    from ray_tpu._private.node import Node, _local_ip_toward
+
+    ip = _local_ip_toward("8.8.8.8:1")
+    if ip.startswith("127."):
+        pytest.skip("no non-loopback interface on this host")
+    head = Node(head=True, num_cpus=0, num_tpus=0,
+                object_store_memory=128 * 1024 * 1024,
+                gcs_address=f"{ip}:0")
+    head.start()
+    worker_node = Node(head=False, num_cpus=2, num_tpus=0,
+                       object_store_memory=128 * 1024 * 1024,
+                       gcs_address=head.gcs_address)
+    worker_node.start()
+    try:
+        assert not head.node_address.startswith("/")
+        assert not worker_node.node_address.startswith("/")
+        ray_tpu.init(address=head.gcs_address)
+
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            import os
+
+            return os.environ.get("RAYTPU_NODE_ADDRESS", "")
+
+        addr = ray_tpu.get(where.remote(), timeout=120)
+        assert not addr.startswith("/"), addr  # worker ran in TCP mode
+        # object plane across TCP too
+        @ray_tpu.remote(num_cpus=1)
+        def big():
+            return np.arange(400_000, dtype=np.int64)
+
+        assert ray_tpu.get(big.remote(), timeout=120).sum() == \
+            np.arange(400_000, dtype=np.int64).sum()
+    finally:
+        ray_tpu.shutdown()
+        worker_node.stop()
+        head.stop()
