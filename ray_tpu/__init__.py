@@ -83,6 +83,12 @@ def init(address: Optional[str] = None, *,
         # submitted jobs by the JobSupervisor, like RAY_ADDRESS).
         import os as _os
 
+        if address and "://" in address:
+            # Remote-driver URI (reference: ray://host:port goes through
+            # the Ray Client proxy).  Attaching drivers here are
+            # first-class cluster members over TCP, so the scheme simply
+            # strips — no proxy process needed.
+            address = address.split("://", 1)[1]
         if address == "auto":
             address = _os.environ.get("RAYTPU_ADDRESS") or None
             if address is None:

@@ -106,6 +106,9 @@ class Config:
     # --- tpu ---
     #: Treat each TPU chip as one unit of the "TPU" resource.
     tpu_chips_per_host: int = 0  # 0 = autodetect
+    #: Bound on the chip-detection subprocess (a hung TPU plugin must
+    #: never hang node bring-up).
+    tpu_detect_timeout_s: float = 60.0
     #: Platform preference for worker JAX initialisation.
     jax_platform: str = ""
 

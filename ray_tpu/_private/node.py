@@ -29,17 +29,35 @@ logger = logging.getLogger(__name__)
 
 def detect_num_tpus(config: Config) -> int:
     """Count local TPU chips. ``num_tpus`` is a first-class predefined
-    resource (the reference's GPU analog, scheduling_ids.h:34)."""
+    resource (the reference's GPU analog, scheduling_ids.h:34).
+
+    Probed in a BOUNDED subprocess: a flaky TPU plugin/tunnel can hang
+    jax.devices() indefinitely, and that must never hang init().  The
+    probe also keeps this process from initializing the TPU runtime
+    (libtpu locks chips per process; workers own them, not the driver).
+    """
     if config.tpu_chips_per_host:
         return config.tpu_chips_per_host
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() in ("cpu", "cpu,"):
         return 0
-    try:
-        import jax
+    import subprocess
+    import sys
 
-        return len([d for d in jax.devices() if d.platform == "tpu"])
-    except Exception:  # noqa: BLE001 - no jax / no TPU
-        return 0
+    code = ("import jax; "
+            "print(len([d for d in jax.devices() "
+            "if d.platform != 'cpu' "
+            "and 'tpu' in d.device_kind.lower()]))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=config.tpu_detect_timeout_s)
+        if r.returncode == 0:
+            return int(r.stdout.strip().splitlines()[-1])
+    except Exception:  # noqa: BLE001 - no jax / probe timeout
+        pass
+    logger.warning("TPU detection failed or timed out; assuming 0 chips "
+                   "(set tpu_chips_per_host to override)")
+    return 0
 
 
 def _gcs_is_local(gcs_address: str) -> bool:

@@ -207,11 +207,11 @@ class NodeManager:
                     cut = len(chunk) - 1
                 # Split on \n ONLY (splitlines would also split \r/\v/\f
                 # and desync the byte-offset bookkeeping, e.g. on tqdm
-                # \r-progress output).
-                raw_lines = chunk[:cut].split(b"\n")
-                if not raw_lines:
-                    offsets[fname] = off + cut + 1
-                    continue
+                # \r-progress output).  cut+1 keeps the final byte of a
+                # force-flushed cap-sized line.
+                raw_lines = chunk[:cut + 1].split(b"\n")
+                if raw_lines and raw_lines[-1] == b"":
+                    raw_lines.pop()  # trailing element after final \n
                 # bound the batch WITHOUT skipping: advance the offset
                 # only past what is actually published
                 if len(raw_lines) > 200:

@@ -103,7 +103,15 @@ def _ensure_built() -> str:
 
 
 def _load_lib() -> ctypes.CDLL:
-    lib = ctypes.CDLL(_ensure_built())
+    # Sanitizer runs point RAYTPU_OBJSTORE_LIB at a `make asan` /
+    # `make tsan` variant (src/Makefile; reference .bazelrc:92-113
+    # TSAN/ASAN configs).  The sanitizer runtime must already be loaded
+    # (LD_PRELOAD or a sanitized python).
+    override = os.environ.get("RAYTPU_OBJSTORE_LIB")
+    if override:
+        lib = ctypes.CDLL(override, mode=ctypes.RTLD_GLOBAL)
+    else:
+        lib = ctypes.CDLL(_ensure_built())
     lib.os_create.restype = ctypes.c_void_p
     lib.os_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.os_attach.restype = ctypes.c_void_p

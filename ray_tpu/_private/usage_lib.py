@@ -24,7 +24,7 @@ from typing import Any, Callable, Dict, Optional
 
 _reporter: Optional[Callable[[Dict[str, Any]], None]] = None
 _thread: Optional[threading.Thread] = None
-_stop = threading.Event()
+_stop: Optional[threading.Event] = None
 _REPORT_INTERVAL_S = 60.0
 
 
@@ -96,20 +96,22 @@ def _default_reporter(session_dir: str) -> Callable[[Dict[str, Any]], None]:
 def start_usage_reporter(cw, session_dir: str) -> None:
     """Start the periodic reporter thread (no-op when opted out).
     Re-entrant across shutdown()/init() cycles in one process."""
-    global _thread
+    global _thread, _stop
     if not usage_stats_enabled():
         return
     stop_usage_reporter()
-    _stop.clear()
+    # Fresh event per start: a previous thread stuck past the join
+    # timeout keeps ITS OWN (set) event and can never resurrect.
+    stop = _stop = threading.Event()
     reporter = _reporter or _default_reporter(session_dir)
 
     def loop():
-        while not _stop.is_set():
+        while not stop.is_set():
             try:
                 reporter(collect(cw))
             except Exception:  # noqa: BLE001 - never disturb the app
                 pass
-            _stop.wait(_REPORT_INTERVAL_S)
+            stop.wait(_REPORT_INTERVAL_S)
 
     _thread = threading.Thread(target=loop, daemon=True,
                                name="raytpu-usage")
@@ -119,6 +121,7 @@ def start_usage_reporter(cw, session_dir: str) -> None:
 def stop_usage_reporter() -> None:
     global _thread
     if _thread is not None:
-        _stop.set()
+        if _stop is not None:
+            _stop.set()
         _thread.join(timeout=2)
         _thread = None

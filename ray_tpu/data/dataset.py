@@ -476,32 +476,48 @@ def from_arrow(table, *, parallelism: int = 8) -> Dataset:
     return Dataset(refs)
 
 
+@ray_tpu.remote
+def _read_file_task(fmt: str, path: str):
+    """One file -> one block, parsed INSIDE a task so reads parallelize
+    across the cluster instead of serializing through the driver
+    (reference: read tasks from read_api.py:227 read_datasource).
+    Requires the path to be readable on every node (shared filesystem),
+    like the reference's file-based datasources."""
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+    if fmt == "csv":
+        from pyarrow import csv as pa_csv
+
+        return pa_csv.read_csv(path)
+    if fmt == "json":
+        from pyarrow import json as pa_json
+
+        return pa_json.read_json(path)
+    raise ValueError(f"unknown format {fmt!r}")
+
+
 def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
-
-    import pyarrow.parquet as pq
 
     files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
         if os.path.isdir(path) else [path]
     if not files:
         raise FileNotFoundError(f"no parquet files under {path}")
-    refs = [ray_tpu.put(pq.read_table(f)) for f in files]
-    return Dataset(refs)
+    return Dataset([_read_file_task.remote("parquet", f) for f in files])
 
 
 def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
     import glob
     import os
 
-    from pyarrow import csv as pa_csv
-
     files = sorted(glob.glob(os.path.join(path, "*.csv"))) \
         if os.path.isdir(path) else [path]
     if not files:
         raise FileNotFoundError(f"no csv files under {path}")
-    refs = [ray_tpu.put(pa_csv.read_csv(f)) for f in files]
-    return Dataset(refs)
+    return Dataset([_read_file_task.remote("csv", f) for f in files])
 
 
 def _list_files(path: str, suffix: str) -> List[str]:
@@ -517,11 +533,8 @@ def _list_files(path: str, suffix: str) -> List[str]:
 
 def read_json(path: str, *, parallelism: int = 8) -> Dataset:
     """Newline-delimited JSON records (reference: read_json)."""
-    from pyarrow import json as pa_json
-
-    refs = [ray_tpu.put(pa_json.read_json(f))
-            for f in _list_files(path, ".json")]
-    return Dataset(refs)
+    return Dataset([_read_file_task.remote("json", f)
+                    for f in _list_files(path, ".json")])
 
 
 def read_text(path: str, *, parallelism: int = 8) -> Dataset:

@@ -319,7 +319,10 @@ def cmd_kill_random_node(args) -> int:
 
     with _attached(args):
         cw = worker_context.core_worker()
-        gcs_host = (args.address or _read_addr()).rsplit(":", 1)[0]
+        raw_addr = args.address or _read_addr()
+        if "://" in raw_addr:  # init() accepts ray://host:port URIs
+            raw_addr = raw_addr.split("://", 1)[1]
+        gcs_host = raw_addr.rsplit(":", 1)[0]
         try:  # hostnames must compare as IPs against node addresses
             gcs_ips = {ai[4][0] for ai in socket.getaddrinfo(
                 gcs_host, None)}

@@ -70,23 +70,53 @@ def _get_or_create_controller():
             ServeController).remote()
 
 
-def run(target: Deployment, *, route_prefix: Optional[str] = None,
-        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
-    """Deploy and return a handle (reference serve.run, serve/api.py:455).
-    With http=True an aiohttp ingress proxy is started as well."""
+def _graphify(obj, deployed: set, controller):
+    """Deployment-graph support (reference: serve/deployment_graph.py on
+    Ray DAG): bound deployments nested in init args deploy first and are
+    replaced by handle markers the replica resolves at construction."""
+    from ray_tpu.serve.replica import DeploymentHandleMarker
+
+    if isinstance(obj, Deployment):
+        _deploy_one(obj, deployed, controller)
+        return DeploymentHandleMarker(obj.name)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_graphify(x, deployed, controller) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _graphify(v, deployed, controller)
+                for k, v in obj.items()}
+    return obj
+
+
+def _deploy_one(target: Deployment, deployed: set, controller,
+                route_prefix: Optional[str] = None) -> None:
     import ray_tpu
 
-    controller = _get_or_create_controller()
-    prefix = route_prefix or target.route_prefix or \
-        (f"/{target.name}" if http else None)
+    if target.name in deployed:
+        return
+    deployed.add(target.name)
+    init_args = _graphify(target.init_args, deployed, controller)
+    init_kwargs = _graphify(target.init_kwargs or {}, deployed,
+                            controller)
     ray_tpu.get(controller.deploy.remote(
         target.name, cloudpickle.dumps(target.func_or_class),
-        target.init_args, target.init_kwargs or {},
+        init_args, init_kwargs,
         num_replicas=target.num_replicas,
         ray_actor_options=target.ray_actor_options,
         max_concurrent_queries=target.max_concurrent_queries,
         autoscaling_config=target.autoscaling_config,
-        route_prefix=prefix), timeout=120)
+        route_prefix=route_prefix or target.route_prefix), timeout=120)
+
+
+def run(target: Deployment, *, route_prefix: Optional[str] = None,
+        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+    """Deploy (a graph of) deployments and return the root handle
+    (reference serve.run, serve/api.py:455; graphs via .bind()
+    composition as in serve/deployment_graph.py).  With http=True an
+    aiohttp ingress proxy is started as well."""
+    controller = _get_or_create_controller()
+    prefix = route_prefix or target.route_prefix or \
+        (f"/{target.name}" if http else None)
+    _deploy_one(target, set(), controller, route_prefix=prefix)
     if http:
         start_http_proxy(port=http_port)
     return DeploymentHandle(target.name, controller)

@@ -15,11 +15,34 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 
+class DeploymentHandleMarker:
+    """Placeholder for a bound sub-deployment inside init args — the
+    deployment-graph edge (reference: serve/deployment_graph.py nodes).
+    Resolved to a live DeploymentHandle at replica construction."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+
+def _resolve_markers(obj):
+    if isinstance(obj, DeploymentHandleMarker):
+        from ray_tpu.serve.api import get_deployment_handle
+
+        return get_deployment_handle(obj.name)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_resolve_markers(x) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _resolve_markers(v) for k, v in obj.items()}
+    return obj
+
+
 class RayServeReplica:
     def __init__(self, serialized_def: bytes, init_args: tuple,
                  init_kwargs: Dict[str, Any], deployment_name: str):
         target = cloudpickle.loads(serialized_def)
         self.deployment_name = deployment_name
+        init_args = _resolve_markers(tuple(init_args))
+        init_kwargs = _resolve_markers(dict(init_kwargs or {}))
         if isinstance(target, type):
             self.callable = target(*init_args, **init_kwargs)
         else:

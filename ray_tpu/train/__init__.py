@@ -17,10 +17,14 @@ from ray_tpu.train.base_trainer import BaseTrainer
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 from ray_tpu.train.jax_backend import JaxConfig
 from ray_tpu.train.jax_trainer import JaxTrainer, jax_utils
+from ray_tpu.train.torch_backend import (TorchConfig, TorchTrainer,
+                                         prepare_data_loader,
+                                         prepare_model)
 
 __all__ = [
     "session", "Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
     "CheckpointConfig", "Result", "Backend", "BackendConfig",
     "BackendExecutor", "TrainingWorkerError", "BaseTrainer",
     "DataParallelTrainer", "JaxConfig", "JaxTrainer", "jax_utils",
+    "TorchConfig", "TorchTrainer", "prepare_model", "prepare_data_loader",
 ]

@@ -1,0 +1,137 @@
+"""Torch backend + TorchTrainer: CPU/gloo data-parallel training.
+
+Role-equivalent of the reference's Torch Train backend (reference
+``train/torch/config.py:29 TorchConfig``, ``:70
+_setup_torch_process_group`` = ``dist.init_process_group``; loop utils
+``train/torch/train_loop_utils.py:28 prepare_model`` wrapping DDP).
+The TPU build's flagship is JaxTrainer — this backend exists for
+ecosystem parity (the image ships CPU torch, so gloo only; a CUDA
+deployment would pass backend="nccl").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+
+
+@dataclasses.dataclass
+class TorchConfig(BackendConfig):
+    backend: str = "gloo"            # "nccl" on CUDA deployments
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _pick_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _get_node_ip() -> str:
+    return socket.gethostbyname(socket.gethostname())
+
+
+def _setup_torch_process_group(backend: str, init_method: str,
+                               rank: int, world_size: int,
+                               timeout_s: float):
+    """Reference: train/torch/config.py:70 _setup_torch_process_group."""
+    import datetime
+
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        return
+    dist.init_process_group(
+        backend=backend, init_method=init_method, rank=rank,
+        world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+
+
+def _teardown_torch_process_group():
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig) -> None:
+        n = len(worker_group)
+        if n <= 1:
+            return
+        import ray_tpu
+
+        ip = worker_group.execute_single(0, _get_node_ip)
+        port = worker_group.execute_single(0, _pick_port)
+        init_method = f"tcp://{ip}:{port}"
+        ray_tpu.get([w.execute.remote(
+            _setup_torch_process_group, backend_config.backend,
+            init_method, i, n, backend_config.init_timeout_s)
+            for i, w in enumerate(worker_group.workers)],
+            timeout=backend_config.init_timeout_s + 30)
+
+    def on_shutdown(self, worker_group, backend_config) -> None:
+        try:
+            worker_group.execute(_teardown_torch_process_group)
+        except Exception:  # noqa: BLE001 - workers may be dead
+            pass
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer + TorchConfig (reference: TorchTrainer)."""
+
+    _default_backend_config = TorchConfig()
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
+                 resume_from_checkpoint=None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=torch_config or TorchConfig(),
+            scaling_config=scaling_config, run_config=run_config,
+            datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)
+
+
+# -- worker-side loop utils (reference: train_loop_utils.py) ---------------
+
+def prepare_model(model):
+    """Wrap in DistributedDataParallel when a process group is active
+    (reference: train/torch/train_loop_utils.py:28 prepare_model)."""
+    import torch.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(loader):
+    """Shard a DataLoader across workers with a DistributedSampler
+    (reference: train_loop_utils.py prepare_data_loader)."""
+    import torch.distributed as dist
+
+    if not (dist.is_initialized() and dist.get_world_size() > 1):
+        return loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    return DataLoader(loader.dataset, batch_size=loader.batch_size,
+                      sampler=DistributedSampler(loader.dataset),
+                      num_workers=0, collate_fn=loader.collate_fn,
+                      drop_last=loader.drop_last)

@@ -1,0 +1,27 @@
+"""IMPALA learning gate."""
+import json
+import os
+
+import ray_tpu
+from ray_tpu.rllib import IMPALA, IMPALAConfig
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+cfg = IMPALAConfig(env="CartPole-v1", num_workers=2,
+                   num_envs_per_worker=2, rollout_fragment_length=64,
+                   train_batch_size=512, lr=5e-3, seed=7)
+algo = IMPALA(cfg)
+best, steps = -1e9, 0
+for i in range(10 if fast else 80):
+    res = algo.train()
+    steps = res["timesteps_total"]
+    best = max(best, res.get("episode_reward_mean", -1e9))
+    if best >= 100.0 or steps > 400_000:
+        break
+print(json.dumps({"episode_reward_mean": best, "env_steps": steps,
+                  "max_env_steps": steps}), flush=True)
+try:
+    algo.stop()
+    ray_tpu.shutdown()
+except BaseException:
+    pass

@@ -1,0 +1,13 @@
+"""Core-ops microbenchmark workload (reference:
+release/microbenchmark/run_microbenchmark.py)."""
+import os
+
+import ray_tpu
+from ray_tpu._private import ray_perf
+
+ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+ray_perf.main(0.3 if os.environ.get("RELEASE_FAST") else 1.0)
+try:
+    ray_tpu.shutdown()
+except BaseException:
+    pass

@@ -1,0 +1,28 @@
+"""PPO learning gate (reference: release/rllib_tests learning tests —
+reward threshold within a sample budget)."""
+import json
+import os
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+cfg = PPOConfig(env="CartPole-v1", num_workers=2,
+                rollout_fragment_length=128,
+                train_batch_size=1024, seed=1)
+algo = PPO(cfg)
+best, steps = -1e9, 0
+for i in range(10 if fast else 60):
+    res = algo.train()
+    steps = res["timesteps_total"]
+    best = max(best, res.get("episode_reward_mean", -1e9))
+    if best >= 120.0 or steps > 300_000:
+        break
+print(json.dumps({"episode_reward_mean": best, "env_steps": steps,
+                  "max_env_steps": steps}), flush=True)
+try:
+    algo.stop()
+    ray_tpu.shutdown()
+except BaseException:
+    pass

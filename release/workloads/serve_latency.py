@@ -1,0 +1,31 @@
+"""Serve latency probe (reference: doc/source/serve/performance.md)."""
+import json
+import os
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+
+@serve.deployment(num_replicas=2)
+def echo(x):
+    return x
+
+h = serve.run(echo)
+n = 50 if os.environ.get("RELEASE_FAST") else 300
+lat = []
+for i in range(n):
+    t0 = time.perf_counter()
+    assert h.call(i, timeout=60) == i
+    lat.append((time.perf_counter() - t0) * 1e3)
+lat = np.asarray(lat[5:])  # drop warmup
+print(json.dumps({"p50_ms": float(np.percentile(lat, 50)),
+                  "p99_ms": float(np.percentile(lat, 99))}), flush=True)
+try:
+    serve.shutdown()
+    ray_tpu.shutdown()
+except BaseException:
+    pass

@@ -1,0 +1,18 @@
+"""Distributed shuffle integrity at scale (reference:
+release/nightly_tests shuffle family, scaled to one host)."""
+import json
+import os
+
+import ray_tpu
+from ray_tpu import data
+
+ray_tpu.init(num_cpus=4, object_store_memory=512 * 1024 * 1024)
+n = 50_000 if os.environ.get("RELEASE_FAST") else 500_000
+ds = data.range(n, parallelism=16).random_shuffle(seed=0)
+ids = sorted(r["id"] for r in ds.take_all())
+print(json.dumps({"rows": len(ids),
+                  "rows_ok": ids == list(range(n))}), flush=True)
+try:
+    ray_tpu.shutdown()
+except BaseException:
+    pass

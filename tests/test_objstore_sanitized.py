@@ -1,0 +1,89 @@
+"""Run the shared-memory store under AddressSanitizer.
+
+Reference analog: the TSAN/ASAN bazel test configs (.bazelrc:92-113)
+applied to the plasma store tests.  Builds the `make asan` variant of
+objstore.cc and drives a multi-process create/seal/get/delete/evict
+stress workload against it in sanitized subprocesses; any ASAN report
+fails the test (the subprocess aborts non-zero).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "ray_tpu", "_private", "_lib",
+                   "libobjstore_asan.so")
+
+STRESS = textwrap.dedent("""
+    import os, random, sys
+    sys.path.insert(0, os.environ["REPO"])
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu._private.object_store import (ObjectStoreClient,
+                                               ObjectStoreError,
+                                               ObjectStoreFull)
+
+    name = os.environ["STORE_NAME"]
+    role = sys.argv[1]
+    if role == "owner":
+        store = ObjectStoreClient(name, create=True,
+                                  capacity=16 * 1024 * 1024)
+    else:
+        store = ObjectStoreClient(name)
+    rng = random.Random(int(sys.argv[2]))
+    mine = []
+    for i in range(300):
+        op = rng.random()
+        try:
+            if op < 0.5:
+                oid = ObjectID.from_random()
+                store.put_bytes(oid, bytes(rng.randrange(1, 65536)))
+                mine.append(oid)
+            elif op < 0.8 and mine:
+                oid = rng.choice(mine)
+                buf = store.get(oid, timeout_ms=0)
+                if buf is not None:
+                    with buf:
+                        assert len(buf.data) >= 0
+            elif op < 0.9 and mine:
+                store.delete(mine.pop(rng.randrange(len(mine))))
+            else:
+                store.evict(65536)
+        except (ObjectStoreFull, ObjectStoreError):
+            store.evict(1 << 20)
+    store.close(destroy=(role == "owner"))
+    print("STRESS-OK")
+""")
+
+
+@pytest.mark.slow
+def test_objstore_stress_under_asan(tmp_path):
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    r = subprocess.run(["make", "-C", os.path.join(REPO, "src"), "asan"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"asan build unavailable: {r.stderr[-200:]}")
+    script = tmp_path / "stress.py"
+    script.write_text(STRESS)
+    env = dict(os.environ, REPO=REPO, STORE_NAME="asan_test_store",
+               RAYTPU_OBJSTORE_LIB=LIB,
+               ASAN_OPTIONS="detect_leaks=0,abort_on_error=1",
+               LD_PRELOAD=_find_asan_rt())
+    owner = subprocess.Popen([sys.executable, str(script), "owner", "1"],
+                             env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, text=True)
+    out, err = owner.communicate(timeout=300)
+    assert owner.returncode == 0, f"ASAN failure:\n{err[-2000:]}"
+    assert "STRESS-OK" in out
+
+
+def _find_asan_rt() -> str:
+    r = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                       capture_output=True, text=True)
+    path = r.stdout.strip()
+    return path if os.path.sep in path else ""

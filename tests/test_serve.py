@@ -171,3 +171,29 @@ def test_serve_autoscales_up_and_down(serve_cluster):
         time.sleep(0.5)
     assert shrunk, "autoscaler never scaled back down"
     serve.delete("slow")
+
+
+def test_deployment_graph_composition(serve_cluster):
+    """A root deployment binds a sub-deployment; requests flow through
+    the graph (reference: serve deployment graphs on Ray DAG,
+    serve/deployment_graph.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Preprocessor:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre  # DeploymentHandle resolved from the marker
+
+        def __call__(self, x):
+            return self.pre.call(x, timeout=60) + 1
+
+    h = serve.run(Model.bind(Preprocessor.bind()))
+    assert h.call(5, timeout=120) == 11  # (5*2)+1
+    assert h.call(0, timeout=60) == 1
+    serve.delete("Model")
+    serve.delete("Preprocessor")
