@@ -105,10 +105,15 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
-def main():
-    ensure_backend()
+def time_config(batch, seq=1024, n_steps=20, preset="gpt2", **overrides):
+    """Compile and time `n_steps` donated train steps of the GPT-2
+    flagship under a data mesh spanning every local chip.
+
+    Returns (tok_s_per_chip, mfu, final_loss, n_chips).  Shared by
+    main() and sweep_tpu.py so the timing methodology (donation, mesh,
+    host-transfer fence, per-chip normalization) has one source of
+    truth."""
     import jax
-    import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import (gpt2_config, gpt2_init, gpt2_logical_axes,
@@ -118,17 +123,7 @@ def main():
     from ray_tpu.parallel.sharding import param_shardings, shard_params
 
     n_chips = len(jax.devices())
-    on_tpu = jax.default_backend() == "tpu"
-    seq = 1024
-    # batch 32/chip measured best on v5e (48 and 64 + chunked loss are
-    # slower; >32 without loss chunking exceeds HBM at f32 logits).
-    batch = 32 * max(1, n_chips) if on_tpu else 2
-    cfg = gpt2_config("gpt2", max_seq=seq, use_flash=None if on_tpu
-                      else False)  # None = measured-crossover dispatch
-    if not on_tpu:  # CPU smoke fallback so bench.py always emits a line
-        cfg = gpt2_config("tiny", use_flash=False)
-        seq = cfg.max_seq
-
+    cfg = gpt2_config(preset, max_seq=seq, **overrides)
     mesh = make_mesh(MeshSpec(data=-1))
     axes = gpt2_logical_axes(cfg)
     tx = optax.adamw(3e-4, weight_decay=0.1)
@@ -157,19 +152,34 @@ def main():
         # backends whose block_until_ready returns early.
         params, opt_state, loss = train_step(params, opt_state, data)
         float(loss)
-        n_steps = 20 if on_tpu else 2
         t0 = time.perf_counter()
         for _ in range(n_steps):
             params, opt_state, loss = train_step(params, opt_state, data)
         final_loss = float(loss)
         dt = time.perf_counter() - t0
 
-    tokens_per_step = batch * seq
-    tok_s = tokens_per_step * n_steps / dt
-    tok_s_chip = tok_s / max(1, n_chips)
-    n_params = gpt2_param_count(cfg)
-    model_flops = 6 * n_params * tok_s_chip  # fwd+bwd FLOPs per token
-    mfu = model_flops / peak_flops_per_chip()
+    tok_s_chip = batch * seq * n_steps / dt / max(1, n_chips)
+    mfu = 6 * gpt2_param_count(cfg) * tok_s_chip / peak_flops_per_chip()
+    return tok_s_chip, mfu, final_loss, n_chips
+
+
+def main():
+    ensure_backend()
+    import jax
+
+    n_chips = len(jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    seq = 1024
+    # batch 32/chip measured best on v5e (48 and 64 + chunked loss are
+    # slower; >32 without loss chunking exceeds HBM at f32 logits).
+    batch = 32 * max(1, n_chips) if on_tpu else 2
+    if on_tpu:
+        tok_s_chip, mfu, final_loss, n_chips = time_config(
+            batch, seq=seq, n_steps=20)
+    else:  # CPU smoke fallback so bench.py always emits a line
+        tok_s_chip, mfu, final_loss, n_chips = time_config(
+            batch, seq=128, n_steps=2, preset="tiny", use_flash=False)
+        seq = 128
     print(json.dumps({
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
                   if on_tpu else "gpt2_tiny_cpu_smoke_tokens_per_sec",

@@ -309,21 +309,34 @@ def _flash_bwd(scale, block_q, block_k, causal, interpret, block_q_bwd,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+DEFAULT_BLOCK_Q_BWD = 256
+DEFAULT_BLOCK_K_BWD = 1024
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    block_q_bwd: int = 256,
-                    block_k_bwd: int = 1024,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention on (B, T, H, D) tensors.  Differentiable; VMEM use
     is O(block), HBM use O(T); causal masking skips ~half the tiles.
-    block_q_bwd/block_k_bwd override the backward kernels' tile sizes
-    (0 = same as forward); the backward kernels hold more live tiles than
-    the forward, so their optimal q-block is smaller (256x1024 measured
-    8x faster than 1024x1024 on v5e at T=1024)."""
+    block_q_bwd/block_k_bwd set the backward kernels' tile sizes; the
+    backward holds more live tiles than the forward, so its optimal
+    q-block is smaller (256x1024 measured 8x faster than 1024x1024 on
+    v5e at T=1024).  Default (None): the tuned (256, 1024) when the
+    forward blocks are also defaults, otherwise mirror the caller's
+    forward blocks so an explicit VMEM-budget tuning governs both
+    passes."""
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if block_q_bwd is None:
+        block_q_bwd = (DEFAULT_BLOCK_Q_BWD if block_q == DEFAULT_BLOCK_Q
+                       else block_q)
+    if block_k_bwd is None:
+        block_k_bwd = (DEFAULT_BLOCK_K_BWD if block_k == DEFAULT_BLOCK_K
+                       else block_k)
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)

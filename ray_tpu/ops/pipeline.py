@@ -56,10 +56,10 @@ def pipeline_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     any/every pipeline rank identically).
     """
     if mesh is None:
-        from jax._src.mesh import thread_resources
+        from ray_tpu.parallel.mesh import active_mesh
 
-        mesh = thread_resources.env.physical_mesh
-        if mesh.empty:
+        mesh = active_mesh()
+        if mesh is None:
             raise RuntimeError("pipeline_apply needs an active mesh "
                                "(use `with jax.set_mesh(mesh):`)")
     S = _stages(mesh, axis)

@@ -18,6 +18,7 @@ from ray_tpu.parallel.mesh import (
     MeshSpec,
     make_mesh,
     make_hybrid_mesh,
+    active_mesh,
     fake_mesh,
     local_mesh,
     AXIS_DATA,
@@ -39,7 +40,7 @@ from ray_tpu.parallel import collective
 __all__ = [
     "TpuGeneration", "SliceTopology", "parse_accelerator_type",
     "ici_domains", "MeshSpec", "make_mesh", "make_hybrid_mesh",
-    "fake_mesh", "local_mesh", "LogicalAxisRules", "logical_to_mesh_axes",
+    "active_mesh", "fake_mesh", "local_mesh", "LogicalAxisRules", "logical_to_mesh_axes",
     "shard_params", "with_logical_constraint", "DEFAULT_RULES", "collective",
     "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR", "AXIS_SEQ", "AXIS_EXPERT",
     "AXIS_PIPELINE",

@@ -154,6 +154,33 @@ def make_hybrid_mesh(spec: MeshSpec, *, num_slices: int,
     return Mesh(dev_array, _AXIS_ORDER)
 
 
+def active_mesh():
+    """The concrete Mesh made current by ``jax.set_mesh`` (or the legacy
+    ``with mesh:`` context manager), or None when no mesh is active.
+
+    jax 0.9's ``jax.set_mesh`` populates the sharding config's
+    device_context but NOT the legacy ``thread_resources`` — code that
+    reads only ``thread_resources.env.physical_mesh`` silently sees "no
+    mesh" under ``set_mesh``.  All mesh-sensitive dispatch in this repo
+    (logical constraints, ring attention, pipeline stages) goes through
+    this helper so both entry APIs work."""
+    try:
+        from jax._src import mesh as _mesh_lib
+        m = _mesh_lib.get_concrete_mesh()
+        if m is not None and not m.empty:
+            return m
+    except Exception:  # noqa: BLE001 - older jax without get_concrete_mesh
+        pass
+    try:
+        from jax._src.mesh import thread_resources
+        m = thread_resources.env.physical_mesh
+        if not m.empty:
+            return m
+    except Exception:  # noqa: BLE001
+        pass
+    return None
+
+
 def local_mesh(spec: Optional[MeshSpec] = None):
     """Mesh over this process's addressable devices only."""
     jax, _ = _import_jax()

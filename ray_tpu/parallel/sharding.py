@@ -97,11 +97,8 @@ def with_logical_constraint(x, logical_axes, rules: LogicalAxisRules =
 
     spec = logical_to_mesh_axes(logical_axes, rules)
     if mesh is None:
-        try:
-            from jax._src.mesh import thread_resources
-            mesh = thread_resources.env.physical_mesh
-            if mesh.empty:
-                return x
-        except Exception:
+        from ray_tpu.parallel.mesh import active_mesh
+        mesh = active_mesh()
+        if mesh is None:
             return x
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

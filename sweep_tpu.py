@@ -1,65 +1,37 @@
-"""One-off TPU tuning sweep for the north-star bench (not part of the suite)."""
-import functools
+"""TPU tuning sweep over bench.py's timing harness (dev tool).
+
+Usage:
+  python sweep_tpu.py '[[32, {}], [32, {"remat_policy": "dots_nb"}]]'
+
+Each entry is [batch_per_chip, {overrides}].  "max_seq"/"seq" and
+"preset" overrides are routed to time_config's seq/preset parameters;
+everything else is passed to gpt2_config.  Reuses bench.time_config so
+the methodology (donation, mesh, fence, per-chip batch and MFU
+normalization) stays identical to the official bench.
+"""
+import json
 import sys
-import time
 
-import jax
-import optax
-
-from ray_tpu.models import gpt2_config, gpt2_init, gpt2_logical_axes, gpt2_loss
-from ray_tpu.models.gpt2 import gpt2_param_count
-from ray_tpu.parallel import MeshSpec, make_mesh
-from ray_tpu.parallel.sharding import param_shardings, shard_params
-
-PEAK = 197e12
-
-
-def run(batch, seq=1024, n_steps=10, **overrides):
-    cfg = gpt2_config("gpt2", max_seq=seq, **overrides)
-    mesh = make_mesh(MeshSpec(data=-1))
-    axes = gpt2_logical_axes(cfg)
-    tx = optax.adamw(3e-4, weight_decay=0.1)
-    params = gpt2_init(jax.random.PRNGKey(0), cfg)
-    with jax.set_mesh(mesh):
-        params = shard_params(params, axes, mesh)
-        opt_state = tx.init(params)
-        p_shard = param_shardings(axes, mesh)
-
-        @functools.partial(jax.jit, in_shardings=(p_shard, None, None),
-                           donate_argnums=(0, 1))
-        def step(params, opt_state, data):
-            loss, grads = jax.value_and_grad(
-                lambda p: gpt2_loss(p, data, cfg))(params)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
-
-        tokens = jax.random.randint(jax.random.PRNGKey(1),
-                                    (batch, seq + 1), 0, cfg.vocab_size)
-        data = {"tokens": tokens}
-        params, opt_state, loss = step(params, opt_state, data)
-        float(loss)
-        t0 = time.perf_counter()
-        for _ in range(n_steps):
-            params, opt_state, loss = step(params, opt_state, data)
-        float(loss)
-        dt = time.perf_counter() - t0
-    tok_s = batch * seq * n_steps / dt
-    mfu = 6 * gpt2_param_count(cfg) * tok_s / PEAK
-    return tok_s, mfu
-
+from bench import time_config
 
 if __name__ == "__main__":
-    import json
+    import jax
+
+    n_chips = len(jax.devices())
     configs = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
-        [32, {"remat_policy": "dots_nb"}],
-        [32, {"remat_policy": "dots_nb", "loss_chunks": 4}],
-        [64, {"remat_policy": "dots_nb", "loss_chunks": 8}],
+        [32, {}],
     ]
-    for batch, kw in configs:
+    for batch_per_chip, kw in configs:
+        kw = dict(kw)
+        seq = kw.pop("max_seq", kw.pop("seq", 1024))
+        preset = kw.pop("preset", "gpt2")
         try:
-            tok_s, mfu = run(batch, **kw)
-            print(f"batch={batch} {kw}: {tok_s:,.0f} tok/s  MFU={mfu:.4f}",
-                  flush=True)
+            tok_s_chip, mfu, _, n = time_config(
+                batch_per_chip * n_chips, seq=seq, n_steps=10,
+                preset=preset, **kw)
+            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: "
+                  f"{tok_s_chip:,.0f} tok/s/chip (x{n} chips)  "
+                  f"MFU={mfu:.4f}", flush=True)
         except Exception as e:
-            print(f"batch={batch} {kw}: FAILED {type(e).__name__}: "
-                  f"{str(e)[:160]}", flush=True)
+            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:160]}", flush=True)
