@@ -63,6 +63,19 @@ class Config:
     #: reference's Redis-backed store, redis_store_client.h:28).
     gcs_persist_path: str = ""
 
+    # --- OOM defense (reference: src/ray/common/memory_monitor.h:48 +
+    # raylet/worker_killing_policy.h:30,58 retriable-LIFO policy) ---
+    #: Node memory usage fraction above which the worker killer engages.
+    #: 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    #: Memory monitor poll interval, seconds (reference:
+    #: memory_monitor_refresh_ms = 250).
+    memory_monitor_interval_s: float = 0.25
+    #: Test hook: when set, the monitor reads the usage fraction from this
+    #: file instead of /proc/meminfo (the reference fakes usage in
+    #: worker_killing_policy tests the same way).
+    memory_monitor_fake_usage_path: str = ""
+
     # --- timeouts / liveness ---
     heartbeat_interval_s: float = 1.0
     num_heartbeats_timeout: int = 30

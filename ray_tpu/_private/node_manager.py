@@ -69,7 +69,8 @@ class ResourceSet:
 class WorkerHandle:
     __slots__ = ("worker_id", "pid", "address", "conn", "proc", "state",
                  "actor_id", "lease_id", "started_at", "tpu_grant",
-                 "tpu_chips", "_actor_resources", "_actor_bundle")
+                 "tpu_chips", "_actor_resources", "_actor_bundle",
+                 "oom_killed")
 
     def __init__(self, worker_id: bytes, proc: subprocess.Popen):
         self.worker_id = worker_id
@@ -85,6 +86,29 @@ class WorkerHandle:
         self.tpu_chips: List[int] = []
         self._actor_resources = None
         self._actor_bundle = None
+        self.oom_killed = False
+
+
+def pick_oom_victim(workers) -> Optional["WorkerHandle"]:
+    """Retriable-LIFO worker killing policy (reference:
+    worker_killing_policy.h:58 RetriableLIFOWorkerKillingPolicy).
+
+    Leased task workers are preferred over actors (tasks are retried by
+    the submitter's existing retry machinery; an actor kill costs a
+    restart and loses its state), and within each group the newest
+    worker dies first — the oldest work is the most likely to be the
+    critical path, and the newest allocation is the most likely cause of
+    the memory spike."""
+    leased = [w for w in workers if w.state == "leased"]
+    if leased:
+        # LIFO by lease order, not process start: workers are reused from
+        # the idle pool, so started_at can predate the current task by
+        # minutes.  lease_id is monotonic per grant.
+        return max(leased, key=lambda w: w.lease_id)
+    actors = [w for w in workers if w.state == "actor"]
+    if actors:
+        return max(actors, key=lambda w: w.started_at)
+    return None
 
 
 class LeaseRequest:
@@ -164,6 +188,10 @@ class NodeManager:
             self._heartbeat_loop())
         self._log_monitor_task = asyncio.get_running_loop().create_task(
             self._log_monitor_loop())
+        self._memory_monitor_task = None
+        if self.config.memory_usage_threshold > 0:
+            self._memory_monitor_task = asyncio.get_running_loop(
+                ).create_task(self._memory_monitor_loop())
 
     async def _log_monitor_loop(self):
         """Tail this node's worker log files and publish new lines to the
@@ -264,12 +292,76 @@ class NodeManager:
                 if self._closing:
                     return
 
+    # ---- OOM defense -----------------------------------------------------
+    # Reference: MemoryMonitor (src/ray/common/memory_monitor.h:48) polls
+    # node memory and invokes a WorkerKillingPolicy
+    # (raylet/worker_killing_policy.h:30,58) that prefers retriable
+    # workers, newest first, so forward progress (the oldest work) is
+    # preserved and the killed work is re-run by the existing retry
+    # machinery.
+
+    def _node_memory_usage(self) -> float:
+        """Used-memory fraction of this node (0.0-1.0)."""
+        fake = self.config.memory_monitor_fake_usage_path
+        if fake:
+            try:
+                with open(fake) as f:
+                    return float(f.read().strip() or 0.0)
+            except Exception:  # noqa: BLE001 - not written yet
+                return 0.0
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    name, _, rest = line.partition(":")
+                    info[name] = int(rest.split()[0]) * 1024
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", total)
+            return 1.0 - avail / total if total else 0.0
+        except Exception:  # noqa: BLE001 - non-Linux fallback
+            return 0.0
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        return pick_oom_victim(self.workers.values())
+
+    async def _memory_monitor_loop(self):
+        while not self._closing:
+            await asyncio.sleep(self.config.memory_monitor_interval_s)
+            try:
+                usage = self._node_memory_usage()
+                if usage < self.config.memory_usage_threshold:
+                    continue
+                victim = self._pick_oom_victim()
+                if victim is None:
+                    continue
+                victim.oom_killed = True
+                logger.warning(
+                    "memory usage %.0f%% above threshold %.0f%%: OOM-"
+                    "killing worker %s (pid=%d, state=%s) — the task/actor "
+                    "will be retried/restarted per its retry policy",
+                    usage * 100, self.config.memory_usage_threshold * 100,
+                    WorkerID(victim.worker_id), victim.pid, victim.state)
+                # mark_dead=False: _on_disconnect runs the full cleanup
+                # (resource release, actor-death report, lease return) so
+                # the kill is indistinguishable from a crash to the retry
+                # machinery, except for the recorded OOM cause.
+                self._kill_worker_process(victim, mark_dead=False)
+                # Give the kill time to actually free memory before
+                # considering another victim.
+                await asyncio.sleep(
+                    max(1.0, self.config.memory_monitor_interval_s))
+            except Exception:  # noqa: BLE001 - monitor must not die
+                if self._closing:
+                    return
+
     async def close(self):
         self._closing = True
         if self._heartbeat_task:
             self._heartbeat_task.cancel()
         if getattr(self, "_log_monitor_task", None):
             self._log_monitor_task.cancel()
+        if getattr(self, "_memory_monitor_task", None):
+            self._memory_monitor_task.cancel()
         # Fail queued lease requests so their handler coroutines (and the
         # remote submitters awaiting them) unwind instead of hanging.
         for req in self._lease_queue:
@@ -445,9 +537,13 @@ class NodeManager:
             if res:
                 self._release(res, getattr(handle, "_actor_bundle", None))
                 self._pump_leases()
+            cause = (f"worker process {handle.pid} OOM-killed by the "
+                     f"memory monitor" if handle.oom_killed
+                     else f"worker process {handle.pid} died")
             asyncio.get_running_loop().create_task(self._report_actor_death(
-                handle.actor_id, f"worker process {handle.pid} died"))
-        logger.warning("worker %s died (state=%s)", WorkerID(worker_id), prev_state)
+                handle.actor_id, cause))
+        logger.warning("worker %s died (state=%s%s)", WorkerID(worker_id),
+                       prev_state, ", oom" if handle.oom_killed else "")
 
     async def _report_actor_death(self, actor_id: bytes, cause: str):
         try:

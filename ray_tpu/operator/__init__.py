@@ -1,0 +1,18 @@
+"""Kubernetes-style operator: reconcile RayCluster resources into pods.
+
+Role-equivalent of the reference's legacy K8s operator
+(``python/ray/ray_operator/operator.py`` reconciling RayCluster CRs) and
+the KubeRay pattern it points users at.  TPU-first difference: a worker
+group may declare a TPU slice (``accelerator`` + ``topology``) and then
+one *replica* = one ICI-connected slice = ``num_hosts`` pods, gang-
+created and gang-deleted, each pod told its position in the slice — the
+unit of scaling is the slice, never an individual TPU host.
+"""
+
+from ray_tpu.operator.crd import (RayClusterSpec, WorkerGroupSpec,
+                                  HeadGroupSpec)
+from ray_tpu.operator.operator import (RayClusterOperator, PodProvider,
+                                       FakePodProvider, Pod)
+
+__all__ = ["RayClusterSpec", "WorkerGroupSpec", "HeadGroupSpec",
+           "RayClusterOperator", "PodProvider", "FakePodProvider", "Pod"]

@@ -1,0 +1,90 @@
+"""RayCluster custom-resource schema (operator's desired state).
+
+Mirrors the shape of the reference operator's RayCluster CR
+(``python/ray/ray_operator/operator_utils.py`` cr -> autoscaler config
+translation) without depending on Kubernetes: the CR is a plain dict
+(what a K8s watch would deliver) parsed into typed dataclasses.
+
+TPU extension (no reference analog): ``WorkerGroupSpec.accelerator`` +
+``topology`` declare that each replica of the group is one TPU slice;
+``num_hosts`` is derived from the topology so the operator gang-creates
+that many pods per replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class HeadGroupSpec:
+    resources: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"CPU": 1.0})
+    pod_template: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class WorkerGroupSpec:
+    name: str
+    replicas: int = 1
+    min_replicas: int = 0
+    max_replicas: int = 10
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: TPU slice per replica, e.g. accelerator="v5e", topology="4x4".
+    accelerator: str = ""
+    topology: str = ""
+    pod_template: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def num_hosts(self) -> int:
+        """Pods per replica: 1 for CPU groups, the slice host count for
+        TPU groups (a replica is an ICI domain, scaled atomically)."""
+        if not self.accelerator:
+            return 1
+        from ray_tpu.parallel.topology import (parse_accelerator_type,
+                                               parse_topology)
+        if self.topology:
+            return parse_topology(self.accelerator, self.topology).num_hosts
+        return parse_accelerator_type(self.accelerator).num_hosts
+
+    def clamped_replicas(self) -> int:
+        return max(self.min_replicas, min(self.replicas, self.max_replicas))
+
+
+@dataclasses.dataclass
+class RayClusterSpec:
+    name: str
+    head: HeadGroupSpec = dataclasses.field(default_factory=HeadGroupSpec)
+    worker_groups: List[WorkerGroupSpec] = dataclasses.field(
+        default_factory=list)
+
+    @classmethod
+    def from_dict(cls, cr: Dict[str, Any]) -> "RayClusterSpec":
+        """Parse a RayCluster CR body (``metadata`` + ``spec`` sections,
+        the shape a K8s watch event carries)."""
+        meta = cr.get("metadata", {})
+        spec = cr.get("spec", {})
+        head = HeadGroupSpec(
+            resources=dict(spec.get("headGroupSpec", {}).get(
+                "resources", {"CPU": 1.0})),
+            pod_template=spec.get("headGroupSpec", {}).get("template", {}))
+        groups = []
+        for g in spec.get("workerGroupSpecs", []):
+            groups.append(WorkerGroupSpec(
+                name=g["groupName"],
+                replicas=int(g.get("replicas", 1)),
+                min_replicas=int(g.get("minReplicas", 0)),
+                max_replicas=int(g.get("maxReplicas", 10)),
+                resources=dict(g.get("resources", {})),
+                accelerator=g.get("accelerator", ""),
+                topology=g.get("topology", ""),
+                pod_template=g.get("template", {})))
+        return cls(name=meta.get("name", "raycluster"), head=head,
+                   worker_groups=groups)
+
+    def group(self, name: str) -> Optional[WorkerGroupSpec]:
+        for g in self.worker_groups:
+            if g.name == name:
+                return g
+        return None

@@ -100,6 +100,24 @@ def parse_accelerator_type(acc: str) -> SliceTopology:
     return SliceTopology(gen, max(1, chips))
 
 
+def parse_topology(generation: str, topology: str) -> SliceTopology:
+    """Parse a generation + "4x4"-style ICI topology (the GKE/kuberay TPU
+    naming: dims multiply to the chip count)."""
+    gen = GENERATIONS.get(generation.strip().lower())
+    if gen is None:
+        raise ValueError(f"unknown TPU generation {generation!r}")
+    try:
+        dims = [int(d) for d in topology.strip().lower().split("x")]
+        chips = math.prod(dims)
+    except ValueError:
+        raise ValueError(f"unrecognized topology {topology!r} "
+                         f"(want e.g. '2x4' or '4x4x4')") from None
+    if len(dims) > gen.torus_dims:
+        raise ValueError(f"{generation} ICI is {gen.torus_dims}-D; "
+                         f"topology {topology!r} has {len(dims)} dims")
+    return SliceTopology(gen, max(1, chips))
+
+
 def ici_domains(nodes: Sequence[dict]) -> Dict[str, List[dict]]:
     """Group node-info dicts by ICI domain (slice id).
 

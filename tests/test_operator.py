@@ -1,0 +1,115 @@
+"""K8s-style operator: RayCluster CR -> pod reconciliation.
+
+Reference analog: python/ray/ray_operator/operator.py (legacy operator
+reconciling RayCluster CRs); the TPU slice gang semantics are new.
+"""
+
+from ray_tpu.operator import (FakePodProvider, RayClusterOperator,
+                              RayClusterSpec)
+
+CR = {
+    "metadata": {"name": "demo"},
+    "spec": {
+        "headGroupSpec": {"resources": {"CPU": 2}},
+        "workerGroupSpecs": [
+            {"groupName": "cpu", "replicas": 2, "maxReplicas": 4,
+             "resources": {"CPU": 4}},
+            {"groupName": "tpu", "replicas": 1, "maxReplicas": 2,
+             "accelerator": "v5e", "topology": "4x4"},
+        ],
+    },
+}
+
+
+def make():
+    prov = FakePodProvider()
+    op = RayClusterOperator(prov)
+    op.apply(CR)
+    return prov, op
+
+
+def test_initial_reconcile_creates_head_workers_and_slices():
+    prov, op = make()
+    op.reconcile()
+    pods = prov.list_pods("demo")
+    heads = [p for p in pods if p.group == "head"]
+    cpus = [p for p in pods if p.group == "cpu"]
+    tpus = [p for p in pods if p.group == "tpu"]
+    assert len(heads) == 1
+    assert len(cpus) == 2
+    # v5e 4x4 = 16 chips / 4 per host = 4 hosts, gang-created
+    assert len(tpus) == 4
+    assert {p.host_index for p in tpus} == {0, 1, 2, 3}
+    assert all(p.env["TPU_HOSTS_PER_SLICE"] == "4" for p in tpus)
+    # idempotent: a second pass takes no actions
+    assert op.reconcile() == 0
+
+
+def test_failed_tpu_pod_tears_down_and_rebuilds_whole_slice():
+    prov, op = make()
+    op.reconcile()
+    victim = [p for p in prov.list_pods("demo") if p.group == "tpu"][2]
+    prov.fail_pod(victim.name)
+    op.reconcile()   # tear down the 4-pod slice
+    op.reconcile()   # rebuild it
+    tpus = [p for p in prov.list_pods("demo") if p.group == "tpu"]
+    assert len(tpus) == 4
+    assert all(p.status == "running" for p in tpus)
+    # all four original slice pods were deleted, not just the failed one
+    assert len([n for n in prov.deleted if "-tpu-" in n]) == 4
+
+
+def test_scale_up_down_and_cr_delete():
+    prov, op = make()
+    op.reconcile()
+    cr2 = {"metadata": {"name": "demo"}, "spec": {
+        "headGroupSpec": {"resources": {"CPU": 2}},
+        "workerGroupSpecs": [
+            {"groupName": "cpu", "replicas": 4, "maxReplicas": 4,
+             "resources": {"CPU": 4}},
+            {"groupName": "tpu", "replicas": 2, "maxReplicas": 2,
+             "accelerator": "v5e", "topology": "4x4"},
+        ]}}
+    op.apply(cr2)
+    op.reconcile()
+    pods = prov.list_pods("demo")
+    assert len([p for p in pods if p.group == "cpu"]) == 4
+    assert len([p for p in pods if p.group == "tpu"]) == 8
+    # scale back down: newest slice removed whole
+    op.apply(CR)
+    op.reconcile()
+    pods = prov.list_pods("demo")
+    assert len([p for p in pods if p.group == "cpu"]) == 2
+    assert len([p for p in pods if p.group == "tpu"]) == 4
+    # head failure repaired
+    head = [p for p in pods if p.group == "head"][0]
+    prov.fail_pod(head.name)
+    op.reconcile()
+    op.reconcile()
+    assert [p for p in prov.list_pods("demo")
+            if p.group == "head" and p.status == "running"]
+    # CR deletion garbage-collects everything
+    op.delete("demo")
+    op.reconcile()
+    assert prov.list_pods("demo") == []
+
+
+def test_replicas_clamped_and_group_removal():
+    prov = FakePodProvider()
+    op = RayClusterOperator(prov)
+    op.apply({"metadata": {"name": "c"}, "spec": {
+        "workerGroupSpecs": [
+            {"groupName": "w", "replicas": 99, "maxReplicas": 3,
+             "resources": {"CPU": 1}}]}})
+    op.reconcile()
+    assert len([p for p in prov.list_pods("c") if p.group == "w"]) == 3
+    # group dropped from the CR: its pods are deleted
+    op.apply({"metadata": {"name": "c"}, "spec": {"workerGroupSpecs": []}})
+    op.reconcile()
+    assert [p for p in prov.list_pods("c") if p.group == "w"] == []
+
+
+def test_spec_parse_tpu_hosts():
+    spec = RayClusterSpec.from_dict(CR)
+    assert spec.group("tpu").num_hosts == 4
+    assert spec.group("cpu").num_hosts == 1
