@@ -4,9 +4,15 @@ TorchPolicy/SampleBatch learner path gets a JAX policy so PPO/IMPALA
 learners run on TPU while rollout workers stay CPU actors")."""
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.dqn import DQN, DQNConfig, QPolicy
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
+                                       MultiAgentPPOConfig)
+from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.rollout_worker import RolloutWorker, TrajectoryWorker
 from ray_tpu.rllib.worker_set import WorkerSet
@@ -14,4 +20,7 @@ from ray_tpu.rllib.worker_set import WorkerSet
 __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "TrajectoryWorker", "WorkerSet", "Algorithm",
            "AlgorithmConfig", "PPO", "PPOConfig", "IMPALA",
-           "IMPALAConfig", "vtrace"]
+           "IMPALAConfig", "vtrace", "DQN", "DQNConfig", "QPolicy",
+           "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader",
+           "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
+           "MultiAgentPPO", "MultiAgentPPOConfig"]

@@ -1,0 +1,344 @@
+"""DQN: off-policy Q-learning with replay (double-DQN + target network).
+
+Reference analog: rllib/algorithms/dqn/ (training_step: sample into the
+replay buffer, train on prioritized samples, update the target net).
+TPU-first learner: `train_intensity` double-DQN gradient steps compile
+into ONE jitted lax.scan call per training_step — minibatches are
+presampled host-side from the replay buffer, stacked, and shipped in a
+single host→device transfer (the same one-dispatch design as the PPO
+learner in policy.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import _net_apply, _net_init
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+import ray_tpu
+
+
+@dataclasses.dataclass(frozen=True)
+class QPolicySpec:
+    obs_dim: int
+    n_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 5e-4
+    gamma: float = 0.99
+    grad_clip: float = 10.0
+    double_q: bool = True
+
+
+class QPolicy:
+    """Epsilon-greedy Q policy; the update is a jitted scan over
+    presampled minibatches with a carried target network."""
+
+    def __init__(self, spec: QPolicySpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        self.params = _net_init(jax.random.PRNGKey(seed),
+                                (spec.obs_dim, *spec.hidden,
+                                 spec.n_actions))
+        self.target_params = self._copy_tree(self.params)
+        self.tx = optax.chain(optax.clip_by_global_norm(spec.grad_clip),
+                              optax.adam(spec.lr))
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.RandomState(seed + 1)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+    @staticmethod
+    def _copy_tree(tree):
+        """Fresh device buffers — the update donates `params`, so the
+        target net must never alias them (f(donate(a), a) is an error)."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(lambda x: jnp.array(x, copy=True), tree)
+
+    def sync_target(self) -> None:
+        self.target_params = self._copy_tree(self.params)
+
+    def _build_fns(self):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+
+        @jax.jit
+        def q_values(params, obs):
+            return _net_apply(params, obs)
+
+        def td_error(params, target_params, mini):
+            q = _net_apply(params, mini[sb.OBS])
+            qa = jnp.take_along_axis(
+                q, mini[sb.ACTIONS][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            q_next_tgt = _net_apply(target_params, mini[sb.NEXT_OBS])
+            if spec.double_q:
+                # action argmax by the ONLINE net, value by the target
+                # net (van Hasselt double-DQN)
+                q_next_online = _net_apply(params, mini[sb.NEXT_OBS])
+                best = jnp.argmax(q_next_online, axis=-1)
+            else:
+                best = jnp.argmax(q_next_tgt, axis=-1)
+            v_next = jnp.take_along_axis(q_next_tgt, best[:, None],
+                                         axis=-1)[:, 0]
+            nonterminal = 1.0 - mini[sb.DONES].astype(jnp.float32)
+            target = mini[sb.REWARDS] + spec.gamma * nonterminal * v_next
+            return qa - jax.lax.stop_gradient(target)
+
+        def loss_fn(params, target_params, mini):
+            td = td_error(params, target_params, mini)
+            w = mini.get("is_weights")
+            huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
+                              jnp.abs(td) - 0.5)
+            if w is not None:
+                huber = huber * w
+            return jnp.mean(huber), td
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def update(params, opt_state, target_params, stacked):
+            """stacked: pytree of (n_steps, minibatch, ...) arrays."""
+            import optax
+
+            def step(carry, mini):
+                params, opt_state = carry
+                (loss, td), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, target_params, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, td)
+
+            (params, opt_state), (losses, tds) = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, losses.mean(), tds
+
+        self._q_values = q_values
+        self._update = update
+
+    # -- inference --------------------------------------------------------
+    def compute_actions(self, obs: np.ndarray,
+                        epsilon: float = 0.0) -> np.ndarray:
+        q = np.asarray(self._q_values(self.params, obs))
+        greedy = q.argmax(axis=-1)
+        if epsilon <= 0.0:
+            return greedy
+        explore = self._rng.rand(len(obs)) < epsilon
+        rand = self._rng.randint(0, self.spec.n_actions, size=len(obs))
+        return np.where(explore, rand, greedy)
+
+    # -- learning ---------------------------------------------------------
+    def learn_on_minibatches(self, minis: List[SampleBatch]
+                             ) -> Tuple[float, np.ndarray]:
+        """Run one jitted scan over the presampled minibatches; returns
+        (mean_loss, td_errors of the LAST minibatch) for priority
+        updates."""
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([m[k] for m in minis])
+                   for k in minis[0].keys()}
+        self.params, self.opt_state, loss, tds = self._update(
+            self.params, self.opt_state, self.target_params, stacked)
+        return float(loss), np.asarray(tds)
+
+
+class TransitionWorker:
+    """CPU actor collecting (obs, action, reward, next_obs, done)
+    transitions with epsilon-greedy exploration (the off-policy
+    counterpart of RolloutWorker; reference: the sampling half of DQN's
+    training_step)."""
+
+    def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
+                 spec: QPolicySpec, num_envs: int = 1,
+                 rollout_fragment_length: int = 50, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ray_tpu.rllib.rollout_worker import _make_env
+
+        self.envs = [_make_env(env, env_config) for _ in range(num_envs)]
+        self.policy = QPolicy(spec, seed=seed)
+        self.fragment = rollout_fragment_length
+        self._obs = [e.reset(seed=seed + i)[0]
+                     for i, e in enumerate(self.envs)]
+        self._ep_rewards = [0.0] * num_envs
+        self.episode_returns: List[float] = []
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self, epsilon: float) -> SampleBatch:
+        n_env = len(self.envs)
+        T = self.fragment
+        shape = (T, n_env)
+        obs_buf = np.zeros(shape + np.shape(self._obs[0]), np.float32)
+        next_buf = np.zeros_like(obs_buf)
+        act_buf = np.zeros(shape, np.int64)
+        rew_buf = np.zeros(shape, np.float32)
+        done_buf = np.zeros(shape, np.bool_)
+        for t in range(T):
+            obs = np.stack(self._obs).astype(np.float32)
+            actions = self.policy.compute_actions(obs, epsilon=epsilon)
+            obs_buf[t] = obs
+            act_buf[t] = actions
+            for i, env in enumerate(self.envs):
+                o2, r, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = r
+                self._ep_rewards[i] += r
+                # time-limit truncation is NOT a terminal for bootstrap
+                done_buf[t, i] = term
+                next_buf[t, i] = np.asarray(o2, np.float32)
+                if term or trunc:
+                    self.episode_returns.append(self._ep_rewards[i])
+                    self._ep_rewards[i] = 0.0
+                    o2 = env.reset()[0]
+                self._obs[i] = o2
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+        return SampleBatch({
+            sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
+            sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
+            sb.NEXT_OBS: flat(next_buf)})
+
+    def pop_episode_returns(self) -> List[float]:
+        out = self.episode_returns
+        self.episode_returns = []
+        return out
+
+
+@dataclasses.dataclass
+class DQNConfig(AlgorithmConfig):
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 5e-4
+    buffer_size: int = 50_000
+    prioritized_replay: bool = False
+    prioritized_alpha: float = 0.6
+    prioritized_beta: float = 0.4
+    learning_starts: int = 1000
+    train_batch_size: int = 32          # minibatch rows per SGD step
+    train_intensity: int = 8            # SGD steps per training_step
+    target_update_freq: int = 500       # env steps between target syncs
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.02
+    epsilon_decay_steps: int = 10_000
+    double_q: bool = True
+    rollout_fragment_length: int = 50
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+    def q_spec(self) -> QPolicySpec:
+        return QPolicySpec(obs_dim=self.obs_dim,
+                           n_actions=self.n_actions,
+                           hidden=tuple(self.hidden), lr=self.lr,
+                           gamma=self.gamma, double_q=self.double_q)
+
+
+class DQN(Algorithm):
+    _config_cls = DQNConfig
+
+    def setup(self, config: DQNConfig) -> None:
+        from ray_tpu.rllib.ppo import _introspect_spaces
+
+        _introspect_spaces(config)
+        spec = config.q_spec()
+        self.policy = QPolicy(spec, seed=config.seed)
+        if config.prioritized_replay:
+            self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
+                config.buffer_size, alpha=config.prioritized_alpha,
+                beta=config.prioritized_beta, seed=config.seed)
+        else:
+            self.buffer = ReplayBuffer(config.buffer_size,
+                                       seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(TransitionWorker)
+        self.workers = [
+            remote_cls.remote(
+                env=config.env, env_config=config.env_config, spec=spec,
+                num_envs=config.num_envs_per_worker,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(1.0, self._env_steps / max(1, c.epsilon_decay_steps))
+        return c.epsilon_initial + frac * (c.epsilon_final -
+                                           c.epsilon_initial)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        eps = self._epsilon()
+        parts = ray_tpu.get([w.sample.remote(eps) for w in self.workers],
+                            timeout=300.0)
+        for p in parts:
+            self.buffer.add(p)
+            self._env_steps += p.count
+
+        stats: Dict[str, Any] = {"epsilon": eps,
+                                 "buffer_size": len(self.buffer),
+                                 "timesteps_this_iter":
+                                     sum(p.count for p in parts)}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis, idx_w = [], []
+            for _ in range(c.train_intensity):
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    mini, idx, w = self.buffer.sample(c.train_batch_size)
+                    mini["is_weights"] = w
+                    idx_w.append(idx)
+                else:
+                    mini = self.buffer.sample(c.train_batch_size)
+                minis.append(mini)
+            loss, tds = self.policy.learn_on_minibatches(minis)
+            stats["loss"] = loss
+            if idx_w:
+                # feed back the last step's TD errors (indices align
+                # with the last sampled minibatch)
+                self.buffer.update_priorities(idx_w[-1], tds[-1])
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_update_freq):
+                self.policy.sync_target()
+                self._last_target_sync = self._env_steps
+            weights = self.policy.get_weights()
+            ref = ray_tpu.put(weights)
+            ray_tpu.get([w.set_weights.remote(ref) for w in self.workers],
+                        timeout=60.0)
+
+        returns = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in returns for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

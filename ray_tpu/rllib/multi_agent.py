@@ -1,0 +1,243 @@
+"""Multi-agent training: dict-keyed envs, policy mapping, per-policy PPO.
+
+Reference analogs: rllib/env/multi_agent_env.py (the dict obs/action
+protocol with "__all__" termination) and the multi-policy machinery of
+rollout_worker.py/policy map (policy_mapping_fn routing agent ids to
+policies, per-policy SampleBatch collection, per-policy SGD).
+
+Design: a MultiAgentRolloutWorker steps one multi-agent env, buffers
+per-AGENT trajectories, GAE-postprocesses them at episode boundaries
+with the owning POLICY's value function, and emits a per-policy batch
+dict.  The learner holds one JaxPolicy per policy id and runs the
+standard jitted PPO update per policy — policies are independent pytrees
+so each update is its own single-dispatch scan (policy.py design).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.ppo import PPOConfig
+from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+
+
+class MultiAgentEnv:
+    """Dict-keyed env protocol (reference multi_agent_env.py):
+
+    reset() -> (obs_dict, info); step(action_dict) ->
+    (obs_dict, reward_dict, terminated_dict, truncated_dict, info);
+    terminated_dict may carry "__all__".  Only agents present in
+    obs_dict act on the next step."""
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+
+class MultiAgentRolloutWorker:
+    def __init__(self, *, env_creator: Callable[[Dict], MultiAgentEnv],
+                 env_config: Optional[Dict] = None,
+                 policy_specs: Dict[str, PolicySpec],
+                 policy_mapping_fn: Callable[[str], str],
+                 gamma: float = 0.99, lam: float = 0.95,
+                 rollout_fragment_length: int = 200, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import zlib
+
+        self.env = env_creator(env_config or {})
+        # crc32, not hash(): str hash is salted per process, and worker
+        # action-sampling seeds should be reproducible across runs
+        self.policies = {
+            pid: JaxPolicy(spec,
+                           seed=seed + zlib.crc32(pid.encode()) % 1000)
+            for pid, spec in policy_specs.items()}
+        self.mapping = policy_mapping_fn
+        self.gamma = gamma
+        self.lam = lam
+        self.fragment = rollout_fragment_length
+        self._obs, _ = self.env.reset(seed=seed)
+        self._ep_reward = 0.0
+        self.episode_returns: List[float] = []
+        # per-agent open trajectory: lists of (obs, act, rew, logp, vf)
+        self._traj: Dict[str, Dict[str, list]] = {}
+
+    def set_weights(self, weights: Dict[str, Any]) -> None:
+        for pid, w in weights.items():
+            self.policies[pid].set_weights(w)
+
+    def _traj_for(self, agent: str) -> Dict[str, list]:
+        return self._traj.setdefault(agent, {
+            "obs": [], "act": [], "rew": [], "logp": [], "vf": []})
+
+    def _flush_agent(self, agent: str, last_value: float,
+                     out: Dict[str, List[SampleBatch]]) -> None:
+        tr = self._traj.pop(agent, None)
+        if not tr or not tr["obs"]:
+            return
+        pid = self.mapping(agent)
+        rew = np.asarray(tr["rew"], np.float32)
+        vf = np.asarray(tr["vf"], np.float32)
+        dones = np.zeros(len(rew), np.bool_)
+        dones[-1] = last_value == 0.0
+        adv, vt = compute_gae(rew, vf, dones, last_value,
+                              gamma=self.gamma, lam=self.lam)
+        out.setdefault(pid, []).append(SampleBatch({
+            sb.OBS: np.asarray(tr["obs"], np.float32),
+            sb.ACTIONS: np.asarray(tr["act"], np.int64),
+            sb.REWARDS: rew, sb.DONES: dones,
+            sb.ACTION_LOGP: np.asarray(tr["logp"], np.float32),
+            sb.VF_PREDS: vf, sb.ADVANTAGES: adv, sb.VALUE_TARGETS: vt}))
+
+    def sample(self) -> Dict[str, SampleBatch]:
+        """`fragment` env steps; returns {policy_id: SampleBatch}."""
+        out: Dict[str, List[SampleBatch]] = {}
+        for _ in range(self.fragment):
+            actions: Dict[str, Any] = {}
+            for agent, obs in self._obs.items():
+                pol = self.policies[self.mapping(agent)]
+                a, logp, vf = pol.compute_actions(
+                    np.asarray(obs, np.float32)[None])
+                tr = self._traj_for(agent)
+                tr["obs"].append(obs)
+                tr["act"].append(int(a[0]))
+                tr["logp"].append(float(logp[0]))
+                tr["vf"].append(float(vf[0]))
+                actions[agent] = int(a[0])
+            obs2, rews, terms, truncs, _ = self.env.step(actions)
+            # every agent that acted gets a reward row (0.0 if the env
+            # omitted it) so trajectory columns stay aligned
+            for agent in actions:
+                r = float(rews.get(agent, 0.0))
+                self._traj[agent]["rew"].append(r)
+                self._ep_reward += r
+            done_all = terms.get("__all__", False) or \
+                truncs.get("__all__", False)
+            for agent in list(self._traj):
+                a_term = terms.get(agent, False)
+                a_trunc = truncs.get(agent, False)
+                if done_all or a_term or a_trunc:
+                    last_v = 0.0
+                    if (a_trunc or truncs.get("__all__", False)) \
+                            and not a_term and agent in obs2:
+                        pol = self.policies[self.mapping(agent)]
+                        last_v = float(pol.value(np.asarray(
+                            obs2[agent], np.float32)[None])[0])
+                    self._flush_agent(agent, last_v, out)
+            if done_all:
+                self.episode_returns.append(self._ep_reward)
+                self._ep_reward = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = obs2
+        # fragment boundary: flush open trajectories bootstrapped with
+        # the current value estimate
+        for agent in list(self._traj):
+            if not self._traj[agent]["obs"]:
+                continue
+            if agent in self._obs:
+                pol = self.policies[self.mapping(agent)]
+                last_v = float(pol.value(np.asarray(
+                    self._obs[agent], np.float32)[None])[0])
+            else:
+                last_v = 0.0
+            self._flush_agent(agent, last_v, out)
+        return {pid: SampleBatch.concat_samples(parts)
+                for pid, parts in out.items()}
+
+    def pop_episode_returns(self) -> List[float]:
+        out = self.episode_returns
+        self.episode_returns = []
+        return out
+
+
+@dataclasses.dataclass
+class MultiAgentPPOConfig(PPOConfig):
+    #: policy id -> (obs_dim, n_actions); specs derive from the base
+    #: PPO hyperparameters
+    policies: Optional[Dict[str, Tuple[int, int]]] = None
+    policy_mapping_fn: Optional[Callable[[str], str]] = None
+
+    def specs(self) -> Dict[str, PolicySpec]:
+        out = {}
+        for pid, (obs_dim, n_actions) in (self.policies or {}).items():
+            cfg = dataclasses.replace(self, obs_dim=obs_dim,
+                                      n_actions=n_actions)
+            out[pid] = PPOConfig.policy_spec(cfg)
+        return out
+
+
+class MultiAgentPPO(Algorithm):
+    _config_cls = MultiAgentPPOConfig
+
+    def setup(self, config: MultiAgentPPOConfig) -> None:
+        if not config.policies or config.policy_mapping_fn is None:
+            raise ValueError("multi-agent needs `policies` and "
+                             "`policy_mapping_fn`")
+        specs = config.specs()
+        self.learner_policies = {
+            pid: JaxPolicy(spec, seed=config.seed)
+            for pid, spec in specs.items()}
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(MultiAgentRolloutWorker)
+        self.workers = [
+            remote_cls.remote(
+                env_creator=config.env, env_config=config.env_config,
+                policy_specs=specs,
+                policy_mapping_fn=config.policy_mapping_fn,
+                gamma=config.gamma, lam=config.lam,
+                rollout_fragment_length=config.rollout_fragment_length,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+        self._sync_weights()
+
+    def _sync_weights(self) -> None:
+        weights = {pid: p.get_weights()
+                   for pid, p in self.learner_policies.items()}
+        ref = ray_tpu.put(weights)
+        ray_tpu.get([w.set_weights.remote(ref) for w in self.workers],
+                    timeout=60.0)
+
+    def training_step(self) -> Dict[str, Any]:
+        per_policy: Dict[str, List[SampleBatch]] = {}
+        steps = 0
+        while steps < self.config.train_batch_size:
+            parts = ray_tpu.get(
+                [w.sample.remote() for w in self.workers], timeout=300.0)
+            for d in parts:
+                for pid, b in d.items():
+                    per_policy.setdefault(pid, []).append(b)
+                    steps += b.count
+        stats: Dict[str, Any] = {"timesteps_this_iter": steps}
+        for pid, batches in per_policy.items():
+            batch = SampleBatch.concat_samples(batches)
+            adv = batch[sb.ADVANTAGES]
+            batch[sb.ADVANTAGES] = ((adv - adv.mean()) /
+                                    max(adv.std(), 1e-6)).astype(
+                                        np.float32)
+            pstats = self.learner_policies[pid].learn_on_batch(batch)
+            stats[pid] = pstats
+        self._sync_weights()
+        returns = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in returns for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -1,0 +1,174 @@
+"""Offline RL: experience I/O + behavior cloning.
+
+Reference analogs: rllib/offline/json_writer.py / json_reader.py (the
+experience interchange format) and rllib/algorithms/bc (MARWIL with
+beta=0 = behavior cloning).  SampleBatches serialize to JSON-lines
+files, one batch per line, columns base64-npz encoded so dtypes/shapes
+round-trip exactly (the reference base64-pickles; npz avoids arbitrary
+code execution on read).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import glob
+import io
+import json
+import os
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import _net_apply, _net_init
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _encode(batch: SampleBatch) -> str:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **{k: np.asarray(v)
+                                for k, v in batch.items()})
+    return json.dumps(
+        {"type": "SampleBatch", "count": batch.count,
+         "data": base64.b64encode(buf.getvalue()).decode()})
+
+
+def _decode(line: str) -> SampleBatch:
+    row = json.loads(line)
+    with np.load(io.BytesIO(base64.b64decode(row["data"]))) as z:
+        return SampleBatch({k: z[k] for k in z.files})
+
+
+class JsonWriter:
+    """Append SampleBatches to a JSON-lines file (reference:
+    offline/json_writer.py)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def write(self, batch: SampleBatch) -> None:
+        self._f.write(_encode(batch) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class JsonReader:
+    """Read SampleBatches from JSON-lines file(s); glob patterns work.
+    next() cycles forever (training epochs); read_all() concatenates."""
+
+    def __init__(self, paths, seed: int = 0):
+        if isinstance(paths, str):
+            paths = sorted(glob.glob(paths)) or [paths]
+        self.paths = list(paths)
+        self._rng = np.random.RandomState(seed)
+        self._lines: List[str] = []
+        for p in self.paths:
+            with open(p) as f:
+                self._lines.extend(
+                    ln for ln in f.read().splitlines() if ln.strip())
+        if not self._lines:
+            raise ValueError(f"no batches found in {self.paths}")
+
+    def next(self) -> SampleBatch:
+        return _decode(self._lines[self._rng.randint(len(self._lines))])
+
+    def read_all(self) -> SampleBatch:
+        return SampleBatch.concat_samples(
+            [_decode(ln) for ln in self._lines])
+
+    def __iter__(self) -> Iterator[SampleBatch]:
+        for ln in self._lines:
+            yield _decode(ln)
+
+
+@dataclasses.dataclass
+class BCConfig(AlgorithmConfig):
+    input_path: str = ""
+    hidden: Tuple[int, ...] = (64, 64)
+    train_batch_size: int = 256
+    sgd_steps_per_iter: int = 50
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class BC(Algorithm):
+    """Behavior cloning: supervised cross-entropy on logged actions
+    (reference: rllib/algorithms/bc — MARWIL with beta=0).  The whole
+    iteration (sgd_steps_per_iter minibatch steps over a device-resident
+    copy of the dataset) is one jitted scan — offline data is static, so
+    it is shipped to the device once at setup."""
+
+    _config_cls = BCConfig
+
+    def setup(self, config: BCConfig) -> None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        data = JsonReader(config.input_path).read_all()
+        if config.obs_dim is None:
+            config.obs_dim = int(np.prod(data[sb.OBS].shape[1:]))
+        if config.n_actions is None:
+            config.n_actions = int(data[sb.ACTIONS].max()) + 1
+        self._obs = jnp.asarray(data[sb.OBS], jnp.float32)
+        self._acts = jnp.asarray(data[sb.ACTIONS], jnp.int32)
+        self.params = _net_init(
+            jax.random.PRNGKey(config.seed),
+            (config.obs_dim, *config.hidden, config.n_actions))
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        n = len(self._acts)
+        mb = min(config.train_batch_size, n)
+        steps = config.sgd_steps_per_iter
+
+        def loss_fn(params, obs, acts):
+            logits = _net_apply(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, acts[:, None], axis=-1)[:, 0]
+            return jnp.mean(nll)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run_iter(params, opt_state, obs, acts, rng):
+            def step(carry, key):
+                params, opt_state = carry
+                idx = jax.random.randint(key, (mb,), 0, n)
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, obs[idx], acts[idx])
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            rng, *keys = jax.random.split(rng, steps + 1)
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), jnp.stack(keys))
+            return params, opt_state, losses.mean(), rng
+
+        self._run_iter = run_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        self.params, self.opt_state, loss, self._rng = self._run_iter(
+            self.params, self.opt_state, self._obs, self._acts, self._rng)
+        return {"loss": float(loss),
+                "timesteps_this_iter":
+                    self.config.sgd_steps_per_iter *
+                    self.config.train_batch_size}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits = _net_apply(self.params, np.asarray(obs, np.float32))
+        return np.asarray(logits).argmax(axis=-1)
