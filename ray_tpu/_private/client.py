@@ -101,6 +101,33 @@ class MemoryStoreEntry:
         await fut
 
 
+def _shallow_aliasing_arrays(value, region, max_depth: int = 3):
+    """numpy arrays inside ``value`` (walking list/tuple/set/dict up to
+    ``max_depth``) that alias the memory ``region``.  Used by the
+    zero-copy get path to tie the shared-memory pin to array lifetime."""
+    import numpy as np
+
+    out = []
+    seen = set()
+    stack = [(value, 0)]
+    while stack:
+        v, d = stack.pop()
+        if isinstance(v, np.ndarray):
+            # dedupe by identity: pickle memoizes repeated arrays into ONE
+            # out-of-band buffer, so counting a duplicate twice would let
+            # a buffer hidden in an opaque object slip past the n_oob
+            # safety comparison
+            if v.size and id(v) not in seen and np.shares_memory(v, region):
+                seen.add(id(v))
+                out.append(v)
+        elif d < max_depth:
+            if isinstance(v, (list, tuple, set, frozenset)):
+                stack.extend((x, d + 1) for x in v)
+            elif isinstance(v, dict):
+                stack.extend((x, d + 1) for x in v.values())
+    return out
+
+
 class LeaseState:
     """Per-scheduling-key pool of leased workers with a task queue
     (reference: direct_task_transport task queues keyed by SchedulingKey)."""
@@ -635,7 +662,29 @@ class CoreWorker:
                 self._contained.setdefault(outer_oid, []).extend(infos)
 
     def _put_shm(self, oid: ObjectID, ser: serialization.SerializedObject):
-        view = self._create_with_backpressure(oid, ser.total_size)
+        if self.spill.enabled and \
+                ser.total_size > self.store.stats()["capacity"]:
+            # can never fit: skip the futile spill/evict backpressure loop
+            # (which would flush the whole working set to disk for
+            # nothing) and fallback-allocate immediately
+            self.spill.write_direct(oid.binary(), ser.to_bytes())
+            return
+        try:
+            view = self._create_with_backpressure(oid, ser.total_size)
+        except ObjectStoreFull:
+            # Fallback allocation (reference: plasma CreateAndSpillIfNeeded
+            # → fallback allocator writes to disk-backed files): the arena
+            # is full of pinned objects (zero-copy views, in-flight task
+            # args) that neither spill nor eviction may touch, so the new
+            # object goes straight to the spill directory; get() restores
+            # it through the normal spill read path.
+            if not self.spill.enabled:
+                raise
+            logger.info("arena full (pinned working set): fallback-"
+                        "allocating %d bytes to spill for %s",
+                        ser.total_size, oid)
+            self.spill.write_direct(oid.binary(), ser.to_bytes())
+            return
         if view is None:
             return  # sealed copy already present: idempotent re-create
         try:
@@ -684,6 +733,53 @@ class CoreWorker:
                     raise
                 time.sleep(0.01)
 
+    def _deserialize_store_buffer(self, buf) -> Tuple[Any, bool]:
+        """Deserialize a pinned shared-memory object, zero-copy when safe.
+
+        The reference serves numpy views backed by pinned plasma buffers
+        (plasma client Get + SerializationContext); the analog here:
+        out-of-band buffers deserialize as views over the pinned arena
+        region, and the pin is released by weakref finalizers once every
+        such array is garbage-collected.  When the value structure hides
+        its arrays from the shallow walk (custom objects), fall back to
+        the one-copy path — correctness over speed."""
+        import weakref
+
+        import numpy as np
+
+        if len(buf.metadata) or not self.config.zero_copy_get:
+            with buf:
+                return serialization.deserialize(
+                    bytes(buf.data) + bytes(buf.metadata))
+        try:
+            value, is_err, n_oob = serialization.deserialize_info(buf.data)
+        except Exception:
+            buf.close()
+            raise
+        if not n_oob:
+            # pure-pickle value: loads() copied everything already
+            buf.close()
+            return value, is_err
+        arrays = _shallow_aliasing_arrays(value, buf.data)
+        if len(arrays) < n_oob:
+            # some buffer is hidden inside an opaque object — re-read
+            # through the copy path so no view can outlive the pin
+            with buf:
+                return serialization.deserialize(
+                    bytes(buf.data) + bytes(buf.metadata))
+        lock = threading.Lock()
+        left = [len(arrays)]
+
+        def _release_pin():
+            with lock:
+                left[0] -= 1
+                if left[0] == 0:
+                    buf.close()
+
+        for a in arrays:
+            weakref.finalize(a, _release_pin)
+        return value, is_err
+
     def _read_ready(self, oid: bytes) -> Optional[Tuple[Any, bool]]:
         """Non-blocking read: memory store, then shared store, then the
         node's spill directory (restore-on-get without re-inserting, so a
@@ -693,10 +789,7 @@ class CoreWorker:
             return serialization.deserialize(entry.data)
         buf = self.store.get(ObjectID(oid), timeout_ms=0)
         if buf is not None:
-            with buf:
-                # Copy out of shm before deserializing so views outlive pin.
-                return serialization.deserialize(
-                    bytes(buf.data) + bytes(buf.metadata))
+            return self._deserialize_store_buffer(buf)
         data = self.spill.read(oid)
         if data is not None:
             return serialization.deserialize(data)

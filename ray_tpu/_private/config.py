@@ -40,6 +40,10 @@ class Config:
     spill_dir: str = ""
     #: Fraction of the arena above which eviction/spill kicks in.
     object_store_full_fraction: float = 0.95
+    #: get() serves numpy arrays as zero-copy views pinned in the arena
+    #: (reference: plasma-backed numpy views); the pin is released when
+    #: the arrays are garbage-collected.  Off = always copy out.
+    zero_copy_get: bool = True
     #: How long a create() queues against a full arena (spilling in the
     #: background) before giving up (reference: plasma CreateRequestQueue).
     create_retry_timeout_s: float = 30.0

@@ -96,6 +96,14 @@ def deserialize(data) -> Tuple[Any, bool]:
     """Returns (value, is_error). ``data`` is bytes or a memoryview aliasing
     shared memory; out-of-band buffers are reconstructed as zero-copy views
     (numpy arrays built on them copy only if the consumer writes)."""
+    value, is_err, _ = deserialize_info(data)
+    return value, is_err
+
+
+def deserialize_info(data) -> Tuple[Any, bool, int]:
+    """deserialize() + the number of out-of-band buffers in the envelope
+    (callers managing a pinned shared-memory region use it to decide
+    whether the value may alias the input)."""
     view = memoryview(data)
     (hlen,) = _LEN.unpack(view[:_LEN.size])
     off = _LEN.size
@@ -106,10 +114,10 @@ def deserialize(data) -> Tuple[Any, bool]:
     pickled = view[off:off + plen]
     off += plen
     if kind == KIND_RAW:
-        return bytes(pickled), False
+        return bytes(pickled), False, 0
     buffers = []
     for blen in header["bl"]:
         buffers.append(pickle.PickleBuffer(view[off:off + blen]))
         off += blen
     value = pickle.loads(bytes(pickled), buffers=buffers)
-    return value, kind == KIND_ERR
+    return value, kind == KIND_ERR, len(buffers)

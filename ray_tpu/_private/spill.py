@@ -84,6 +84,25 @@ class SpillManager:
         self.store.delete(oid)
         return True
 
+    def write_direct(self, oid: bytes, payload: bytes) -> None:
+        """Write a serialized object straight to disk, bypassing the
+        arena — the fallback-allocation path when a create cannot fit
+        even after spilling/eviction (reference: plasma
+        CreateAndSpillIfNeeded / fallback allocator, client.h:128).
+        Readers find it via the normal spill restore-on-get path."""
+        self._ensure_dir()
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.rename(tmp, self._path(oid))  # atomic
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
     # -- read path ---------------------------------------------------------
 
     def contains(self, oid: bytes) -> bool:

@@ -317,9 +317,10 @@ class DQN(Algorithm):
             loss, tds = self.policy.learn_on_minibatches(minis)
             stats["loss"] = loss
             if idx_w:
-                # feed back the last step's TD errors (indices align
-                # with the last sampled minibatch)
-                self.buffer.update_priorities(idx_w[-1], tds[-1])
+                # feed back every step's TD errors (tds rows align with
+                # the sampled minibatches in order)
+                for idx, td in zip(idx_w, tds):
+                    self.buffer.update_priorities(idx, td)
             if (self._env_steps - self._last_target_sync
                     >= c.target_update_freq):
                 self.policy.sync_target()

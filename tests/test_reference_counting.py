@@ -103,10 +103,15 @@ def test_lineage_reconstruction_after_eviction(ray_start_regular):
     def make_array(n):
         return np.full(n, 3.0, dtype=np.float32)
 
+    import gc
+
     cw = _cw()
     ref = make_array.remote(200_000)  # > inline limit -> lives in shm
-    first = ray_tpu.get(ref)
+    # copy out: a live zero-copy view would pin the object and (correctly)
+    # block the delete below — this test is about lineage, not pinning
+    first = np.array(ray_tpu.get(ref))
     assert first[0] == 3.0
+    gc.collect()  # release the zero-copy pin before simulating eviction
     # Simulate eviction: delete the only store copy behind the owner's back.
     assert cw.store.delete(ObjectID(ref.binary()))
     assert not cw.store.contains(ObjectID(ref.binary()))

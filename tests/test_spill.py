@@ -73,12 +73,33 @@ def test_task_returns_spill_and_restore(small_store_cluster):
         assert v[0] == i
 
 
-def test_create_backpressure_unspillable(small_store_cluster):
-    """When the arena is simply too small for one object, create fails
-    cleanly (no hang) after the backpressure window."""
+def test_oversized_put_fallback_allocates_to_disk(small_store_cluster):
+    """An object bigger than the whole arena still puts and gets:
+    create falls back to disk-backed allocation (reference: plasma
+    CreateAndSpillIfNeeded → fallback allocator, client.h:128)."""
     cw = _cw()
     cw.config.create_retry_timeout_s = 1.0
-    from ray_tpu._private.object_store import ObjectStoreError
+    big = np.zeros(80 * 1024 * 1024, dtype=np.uint8)  # > arena
+    big[7] = 42
+    ref = ray_tpu.put(big)
+    out = ray_tpu.get(ref, timeout=60)
+    assert out[7] == 42 and out.shape == big.shape
 
-    with pytest.raises((ObjectStoreError, MemoryError)):
-        ray_tpu.put(np.zeros(80 * 1024 * 1024, dtype=np.uint8))  # > arena
+
+def test_oversized_put_without_spill_fails_cleanly(tmp_path):
+    """With spilling disabled there is no fallback: create fails with a
+    clear error instead of hanging."""
+    import ray_tpu as rt
+
+    rt.init(num_cpus=1, object_store_memory=32 * 1024 * 1024,
+            _system_config={"spill_dir": "/dev/null/nonexistent-disable",
+                            "create_retry_timeout_s": 1.0})
+    try:
+        from ray_tpu._private.object_store import ObjectStoreError
+
+        cw = _cw()
+        cw.spill.dir = ""  # hard-disable the spill path
+        with pytest.raises((ObjectStoreError, MemoryError)):
+            rt.put(np.zeros(80 * 1024 * 1024, dtype=np.uint8))
+    finally:
+        rt.shutdown()
