@@ -80,6 +80,10 @@ class Config:
     #: worker_killing_policy tests the same way).
     memory_monitor_fake_usage_path: str = ""
 
+    #: Debounce for event-driven resource pushes to the GCS (reference:
+    #: RaySyncer push-on-change; heartbeats remain the polling fallback).
+    resource_report_debounce_s: float = 0.05
+
     # --- timeouts / liveness ---
     heartbeat_interval_s: float = 1.0
     num_heartbeats_timeout: int = 30

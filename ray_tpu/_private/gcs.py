@@ -87,7 +87,8 @@ class ActorInfo:
 class NodeInfo:
     __slots__ = ("node_id", "conn", "resources_total", "resources_available",
                  "address", "object_store_name", "last_heartbeat", "alive",
-                 "labels", "pending_demand", "num_busy_workers")
+                 "labels", "pending_demand", "num_busy_workers",
+                 "resource_version")
 
     def __init__(self, node_id: bytes, conn: protocol.Connection,
                  resources: Dict[str, float], address: str,
@@ -107,6 +108,10 @@ class NodeInfo:
         #: leased/actor workers on the node (autoscaler occupancy signal —
         #: zero-resource actors must block idle scale-down).
         self.num_busy_workers = 0
+        #: last applied resource-report version (reference: RaySyncer
+        #: versioned snapshots, ray_syncer.h — late/out-of-order reports
+        #: must not overwrite newer state).
+        self.resource_version = -1
 
 
 class PlacementGroupInfo:
@@ -347,15 +352,44 @@ class GcsServer:
         logger.info("node registered: %s %s", NodeID(node_id), payload["address"])
         return True
 
+    def _apply_resource_report(self, info: "NodeInfo", payload) -> bool:
+        """Versioned merge of a node resource report (reference: RaySyncer
+        reporter/receiver, ray_syncer.h): reports carry the node's
+        monotonic version; anything strictly below the last applied
+        version is a reordered duplicate and dropped, while same-version
+        reports refresh (they reconcile optimistic debits)."""
+        version = payload.get("resource_version", None)
+        # strictly-older reports are reordered duplicates and dropped;
+        # same-version reports are accepted — they are the node's
+        # authoritative state and reconcile any optimistic spillback
+        # debits applied since (see _debit)
+        if version is not None and version < info.resource_version:
+            return False
+        if version is not None:
+            info.resource_version = version
+        if "resources_available" in payload:
+            info.resources_available = payload["resources_available"]
+        return True
+
     async def rpc_node_heartbeat(self, conn, payload):
         info = self.nodes.get(payload["node_id"])
         if info is None:
             return {"reregister": True}
         info.last_heartbeat = time.monotonic()
-        info.resources_available = payload.get(
-            "resources_available", info.resources_available)
+        self._apply_resource_report(info, payload)
         info.pending_demand = payload.get("pending_demand", [])
         info.num_busy_workers = payload.get("num_busy_workers", 0)
+        return {"reregister": False}
+
+    async def rpc_node_resource_update(self, conn, payload):
+        """Event-driven resource delta pushed on acquire/release, between
+        heartbeats — spillback and actor placement then work from
+        sub-second-fresh state instead of the heartbeat interval
+        (reference: the syncer's push-on-change vs the polling report)."""
+        info = self.nodes.get(payload["node_id"])
+        if info is None:
+            return {"reregister": True}
+        self._apply_resource_report(info, payload)
         return {"reregister": False}
 
     async def rpc_node_list(self, conn, payload):
@@ -498,7 +532,21 @@ class GcsServer:
             for k, v in resources.items())]
         pool = free or candidates
         best = max(pool, key=lambda n: sum(n.resources_available.values()))
+        # Optimistic local debit until the node's next versioned report:
+        # N concurrent spillbacks must not all pick the same "most free"
+        # node off the same stale snapshot (reference: the cluster
+        # resource scheduler's local view is debited at decision time and
+        # reconciled by the syncer).
+        self._debit(best, resources)
         return {"node_id": best.node_id, "address": best.address}
+
+    @staticmethod
+    def _debit(info: "NodeInfo", resources: Dict[str, float]) -> None:
+        for k, v in resources.items():
+            # clamp at zero: fallback picks from busy nodes must not push
+            # user-facing availability aggregates negative
+            info.resources_available[k] = max(
+                0.0, info.resources_available.get(k, 0.0) - v)
 
     async def rpc_autoscaler_demand(self, conn, payload):
         """Aggregate demand for the autoscaler: queued lease shapes from
@@ -541,7 +589,9 @@ class GcsServer:
         free = [n for n in candidates if all(
             n.resources_available.get(k, 0.0) >= v for k, v in resources.items())]
         pool = free or candidates
-        return max(pool, key=lambda n: sum(n.resources_available.values()))
+        best = max(pool, key=lambda n: sum(n.resources_available.values()))
+        self._debit(best, resources)  # see rpc_pick_node_for_lease
+        return best
 
     async def rpc_actor_register(self, conn, payload):
         actor_id = payload["actor_id"]

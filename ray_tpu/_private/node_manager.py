@@ -151,6 +151,9 @@ class NodeManager:
         self._worker_registered: Dict[bytes, asyncio.Future] = {}
         self._lease_queue: List[LeaseRequest] = []
         self._lease_counter = 0
+        #: monotonic version for resource reports (syncer ordering)
+        self._resource_version = 0
+        self._resource_push_task: Optional[asyncio.Task] = None
         self._leases: Dict[int, Tuple[WorkerHandle, Dict[str, float],
                                       Optional[Tuple[bytes, int]]]] = {}
         # Core-worker (driver/worker) connections by worker id, for owner
@@ -265,6 +268,7 @@ class NodeManager:
             try:
                 reply = await self.gcs_conn.call("node_heartbeat", {
                     "node_id": self.node_id.binary(),
+                    "resource_version": self._resource_version,
                     "resources_available": self.resources.available,
                     # Queued lease shapes ride the heartbeat so the
                     # autoscaler sees per-node pending demand (reference:
@@ -362,6 +366,8 @@ class NodeManager:
             self._log_monitor_task.cancel()
         if getattr(self, "_memory_monitor_task", None):
             self._memory_monitor_task.cancel()
+        if getattr(self, "_resource_push_task", None):
+            self._resource_push_task.cancel()
         # Fail queued lease requests so their handler coroutines (and the
         # remote submitters awaiting them) unwind instead of hanging.
         for req in self._lease_queue:
@@ -563,12 +569,54 @@ class NodeManager:
         rset = self._rset(bundle)
         if rset is None:
             return False
-        return rset.acquire(resources)
+        ok = rset.acquire(resources)
+        if ok and resources:
+            self._resources_changed()
+        return ok
 
     def _release(self, resources, bundle):
         rset = self._rset(bundle)
         if rset is not None:
             rset.release(resources)
+            if resources:
+                self._resources_changed()
+
+    # ---- resource syncer (reference: ray_syncer.h — versioned,
+    # push-on-change resource reports layered over the heartbeat poll) ---
+
+    def _resources_changed(self) -> None:
+        """Bump the report version and schedule a debounced push so the
+        GCS's view goes stale by at most resource_report_debounce_s
+        instead of a full heartbeat interval."""
+        self._resource_version += 1
+        if self._resource_push_task is None or \
+                self._resource_push_task.done():
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return  # not on the manager loop (tests poking directly)
+            self._resource_push_task = loop.create_task(
+                self._push_resource_update())
+
+    async def _push_resource_update(self):
+        # loop: changes landing while the RPC is in flight would otherwise
+        # be dropped (no new task is scheduled while this one runs) and go
+        # stale until the next heartbeat
+        while not self._closing and self.gcs_conn is not None:
+            await asyncio.sleep(self.config.resource_report_debounce_s)
+            if self._closing or self.gcs_conn is None:
+                return
+            sent = self._resource_version
+            try:
+                await self.gcs_conn.call("node_resource_update", {
+                    "node_id": self.node_id.binary(),
+                    "resource_version": sent,
+                    "resources_available": self.resources.available,
+                }, timeout=5.0)
+            except Exception:  # noqa: BLE001 - heartbeat is the fallback
+                return
+            if self._resource_version == sent:
+                return
 
     # ---- lease protocol --------------------------------------------------
 
