@@ -28,8 +28,57 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "nodes", "cluster_resources",
     "available_resources", "ObjectRef", "ActorHandle", "method",
-    "get_runtime_context", "exceptions", "timeline", "__version__",
+    "get_runtime_context", "exceptions", "timeline", "client",
+    "__version__",
 ]
+
+
+class ClientContext:
+    """Handle returned by ``ray_tpu.client(...).connect()`` (reference:
+    ray.client ClientContext — disconnect() detaches the driver)."""
+
+    def __init__(self, info: dict):
+        self.address = info.get("gcs_address", "")
+        self.session_dir = info.get("session_dir", "")
+
+    def disconnect(self) -> None:
+        shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.disconnect()
+
+
+class ClientBuilder:
+    """``ray_tpu.client("host:port").connect()`` — remote-driver attach
+    (reference: python/ray/client_builder.py ClientBuilder).
+
+    The reference needs a dedicated Ray Client gRPC proxy because its
+    driver must normally live on a cluster node; this runtime's driver
+    protocol is already remote-capable over TCP, so the builder is a
+    thin veneer over ``init(address=...)`` with the same call shape."""
+
+    def __init__(self, address: Optional[str] = None):
+        self._address = address
+        self._init_kwargs: Dict[str, Any] = {}
+
+    def namespace(self, ns: str) -> "ClientBuilder":
+        self._init_kwargs["namespace"] = ns
+        return self
+
+    def connect(self) -> ClientContext:
+        info = init(address=self._address, **self._init_kwargs)
+        return ClientContext(info if isinstance(info, dict) else {})
+
+
+def client(address: Optional[str] = None) -> ClientBuilder:
+    """Remote-driver connection builder; accepts ``ray://host:port`` or
+    plain ``host:port`` (reference: ray.client())."""
+    if address and address.startswith("ray://"):
+        address = address[len("ray://"):]
+    return ClientBuilder(address)
 
 
 def timeline(filename=None):

@@ -109,6 +109,13 @@ class ActorClass:
         ac._pickled = self._pickled
         return ac
 
+    def bind(self, *args, **kwargs):
+        """Record a lazy actor-construction DAG node (reference:
+        ray.dag ClassNode)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def __reduce__(self):
         return (ActorClass, (self._cls, self._options))
 

@@ -288,6 +288,21 @@ def _block(x, layer_params, cfg: GPT2Config, rules):
     return x
 
 
+def _flash_active(cfg: GPT2Config, T: int) -> bool:
+    """Whether attention will actually take the flash kernel at seq T —
+    the precondition for mlp_only remat's memory claim (the un-rematted
+    NON-flash path would save O(T^2) score tensors per layer: ~25 GiB at
+    B=32/T=1024/12 layers).  Mirrors causal_attention's dispatch."""
+    if cfg.use_flash is False or cfg.seq_parallel:
+        return False
+    if cfg.use_flash is True:
+        return True
+    from ray_tpu.ops.attention import _FLASH_MIN_SEQ, _on_tpu
+
+    return _on_tpu() and T >= _FLASH_MIN_SEQ and T % 128 == 0 \
+        and cfg.head_dim % 64 == 0
+
+
 def gpt2_hidden(params, tokens, cfg: GPT2Config,
                 rules=DEFAULT_RULES) -> jnp.ndarray:
     """tokens (B, T) int32 → post-ln_f hidden states (B, T, d_model)."""
@@ -296,7 +311,8 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config,
     x = x + params["wpe"].astype(cfg.dtype)[:T]
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
 
-    if cfg.remat and cfg.remat_policy == "mlp_only":
+    if cfg.remat and cfg.remat_policy == "mlp_only" \
+            and _flash_active(cfg, T):
         # Sublayer-granular remat: the attention half is NOT rematted —
         # the flash kernel's backward recomputes score tiles internally
         # from O(T) residuals (q,k,v,o,lse), so re-running the flash

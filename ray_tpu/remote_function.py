@@ -79,6 +79,13 @@ class RemoteFunction:
         rf._pickled = self._pickled
         return rf
 
+    def bind(self, *args, **kwargs):
+        """Record a lazy DAG node instead of submitting (reference:
+        ray.dag — fn.bind builds a FunctionNode)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __reduce__(self):
         # Remote functions captured in closures of other remote functions
         # must travel; rebuild fresh (locks are per-process).

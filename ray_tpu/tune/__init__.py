@@ -3,6 +3,7 @@ python/ray/tune — Tuner.fit → TrialRunner event loop over trial actors,
 searchers + schedulers)."""
 
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     MedianStoppingRule,
                                      PopulationBasedTraining,
                                      TrialScheduler)
 from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
@@ -13,6 +14,6 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

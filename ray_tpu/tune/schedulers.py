@@ -9,7 +9,7 @@ that rung so far (asynchronous — no waiting for full brackets).
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
@@ -182,3 +182,53 @@ class PopulationBasedTraining(TrialScheduler):
         trial.restore_checkpoint = donor.checkpoint
         self.num_exploits += 1
         return RESTART
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-best metric is worse than the median of
+    other trials' running averages at the same step (reference:
+    tune/schedulers/median_stopping_rule.py, after the Vizier rule).
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min or max")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        #: trial_id -> list of (time_attr value, metric value) reports
+        self._history: Dict[str, List[Tuple[float, float]]] = {}
+
+    def _running_avg(self, trial_id: str, upto_t: float
+                     ) -> Optional[float]:
+        vals = [v for t, v in self._history.get(trial_id, [])
+                if t <= upto_t]
+        return sum(vals) / len(vals) if vals else None
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        hist = self._history.setdefault(trial.trial_id, [])
+        t = float(result.get(self.time_attr, len(hist) + 1))
+        hist.append((t, float(val)))
+        if t <= self.grace_period:
+            return CONTINUE
+        # compare against other trials' running averages UP TO the same
+        # point on the configured time axis, so fast- and slow-reporting
+        # trials align on time_attr rather than report count
+        others = [self._running_avg(tid, t)
+                  for tid in self._history if tid != trial.trial_id]
+        others = [a for a in others if a is not None]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        ordered = sorted(others)
+        median = ordered[len(ordered) // 2]
+        vals = [v for _, v in hist]
+        best = max(vals) if self.mode == "max" else min(vals)
+        worse = best < median if self.mode == "max" else best > median
+        return STOP if worse else CONTINUE

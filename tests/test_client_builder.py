@@ -1,0 +1,19 @@
+"""ray_tpu.client() remote-driver builder (reference: ray.client / client_builder.py)."""
+
+import ray_tpu
+
+
+def test_client_builder():
+    ctx = ray_tpu.client().connect()
+    try:
+        assert ray_tpu.is_initialized()
+        assert ctx.address
+
+        @ray_tpu.remote
+        def ping():
+            return "pong"
+
+        assert ray_tpu.get(ping.remote()) == "pong"
+    finally:
+        ctx.disconnect()
+    assert not ray_tpu.is_initialized()

@@ -1,0 +1,119 @@
+"""Lazy DAG API (reference: python/ray/dag — DAGNode/bind/InputNode) +
+ray_tpu.client() builder + MedianStoppingRule.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+
+
+def test_function_dag_diamond(ray_start_shared):
+    calls = []
+
+    @ray_tpu.remote
+    def source(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def left(s):
+        return s * 2
+
+    @ray_tpu.remote
+    def right(s):
+        return s * 3
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        s = source.bind(inp)
+        dag = join.bind(left.bind(s), right.bind(s))
+
+    # (x+1)*2 + (x+1)*3 = 5x + 5
+    assert ray_tpu.get(dag.execute(4)) == 25
+    # re-executable with new input
+    assert ray_tpu.get(dag.execute(0)) == 5
+
+
+def test_shared_node_executes_once(ray_start_shared):
+    @ray_tpu.remote
+    def effect(x):
+        import os
+        import tempfile
+
+        # count executions via the filesystem (workers are separate
+        # processes)
+        with open(x, "a") as f:
+            f.write("1")
+        return x
+
+    @ray_tpu.remote
+    def reader(p1, p2):
+        with open(p1) as f:
+            return f.read()
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".cnt", delete=False) as tf:
+        path = tf.name
+    shared = effect.bind(path)
+    dag = reader.bind(shared, shared)
+    assert ray_tpu.get(dag.execute()) == "1"  # one execution, not two
+
+
+def test_actor_dag(ray_start_shared):
+    @ray_tpu.remote
+    class Accum:
+        def __init__(self, start):
+            self.total = start
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    with InputNode() as inp:
+        acc = Accum.bind(10)
+        dag = acc.add.bind(inp)
+
+    assert ray_tpu.get(dag.execute(5)) == 15
+    # each execute() creates a fresh actor per reference semantics
+    assert ray_tpu.get(dag.execute(7)) == 17
+
+
+def test_kwargs_and_nested_containers(ray_start_shared):
+    @ray_tpu.remote
+    def f(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def g(items, scale=1):
+        return sum(items) * scale
+
+    dag = g.bind([f.bind(1), f.bind(2)], scale=2)
+    assert ray_tpu.get(dag.execute()) == 60
+
+
+def test_median_stopping_rule():
+    from ray_tpu.tune import MedianStoppingRule
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+            self.iteration = 0
+
+    rule = MedianStoppingRule(metric="score", mode="max",
+                              grace_period=1, min_samples_required=2)
+    good, bad, mid = T("good"), T("bad"), T("mid")
+    # build history: good reports high, mid middling, bad low
+    for step in range(1, 4):
+        assert rule.on_trial_result(good, {"score": 10.0 * step}) \
+            == CONTINUE
+        rule.on_trial_result(mid, {"score": 5.0})
+        decision = rule.on_trial_result(bad, {"score": 0.1})
+    assert decision == STOP
+    # the good trial is never stopped
+    assert rule.on_trial_result(good, {"score": 40.0}) == CONTINUE
