@@ -126,8 +126,15 @@ _state_lock = threading.Lock()
 _core_worker: Optional[Any] = None
 _node: Optional[Any] = None
 _mode: str = ""
-# Per-thread execution context (current task/actor) for workers.
-_tls = threading.local()
+# Per-execution context (current task/actor) for workers.  ContextVars
+# behave like thread-locals on plain threads AND isolate per-asyncio-Task
+# for async actor methods (each Task runs in its own context copy, so
+# interleaved coroutines from different tasks can't clobber each other —
+# a bare threading.local could).
+import contextvars as _contextvars
+
+_task_id_var = _contextvars.ContextVar("raytpu_task_id", default=b"")
+_actor_id_var = _contextvars.ContextVar("raytpu_actor_id", default=b"")
 
 
 def set_core_worker(cw, node=None, mode: str = "driver"):
@@ -170,13 +177,13 @@ def clear():
 
 
 def set_task_context(task_id: bytes, actor_id: bytes = b""):
-    _tls.task_id = task_id
-    _tls.actor_id = actor_id
+    _task_id_var.set(task_id)
+    _actor_id_var.set(actor_id)
 
 
 def current_task_id() -> bytes:
-    return getattr(_tls, "task_id", b"")
+    return _task_id_var.get()
 
 
 def current_actor_id() -> bytes:
-    return getattr(_tls, "actor_id", b"")
+    return _actor_id_var.get()

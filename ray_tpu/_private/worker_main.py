@@ -22,6 +22,7 @@ import sys
 import threading
 import time
 import traceback
+import concurrent.futures
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Optional
 
@@ -91,6 +92,15 @@ class WorkerServer:
         self._cancelled_pending: set = set()
         #: task ids whose thread got an async exc delivered (not yet raised).
         self._cancel_delivered: set = set()
+        #: lazily-started event loop (own thread) for async actor methods
+        #: (reference: async actors on boost fibers, fiber.h:17 — here a
+        #: shared asyncio loop so concurrent coroutines interleave, which
+        #: is what @serve.batch relies on to collect a batch).
+        self._user_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._user_loop_lock = threading.Lock()
+        #: task_id -> concurrent future of a coroutine parked on the
+        #: user loop (cancellation target for async methods).
+        self._running_async: Dict[bytes, Any] = {}
         #: serializes async-exc delivery against task start/finish so a
         #: cancellation can never land in the NEXT task run by the same
         #: pool thread.
@@ -177,6 +187,16 @@ class WorkerServer:
         ref = ObjectRefInfo(m["oid"], m["owner"], m["addr"])
         return self.cw.get([ref], timeout=60.0)[0]
 
+    def _ensure_user_loop(self) -> asyncio.AbstractEventLoop:
+        with self._user_loop_lock:
+            if self._user_loop is None:
+                loop = asyncio.new_event_loop()
+                t = threading.Thread(target=loop.run_forever,
+                                     name="async-actor-loop", daemon=True)
+                t.start()
+                self._user_loop = loop
+            return self._user_loop
+
     def _execute(self, spec: dict, fn) -> list:
         """Run user code; build the returns list for the RPC reply.
         [HOT LOOP — analog of _raylet.pyx:672 execute_task]."""
@@ -214,6 +234,31 @@ class WorkerServer:
                     result = fn(*args, **kwargs)
             else:
                 result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                # async task/actor method: run on the shared user loop so
+                # concurrent invocations interleave (async actor
+                # semantics; serve batching depends on this).  The shim
+                # re-establishes the task context INSIDE the Task (each
+                # asyncio Task gets its own contextvars copy, isolating
+                # interleaved coroutines), and the future is registered
+                # so rpc_cancel_task can cancel a parked coroutine — the
+                # pool thread blocked in .result() can't take an async
+                # exception.
+                async def _with_ctx(coro=result, _tid=task_id,
+                                    _aid=spec.get("actor_id", b"")):
+                    worker_context.set_task_context(_tid, _aid)
+                    return await coro
+
+                afut = asyncio.run_coroutine_threadsafe(
+                    _with_ctx(), self._ensure_user_loop())
+                self._running_async[task_id] = afut
+                try:
+                    result = afut.result()
+                except concurrent.futures.CancelledError:
+                    raise exceptions.TaskCancelledError(
+                        "task was cancelled while awaiting") from None
+                finally:
+                    self._running_async.pop(task_id, None)
             if num_returns == 0:
                 return []
             values = (result,) if num_returns == 1 else tuple(result)
@@ -296,7 +341,21 @@ class WorkerServer:
     async def rpc_become_actor(self, conn, payload):
         spec = payload["spec"]
         self.actor.actor_id = payload["actor_id"]
-        self.actor.max_concurrency = spec.get("max_concurrency", 1)
+        mc = spec.get("max_concurrency", 0)
+        if not mc:
+            # unset: async actors (any coroutine method on the class)
+            # default to high concurrency so interleaving-dependent
+            # patterns (events, serve batching) work out of the box —
+            # reference semantics: async actors default max_concurrency
+            # 1000 while sync actors stay strictly serial
+            cls = self.fns.get(spec["job_id"], spec["fid"])
+            has_async = any(
+                asyncio.iscoroutinefunction(getattr(cls, n, None))
+                for n in dir(cls) if not n.startswith("__"))
+            if asyncio.iscoroutinefunction(getattr(cls, "__call__", None)):
+                has_async = True
+            mc = 100 if has_async else 1
+        self.actor.max_concurrency = mc
         if self.actor.max_concurrency > 1:
             self.exec_pool = ThreadPoolExecutor(
                 max_workers=self.actor.max_concurrency,
@@ -426,6 +485,14 @@ class WorkerServer:
             return True
         task_id = payload["task_id"]
         import ctypes
+
+        # async method parked on the user loop: cancel the coroutine —
+        # the pool thread is blocked in Future.result() where an async
+        # exception cannot be delivered
+        afut = self._running_async.get(task_id)
+        if afut is not None:
+            afut.cancel()
+            return True
 
         with self._cancel_lock:
             tid = self._running_tasks.get(task_id)

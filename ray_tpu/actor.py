@@ -136,7 +136,10 @@ class ActorClass:
             name=opts.get("name") or "",
             max_restarts=opts.get("max_restarts", 0),
             lifetime=opts.get("lifetime") or "",
-            max_concurrency=opts.get("max_concurrency", 1),
+            # 0 = unset: the worker raises it for async actors (classes
+            # with coroutine methods default to high concurrency so their
+            # coroutines interleave — reference async-actor semantics)
+            max_concurrency=opts.get("max_concurrency", 0),
             pg=_pg_option(opts),
         )
         cw.wait_actor_ready(actor_id)

@@ -297,10 +297,9 @@ def _flash_active(cfg: GPT2Config, T: int) -> bool:
         return False
     if cfg.use_flash is True:
         return True
-    from ray_tpu.ops.attention import _FLASH_MIN_SEQ, _on_tpu
+    from ray_tpu.ops.attention import flash_auto_dispatch
 
-    return _on_tpu() and T >= _FLASH_MIN_SEQ and T % 128 == 0 \
-        and cfg.head_dim % 64 == 0
+    return flash_auto_dispatch(T, cfg.head_dim)
 
 
 def gpt2_hidden(params, tokens, cfg: GPT2Config,

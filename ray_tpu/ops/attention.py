@@ -46,6 +46,14 @@ def _on_tpu() -> bool:
         return False
 
 
+def flash_auto_dispatch(T: int, D: int) -> bool:
+    """The use_flash=None auto rule, shared with callers that must
+    predict the dispatch (e.g. gpt2's mlp_only remat guard, whose memory
+    claim only holds when flash actually runs)."""
+    return _on_tpu() and T >= _FLASH_MIN_SEQ and T % 128 == 0 \
+        and D % 64 == 0
+
+
 def causal_attention(q, k, v, *, use_flash: Optional[bool] = None,
                      scale: Optional[float] = None) -> jnp.ndarray:
     """Causal MHA on (B, T, H, D) tensors.
@@ -55,8 +63,7 @@ def causal_attention(q, k, v, *, use_flash: Optional[bool] = None,
     """
     T, D = q.shape[1], q.shape[-1]
     if use_flash is None:
-        use_flash = _on_tpu() and T >= _FLASH_MIN_SEQ and T % 128 == 0 \
-            and D % 64 == 0
+        use_flash = flash_auto_dispatch(T, D)
     if use_flash:
         from ray_tpu.ops.flash_attention import flash_attention
         return flash_attention(q, k, v, causal=True, scale=scale)

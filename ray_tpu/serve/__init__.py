@@ -3,8 +3,9 @@
 from ray_tpu.serve.api import (Deployment, delete, deployment,
                                get_deployment_handle, run, shutdown,
                                start_http_proxy)
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
-           "start_http_proxy"]
+           "start_http_proxy", "batch"]

@@ -227,7 +227,9 @@ class MedianStoppingRule(TrialScheduler):
         if len(others) < self.min_samples:
             return CONTINUE
         ordered = sorted(others)
-        median = ordered[len(ordered) // 2]
+        n = len(ordered)
+        median = (ordered[n // 2] if n % 2
+                  else (ordered[n // 2 - 1] + ordered[n // 2]) / 2.0)
         vals = [v for _, v in hist]
         best = max(vals) if self.mode == "max" else min(vals)
         worse = best < median if self.mode == "max" else best > median
