@@ -16,6 +16,9 @@ from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
 from ray_tpu.models.resnet import (ResNetConfig, resnet_config,
                                    resnet_forward, resnet_init,
                                    resnet_logical_axes, resnet_loss)
+from ray_tpu.models.vit import (ViTConfig, vit_config, vit_forward,
+                                vit_init, vit_logical_axes, vit_loss,
+                                vit_param_count)
 
 __all__ = [
     "GPT2Config", "gpt2_config", "gpt2_init", "gpt2_forward", "gpt2_loss",
@@ -24,4 +27,6 @@ __all__ = [
     "MoEConfig", "moe_init", "moe_apply", "moe_logical_axes",
     "ResNetConfig", "resnet_config", "resnet_init", "resnet_forward",
     "resnet_loss", "resnet_logical_axes",
+    "ViTConfig", "vit_config", "vit_init", "vit_forward", "vit_loss",
+    "vit_logical_axes", "vit_param_count",
 ]

@@ -1,0 +1,240 @@
+"""Vision Transformer (ViT), TPU-first.
+
+Completes the model zoo's image-transformer family alongside GPT-2
+(text) and ResNet (conv).  The reference ships no models (it wraps user
+torch modules, train/torch/train_loop_utils.py:28); this exists because
+on TPU the compute path is the framework's value.
+
+Same design rules as gpt2.py: functional init/apply over a pytree,
+layers stacked on a leading axis under `lax.scan`, bf16 compute / f32
+params, logical sharding axes reusing the SAME rule table (embed->fsdp,
+heads/mlp->tensor), projections in flattened-GEMM form (the 5-D einsum
+lowers 10x slower on v5e — see gpt2._attention), and the pallas flash
+kernel for attention when profitable (non-causal here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.sharding import DEFAULT_RULES, with_logical_constraint
+
+_PRESETS = {
+    # name: (n_layer, n_head, d_model, patch)
+    "tiny": (2, 2, 64, 8),            # test-sized
+    "vit-s16": (12, 6, 384, 16),
+    "vit-b16": (12, 12, 768, 16),
+    "vit-l16": (24, 16, 1024, 16),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    n_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    use_flash: Optional[bool] = None
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def vit_config(name: str = "vit-b16", **overrides) -> ViTConfig:
+    n_layer, n_head, d_model, patch = _PRESETS[name]
+    kw: Dict[str, Any] = dict(n_layer=n_layer, n_head=n_head,
+                              d_model=d_model, d_ff=4 * d_model,
+                              patch_size=patch)
+    if name == "tiny":
+        kw.update(image_size=32, n_classes=10)
+    kw.update(overrides)
+    return ViTConfig(**kw)
+
+
+def vit_param_count(cfg: ViTConfig) -> int:
+    d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layer
+    per_layer = (4 * d * d + 4 * d) + (2 * d * f + d + f) + 4 * d
+    patch_in = cfg.patch_size ** 2 * 3
+    return (patch_in * d + d                       # patch embed
+            + (cfg.n_patches + 1) * d + d          # pos emb + cls
+            + L * per_layer + 2 * d                # blocks + final ln
+            + d * cfg.n_classes + cfg.n_classes)   # head
+
+
+def vit_logical_axes(cfg: ViTConfig) -> Dict[str, Any]:
+    return {
+        "patch_w": (None, "embed"),
+        "patch_b": ("embed",),
+        "pos": (None, "embed"),
+        "cls": (None, "embed"),
+        "ln_f": {"scale": ("embed",), "bias": ("embed",)},
+        "head_w": ("embed", None),
+        "head_b": (None,),
+        "blocks": {
+            "ln1": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "ln2": {"scale": (None, "embed"), "bias": (None, "embed")},
+            "attn": {
+                "qkv_w": (None, "embed", None, "heads", "head_dim"),
+                "qkv_b": (None, None, "heads", "head_dim"),
+                "o_w": (None, "heads", "head_dim", "embed"),
+                "o_b": (None, "embed"),
+            },
+            "mlp": {
+                "fc_w": (None, "embed", "mlp"),
+                "fc_b": (None, "mlp"),
+                "proj_w": (None, "mlp", "embed"),
+                "proj_b": (None, "embed"),
+            },
+        },
+    }
+
+
+def vit_init(key, cfg: ViTConfig) -> Dict[str, Any]:
+    L, d, f, h, hd = (cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.n_head,
+                      cfg.head_dim)
+    pd = cfg.param_dtype
+    k = iter(jax.random.split(key, 10))
+    std = 0.02
+    res_std = std / math.sqrt(2 * L)
+    patch_in = cfg.patch_size ** 2 * 3
+
+    def norm(kk, shape, s=std):
+        return (jax.random.normal(kk, shape, dtype=jnp.float32) * s
+                ).astype(pd)
+
+    return {
+        "patch_w": norm(next(k), (patch_in, d),
+                        s=1.0 / math.sqrt(patch_in)),
+        "patch_b": jnp.zeros((d,), pd),
+        "pos": norm(next(k), (cfg.n_patches + 1, d), s=0.01),
+        "cls": jnp.zeros((1, d), pd),
+        "ln_f": {"scale": jnp.ones((d,), pd), "bias": jnp.zeros((d,), pd)},
+        "head_w": jnp.zeros((d, cfg.n_classes), pd),  # ViT: zero-init head
+        "head_b": jnp.zeros((cfg.n_classes,), pd),
+        "blocks": {
+            "ln1": {"scale": jnp.ones((L, d), pd),
+                    "bias": jnp.zeros((L, d), pd)},
+            "ln2": {"scale": jnp.ones((L, d), pd),
+                    "bias": jnp.zeros((L, d), pd)},
+            "attn": {
+                "qkv_w": norm(next(k), (L, d, 3, h, hd)),
+                "qkv_b": jnp.zeros((L, 3, h, hd), pd),
+                "o_w": norm(next(k), (L, h, hd, d), s=res_std),
+                "o_b": jnp.zeros((L, d), pd),
+            },
+            "mlp": {
+                "fc_w": norm(next(k), (L, d, f)),
+                "fc_b": jnp.zeros((L, f), pd),
+                "proj_w": norm(next(k), (L, f, d), s=res_std),
+                "proj_b": jnp.zeros((L, d), pd),
+            },
+        },
+    }
+
+
+def _layernorm(x, scale, bias, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps) * scale + bias).astype(x.dtype)
+
+
+def _attention(x, p, cfg: ViTConfig, rules):
+    B, T, d = x.shape
+    h, hd = cfg.n_head, cfg.head_dim
+    w = p["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
+    qkv = (x @ w).reshape(B, T, 3, h, hd) + p["qkv_b"].astype(cfg.dtype)
+    q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    q = with_logical_constraint(q, ("batch", "seq", "heads", "head_dim"),
+                                rules)
+    use_flash = cfg.use_flash
+    if use_flash is None:
+        from ray_tpu.ops.attention import flash_auto_dispatch
+
+        use_flash = flash_auto_dispatch(T, hd)
+    if use_flash:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        o = flash_attention(q, kk, v, causal=False)
+    else:
+        from ray_tpu.ops.attention import reference_attention
+
+        o = reference_attention(q, kk, v, causal=False)
+    wo = p["o_w"].astype(cfg.dtype).reshape(h * hd, d)
+    return o.reshape(B, T, h * hd) @ wo + p["o_b"].astype(cfg.dtype)
+
+
+def _mlp(x, p, cfg: ViTConfig, rules):
+    hd = jax.nn.gelu(x @ p["fc_w"].astype(cfg.dtype)
+                     + p["fc_b"].astype(cfg.dtype))
+    hd = with_logical_constraint(hd, ("batch", "seq", "mlp"), rules)
+    return hd @ p["proj_w"].astype(cfg.dtype) + p["proj_b"].astype(cfg.dtype)
+
+
+def _block(x, p, cfg: ViTConfig, rules):
+    x = x + _attention(_layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"]),
+                       p["attn"], cfg, rules)
+    x = x + _mlp(_layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"]),
+                 p["mlp"], cfg, rules)
+    return with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+
+
+def vit_forward(params, images, cfg: ViTConfig,
+                rules=DEFAULT_RULES) -> jnp.ndarray:
+    """images (B, H, W, 3) float → logits (B, n_classes) float32."""
+    B, H, W, C = images.shape
+    ps = cfg.patch_size
+    # patchify as one reshape+GEMM (the TPU-friendly conv-free form):
+    # (B, H/ps, ps, W/ps, ps, C) -> (B, N, ps*ps*C) @ (ps*ps*C, d)
+    x = images.astype(cfg.dtype).reshape(B, H // ps, ps, W // ps, ps, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, cfg.n_patches,
+                                              ps * ps * C)
+    x = x @ params["patch_w"].astype(cfg.dtype) \
+        + params["patch_b"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls"].astype(cfg.dtype),
+                           (B, 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"].astype(cfg.dtype)
+    x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
+
+    block = partial(_block, cfg=cfg, rules=rules)
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, layer_params):
+        return block(carry, layer_params), None
+
+    x, _ = lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x[:, 0], params["ln_f"]["scale"],
+                   params["ln_f"]["bias"])  # CLS token
+    return (x @ params["head_w"].astype(cfg.dtype)
+            + params["head_b"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def vit_loss(params, batch, cfg: ViTConfig,
+             rules=DEFAULT_RULES) -> jnp.ndarray:
+    """batch: {"images": (B,H,W,3), "labels": (B,)} → mean CE loss."""
+    logits = vit_forward(params, batch["images"], cfg, rules)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, batch["labels"][:, None],
+                               axis=-1)[:, 0]
+    return jnp.mean(nll)

@@ -313,30 +313,49 @@ DEFAULT_BLOCK_Q_BWD = 256
 DEFAULT_BLOCK_K_BWD = 1024
 
 
+def auto_blocks(T: int):
+    """Measured-on-v5e block policy: stream the WHOLE key axis per q-tile
+    whenever the f32 score tile fits VMEM (nk>1 — online-softmax scratch
+    revisits across kv grid steps — costs ~10x on this toolchain), with
+    bq capped at 1024 (bq=512 is a measured mosaic pathology: 1766ms vs
+    21.7ms at T=2048-class shapes).  Past T=2048 the (1024, T) tile no
+    longer compiles, so kv streaming is unavoidable; per-shard sequence
+    lengths under ring attention stay <= 2048 and remain on the happy
+    path.  Returns (block_q, block_k, block_q_bwd, block_k_bwd)."""
+    if T <= 2048:
+        return min(1024, T), T, 256, T
+    return 1024, 1024, 256, 1024
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
                     block_k_bwd: Optional[int] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention on (B, T, H, D) tensors.  Differentiable; VMEM use
     is O(block), HBM use O(T); causal masking skips ~half the tiles.
-    block_q_bwd/block_k_bwd set the backward kernels' tile sizes; the
-    backward holds more live tiles than the forward, so its optimal
-    q-block is smaller (256x1024 measured 8x faster than 1024x1024 on
-    v5e at T=1024).  Default (None): the tuned (256, 1024) when the
-    forward blocks are also defaults, otherwise mirror the caller's
-    forward blocks so an explicit VMEM-budget tuning governs both
-    passes."""
+    Defaults (None) come from auto_blocks(T) — the measured v5e policy;
+    explicitly set forward blocks also govern the backward unless
+    backward blocks are set too (an explicit VMEM-budget tuning governs
+    both passes)."""
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
-    if block_q_bwd is None:
-        block_q_bwd = (DEFAULT_BLOCK_Q_BWD if block_q == DEFAULT_BLOCK_Q
-                       else block_q)
-    if block_k_bwd is None:
-        block_k_bwd = (DEFAULT_BLOCK_K_BWD if block_k == DEFAULT_BLOCK_K
-                       else block_k)
+    auto_q, auto_k, auto_qb, auto_kb = auto_blocks(T)
+    if block_q is None and block_k is None:
+        block_q, block_k = auto_q, auto_k
+        if block_q_bwd is None:
+            block_q_bwd = auto_qb
+        if block_k_bwd is None:
+            block_k_bwd = auto_kb
+    else:
+        block_q = block_q or auto_q
+        block_k = block_k or auto_k
+        if block_q_bwd is None:
+            block_q_bwd = block_q
+        if block_k_bwd is None:
+            block_k_bwd = block_k
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)

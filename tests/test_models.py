@@ -110,3 +110,64 @@ def test_resnet_tiny_forward_and_loss():
                            np.asarray(state["stem"]["mean"]))
     logits, _ = resnet_forward(params, state, x, cfg, training=False)
     assert logits.shape == (2, cfg.n_classes)
+
+
+def test_vit_forward_loss_and_grad():
+    """ViT tiny: shapes, loss finiteness, grads flow, param count."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import (vit_config, vit_forward, vit_init,
+                                vit_loss, vit_param_count)
+
+    cfg = vit_config("tiny")
+    params = vit_init(jax.random.PRNGKey(0), cfg)
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert n == vit_param_count(cfg), (n, vit_param_count(cfg))
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
+    labels = jnp.array([1, 3])
+    logits = vit_forward(params, imgs, cfg)
+    assert logits.shape == (2, cfg.n_classes)
+    assert logits.dtype == jnp.float32
+    loss, grads = jax.value_and_grad(
+        lambda p: vit_loss(p, {"images": imgs, "labels": labels}, cfg)
+    )(params)
+    assert jnp.isfinite(loss)
+    # zero-init head => uniform logits => loss == log(n_classes)
+    import math as _m
+
+    assert abs(float(loss) - _m.log(cfg.n_classes)) < 1e-3
+    g = jax.tree.leaves(grads)
+    assert all(jnp.all(jnp.isfinite(x)) for x in g)
+    # the zero-init head blocks backbone grads at step 0 (standard ViT
+    # init); the head itself must receive gradient
+    assert float(jnp.abs(grads["head_w"]).sum()) > 0
+    assert float(jnp.abs(grads["patch_w"]).sum()) == 0.0
+
+
+def test_vit_shards_on_mesh():
+    """ViT trains one jitted step under an fsdp x tensor mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import vit_config, vit_init, vit_logical_axes, vit_loss
+    from ray_tpu.parallel import MeshSpec, fake_mesh
+    from ray_tpu.parallel.sharding import shard_params
+
+    mesh = fake_mesh(8, MeshSpec(data=2, fsdp=2, tensor=2))
+    cfg = vit_config("tiny")
+    axes = vit_logical_axes(cfg)
+    params = vit_init(jax.random.PRNGKey(0), cfg)
+    with jax.set_mesh(mesh):
+        params = shard_params(params, axes, mesh)
+        imgs = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+        labels = jnp.arange(4)
+
+        @jax.jit
+        def step(p):
+            return jax.value_and_grad(
+                lambda q: vit_loss(q, {"images": imgs, "labels": labels},
+                                   cfg))(p)
+
+        loss, grads = step(params)
+        assert jnp.isfinite(loss)
