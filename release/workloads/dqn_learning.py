@@ -1,0 +1,32 @@
+"""DQN learning gate (prioritized replay) on CartPole — the off-policy
+counterpart of the PPO gate (reference: release/rllib_tests learning
+tests)."""
+import json
+import os
+
+import ray_tpu
+from ray_tpu.rllib import DQN, DQNConfig
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+cfg = DQNConfig(env="CartPole-v1", num_workers=2,
+                rollout_fragment_length=64, buffer_size=50_000,
+                learning_starts=500, train_batch_size=64,
+                train_intensity=16, target_update_freq=500,
+                epsilon_decay_steps=8_000, prioritized_replay=True,
+                lr=1e-3, seed=1)
+algo = DQN(cfg)
+best, steps = -1e9, 0
+for i in range(15 if fast else 120):
+    res = algo.train()
+    steps = res["timesteps_total"]
+    best = max(best, res.get("episode_reward_mean", -1e9))
+    if best >= 120.0 or steps > 300_000:
+        break
+print(json.dumps({"episode_reward_mean": best, "env_steps": steps}),
+      flush=True)
+try:
+    algo.stop()
+    ray_tpu.shutdown()
+except BaseException:
+    pass
