@@ -74,6 +74,38 @@ class DashboardActor:
                                         name="dashboard")
         self._thread.start()
         self._started.wait(timeout=30)
+        self._write_prom_service_discovery()
+
+    def _write_prom_service_discovery(self) -> None:
+        """Prometheus file-based service discovery (reference:
+        _private/metrics_agent.py:340 PrometheusServiceDiscoveryWriter):
+        point prometheus at
+        <session_dir>/prom_metrics_service_discovery.json via
+        file_sd_configs and it scrapes the cluster's /metrics."""
+        import json
+        import os
+
+        from ray_tpu._private import worker_context
+
+        node = worker_context.node()
+        # the dashboard usually runs as a remote actor: no Node object in
+        # this process, but every worker carries the session dir in env
+        session_dir = (node.session_dir if node is not None
+                       else os.environ.get("RAYTPU_SESSION_DIR", ""))
+        if not session_dir:
+            return
+        path = os.path.join(session_dir,
+                            "prom_metrics_service_discovery.json")
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump([{
+                    "labels": {"job": "ray_tpu"},
+                    "targets": [f"{self.host}:{self.port}"],
+                }], f)
+            os.replace(tmp, path)  # atomic: prometheus may be reading
+        except OSError:
+            pass
 
     def _state(self):
         from ray_tpu.util import state

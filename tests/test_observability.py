@@ -75,6 +75,24 @@ def test_dashboard_endpoints(obs_cluster):
     ray_tpu.get([t.remote() for _ in range(3)], timeout=30)
     _wait_events(3)
     url = start_dashboard(port=18265)
+    # Prometheus file-based service discovery written into the session dir
+    import glob as _glob
+    import time as _time
+
+    deadline = _time.time() + 10
+    sd_files = []
+    while _time.time() < deadline and not sd_files:
+        sd_files = _glob.glob(
+            "/tmp/raytpu/s_*/prom_metrics_service_discovery.json")
+        _time.sleep(0.2)
+    assert sd_files, "prometheus service-discovery file not written"
+    import json as _json
+
+    # stale session dirs may linger in /tmp: any file with our target OK
+    targets = [t for f in sd_files for e in _json.load(open(f))
+               for t in e.get("targets", [])]
+    assert "127.0.0.1:18265" in targets, targets
+
     nodes = requests.get(f"{url}/api/nodes", timeout=30).json()
     assert len(nodes) == 1
     summary = requests.get(f"{url}/api/summary", timeout=30).json()
