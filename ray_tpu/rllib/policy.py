@@ -24,6 +24,8 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 @dataclasses.dataclass(frozen=True)
 class PolicySpec:
     obs_dim: int
+    #: discrete: number of actions; continuous: action dimensionality
+    #: (set continuous=True)
     n_actions: int
     hidden: Tuple[int, ...] = (64, 64)
     lr: float = 3e-4
@@ -33,6 +35,9 @@ class PolicySpec:
     num_sgd_iter: int = 6
     minibatch_size: int = 128
     grad_clip: float = 0.5
+    #: Box action spaces: diagonal-Gaussian policy (state-dependent mean,
+    #: state-independent log_std — standard PPO parameterization).
+    continuous: bool = False
 
 
 def _net_init(key, dims):
@@ -69,6 +74,8 @@ class JaxPolicy:
         import jax
         import optax
 
+        import jax.numpy as jnp
+
         self.spec = spec
         key = jax.random.PRNGKey(seed)
         kp, kv = jax.random.split(key)
@@ -77,6 +84,8 @@ class JaxPolicy:
                                  spec.n_actions)),
             "vf": _net_init(kv, (spec.obs_dim, *spec.hidden, 1)),
         }
+        if spec.continuous:
+            self.params["log_std"] = jnp.zeros((spec.n_actions,))
         self.tx = optax.chain(
             optax.clip_by_global_norm(spec.grad_clip),
             optax.adam(spec.lr))
@@ -108,22 +117,44 @@ class JaxPolicy:
             vf = _net_apply(params["vf"], obs)[..., 0]
             return logits, vf
 
+        _half_log_2pi_e = 0.5 * (jnp.log(2 * jnp.pi) + 1.0)
+
+        def _gaussian_logp(mean, log_std, actions):
+            std = jnp.exp(log_std)
+            return jnp.sum(
+                -0.5 * jnp.square((actions - mean) / std)
+                - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+
         @jax.jit
         def act(params, obs, rng):
             logits, vf = logits_vf(params, obs)
             rng, sub = jax.random.split(rng)
-            actions = jax.random.categorical(sub, logits)
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(logp_all, actions[:, None],
-                                       axis=-1)[:, 0]
+            if spec.continuous:
+                log_std = params["log_std"]
+                noise = jax.random.normal(sub, logits.shape)
+                actions = logits + jnp.exp(log_std) * noise
+                logp = _gaussian_logp(logits, log_std, actions)
+            else:
+                actions = jax.random.categorical(sub, logits)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(logp_all, actions[:, None],
+                                           axis=-1)[:, 0]
             return actions, logp, vf, rng
 
         def ppo_loss(params, batch):
             logits, vf = logits_vf(params, batch[sb.OBS])
-            logp_all = jax.nn.log_softmax(logits)
-            logp = jnp.take_along_axis(
-                logp_all, batch[sb.ACTIONS][:, None].astype(jnp.int32),
-                axis=-1)[:, 0]
+            if spec.continuous:
+                log_std = params["log_std"]
+                logp = _gaussian_logp(logits, log_std, batch[sb.ACTIONS])
+                entropy = jnp.sum(log_std + _half_log_2pi_e)
+            else:
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all,
+                    batch[sb.ACTIONS][:, None].astype(jnp.int32),
+                    axis=-1)[:, 0]
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
             ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
             adv = batch[sb.ADVANTAGES]
             surr = jnp.minimum(
@@ -132,8 +163,6 @@ class JaxPolicy:
                          1 + spec.clip_param) * adv)
             pi_loss = -jnp.mean(surr)
             vf_loss = jnp.mean(jnp.square(vf - batch[sb.VALUE_TARGETS]))
-            entropy = -jnp.mean(
-                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
             total = pi_loss + spec.vf_coeff * vf_loss \
                 - spec.entropy_coeff * entropy
             return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,

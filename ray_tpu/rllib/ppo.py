@@ -35,6 +35,8 @@ class PPOConfig(AlgorithmConfig):
     # set from the env when obs/action spaces are introspectable
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
+    #: Box action spaces: diagonal-Gaussian policy (auto-detected)
+    continuous: bool = False
 
     def policy_spec(self) -> PolicySpec:
         if self.obs_dim is None or self.n_actions is None:
@@ -46,7 +48,8 @@ class PPOConfig(AlgorithmConfig):
             clip_param=self.clip_param, vf_coeff=self.vf_coeff,
             entropy_coeff=self.entropy_coeff,
             num_sgd_iter=self.num_sgd_iter,
-            minibatch_size=self.minibatch_size, grad_clip=self.grad_clip)
+            minibatch_size=self.minibatch_size, grad_clip=self.grad_clip,
+            continuous=self.continuous)
 
 
 def _introspect_spaces(cfg: PPOConfig) -> None:
@@ -57,7 +60,22 @@ def _introspect_spaces(cfg: PPOConfig) -> None:
     env = _make_env(cfg.env, cfg.env_config)
     try:
         cfg.obs_dim = int(np.prod(env.observation_space.shape))
-        cfg.n_actions = int(env.action_space.n)
+        space = env.action_space
+        if hasattr(space, "n"):
+            cfg.n_actions = int(space.n)
+        elif hasattr(cfg, "continuous"):
+            # Box: diagonal-Gaussian policy over the action vector
+            cfg.n_actions = int(np.prod(space.shape))
+            cfg.continuous = True
+        else:
+            # shared by discrete-only algos (DQN/IMPALA): fail loudly
+            # instead of silently building a categorical policy over a
+            # Box space
+            raise TypeError(
+                f"{type(cfg).__name__} supports discrete action spaces "
+                f"only; got a continuous space with shape "
+                f"{getattr(space, 'shape', '?')} (use PPO for "
+                f"continuous control)")
     finally:
         env.close() if hasattr(env, "close") else None
 

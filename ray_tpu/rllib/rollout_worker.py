@@ -37,6 +37,14 @@ class RolloutWorker:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         self.envs = [_make_env(env, env_config) for _ in range(num_envs)]
         self.policy = JaxPolicy(policy_spec, seed=seed)
+        # Box-space metadata for continuous policies: executed actions are
+        # reshaped to the env's action shape and clipped to its bounds
+        # (the BATCH keeps the raw sampled action so the PPO ratio refers
+        # to what was actually sampled — reference clip_actions behavior)
+        space = getattr(self.envs[0], "action_space", None)
+        self._action_shape = tuple(getattr(space, "shape", ()) or ())
+        self._action_low = getattr(space, "low", None)
+        self._action_high = getattr(space, "high", None)
         self.gamma = gamma
         self.lam = lam
         self.fragment = rollout_fragment_length
@@ -52,8 +60,13 @@ class RolloutWorker:
         """One fragment per env, GAE-postprocessed and concatenated."""
         n_env = len(self.envs)
         T = self.fragment
+        continuous = getattr(self.policy.spec, "continuous", False)
         obs_buf = np.zeros((T, n_env) + np.shape(self._obs[0]), np.float32)
-        act_buf = np.zeros((T, n_env), np.int64)
+        if continuous:
+            act_buf = np.zeros((T, n_env, self.policy.spec.n_actions),
+                               np.float32)
+        else:
+            act_buf = np.zeros((T, n_env), np.int64)
         rew_buf = np.zeros((T, n_env), np.float32)
         done_buf = np.zeros((T, n_env), np.bool_)
         logp_buf = np.zeros((T, n_env), np.float32)
@@ -67,7 +80,16 @@ class RolloutWorker:
             logp_buf[t] = logp
             vf_buf[t] = vf
             for i, env in enumerate(self.envs):
-                o2, r, term, trunc, _ = env.step(int(actions[i]))
+                if continuous:
+                    a = np.asarray(actions[i], np.float32)
+                    if self._action_low is not None:
+                        a = np.clip(a, self._action_low,
+                                    self._action_high)
+                    if self._action_shape:
+                        a = a.reshape(self._action_shape)
+                else:
+                    a = int(actions[i])
+                o2, r, term, trunc, _ = env.step(a)
                 rew_buf[t, i] = r
                 self._ep_rewards[i] += r
                 if trunc and not term:
