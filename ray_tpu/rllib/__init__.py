@@ -11,6 +11,7 @@ from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
 from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -23,4 +24,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "IMPALAConfig", "vtrace", "DQN", "DQNConfig", "QPolicy",
            "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader",
            "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
-           "MultiAgentPPO", "MultiAgentPPOConfig"]
+           "MultiAgentPPO", "MultiAgentPPOConfig", "SAC", "SACConfig",
+           "SACPolicy"]

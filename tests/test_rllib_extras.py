@@ -262,3 +262,25 @@ def test_continuous_ppo_learns(ray_start_shared):
         assert result.get("episode_reward_mean", -99) >= -4.0, result
     finally:
         algo.stop()
+
+
+def test_sac_learns_continuous_target(ray_start_shared):
+    """SAC drives the tanh-Gaussian actor onto the per-state targets of
+    the continuous bandit (off-policy counterpart of the PPO test)."""
+    from ray_tpu.rllib import SAC, SACConfig
+
+    cfg = SACConfig(env=lambda _=None: TargetEnv(), num_workers=1,
+                    hidden=(32, 32), buffer_size=20_000,
+                    learning_starts=200, train_batch_size=128,
+                    train_intensity=32, lr=3e-3, gamma=0.0,
+                    rollout_fragment_length=100, seed=0)
+    algo = SAC(cfg)
+    try:
+        result = {}
+        for _ in range(25):
+            result = algo.train()
+            if result.get("episode_reward_mean", -99) >= -2.0:
+                break
+        assert result.get("episode_reward_mean", -99) >= -4.0, result
+    finally:
+        algo.stop()
