@@ -180,7 +180,7 @@ def main():
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=128, n_steps=2, preset="tiny", use_flash=False)
         seq = 128
-    print(json.dumps({
+    result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
                   if on_tpu else "gpt2_tiny_cpu_smoke_tokens_per_sec",
         "value": round(tok_s_chip, 1),
@@ -191,7 +191,27 @@ def main():
                    "loss": round(final_loss, 3),
                    "backend": jax.default_backend(),
                    "tpu_error": TPU_ERROR},
-    }))
+    }
+    record = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "BENCH_TPU_LAST.json")
+    if on_tpu:
+        # persist the successful TPU measurement: the tunnel flakes for
+        # hours at a time (rounds 1-2 never got a TPU number), so a CPU
+        # fallback should still surface the last REAL chip result,
+        # clearly labeled as historical.
+        try:
+            with open(record, "w") as f:
+                json.dump(dict(result, recorded_at=time.strftime(
+                    "%Y-%m-%d %H:%M:%S")), f, indent=1)
+        except OSError:
+            pass
+    else:
+        try:
+            with open(record) as f:
+                result["detail"]["last_known_tpu_result"] = json.load(f)
+        except Exception:  # noqa: BLE001 - no prior TPU run recorded
+            pass
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
