@@ -6,11 +6,12 @@ consumed by Train/Tune (`ScalingConfig`, `RunConfig`, `FailureConfig`,
 `CheckpointConfig`).
 """
 
+from ray_tpu.air.batch_predictor import BatchPredictor, Predictor
 from ray_tpu.air.checkpoint import Checkpoint
 from ray_tpu.air.config import (CheckpointConfig, FailureConfig, RunConfig,
                                 ScalingConfig)
 from ray_tpu.air.result import Result
 from ray_tpu.air import session
 
-__all__ = ["Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",
+__all__ = ["Checkpoint", "BatchPredictor", "Predictor", "ScalingConfig", "RunConfig", "FailureConfig",
            "CheckpointConfig", "Result", "session"]

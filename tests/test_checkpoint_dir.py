@@ -19,3 +19,32 @@ def test_directory_checkpoint_packs_files(tmp_path):
     assert (tmp_path / "out" / "weights.bin").read_bytes() == \
         b"\x01\x02\x03" * 100
     assert (tmp_path / "out" / "nested" / "meta.txt").read_text() == "hello"
+
+
+def test_batch_predictor_over_dataset(ray_start_regular):
+    """BatchPredictor: checkpoint -> actor-pool inference over a Dataset
+    (reference: train/batch_predictor.py + the GPU batch-prediction
+    benchmark shape)."""
+    import numpy as np
+
+    from ray_tpu import data
+    from ray_tpu.air import BatchPredictor, Checkpoint, Predictor
+
+    class ScalePredictor(Predictor):
+        def __init__(self, w):
+            self.w = w
+
+        @classmethod
+        def from_checkpoint(cls, ckpt):
+            return cls(ckpt.to_dict()["w"])
+
+        def predict(self, batch):
+            return {"y": batch["x"] * self.w}
+
+    ckpt = Checkpoint.from_dict({"w": 3.0})
+    bp = BatchPredictor.from_checkpoint(ckpt, ScalePredictor)
+    ds = data.from_numpy({"x": np.arange(32, dtype=np.float32)})
+    out = bp.predict(ds, min_scoring_workers=2)
+    rows = out.take_all()
+    ys = sorted(r["y"] for r in rows)
+    assert ys == [i * 3.0 for i in range(32)]
