@@ -1,0 +1,103 @@
+"""Sharded checkpointing for mesh-parallel training (orbax-backed).
+
+SURVEY §7 lists "orbax-style sharded checkpoints" among the gaps the
+reference leaves open (its Train checkpoints are whole-model torch
+state_dicts shipped through the object store).  On TPU the params are
+GSPMD-sharded jax.Arrays: every host must write exactly its own shards
+(a gather-to-host-0 both OOMs and wastes ICI), and restore must be able
+to RE-shard onto a different mesh (elastic restart onto fewer/more
+chips, or a different parallelism layout).
+
+orbax's OCDBT/zarr format does both; these helpers pin down the
+framework's conventions (layout, resharding, AIR interop) so trainers
+and user code share one path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_sharded", "restore_sharded", "latest_step",
+           "sharded_checkpoint_to_air"]
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _step_dir(path: str, step: Optional[int]) -> str:
+    return os.path.join(path, f"step_{step}") if step is not None else path
+
+
+def save_sharded(params: Any, path: str, *,
+                 step: Optional[int] = None) -> str:
+    """Write a (possibly mesh-sharded) pytree; each process writes only
+    its addressable shards.  Returns the checkpoint directory."""
+    target = os.path.abspath(_step_dir(path, step))
+    ckptr = _checkpointer()
+    ckptr.save(target, params, force=True)
+    ckptr.wait_until_finished()
+    return target
+
+
+def restore_sharded(path: str, *, step: Optional[int] = None,
+                    template: Any = None, mesh=None, axes: Any = None,
+                    rules=None) -> Any:
+    """Restore a pytree saved with save_sharded.
+
+    Resharding: pass `mesh` + `axes` (the model's logical-axis pytree,
+    e.g. gpt2_logical_axes(cfg)) to land the restored params directly
+    under that mesh's shardings — valid even when the saving run used a
+    different mesh shape.  With neither, arrays restore unsharded
+    (single-process layouts).  `template` (an abstract or concrete
+    pytree) pins dtypes/shapes when the target structure is ambiguous.
+    """
+    import jax
+
+    target = os.path.abspath(_step_dir(path, step))
+    ckptr = _checkpointer()
+    restored = (ckptr.restore(target, template)
+                if template is not None else ckptr.restore(target))
+    if mesh is None or axes is None:
+        return restored
+    from jax.sharding import NamedSharding
+
+    from ray_tpu.parallel.sharding import (DEFAULT_RULES,
+                                           logical_to_mesh_axes)
+
+    rules = rules or DEFAULT_RULES
+
+    def place(ax, x):
+        spec = logical_to_mesh_axes(tuple(ax), rules)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    # axes leads the map: its leaves are axis-name tuples, and the
+    # matching restored subtree (an array) is passed through whole
+    return jax.tree.map(place, axes, restored,
+                        is_leaf=lambda n: isinstance(n, tuple))
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Largest step_N subdirectory under `path`, or None."""
+    try:
+        steps = [int(d[len("step_"):]) for d in os.listdir(path)
+                 if d.startswith("step_") and
+                 d[len("step_"):].isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def sharded_checkpoint_to_air(path: str, step: Optional[int] = None):
+    """Wrap a sharded checkpoint directory as an AIR Checkpoint so it
+    flows through session.report / Tune bookkeeping like any other
+    artifact (the directory itself stays in place — sharded checkpoints
+    are too big to ship through the object store)."""
+    from ray_tpu.air import Checkpoint
+
+    return Checkpoint.from_dict({
+        "sharded_checkpoint_path": os.path.abspath(
+            _step_dir(path, step))})

@@ -1,0 +1,54 @@
+"""Sharded checkpoint save/restore with resharding (orbax-backed).
+
+SURVEY §7 names orbax-style sharded checkpoints as a design-fresh gap;
+these tests cover: sharded save on one mesh, restore onto a DIFFERENT
+mesh layout (elastic restart), value fidelity, step management, and AIR
+interop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt2_config, gpt2_init, gpt2_logical_axes
+from ray_tpu.parallel import MeshSpec, fake_mesh
+from ray_tpu.parallel.sharding import param_shardings, shard_params
+from ray_tpu.train import (latest_step, restore_sharded, save_sharded,
+                           sharded_checkpoint_to_air)
+
+
+def test_save_sharded_restore_resharded(tmp_path):
+    cfg = gpt2_config("nano")
+    axes = gpt2_logical_axes(cfg)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+
+    mesh_a = fake_mesh(8, MeshSpec(fsdp=4, tensor=2))
+    with jax.set_mesh(mesh_a):
+        sharded = shard_params(params, axes, mesh_a)
+    path = save_sharded(sharded, str(tmp_path / "ckpt"), step=3)
+
+    # restore onto a DIFFERENT layout: pure data-parallel mesh
+    mesh_b = fake_mesh(8, MeshSpec(data=8))
+    restored = restore_sharded(str(tmp_path / "ckpt"), step=3,
+                               mesh=mesh_b, axes=axes)
+    for orig, new in zip(jax.tree.leaves(params),
+                         jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(orig), np.asarray(new))
+    # restored arrays carry mesh_b shardings matching the rule table
+    want = param_shardings(axes, mesh_b)
+    for s_want, leaf in zip(jax.tree.leaves(want),
+                            jax.tree.leaves(restored)):
+        assert leaf.sharding == s_want
+
+    assert latest_step(str(tmp_path / "ckpt")) == 3
+
+
+def test_restore_without_mesh_and_air_interop(tmp_path):
+    tree = {"w": jnp.arange(8.0), "b": jnp.ones((2, 2))}
+    path = save_sharded(tree, str(tmp_path / "flat"))
+    back = restore_sharded(str(tmp_path / "flat"))
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.arange(8.0))
+
+    ckpt = sharded_checkpoint_to_air(str(tmp_path / "flat"))
+    assert ckpt.to_dict()["sharded_checkpoint_path"].endswith("flat")
