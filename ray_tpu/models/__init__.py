@@ -9,6 +9,8 @@ and sequence parallelism by swapping the rule table.
 from ray_tpu.models.gpt2 import (GPT2Config, gpt2_config, gpt2_forward,
                                  gpt2_init, gpt2_logical_axes, gpt2_loss,
                                  gpt2_param_count)
+from ray_tpu.models.gpt2_decode import (decode_step, generate,
+                                        init_cache)
 from ray_tpu.models.moe import (MoEConfig, moe_apply, moe_init,
                                 moe_logical_axes)
 from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
@@ -22,7 +24,8 @@ from ray_tpu.models.vit import (ViTConfig, vit_config, vit_forward,
 
 __all__ = [
     "GPT2Config", "gpt2_config", "gpt2_init", "gpt2_forward", "gpt2_loss",
-    "gpt2_logical_axes", "gpt2_param_count",
+    "gpt2_logical_axes", "gpt2_param_count", "init_cache", "decode_step",
+    "generate",
     "MLPConfig", "mlp_init", "mlp_forward", "mlp_loss", "mlp_logical_axes",
     "MoEConfig", "moe_init", "moe_apply", "moe_logical_axes",
     "ResNetConfig", "resnet_config", "resnet_init", "resnet_forward",

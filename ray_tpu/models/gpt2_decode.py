@@ -1,0 +1,135 @@
+"""Autoregressive decoding with a KV cache for the GPT-2 family.
+
+The training path (gpt2.py) recomputes full-sequence attention; serving
+needs incremental decode: O(1) new compute per token against cached
+keys/values.  TPU-first choices:
+
+  * static shapes everywhere — the cache is allocated at max_seq and
+    positions beyond `pos` are masked, so ONE compiled step serves the
+    whole generation (no shape-polymorphic recompile);
+  * the per-token step is a `lax.scan` over the stacked layer params
+    with the cache in the carry (same scan-stacked layout as training —
+    one layer traced once);
+  * generation is itself a `lax.scan` over time: prefill + N sampling
+    steps compile into a single dispatch.
+
+No reference analog (the reference wraps user torch modules); this is
+the piece that makes ray_tpu.serve a real LM server.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.gpt2 import GPT2Config, _layernorm
+
+__all__ = ["init_cache", "decode_step", "generate"]
+
+
+def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
+    """Preallocated (L, B, S, H, hd) key/value cache + position 0."""
+    shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def decode_step(params, cache, tokens, cfg: GPT2Config
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One token per sequence: tokens (B,) int32 at position cache[pos].
+
+    Returns (logits (B, padded_vocab) float32, updated cache)."""
+    B = tokens.shape[0]
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    pos = cache["pos"]
+    x = params["wte"].astype(cfg.dtype)[tokens]          # (B, d)
+    x = x + params["wpe"].astype(cfg.dtype)[pos]
+
+    pos_mask = (jnp.arange(cfg.max_seq) <= pos)          # (S,)
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        ck = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)    # (B,S,H,hd)
+        cv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        w = p["attn"]["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
+        qkv = (xa @ w).reshape(B, 3, h, hd) \
+            + p["attn"]["qkv_b"].astype(cfg.dtype)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B,h,hd)
+        ck = lax.dynamic_update_slice_in_dim(
+            ck, k_new[:, None], pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(
+            cv, v_new[:, None], pos, axis=1)
+        # attention of the single query against the cache
+        scores = jnp.einsum("bhd,bshd->bhs", q, ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(pos_mask[None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bhs,bshd->bhd", probs, cv)       # (B,h,hd)
+        wo = p["attn"]["o_w"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, h * hd) @ wo
+                 + p["attn"]["o_b"].astype(cfg.dtype))
+        xm = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        hmid = jax.nn.gelu(xm @ p["mlp"]["fc_w"].astype(cfg.dtype)
+                           + p["mlp"]["fc_b"].astype(cfg.dtype))
+        x = x + (hmid @ p["mlp"]["proj_w"].astype(cfg.dtype)
+                 + p["mlp"]["proj_b"].astype(cfg.dtype))
+        return (x, lidx + 1), (ck, cv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    return logits, cache
+
+
+def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
+             max_new_tokens: int, temperature: float = 1.0,
+             key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """prompt (B, T0) int32 → (B, T0 + max_new_tokens) int32.
+
+    temperature 0 = greedy.  The whole generation (prefill + sampling)
+    is one jitted program; call under jax.jit with static cfg/
+    max_new_tokens for repeated use."""
+    B, T0 = prompt.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B)
+
+    def prefill_step(cache, tok):
+        logits, cache = decode_step(params, cache, tok, cfg)
+        return cache, logits
+
+    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
+    last_logits = logits_seq[-1]                         # (B, V)
+
+    def sample(logits, k):
+        # mask the padded vocab tail so it can never be sampled
+        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30,
+                       dtype=logits.dtype)
+        if cfg.padded_vocab != cfg.vocab_size:
+            logits = logits.at[..., cfg.vocab_size:].set(neg)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / jnp.float32(temperature)).astype(jnp.int32)
+
+    def gen_step(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        new_logits, cache = decode_step(params, cache, tok, cfg)
+        return (cache, new_logits), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
+    return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
+                           axis=1)
