@@ -61,6 +61,13 @@ class GPT2Config:
     #: layer's weight loads with the previous layer's compute.
     scan_unroll: int = 1
     seq_parallel: bool = False  # ring attention over the mesh "seq" axis
+    #: >0 replaces every block's dense MLP with a mixture-of-experts FF
+    #: (ray_tpu.models.moe) routed top-k over the `expert` mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    #: weight of the Switch load-balancing aux loss added by gpt2_loss
+    moe_aux_weight: float = 0.01
     # pad vocab to a multiple of 128 so the logits matmul tiles the MXU
     # cleanly and the vocab dim shards evenly under tensor parallelism
     vocab_pad_to: int = 128
@@ -98,7 +105,12 @@ def gpt2_config(name: str = "gpt2", **overrides) -> GPT2Config:
 
 def gpt2_param_count(cfg: GPT2Config) -> int:
     d, f, L = cfg.d_model, cfg.d_ff, cfg.n_layer
-    per_layer = (4 * d * d + 4 * d) + (2 * d * f + d + f) + 4 * d  # attn+mlp+2ln
+    if cfg.n_experts:
+        E = cfg.n_experts
+        ff = d * E + E * (2 * d * f + d + f)  # gate + E experts
+    else:
+        ff = 2 * d * f + d + f
+    per_layer = (4 * d * d + 4 * d) + ff + 4 * d  # attn+ff+2ln
     return cfg.vocab_size * d + cfg.max_seq * d + L * per_layer + 2 * d
 
 
@@ -126,12 +138,18 @@ def gpt2_logical_axes(cfg: GPT2Config) -> Dict[str, Any]:
                 "o_w": (None, "heads", "head_dim", "embed"),
                 "o_b": (None, "embed"),
             },
-            "mlp": {
+            **({"moe": {
+                "gate": (None, "embed", None),
+                "w1": (None, "expert", "embed", "mlp"),
+                "b1": (None, "expert", "mlp"),
+                "w2": (None, "expert", "mlp", "embed"),
+                "b2": (None, "expert", "embed"),
+            }} if cfg.n_experts else {"mlp": {
                 "fc_w": (None, "embed", "mlp"),
                 "fc_b": (None, "mlp"),
                 "proj_w": (None, "mlp", "embed"),
                 "proj_b": (None, "embed"),
-            },
+            }}),
         },
     }
 
@@ -164,12 +182,19 @@ def gpt2_init(key, cfg: GPT2Config) -> Dict[str, Any]:
                 "o_w": norm(next(k), (L, h, hd, d), s=res_std),
                 "o_b": jnp.zeros((L, d), pd),
             },
-            "mlp": {
+            **({"moe": {
+                "gate": norm(next(k), (L, d, cfg.n_experts)),
+                "w1": norm(next(k), (L, cfg.n_experts, d, f)),
+                "b1": jnp.zeros((L, cfg.n_experts, f), pd),
+                "w2": norm(next(k), (L, cfg.n_experts, f, d),
+                           s=res_std),
+                "b2": jnp.zeros((L, cfg.n_experts, d), pd),
+            }} if cfg.n_experts else {"mlp": {
                 "fc_w": norm(next(k), (L, d, f)),
                 "fc_b": jnp.zeros((L, f), pd),
                 "proj_w": norm(next(k), (L, f, d), s=res_std),
                 "proj_b": jnp.zeros((L, d), pd),
-            },
+            }}),
         },
     }
 
@@ -277,15 +302,31 @@ def _mlp(x, p, cfg: GPT2Config, rules):
     return out + p["proj_b"].astype(cfg.dtype)
 
 
+def _moe_cfg(cfg: GPT2Config):
+    from ray_tpu.models.moe import MoEConfig
+
+    return MoEConfig(d_model=cfg.d_model, d_ff=cfg.d_ff,
+                     n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                     capacity_factor=cfg.moe_capacity_factor,
+                     dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+
+
 def _block(x, layer_params, cfg: GPT2Config, rules):
+    """Returns (x, moe_aux_loss) — aux is 0.0 for dense blocks."""
     p = layer_params
     x = x + _attention(
         _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"]), p["attn"], cfg,
         rules)
-    x = x + _mlp(_layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"]),
-                 p["mlp"], cfg, rules)
+    xm = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+    if cfg.n_experts:
+        from ray_tpu.models.moe import moe_apply
+
+        y, aux = moe_apply(p["moe"], xm, _moe_cfg(cfg), rules)
+    else:
+        y, aux = _mlp(xm, p["mlp"], cfg, rules), jnp.float32(0.0)
+    x = x + y
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
-    return x
+    return x, aux
 
 
 def _flash_active(cfg: GPT2Config, T: int) -> bool:
@@ -303,13 +344,19 @@ def _flash_active(cfg: GPT2Config, T: int) -> bool:
 
 
 def gpt2_hidden(params, tokens, cfg: GPT2Config,
-                rules=DEFAULT_RULES) -> jnp.ndarray:
-    """tokens (B, T) int32 → post-ln_f hidden states (B, T, d_model)."""
+                rules=DEFAULT_RULES, return_aux: bool = False):
+    """tokens (B, T) int32 → post-ln_f hidden states (B, T, d_model).
+    return_aux=True additionally returns the summed MoE load-balance
+    loss (0.0 for dense configs)."""
     B, T = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens]
     x = x + params["wpe"].astype(cfg.dtype)[:T]
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
 
+    if cfg.remat and cfg.remat_policy == "mlp_only" and cfg.n_experts:
+        raise NotImplementedError(
+            "remat_policy='mlp_only' is a dense-MLP recipe; MoE blocks "
+            "use remat_policy='full' (or 'dots_nb')")
     if cfg.remat and cfg.remat_policy == "mlp_only" \
             and _flash_active(cfg, T):
         # Sublayer-granular remat: the attention half is NOT rematted —
@@ -340,8 +387,9 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config,
 
         x, _ = lax.scan(scan_body, x, params["blocks"],
                         unroll=cfg.scan_unroll)
-        return _layernorm(x, params["ln_f"]["scale"],
-                          params["ln_f"]["bias"])
+        out = _layernorm(x, params["ln_f"]["scale"],
+                         params["ln_f"]["bias"])
+        return (out, jnp.float32(0.0)) if return_aux else out
 
     block = partial(_block, cfg=cfg, rules=rules)
     if cfg.remat:
@@ -359,22 +407,30 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config,
         block = jax.checkpoint(block, policy=policy)
 
     def scan_body(carry, layer_params):
-        return block(carry, layer_params), None
+        return block(carry, layer_params)
 
-    x, _ = lax.scan(scan_body, x, params["blocks"], unroll=cfg.scan_unroll)
-    return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    x, auxes = lax.scan(scan_body, x, params["blocks"],
+                        unroll=cfg.scan_unroll)
+    out = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    return (out, jnp.sum(auxes)) if return_aux else out
+
+
+def _tied_logits(hidden, wte, cfg: GPT2Config, rules):
+    """Tied-embedding projection — the ONE place defining the contract:
+    bf16 operands with float32 accumulation (the MXU runs at bf16 rate
+    while the softmax/loss still sees float32 logits; a pure-f32 matmul
+    would run at 1/3 MXU rate via multi-pass)."""
+    logits = jnp.einsum("btd,vd->btv", hidden, wte.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return with_logical_constraint(logits, ("batch", "seq", "vocab"),
+                                   rules)
 
 
 def gpt2_forward(params, tokens, cfg: GPT2Config,
                  rules=DEFAULT_RULES) -> jnp.ndarray:
     """tokens (B, T) int32 → logits (B, T, padded_vocab) float32."""
     x = gpt2_hidden(params, tokens, cfg, rules)
-    # Tied embeddings.  bf16 operands with float32 accumulation: the MXU
-    # runs at bf16 rate while the softmax/loss still sees float32 logits
-    # (a pure-f32 matmul would run at 1/3 MXU rate via multi-pass).
-    logits = jnp.einsum("btd,vd->btv", x, params["wte"].astype(cfg.dtype),
-                        preferred_element_type=jnp.float32)
-    return with_logical_constraint(logits, ("batch", "seq", "vocab"), rules)
+    return _tied_logits(x, params["wte"], cfg, rules)
 
 
 def _nll_from_logits(logits, targets, cfg: GPT2Config):
@@ -425,14 +481,17 @@ def gpt2_loss(params, batch, cfg: GPT2Config,
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     mask = batch.get("mask")
+    hidden, aux = gpt2_hidden(params, inputs, cfg, rules,
+                              return_aux=True)
+    aux_term = cfg.moe_aux_weight * aux if cfg.n_experts else 0.0
     if cfg.loss_chunks > 1:
-        hidden = gpt2_hidden(params, inputs, cfg, rules)
         if mask is None:
             mask = jnp.ones(targets.shape, jnp.float32)
         return _chunked_ce(hidden, params["wte"], targets,
-                           mask.astype(jnp.float32), cfg)
-    logits = gpt2_forward(params, inputs, cfg, rules)
+                           mask.astype(jnp.float32), cfg) + aux_term
+    logits = _tied_logits(hidden, params["wte"], cfg, rules)
     nll = _nll_from_logits(logits, targets, cfg)
     if mask is not None:
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask),
+                                                 1.0) + aux_term
+    return jnp.mean(nll) + aux_term

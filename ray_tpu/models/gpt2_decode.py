@@ -33,6 +33,10 @@ __all__ = ["init_cache", "decode_step", "generate"]
 
 def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
     """Preallocated (L, B, S, H, hd) key/value cache + position 0."""
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "KV-cache decoding currently supports dense GPT-2 configs "
+            "only (n_experts=0); MoE decode needs per-step routing")
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),

@@ -102,3 +102,47 @@ def test_moe_trains():
         params, opt, l = step(params, opt)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_moe_gpt2_trains_on_expert_mesh():
+    """GPT-2 with n_experts>0: the MoE FF replaces the dense MLP, the
+    aux load-balance loss flows into gpt2_loss, and one jitted train
+    step runs under a mesh with a real expert axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.models import (gpt2_config, gpt2_init,
+                                gpt2_logical_axes, gpt2_loss,
+                                gpt2_param_count)
+    from ray_tpu.parallel import MeshSpec, fake_mesh
+    from ray_tpu.parallel.sharding import shard_params
+
+    cfg = gpt2_config("nano", n_experts=4, moe_top_k=2, use_flash=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    assert "moe" in params["blocks"] and "mlp" not in params["blocks"]
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert n == gpt2_param_count(cfg)
+
+    mesh = fake_mesh(8, MeshSpec(data=2, expert=4))
+    axes = gpt2_logical_axes(cfg)
+    toks = {"tokens": np.arange(2 * 33).reshape(2, 33) % cfg.vocab_size}
+    tx = optax.adam(1e-3)
+    with jax.set_mesh(mesh):
+        params = shard_params(params, axes, mesh)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt2_loss(p, toks, cfg))(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(8):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
