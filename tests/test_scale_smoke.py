@@ -1,0 +1,90 @@
+"""Scalability envelope smoke (reference: release/benchmarks/README.md
+rows — many tasks / actors / PGs / object args — scaled to a 1-core CI
+box; the release suite carries the full-size variants)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture(scope="module")
+def scale_cluster():
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_many_small_tasks(scale_cluster):
+    # num_cpus=1: tasks pipeline through the warm 8-worker lease pool
+    # (fractional CPUs would fork hundreds of workers on this 1-core
+    # box — the release suite carries the big-fan-out variant)
+    @ray_tpu.remote(num_cpus=1)
+    def inc(x):
+        return x + 1
+
+    refs = [inc.remote(i) for i in range(2000)]
+    out = ray_tpu.get(refs, timeout=300)
+    assert out == [i + 1 for i in range(2000)]
+
+
+def test_many_actors(scale_cluster):
+    @ray_tpu.remote(num_cpus=0.1)
+    class A:
+        def __init__(self, i):
+            self.i = i
+
+        def who(self):
+            return self.i
+
+    actors = [A.remote(i) for i in range(60)]
+    got = ray_tpu.get([a.who.remote() for a in actors], timeout=300)
+    assert got == list(range(60))
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_many_object_args_one_task(scale_cluster):
+    """Reference row: 10k object args to one task (scaled to 512)."""
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    refs = [ray_tpu.put(i) for i in range(512)]
+    assert ray_tpu.get(total.remote(*refs), timeout=300) == \
+        sum(range(512))
+
+
+def test_many_returns_one_task(scale_cluster):
+    """Reference row: 3k returns from one task (scaled to 256)."""
+
+    @ray_tpu.remote(num_returns=256)
+    def fan():
+        return tuple(range(256))
+
+    refs = fan.remote()
+    out = ray_tpu.get(list(refs), timeout=300)
+    assert out == list(range(256))
+
+
+def test_many_placement_groups(scale_cluster):
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    pgs = []
+    for _ in range(30):
+        pg = placement_group([{"CPU": 0.1}])
+        pgs.append(pg)
+    for pg in pgs:
+        pg.ready(timeout=60)
+    for pg in pgs:
+        remove_placement_group(pg)
+
+
+def test_get_many_objects_at_once(scale_cluster):
+    """Reference row: 10k plasma objects in one ray.get (scaled 1k)."""
+    refs = [ray_tpu.put(np.full(64, i, np.int64)) for i in range(1000)]
+    out = ray_tpu.get(refs, timeout=300)
+    for i in (0, 500, 999):
+        assert out[i][0] == i
