@@ -70,12 +70,19 @@ class JaxPolicy:
     weight broadcast rides the object store.
     """
 
-    def __init__(self, spec: PolicySpec, seed: int = 0):
+    def __init__(self, spec: PolicySpec, seed: int = 0, mesh=None):
+        """mesh: a jax Mesh with a "data" axis — the learner update then
+        runs data-parallel across its devices (params replicated, batch
+        rows sharded, gradients psum'd by GSPMD).  The multi-chip
+        learner analog of the reference's multi-GPU tower stack
+        (multi_gpu_learner_thread.py), expressed as shardings instead
+        of explicit replicas."""
         import jax
         import optax
 
         import jax.numpy as jnp
 
+        self.mesh = mesh
         self.spec = spec
         key = jax.random.PRNGKey(seed)
         kp, kv = jax.random.split(key)
@@ -218,7 +225,25 @@ class JaxPolicy:
 
     # -- learning ---------------------------------------------------------
     def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
-        dev = batch.to_device()
-        self.params, self.opt_state, stats, self._rng = self._update(
-            self.params, self.opt_state, dev, self._rng)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            rows = NamedSharding(self.mesh, P("data"))
+            n = batch.count
+            shards = self.mesh.shape.get("data", 1)
+            usable = (n // shards) * shards  # row axis must shard evenly
+            dev = {k: jax.device_put(v[:usable], rows)
+                   for k, v in batch.items()}
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+            with jax.set_mesh(self.mesh):
+                (self.params, self.opt_state, stats,
+                 self._rng) = self._update(self.params, self.opt_state,
+                                           dev, self._rng)
+        else:
+            dev = batch.to_device()
+            self.params, self.opt_state, stats, self._rng = self._update(
+                self.params, self.opt_state, dev, self._rng)
         return {k: float(v) for k, v in stats.items()}

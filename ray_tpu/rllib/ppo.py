@@ -37,6 +37,9 @@ class PPOConfig(AlgorithmConfig):
     n_actions: Optional[int] = None
     #: Box action spaces: diagonal-Gaussian policy (auto-detected)
     continuous: bool = False
+    #: >1: the learner update runs data-parallel over this many local
+    #: devices (params replicated, batch sharded, grads psum'd)
+    learner_devices: int = 1
 
     def policy_spec(self) -> PolicySpec:
         if self.obs_dim is None or self.n_actions is None:
@@ -86,7 +89,16 @@ class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
         _introspect_spaces(config)
         spec = config.policy_spec()
-        self.learner_policy = JaxPolicy(spec, seed=config.seed)
+        mesh = None
+        if config.learner_devices > 1:
+            from ray_tpu.parallel import MeshSpec, make_mesh
+            import jax
+
+            mesh = make_mesh(
+                MeshSpec(data=config.learner_devices),
+                devices=jax.devices()[:config.learner_devices])
+        self.learner_policy = JaxPolicy(spec, seed=config.seed,
+                                        mesh=mesh)
         self.workers = WorkerSet(
             num_workers=config.num_workers, env=config.env,
             env_config=config.env_config, policy_spec=spec,
