@@ -60,7 +60,11 @@ class GPT2Config:
     #: lax.scan unroll factor for the layer stack: >1 lets XLA overlap one
     #: layer's weight loads with the previous layer's compute.
     scan_unroll: int = 1
-    seq_parallel: bool = False  # ring attention over the mesh "seq" axis
+    seq_parallel: bool = False  # context parallelism over the "seq" axis
+    #: context-parallel algorithm: "ring" (kv blocks rotate by ppermute,
+    #: O(T/n) memory) or "ulysses" (head-scatter/seq-gather all-to-all —
+    #: cheaper collectives when heads >> seq shards)
+    sp_mode: str = "ring"
     #: >0 replaces every block's dense MLP with a mixture-of-experts FF
     #: (ray_tpu.models.moe) routed top-k over the `expert` mesh axis.
     n_experts: int = 0
@@ -256,7 +260,7 @@ def _attention(x, p, cfg: GPT2Config, rules):
                                 rules)
     o = None
     if cfg.seq_parallel:
-        o = _ring_attention_sharded(q, kk, v, rules)
+        o = _ring_attention_sharded(q, kk, v, rules, cfg.sp_mode)
     if o is None:
         from ray_tpu.ops.attention import causal_attention
         o = causal_attention(q, kk, v, use_flash=cfg.use_flash)
@@ -267,7 +271,7 @@ def _attention(x, p, cfg: GPT2Config, rules):
     return out + p["o_b"].astype(cfg.dtype)
 
 
-def _ring_attention_sharded(q, k, v, rules):
+def _ring_attention_sharded(q, k, v, rules, sp_mode: str = "ring"):
     """Context parallelism: the model stays GSPMD-partitioned, but
     attention (the one op coupling all sequence positions) drops into an
     explicit shard_map running ring attention over the "seq" mesh axis.
@@ -282,15 +286,17 @@ def _ring_attention_sharded(q, k, v, rules):
             return None
     except Exception:  # noqa: BLE001 - no mesh machinery available
         return None
-    from ray_tpu.ops.ring_attention import ring_attention
+    from ray_tpu.ops.ring_attention import (ring_attention,
+                                            ulysses_attention)
     from ray_tpu.parallel.sharding import logical_to_mesh_axes
 
     spec = logical_to_mesh_axes(("batch", "seq", "heads", "head_dim"),
                                 rules)
     import functools
 
+    fn = ulysses_attention if sp_mode == "ulysses" else ring_attention
     return jax.shard_map(
-        functools.partial(ring_attention, causal=True),
+        functools.partial(fn, causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
 
 

@@ -56,3 +56,29 @@ def test_gpt2_seq_parallel_grads_match():
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    atol=2e-4, rtol=2e-3,
                                    err_msg=str(path))
+
+
+def test_gpt2_ulysses_mode_matches_plain():
+    """sp_mode="ulysses": head-scatter/seq-gather context parallelism
+    gives the same loss as the unsharded model."""
+    base = gpt2_config("nano", use_flash=False, remat=False,
+                       dtype=jnp.float32)
+    sp = gpt2_config("nano", use_flash=False, remat=False,
+                     dtype=jnp.float32, seq_parallel=True,
+                     sp_mode="ulysses")
+    params = gpt2_init(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 65), 0,
+                                base.vocab_size)
+    batch = {"tokens": tokens}
+    expected = float(gpt2_loss(params, batch, base))
+
+    # nano has 2 heads: seq=2 divides them for the head-scatter
+    mesh = fake_mesh(8, MeshSpec(data=2, fsdp=2, seq=2))
+    axes = gpt2_logical_axes(sp)
+    with jax.set_mesh(mesh):
+        sharded = shard_params(params, axes, mesh)
+        shardings = param_shardings(axes, mesh)
+        f = jax.jit(lambda p, b: gpt2_loss(p, b, sp),
+                    in_shardings=(shardings, None))
+        got = float(f(sharded, batch))
+    assert abs(got - expected) < 1e-3
