@@ -1,0 +1,70 @@
+"""SAC learning gate on a continuous-control task (reference:
+release/rllib_tests learning tests; continuous counterpart of the
+PPO/DQN gates).  Pendulum-free: a bounded target-tracking env."""
+import json
+import os
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import SAC, SACConfig
+
+
+class TrackEnv:
+    """obs = [state one-hot]; reward = -(a - target[state])^2."""
+
+    class _Box:
+        shape = (1,)
+        low = np.array([-1.0])
+        high = np.array([1.0])
+
+    class _Obs:
+        shape = (4,)
+
+    def __init__(self, episode_len=20, seed=0):
+        self.observation_space = self._Obs()
+        self.action_space = self._Box()
+        self._rng = np.random.RandomState(seed)
+        self._len = episode_len
+        self._targets = np.array([-0.8, -0.3, 0.3, 0.8])
+        self._t = 0
+
+    def _obs(self):
+        self._state = self._rng.randint(4)
+        o = np.zeros(4, np.float32)
+        o[self._state] = 1.0
+        return o
+
+    def reset(self, seed=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        a = float(np.asarray(action).ravel()[0])
+        r = -(a - self._targets[self._state]) ** 2
+        self._t += 1
+        return self._obs(), r, self._t >= self._len, False, {}
+
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+cfg = SACConfig(env=lambda _=None: TrackEnv(), num_workers=2,
+                hidden=(64, 64), buffer_size=50_000,
+                learning_starts=400, train_batch_size=128,
+                train_intensity=32, lr=3e-3, gamma=0.0,
+                rollout_fragment_length=100, seed=1)
+algo = SAC(cfg)
+best, steps = -1e9, 0
+for i in range(12 if fast else 80):
+    res = algo.train()
+    steps = res["timesteps_total"]
+    best = max(best, res.get("episode_reward_mean", -1e9))
+    if best >= -1.0:
+        break
+print(json.dumps({"episode_reward_mean": best, "env_steps": steps}),
+      flush=True)
+try:
+    algo.stop()
+    ray_tpu.shutdown()
+except BaseException:
+    pass
