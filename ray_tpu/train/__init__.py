@@ -21,11 +21,13 @@ from ray_tpu.train.torch_backend import (TorchConfig, TorchTrainer,
                                          prepare_data_loader,
                                          prepare_model)
 
+from ray_tpu.train.grad_accum import accumulated_train_step
 from ray_tpu.train.checkpointing import (latest_step, restore_sharded,
                                          save_sharded,
                                          sharded_checkpoint_to_air)
 
 __all__ = [
+    "accumulated_train_step",
     "save_sharded", "restore_sharded", "latest_step",
     "sharded_checkpoint_to_air",
     "session", "Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",

@@ -1,0 +1,65 @@
+"""Gradient accumulation: fit a large effective batch in bounded HBM.
+
+The standard TPU recipe when the wanted global batch exceeds device
+memory at full activation size: split the batch into microbatches,
+accumulate gradients across them inside ONE jitted step (a `lax.scan`,
+so one dispatch and one optimizer update per effective batch), and
+apply the update once.  Pairs with per-microbatch `jax.checkpoint`
+already inside the models.
+
+No reference analog at the framework level (torch users hand-roll
+`loss.backward()` loops); here it's a first-class loop util because the
+jit boundary placement (scan INSIDE the step) is the part people get
+wrong — an outer Python loop would re-dispatch and re-transfer per
+microbatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+__all__ = ["accumulated_train_step"]
+
+
+def accumulated_train_step(loss_fn: Callable, tx, *,
+                           num_microbatches: int) -> Callable:
+    """Build `step(params, opt_state, batch) -> (params, opt_state,
+    loss)` that averages gradients over `num_microbatches` slices of the
+    leading batch axis before applying ONE optimizer update.
+
+    loss_fn(params, microbatch) -> scalar loss.  Every leaf of `batch`
+    must have a leading axis divisible by num_microbatches.  The
+    returned step is NOT jitted — wrap it in jax.jit (with your
+    shardings) at the call site."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    n = num_microbatches
+
+    def step(params, opt_state, batch) -> Tuple[Any, Any, jnp.ndarray]:
+        def split(v):
+            b = v.shape[0]
+            if b % n:
+                raise ValueError(
+                    f"batch axis {b} not divisible by "
+                    f"num_microbatches={n}")
+            return v.reshape(n, b // n, *v.shape[1:])
+
+        micro = jax.tree.map(split, batch)
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def body(carry, mb):
+            gsum, lsum = carry
+            loss, grads = grad_fn(params, mb)
+            gsum = jax.tree.map(jnp.add, gsum, grads)
+            return (gsum, lsum + loss), None
+
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        (gsum, lsum), _ = jax.lax.scan(body, (zeros, jnp.float32(0.0)),
+                                       micro)
+        grads = jax.tree.map(lambda g: g / n, gsum)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), new_opt, lsum / n)
+
+    return step
