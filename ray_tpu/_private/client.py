@@ -525,7 +525,9 @@ class CoreWorker:
         for info in lin["dep_pins"]:
             self.add_local_ref(info)
         with self._ref_lock:
-            for i in range(num_returns):
+            # dynamic tasks: the primary registers here; item oids
+            # attach to the same entry at reply time (_ingest_returns)
+            for i in range(1 if num_returns == -1 else num_returns):
                 roid = ObjectID.for_return(task_id, i + 1).binary()
                 if roid not in self._freed:
                     self._lineage[roid] = lin
@@ -1084,8 +1086,11 @@ class CoreWorker:
                     ) -> List["ObjectRefInfo"]:
         self._task_counter += 1
         task_id = TaskID.for_task(self.job_id)
+        # dynamic (-1): one primary ref now; the items materialize as
+        # for_return(i+2) objects reported on the task reply
+        n_refs = 1 if num_returns == -1 else num_returns
         return_ids = [ObjectID.for_return(task_id, i + 1).binary()
-                      for i in range(num_returns)]
+                      for i in range(n_refs)]
         for oid in return_ids:
             self._ensure_entry(oid)
         skey = self._scheduling_key(resources, pg)
@@ -1122,7 +1127,7 @@ class CoreWorker:
         try:
             dep_error = await self._async_resolve_deps(args, kwargs)
             if dep_error is not None:
-                for i in range(num_returns):
+                for i in range(1 if num_returns == -1 else num_returns):
                     oid = ObjectID.for_return(task_id, i + 1).binary()
                     self._store_local(oid, dep_error, True)
                 return
@@ -1161,7 +1166,7 @@ class CoreWorker:
         err = exc if isinstance(exc, exceptions.RayTpuError) else \
             exceptions.RayTaskError(repr(exc), "")
         data = serialization.serialize_error(err).to_bytes()
-        for i in range(spec["num_returns"]):
+        for i in range(1 if spec["num_returns"] == -1 else spec["num_returns"]):
             oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
             self._store_local(oid, data, True)
 
@@ -1311,7 +1316,7 @@ class CoreWorker:
     def _fail_task_user_error(self, spec, e: protocol.RpcError):
         err = exceptions.RayTaskError(str(e), e.remote_traceback)
         data = serialization.serialize_error(err).to_bytes()
-        for i in range(spec["num_returns"]):
+        for i in range(1 if spec["num_returns"] == -1 else spec["num_returns"]):
             oid = ObjectID.for_return(TaskID(spec["task_id"]), i + 1).binary()
             self._store_local(oid, data, True)
 
@@ -1331,6 +1336,22 @@ class CoreWorker:
         nested ObjectRefs (the worker is then holding pins that must be
         released via release_return_pins once our own borrows are acked)."""
         had_contained = False
+        if spec["num_returns"] == -1:
+            # dynamic items: key each item oid into the task's lineage
+            # entry (registered under the primary at submit) so lost
+            # items reconstruct by re-executing the task — re-execution
+            # derives the same for_return oids.
+            primary = ObjectID.for_return(TaskID(spec["task_id"]),
+                                          1).binary()
+            with self._ref_lock:
+                lin = self._lineage.get(primary)
+                if lin is not None:
+                    for ret in reply["returns"]:
+                        oid = ret["oid"]
+                        if oid != primary and oid not in self._lineage \
+                                and oid not in self._freed:
+                            self._lineage[oid] = lin
+                            lin["live_returns"] += 1
         for ret in reply["returns"]:
             oid = ret["oid"]
             with self._ref_lock:

@@ -197,13 +197,36 @@ class WorkerServer:
                 self._user_loop = loop
             return self._user_loop
 
+    def _build_return_entry(self, oid, value, ret_pins: list) -> dict:
+        """Serialize one task return into a reply entry (inline or shm),
+        collecting embedded refs into the contained/bridge-pin protocol
+        (client.py hold_return_pins / release_return_pins)."""
+        ser, collected = self.cw._serialize_collecting(value)
+        entry = {"oid": oid.binary()}
+        if collected:
+            entry["contained"] = [
+                (i.oid, i.owner, i.node_address) for i in collected]
+            for info in collected:
+                self.cw.add_local_ref(info)
+            ret_pins.extend(collected)
+        if ser.total_size <= self.config.max_inline_object_size:
+            entry["d"] = ser.to_bytes()
+        else:
+            self.cw._put_shm(oid, ser)
+            # carry the executing node's address: a cross-node submitter
+            # must pull the object to its own store
+            entry["in_store"] = True
+            entry["node"] = self.cw.node_address
+        return entry
+
     def _execute(self, spec: dict, fn) -> list:
         """Run user code; build the returns list for the RPC reply.
         [HOT LOOP — analog of _raylet.pyx:672 execute_task]."""
         task_id = spec["task_id"]
         num_returns = spec["num_returns"]
+        dynamic = num_returns == -1
         return_oids = [ObjectID.for_return(TaskID(task_id), i + 1)
-                       for i in range(num_returns)]
+                       for i in range(1 if dynamic else num_returns)]
         # Thread-local so concurrent actor threads don't clobber each other.
         worker_context.set_task_context(task_id, spec.get("actor_id", b""))
         with self._cancel_lock:
@@ -215,7 +238,8 @@ class WorkerServer:
                 data = serialization.serialize_error(err).to_bytes()
                 return [{"oid": ObjectID.for_return(
                     TaskID(task_id), i + 1).binary(), "d": data,
-                    "err": True} for i in range(num_returns)]
+                    "err": True} for i in range(1 if dynamic else
+                                                num_returns)]
             self._running_tasks[task_id] = threading.get_ident()
         ev = {"task_id": task_id.hex(), "name": spec.get("name", "")
               or spec.get("method", "task"),
@@ -261,6 +285,38 @@ class WorkerServer:
                     self._running_async.pop(task_id, None)
             if num_returns == 0:
                 return []
+            if dynamic:
+                # generator task (num_returns="dynamic"): stream each
+                # yielded item into its own caller-owned return
+                # for_return(i+2..) AS PRODUCED (peak memory = one item,
+                # the point of generator tasks), then emit the primary
+                # return as the list of item refs — the nested-return
+                # pin/contained machinery keeps items alive until the
+                # caller registers.
+                import collections.abc
+
+                if not isinstance(result, collections.abc.Iterator):
+                    raise TypeError(
+                        "num_returns='dynamic' tasks must return a "
+                        f"generator/iterator, got {type(result).__name__}")
+                from ray_tpu._private.worker_context import ObjectRef
+
+                caller = spec["caller"]
+                caller_addr = spec.get("caller_addr", "")
+                out = []
+                ret_pins = []
+                item_refs = []
+                for i, item in enumerate(result):
+                    oid = ObjectID.for_return(TaskID(task_id), i + 2)
+                    out.append(self._build_return_entry(oid, item,
+                                                        ret_pins))
+                    item_refs.append(ObjectRef(ObjectRefInfo(
+                        oid.binary(), caller, caller_addr)))
+                out.insert(0, self._build_return_entry(
+                    return_oids[0], item_refs, ret_pins))
+                if ret_pins:
+                    self.cw.hold_return_pins(task_id, ret_pins)
+                return out
             values = (result,) if num_returns == 1 else tuple(result)
             if num_returns > 1 and len(values) != num_returns:
                 raise ValueError(
@@ -269,27 +325,7 @@ class WorkerServer:
             out = []
             ret_pins = []
             for oid, value in zip(return_oids, values):
-                ser, collected = self.cw._serialize_collecting(value)
-                entry = {"oid": oid.binary()}
-                if collected:
-                    # Refs embedded in the return: report them to the
-                    # caller (the return's owner pins them as contained)
-                    # and bridge-pin them here until it confirms
-                    # (client.py hold_return_pins / release_return_pins).
-                    entry["contained"] = [
-                        (i.oid, i.owner, i.node_address) for i in collected]
-                    for info in collected:
-                        self.cw.add_local_ref(info)
-                    ret_pins.extend(collected)
-                if ser.total_size <= self.config.max_inline_object_size:
-                    entry["d"] = ser.to_bytes()
-                else:
-                    self.cw._put_shm(oid, ser)
-                    # carry the executing node's address: a cross-node
-                    # submitter must pull the object to its own store
-                    entry["in_store"] = True
-                    entry["node"] = self.cw.node_address
-                out.append(entry)
+                out.append(self._build_return_entry(oid, value, ret_pins))
             if ret_pins:
                 self.cw.hold_return_pins(task_id, ret_pins)
             return out

@@ -60,6 +60,11 @@ class ActorHandle:
     def _invoke(self, method: str, args, kwargs, opts):
         cw = worker_context.core_worker()
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "dynamic":
+            raise ValueError(
+                "num_returns='dynamic' is not supported for actor "
+                "methods (only stateless tasks); return a list of "
+                "ray_tpu.put refs instead")
         refs = cw.submit_actor_task(self._actor_id, method, args, kwargs,
                                     num_returns=num_returns)
         wrapped = [ObjectRef(r) for r in refs]

@@ -106,6 +106,12 @@ class RemoteFunction:
         fid = self._ensure_exported(cw)
         opts = self._options
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "dynamic":
+            # generator task: the count of returns is decided by the
+            # task at run time (reference: num_returns="dynamic" /
+            # ObjectRefGenerator).  get() on the returned ref yields the
+            # list of per-item ObjectRefs.
+            num_returns = -1
         refs = cw.submit_task(
             fid, args, kwargs,
             num_returns=num_returns,
@@ -117,6 +123,6 @@ class RemoteFunction:
         wrapped = [ObjectRef(r) for r in refs]
         if num_returns == 0:
             return None
-        if num_returns == 1:
+        if num_returns in (1, -1):
             return wrapped[0]
         return wrapped
