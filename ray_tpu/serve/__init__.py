@@ -2,10 +2,10 @@
 
 from ray_tpu.serve.api import (Deployment, delete, deployment,
                                get_deployment_handle, run, shutdown,
-                               start_http_proxy)
+                               start_http_proxy, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
-           "start_http_proxy", "batch"]
+           "start_http_proxy", "batch", "status"]

@@ -141,6 +141,15 @@ def start_http_proxy(port: int = 8000, host: str = "127.0.0.1") -> str:
     return ray_tpu.get(proxy.address.remote(), timeout=30)
 
 
+def status() -> dict:
+    """Cluster serve status: {deployment: {status, replicas, ...}}
+    (reference: serve.status())."""
+    import ray_tpu
+
+    controller = _get_or_create_controller()
+    return ray_tpu.get(controller.status.remote(), timeout=30.0)
+
+
 def delete(name: str) -> None:
     import ray_tpu
 

@@ -110,6 +110,25 @@ class ServeController:
         with self._lock:
             return sorted(self.deployments)
 
+    def status(self) -> Dict[str, Any]:
+        """Deployment statuses (reference: serve.status() /
+        StatusOverview): replica counts, autoscaling mode, route."""
+        with self._lock:
+            out = {}
+            routes = {v: k for k, v in self.routes.items()}
+            for name, d in self.deployments.items():
+                out[name] = {
+                    "status": "HEALTHY" if d["replicas"] else "UNHEALTHY",
+                    "replicas": len(d["replicas"]),
+                    "target_replicas": d["config"].get("num_replicas",
+                                                       len(d["replicas"])),
+                    "autoscaling": bool(
+                        d["config"].get("autoscaling_config")),
+                    "version": d["version"],
+                    "route": routes.get(name),
+                }
+            return out
+
     # -- reconciliation ---------------------------------------------------
     def _reconcile_loop(self):
         import ray_tpu

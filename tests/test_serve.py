@@ -197,3 +197,19 @@ def test_deployment_graph_composition(serve_cluster):
     assert h.call(0, timeout=60) == 1
     serve.delete("Model")
     serve.delete("Preprocessor")
+
+
+def test_serve_status(ray_start_shared):
+    @serve.deployment(num_replicas=2)
+    class Echo2:
+        def __call__(self, x):
+            return x
+
+    serve.run(Echo2.bind())
+    try:
+        st = serve.status()
+        assert st["Echo2"]["status"] == "HEALTHY"
+        assert st["Echo2"]["replicas"] == 2
+        assert st["Echo2"]["autoscaling"] is False
+    finally:
+        serve.shutdown()
