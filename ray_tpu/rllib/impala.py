@@ -79,17 +79,21 @@ class IMPALAConfig(AlgorithmConfig):
     max_requests_in_flight_per_worker: int = 2
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
+    #: >1: the v-trace update runs data-parallel over this many local
+    #: devices (fragment batch sharded on B, grads psum'd by GSPMD)
+    learner_devices: int = 1
 
 
 class IMPALAPolicy:
     """Actor-critic policy with the v-trace actor-critic update as ONE
     jitted call over a time-major fragment batch."""
 
-    def __init__(self, cfg: IMPALAConfig, seed: int = 0):
+    def __init__(self, cfg: IMPALAConfig, seed: int = 0, mesh=None):
         import jax
         import optax
 
         self.cfg = cfg
+        self.mesh = mesh
         kp, kv = jax.random.split(jax.random.PRNGKey(seed))
         self.params = {
             "pi": _net_init(kp, (cfg.obs_dim, *cfg.hidden, cfg.n_actions)),
@@ -141,14 +145,36 @@ class IMPALAPolicy:
         self._update = update
 
     def stage(self, host_batch: Dict[str, np.ndarray]):
-        """Async host→device transfer (the loader-thread replacement)."""
+        """Async host→device transfer (the loader-thread replacement).
+        With a learner mesh, arrays land already sharded on the batch
+        axis (time-major fragments: (T,B,...) shard on axis 1; last_obs
+        (B,D) on axis 0)."""
         import jax
 
-        return jax.tree.map(jax.device_put, host_batch)
+        if self.mesh is None:
+            return jax.tree.map(jax.device_put, host_batch)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        out = {}
+        for k, v in host_batch.items():
+            spec = P("data") if k == "last_obs" else P(None, "data")
+            out[k] = jax.device_put(v, NamedSharding(self.mesh, spec))
+        return out
 
     def learn_staged(self, dev_batch) -> Dict[str, Any]:
         """Dispatch the update; returns DEVICE stats (not synced — the
         caller fetches once per training_step)."""
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+            with jax.set_mesh(self.mesh):
+                self.params, self.opt_state, stats = self._update(
+                    self.params, self.opt_state, dev_batch)
+            return stats
         self.params, self.opt_state, stats = self._update(
             self.params, self.opt_state, dev_batch)
         return stats
@@ -168,7 +194,16 @@ class IMPALA(Algorithm):
         from ray_tpu.rllib.rollout_worker import TrajectoryWorker
 
         _introspect_spaces(config)
-        self.policy = IMPALAPolicy(config, seed=config.seed)
+        mesh = None
+        if config.learner_devices > 1:
+            import jax
+
+            from ray_tpu.parallel import MeshSpec, make_mesh
+
+            mesh = make_mesh(
+                MeshSpec(data=config.learner_devices),
+                devices=jax.devices()[:config.learner_devices])
+        self.policy = IMPALAPolicy(config, seed=config.seed, mesh=mesh)
         spec = PolicySpec(obs_dim=config.obs_dim,
                           n_actions=config.n_actions,
                           hidden=tuple(config.hidden), lr=config.lr)
