@@ -9,6 +9,28 @@ from typing import Any, Dict, List, Union
 import numpy as np
 
 
+def _tensor_array(arr: np.ndarray):
+    """N-D numpy column -> nested FixedSizeList arrow array (the role of
+    the reference's ArrowTensorArray extension, data/extensions/
+    tensor_extension.py): rows keep their (possibly multi-dim) shape
+    through the block format and reassemble to numpy in format_batch."""
+    import pyarrow as pa
+
+    flat = pa.array(arr.reshape(-1))
+    for dim in reversed(arr.shape[1:]):
+        flat = pa.FixedSizeListArray.from_arrays(flat, dim)
+    return flat
+
+
+def _column_array(v):
+    import pyarrow as pa
+
+    arr = np.asarray(v)
+    if arr.ndim > 1:
+        return _tensor_array(arr)
+    return pa.array(arr)
+
+
 def to_table(data) -> "pyarrow.Table":
     import pandas as pd
     import pyarrow as pa
@@ -18,10 +40,10 @@ def to_table(data) -> "pyarrow.Table":
     if isinstance(data, pd.DataFrame):
         return pa.Table.from_pandas(data, preserve_index=False)
     if isinstance(data, dict):
-        return pa.table({k: np.asarray(v) for k, v in data.items()})
+        return pa.table({k: _column_array(v) for k, v in data.items()})
     if isinstance(data, np.ndarray):
-        return pa.table({"value": data} if data.ndim == 1 else
-                        {"value": list(data)})
+        return pa.table({"value": pa.array(data) if data.ndim == 1 else
+                         _tensor_array(data)})
     if isinstance(data, list):
         if data and isinstance(data[0], dict):
             cols: Dict[str, List[Any]] = {}
@@ -39,8 +61,29 @@ def format_batch(table, batch_format: str):
     if batch_format == "pandas":
         return table.to_pandas()
     if batch_format in ("numpy", "dict", "default"):
-        return {name: col.to_numpy(zero_copy_only=False)
-                for name, col in zip(table.column_names, table.columns)}
+        import pyarrow as pa
+
+        out = {}
+        for name, col in zip(table.column_names, table.columns):
+            typ = col.type
+            if pa.types.is_fixed_size_list(typ):
+                # tensor column: unnest FixedSizeList levels back to the
+                # original (rows, *dims) numpy shape
+                dims = []
+                inner = typ
+                while pa.types.is_fixed_size_list(inner):
+                    dims.append(inner.list_size)
+                    inner = inner.value_type
+                arr = col.combine_chunks()
+                flat = arr
+                while hasattr(flat, "flatten") and \
+                        pa.types.is_fixed_size_list(flat.type):
+                    flat = flat.flatten()
+                out[name] = flat.to_numpy(zero_copy_only=False).reshape(
+                    (len(col), *dims))
+            else:
+                out[name] = col.to_numpy(zero_copy_only=False)
+        return out
     raise ValueError(f"unknown batch_format {batch_format!r}")
 
 

@@ -100,3 +100,16 @@ class Algorithm:
 
         trainable.__name__ = cls.__name__
         return trainable
+
+
+def learner_mesh(learner_devices: int):
+    """Local data mesh for multi-device learner updates (shared by
+    PPO/IMPALA/DQN setup); None when learner_devices <= 1."""
+    if learner_devices <= 1:
+        return None
+    import jax
+
+    from ray_tpu.parallel import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=learner_devices),
+                     devices=jax.devices()[:learner_devices])

@@ -41,11 +41,12 @@ class QPolicy:
     """Epsilon-greedy Q policy; the update is a jitted scan over
     presampled minibatches with a carried target network."""
 
-    def __init__(self, spec: QPolicySpec, seed: int = 0):
+    def __init__(self, spec: QPolicySpec, seed: int = 0, mesh=None):
         import jax
         import optax
 
         self.spec = spec
+        self.mesh = mesh
         self.params = _net_init(jax.random.PRNGKey(seed),
                                 (spec.obs_dim, *spec.hidden,
                                  spec.n_actions))
@@ -159,6 +160,25 @@ class QPolicy:
         updates."""
         import jax.numpy as jnp
 
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rows = NamedSharding(self.mesh, P(None, "data"))
+            repl = NamedSharding(self.mesh, P())
+            # stack on HOST: one sharded transfer instead of a default-
+            # device upload followed by a device-to-device reshard
+            stacked = {k: jax.device_put(
+                np.stack([m[k] for m in minis]), rows)
+                for k in minis[0].keys()}
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+            self.target_params = jax.device_put(self.target_params, repl)
+            with jax.set_mesh(self.mesh):
+                self.params, self.opt_state, loss, tds = self._update(
+                    self.params, self.opt_state, self.target_params,
+                    stacked)
+            return float(loss), np.asarray(tds)
         stacked = {k: jnp.stack([m[k] for m in minis])
                    for k in minis[0].keys()}
         self.params, self.opt_state, loss, tds = self._update(
@@ -248,6 +268,8 @@ class DQNConfig(AlgorithmConfig):
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
+    #: >1: the TD update runs data-parallel over this many local devices
+    learner_devices: int = 1
 
     def q_spec(self) -> QPolicySpec:
         return QPolicySpec(obs_dim=self.obs_dim,
@@ -264,7 +286,16 @@ class DQN(Algorithm):
 
         _introspect_spaces(config)
         spec = config.q_spec()
-        self.policy = QPolicy(spec, seed=config.seed)
+        if config.learner_devices > 1 and \
+                config.train_batch_size % config.learner_devices:
+            raise ValueError(
+                f"train_batch_size={config.train_batch_size} must divide "
+                f"by learner_devices={config.learner_devices} (the "
+                f"minibatch row axis shards across the mesh)")
+        from ray_tpu.rllib.algorithm import learner_mesh
+
+        self.policy = QPolicy(spec, seed=config.seed,
+                              mesh=learner_mesh(config.learner_devices))
         if config.prioritized_replay:
             self.buffer: ReplayBuffer = PrioritizedReplayBuffer(
                 config.buffer_size, alpha=config.prioritized_alpha,

@@ -194,16 +194,17 @@ class IMPALA(Algorithm):
         from ray_tpu.rllib.rollout_worker import TrajectoryWorker
 
         _introspect_spaces(config)
-        mesh = None
-        if config.learner_devices > 1:
-            import jax
+        if config.learner_devices > 1 and \
+                config.num_envs_per_worker % config.learner_devices:
+            raise ValueError(
+                f"num_envs_per_worker={config.num_envs_per_worker} must "
+                f"divide by learner_devices={config.learner_devices} "
+                f"(the fragment batch axis shards across the mesh)")
+        from ray_tpu.rllib.algorithm import learner_mesh
 
-            from ray_tpu.parallel import MeshSpec, make_mesh
-
-            mesh = make_mesh(
-                MeshSpec(data=config.learner_devices),
-                devices=jax.devices()[:config.learner_devices])
-        self.policy = IMPALAPolicy(config, seed=config.seed, mesh=mesh)
+        self.policy = IMPALAPolicy(
+            config, seed=config.seed,
+            mesh=learner_mesh(config.learner_devices))
         spec = PolicySpec(obs_dim=config.obs_dim,
                           n_actions=config.n_actions,
                           hidden=tuple(config.hidden), lr=config.lr)

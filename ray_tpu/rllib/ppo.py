@@ -89,16 +89,11 @@ class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
         _introspect_spaces(config)
         spec = config.policy_spec()
-        mesh = None
-        if config.learner_devices > 1:
-            from ray_tpu.parallel import MeshSpec, make_mesh
-            import jax
+        from ray_tpu.rllib.algorithm import learner_mesh
 
-            mesh = make_mesh(
-                MeshSpec(data=config.learner_devices),
-                devices=jax.devices()[:config.learner_devices])
-        self.learner_policy = JaxPolicy(spec, seed=config.seed,
-                                        mesh=mesh)
+        self.learner_policy = JaxPolicy(
+            spec, seed=config.seed,
+            mesh=learner_mesh(config.learner_devices))
         self.workers = WorkerSet(
             num_workers=config.num_workers, env=config.env,
             env_config=config.env_config, policy_spec=spec,
