@@ -42,12 +42,13 @@ class SACPolicy:
 
     Actions live in [-1, 1]; callers rescale to env bounds."""
 
-    def __init__(self, spec: SACSpec, seed: int = 0):
+    def __init__(self, spec: SACSpec, seed: int = 0, mesh=None):
         import jax
         import jax.numpy as jnp
         import optax
 
         self.spec = spec
+        self.mesh = mesh
         ka, k1, k2 = jax.random.split(jax.random.PRNGKey(seed), 3)
         obs, act = spec.obs_dim, spec.action_dim
         self.params = {
@@ -197,6 +198,24 @@ class SACPolicy:
                              ) -> Dict[str, float]:
         import jax.numpy as jnp
 
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rows = NamedSharding(self.mesh, P(None, "data"))
+            repl = NamedSharding(self.mesh, P())
+            stacked = {k: jax.device_put(
+                np.stack([m[k] for m in minis]), rows)
+                for k in minis[0].keys()}
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+            self.target = jax.device_put(self.target, repl)
+            with jax.set_mesh(self.mesh):
+                (self.params, self.opt_state, self.target, stats,
+                 self._rng) = self._update(self.params, self.opt_state,
+                                           self.target, stacked,
+                                           self._rng)
+            return {k: float(v) for k, v in stats.items()}
         stacked = {k: jnp.stack([m[k] for m in minis])
                    for k in minis[0].keys()}
         (self.params, self.opt_state, self.target, stats,
@@ -285,6 +304,8 @@ class SACConfig(AlgorithmConfig):
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     action_dim: Optional[int] = None
+    #: >1: the SAC update runs data-parallel over this many local devices
+    learner_devices: int = 1
 
     def sac_spec(self) -> SACSpec:
         return SACSpec(obs_dim=self.obs_dim, action_dim=self.action_dim,
@@ -315,7 +336,15 @@ class SAC(Algorithm):
             finally:
                 env.close() if hasattr(env, "close") else None
         spec = config.sac_spec()
-        self.policy = SACPolicy(spec, seed=config.seed)
+        if config.learner_devices > 1 and \
+                config.train_batch_size % config.learner_devices:
+            raise ValueError(
+                f"train_batch_size={config.train_batch_size} must divide "
+                f"by learner_devices={config.learner_devices}")
+        from ray_tpu.rllib.algorithm import learner_mesh
+
+        self.policy = SACPolicy(spec, seed=config.seed,
+                                mesh=learner_mesh(config.learner_devices))
         self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
         remote_cls = ray_tpu.remote(
             num_cpus=config.num_cpus_per_worker)(
