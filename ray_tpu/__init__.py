@@ -387,9 +387,19 @@ class _RuntimeContext:
     def actor_id(self) -> bytes:
         return worker_context.current_actor_id()
 
+    def get_tpu_ids(self) -> List[int]:
+        """Physical TPU chip indices granted to this worker process via
+        its TPU_VISIBLE_CHIPS visibility grant (reference analog:
+        ray.get_gpu_ids / worker.py:821 from CUDA_VISIBLE_DEVICES)."""
+        import os
+
+        csv = os.environ.get("TPU_VISIBLE_CHIPS", "")
+        return [int(c) for c in csv.split(",") if c.strip()]
+
     def get(self) -> dict:
         return {"job_id": self.job_id, "node_id": self.node_id,
-                "task_id": self.task_id, "actor_id": self.actor_id}
+                "task_id": self.task_id, "actor_id": self.actor_id,
+                "tpu_ids": self.get_tpu_ids()}
 
 
 def get_runtime_context() -> _RuntimeContext:

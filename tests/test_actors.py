@@ -187,3 +187,23 @@ def test_detached_named_actor_lookup(ray_start_regular):
     Counter.options(name="det", lifetime="detached").remote()
     h = ray_tpu.get_actor("det")
     assert ray_tpu.get(h.read.remote()) == 0
+
+
+def test_get_tpu_ids_visibility_grant(ray_start_shared):
+    """get_runtime_context().get_tpu_ids() reflects the worker's
+    TPU_VISIBLE_CHIPS grant (ray.get_gpu_ids analog).  Driver-side it
+    is empty; inside a worker it matches the chip env."""
+    assert ray_tpu.get_runtime_context().get_tpu_ids() == []
+
+    @ray_tpu.remote
+    def whoami():
+        import os
+
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_tpu_ids(), os.environ.get("TPU_VISIBLE_CHIPS", "")
+
+    ids, env = ray_tpu.get(whoami.remote())
+    if env:
+        assert ids == [int(c) for c in env.split(",")]
+    else:
+        assert ids == []
