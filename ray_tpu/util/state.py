@@ -79,3 +79,50 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+class profile_device:
+    """Capture an XLA/TPU device trace alongside the task timeline
+    (reference gap noted in SURVEY §5.1: the reference merges Ray task
+    events only; JAX's profiler captures the device side).
+
+    Usage:
+        with ray_tpu.util.state.profile_device("/tmp/trace"):
+            train_step(...)
+        ray_tpu.timeline("tasks.json")   # task-level chrome trace
+
+    The device trace lands in TensorBoard/XProf format under `logdir`
+    ("tensorboard --logdir" or xprof to view); the task timeline stays
+    chrome-trace.  The two share wall-clock timestamps, so aligning a
+    slow task with its device activity is a same-axis comparison.
+    Degrades to a no-op (with a warning) where the backend has no
+    profiler support (e.g. some tunneled TPU plugins).
+    """
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._active = False
+
+    def __enter__(self):
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        except Exception as e:  # noqa: BLE001 - no profiler support
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "device profiler unavailable (%s); task timeline still "
+                "records", e)
+        return self
+
+    def __exit__(self, *exc):
+        if self._active:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+        return False
