@@ -89,6 +89,35 @@ class WorkerHandle:
         self.oom_killed = False
 
 
+def pick_tpu_chips(free: List[int], need: int) -> List[int]:
+    """ICI-aware chip selection: prefer a CONTIGUOUS run of chip indices
+    (on-host TPU chips are wired so that index-adjacent chips are ICI
+    neighbors on the standard v4/v5e host layouts), so a multi-chip
+    grant forms a connected mesh instead of an arbitrary scatter —
+    SURVEY §7's "ICI neighbor awareness in the scheduler" (the reference
+    has no TPU topology model at all).  Falls back to the lowest free
+    indices when no contiguous run exists; also prefers the SMALLEST
+    adequate run to keep large runs intact for future big grants
+    (best-fit, like the allocator in objstore.cc)."""
+    if need <= 1:
+        return free[:need]
+    runs: List[List[int]] = []
+    ordered = sorted(free)
+    run = [ordered[0]]
+    for c in ordered[1:]:
+        if c == run[-1] + 1:
+            run.append(c)
+        else:
+            runs.append(run)
+            run = [c]
+    runs.append(run)
+    fitting = [r for r in runs if len(r) >= need]
+    if fitting:
+        best = min(fitting, key=len)  # best-fit: smallest adequate run
+        return best[:need]
+    return ordered[:need]  # fragmented: lowest indices
+
+
 def pick_oom_victim(workers) -> Optional["WorkerHandle"]:
     """Retriable-LIFO worker killing policy (reference:
     worker_killing_policy.h:58 RetriableLIFOWorkerKillingPolicy).
@@ -429,7 +458,9 @@ class NodeManager:
                 raise RuntimeError(
                     f"no free TPU chips for grant {tpu_grant} "
                     f"(free={self._tpu_chips_free})")
-            chips = [self._tpu_chips_free.pop(0) for _ in range(need)]
+            chips = pick_tpu_chips(self._tpu_chips_free, need)
+            for c in chips:
+                self._tpu_chips_free.remove(c)
             csv = ",".join(str(c) for c in chips)
             env["TPU_VISIBLE_CHIPS"] = csv
             env["TPU_VISIBLE_DEVICES"] = csv

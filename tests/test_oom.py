@@ -127,3 +127,20 @@ def test_oom_kill_restarts_actor(oom_cluster):
     else:
         pytest.fail("actor was not OOM-killed/restarted")
     assert ray_tpu.get(c.bump.remote()) == 1
+
+
+def test_pick_tpu_chips_prefers_contiguous_runs():
+    """ICI-aware chip selection: contiguous runs win, best-fit keeps
+    large runs intact, fragmented pools fall back to lowest indices."""
+    from ray_tpu._private.node_manager import pick_tpu_chips
+
+    # free = two runs: [0..3] and [6..7]; need 2 -> take the SMALL run
+    assert pick_tpu_chips([0, 1, 2, 3, 6, 7], 2) == [6, 7]
+    # need 4 -> only the big run fits
+    assert pick_tpu_chips([0, 1, 2, 3, 6, 7], 4) == [0, 1, 2, 3]
+    # fragmented: no run of 3 -> lowest indices
+    assert pick_tpu_chips([0, 2, 4, 6], 3) == [0, 2, 4]
+    # single chip: first free
+    assert pick_tpu_chips([5, 1], 1) == [5]
+    # unsorted input handled
+    assert pick_tpu_chips([7, 6, 3, 2, 1, 0], 2) == [6, 7]
