@@ -40,6 +40,9 @@ class PPOConfig(AlgorithmConfig):
     #: >1: the learner update runs data-parallel over this many local
     #: devices (params replicated, batch sharded, grads psum'd)
     learner_devices: int = 1
+    #: "MeanStdFilter" = running obs normalization in rollout workers,
+    #: synced+merged across workers every training_step
+    observation_filter: str = "NoFilter"
 
     def policy_spec(self) -> PolicySpec:
         if self.obs_dim is None or self.n_actions is None:
@@ -101,7 +104,8 @@ class PPO(Algorithm):
             rollout_fragment_length=config.rollout_fragment_length,
             gamma=config.gamma, lam=config.lam,
             num_cpus_per_worker=config.num_cpus_per_worker,
-            seed=config.seed)
+            seed=config.seed,
+            observation_filter=config.observation_filter)
         self.workers.sync_weights(self.learner_policy.get_weights())
 
     def training_step(self) -> Dict[str, Any]:
@@ -120,6 +124,11 @@ class PPO(Algorithm):
 
         stats = self.learner_policy.learn_on_batch(batch)
         self.workers.sync_weights(self.learner_policy.get_weights())
+        if config_filter := getattr(self.config, "observation_filter",
+                                    "NoFilter"):
+            if config_filter != "NoFilter":
+                self._filter_state = self.workers.sync_filters(
+                    getattr(self, "_filter_state", None))
         self._episode_returns.extend(self.workers.episode_returns())
         stats["timesteps_this_iter"] = batch.count
         return stats

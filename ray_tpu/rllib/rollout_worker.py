@@ -31,7 +31,8 @@ class RolloutWorker:
     def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
                  policy_spec: PolicySpec, num_envs: int = 1,
                  gamma: float = 0.99, lam: float = 0.95,
-                 rollout_fragment_length: int = 200, seed: int = 0):
+                 rollout_fragment_length: int = 200, seed: int = 0,
+                 observation_filter: str = "NoFilter"):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -45,6 +46,7 @@ class RolloutWorker:
         self._action_shape = tuple(getattr(space, "shape", ()) or ())
         self._action_low = getattr(space, "low", None)
         self._action_high = getattr(space, "high", None)
+
         self.gamma = gamma
         self.lam = lam
         self.fragment = rollout_fragment_length
@@ -52,6 +54,17 @@ class RolloutWorker:
                      for i, e in enumerate(self.envs)]
         self._ep_rewards = [0.0] * num_envs
         self.episode_returns: List[float] = []
+        # Observation filter: the LOCAL filter normalizes (and keeps
+        # updating between syncs); the DELTA filter accumulates only the
+        # raw observations seen since the last sync — the
+        # FilterManager.synchronize buffer design, so the coordinator can
+        # Chan-merge disjoint deltas without double-counting history.
+        from ray_tpu.rllib.filters import make_filter
+
+        self._filter_name = observation_filter
+        obs_shape = np.shape(self._obs[0])
+        self.obs_filter = make_filter(observation_filter, obs_shape)
+        self._filter_delta = make_filter(observation_filter, obs_shape)
 
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
@@ -73,7 +86,9 @@ class RolloutWorker:
         vf_buf = np.zeros((T, n_env), np.float32)
 
         for t in range(T):
-            obs = np.stack(self._obs).astype(np.float32)
+            raw = np.stack(self._obs).astype(np.float32)
+            self._filter_delta(raw)  # accumulate for the next sync
+            obs = self.obs_filter(raw)
             actions, logp, vf = self.policy.compute_actions(obs)
             obs_buf[t] = obs
             act_buf[t] = actions
@@ -96,8 +111,9 @@ class RolloutWorker:
                     # truncation: bootstrap with V of the PRE-reset state
                     # folded into the reward, then cut the GAE chain —
                     # otherwise the next episode's reset value leaks in
-                    v_boot = float(self.policy.value(
-                        np.asarray(o2, np.float32)[None])[0])
+                    v_boot = float(self.policy.value(self.obs_filter(
+                        np.asarray(o2, np.float32)[None],
+                        update=False))[0])
                     rew_buf[t, i] += self.gamma * v_boot
                 done_buf[t, i] = term or trunc
                 if term or trunc:
@@ -106,7 +122,8 @@ class RolloutWorker:
                     o2 = env.reset()[0]
                 self._obs[i] = o2
 
-        last_obs = np.stack(self._obs).astype(np.float32)
+        last_obs = self.obs_filter(
+            np.stack(self._obs).astype(np.float32), update=False)
         last_vf = self.policy.value(last_obs)
 
         parts = []
@@ -127,11 +144,34 @@ class RolloutWorker:
         self.episode_returns = []
         return out
 
+    def pop_filter_delta(self):
+        """Return + clear the since-last-sync delta state."""
+        from ray_tpu.rllib.filters import make_filter
+
+        state = self._filter_delta.get_state()
+        self._filter_delta = make_filter(self._filter_name,
+                                         np.shape(self._obs[0]))
+        return state
+
+    def get_filter_state(self):
+        return self.obs_filter.get_state()
+
+    def set_filter_state(self, state) -> None:
+        self.obs_filter.set_state(state)
+
 
 class TrajectoryWorker(RolloutWorker):
     """Rollout worker emitting raw time-major fragments for off-policy
     learners (IMPALA): no GAE — v-trace runs on the learner with ITS
     values (reference: rollout collection for impala.py's vtrace path)."""
+
+    def __init__(self, **kwargs):
+        if kwargs.get("observation_filter", "NoFilter") not in (
+                None, "", "NoFilter"):
+            raise ValueError(
+                "TrajectoryWorker does not apply observation filters; "
+                "normalize in the env wrapper for IMPALA")
+        super().__init__(**kwargs)
 
     def sample_trajectory(self) -> Dict[str, np.ndarray]:
         n_env = len(self.envs)

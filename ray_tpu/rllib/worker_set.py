@@ -18,12 +18,14 @@ class WorkerSet:
                  num_envs_per_worker: int = 1,
                  rollout_fragment_length: int = 200,
                  gamma: float = 0.99, lam: float = 0.95,
-                 num_cpus_per_worker: float = 1.0, seed: int = 0):
+                 num_cpus_per_worker: float = 1.0, seed: int = 0,
+                 observation_filter: str = "NoFilter"):
         self.num_workers = num_workers
         kwargs = dict(env=env, env_config=env_config,
                       policy_spec=policy_spec,
                       num_envs=num_envs_per_worker, gamma=gamma, lam=lam,
-                      rollout_fragment_length=rollout_fragment_length)
+                      rollout_fragment_length=rollout_fragment_length,
+                      observation_filter=observation_filter)
         remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
             RolloutWorker)
         self.workers = [remote_cls.remote(seed=seed + 1000 * (i + 1),
@@ -46,6 +48,26 @@ class WorkerSet:
             [w.pop_episode_returns.remote() for w in self.workers],
             timeout=timeout)
         return [r for p in parts for r in p]
+
+    def sync_filters(self, global_state, timeout: float = 60.0):
+        """Pull each worker's since-last-sync DELTA, merge into the
+        coordinator's global state, broadcast the merged state back;
+        returns the new global state (reference:
+        FilterManager.synchronize — deltas, never full states, so shared
+        history is counted exactly once)."""
+        from ray_tpu.rllib.filters import merge_filter_states
+
+        deltas = ray_tpu.get(
+            [w.pop_filter_delta.remote() for w in self.workers],
+            timeout=timeout)
+        merged = merge_filter_states(
+            ([global_state] if global_state else []) + deltas)
+        if merged.get("type") == "NoFilter":
+            return global_state
+        ray_tpu.get(
+            [w.set_filter_state.remote(merged) for w in self.workers],
+            timeout=timeout)
+        return merged
 
     def stop(self) -> None:
         for w in self.workers:
