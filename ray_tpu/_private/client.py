@@ -102,9 +102,10 @@ class MemoryStoreEntry:
 
 
 def _shallow_aliasing_arrays(value, region, max_depth: int = 3):
-    """numpy arrays inside ``value`` (walking list/tuple/set/dict up to
-    ``max_depth``) that alias the memory ``region``.  Used by the
-    zero-copy get path to tie the shared-memory pin to array lifetime."""
+    """numpy arrays inside ``value`` (walking list/tuple/set/dict and
+    plain-object ``__dict__``/``__slots__`` up to ``max_depth``) that
+    alias the memory ``region``.  Used by the zero-copy get path to tie
+    the shared-memory pin to array lifetime."""
     import numpy as np
 
     out = []
@@ -125,7 +126,48 @@ def _shallow_aliasing_arrays(value, region, max_depth: int = 3):
                 stack.extend((x, d + 1) for x in v)
             elif isinstance(v, dict):
                 stack.extend((x, d + 1) for x in v.values())
+            else:
+                inst = getattr(v, "__dict__", None)
+                if isinstance(inst, dict):
+                    stack.extend((x, d + 1) for x in inst.values())
+                for slot in getattr(type(v), "__slots__", ()) or ():
+                    if isinstance(slot, str) and hasattr(v, slot):
+                        stack.append((getattr(v, slot), d + 1))
     return out
+
+
+def _arrays_cover_spans(arrays, region, spans) -> bool:
+    """True iff the walked ``arrays`` account for EVERY out-of-band
+    buffer span, one distinct array per span.  A count comparison is not
+    enough: a custom reducer can rebuild two views over one buffer while
+    another buffer's only view hides in an opaque object — base-address/
+    extent matching routes that to the copy path.  Best-effort, not a
+    proof: a reducer can still hide a view somewhere the walk cannot see
+    (a closure, a C-extension object) while exposing exactly one visible
+    sibling per buffer; the ``__dict__``/``__slots__`` walk plus the
+    one-array-per-span rule covers every pattern expressible with plain
+    Python objects up to the walk depth."""
+    import numpy as np
+
+    if len(arrays) != len(spans):
+        return False
+    base = np.frombuffer(region, dtype=np.uint8).ctypes.data
+    unmatched = {i: (base + off, base + off + ln)
+                 for i, (off, ln) in enumerate(spans)}
+    for a in arrays:
+        if not (a.flags["C_CONTIGUOUS"] or a.flags["F_CONTIGUOUS"]):
+            return False  # strided view from a custom reducer: copy path
+        addr = a.__array_interface__["data"][0]
+        end = addr + a.nbytes
+        hit = None
+        for i, (lo, hi) in unmatched.items():
+            if lo <= addr and end <= hi:
+                hit = i
+                break
+        if hit is None:
+            return False
+        del unmatched[hit]
+    return not unmatched
 
 
 class LeaseState:
@@ -754,18 +796,21 @@ class CoreWorker:
                 return serialization.deserialize(
                     bytes(buf.data) + bytes(buf.metadata))
         try:
-            value, is_err, n_oob = serialization.deserialize_info(buf.data)
+            value, is_err, spans = \
+                serialization.deserialize_info_spans(buf.data)
         except Exception:
             buf.close()
             raise
-        if not n_oob:
+        if not spans:
             # pure-pickle value: loads() copied everything already
             buf.close()
             return value, is_err
         arrays = _shallow_aliasing_arrays(value, buf.data)
-        if len(arrays) < n_oob:
-            # some buffer is hidden inside an opaque object — re-read
-            # through the copy path so no view can outlive the pin
+        if not _arrays_cover_spans(arrays, buf.data, spans):
+            # an out-of-band buffer has no (or an ambiguous) visible
+            # owner among the shallow-walked arrays — a view may be
+            # hidden inside an opaque object.  Re-read through the copy
+            # path so no view can outlive the pin.
             with buf:
                 return serialization.deserialize(
                     bytes(buf.data) + bytes(buf.metadata))

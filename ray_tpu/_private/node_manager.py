@@ -99,8 +99,8 @@ def pick_tpu_chips(free: List[int], need: int) -> List[int]:
     indices when no contiguous run exists; also prefers the SMALLEST
     adequate run to keep large runs intact for future big grants
     (best-fit, like the allocator in objstore.cc)."""
-    if need <= 1:
-        return free[:need]
+    if need <= 0 or not free:
+        return []
     runs: List[List[int]] = []
     ordered = sorted(free)
     run = [ordered[0]]
@@ -114,7 +114,11 @@ def pick_tpu_chips(free: List[int], need: int) -> List[int]:
     fitting = [r for r in runs if len(r) >= need]
     if fitting:
         best = min(fitting, key=len)  # best-fit: smallest adequate run
-        return best[:need]
+        # take from the run's tail so the remainder stays contiguous
+        # with lower neighbors; for need==1 this carves an endpoint off
+        # the smallest run instead of the head of the free list, keeping
+        # large contiguous runs intact for future multi-chip grants
+        return best[len(best) - need:]
     return ordered[:need]  # fragmented: lowest indices
 
 

@@ -104,6 +104,15 @@ def deserialize_info(data) -> Tuple[Any, bool, int]:
     """deserialize() + the number of out-of-band buffers in the envelope
     (callers managing a pinned shared-memory region use it to decide
     whether the value may alias the input)."""
+    value, is_err, spans = deserialize_info_spans(data)
+    return value, is_err, len(spans)
+
+
+def deserialize_info_spans(data) -> Tuple[Any, bool, list]:
+    """deserialize() + the (offset, length) span of every out-of-band
+    buffer relative to the start of ``data``.  The zero-copy get path
+    matches deserialized arrays to these spans one-to-one before tying
+    the shared-memory pin to array lifetime."""
     view = memoryview(data)
     (hlen,) = _LEN.unpack(view[:_LEN.size])
     off = _LEN.size
@@ -114,10 +123,12 @@ def deserialize_info(data) -> Tuple[Any, bool, int]:
     pickled = view[off:off + plen]
     off += plen
     if kind == KIND_RAW:
-        return bytes(pickled), False, 0
+        return bytes(pickled), False, []
     buffers = []
+    spans = []
     for blen in header["bl"]:
         buffers.append(pickle.PickleBuffer(view[off:off + blen]))
+        spans.append((off, blen))
         off += blen
     value = pickle.loads(bytes(pickled), buffers=buffers)
-    return value, kind == KIND_ERR, len(buffers)
+    return value, kind == KIND_ERR, spans

@@ -391,11 +391,23 @@ class WorkerServer:
             if asyncio.iscoroutinefunction(getattr(cls, "__call__", None)):
                 has_async = True
             mc = 100 if has_async else 1
+            # Auto-raised concurrency must only benefit coroutine methods
+            # (they park on the user loop anyway).  SYNC methods of an
+            # auto-detected async actor serialize against EACH OTHER on a
+            # single thread (so unsynchronized read-modify-write state
+            # stays safe), but — deliberate divergence from the
+            # reference, where they run on and block the event loop —
+            # they do NOT block coroutine progress.  A user-set
+            # max_concurrency opts sync methods into threads explicitly.
+            self.actor.sync_serial = has_async
         self.actor.max_concurrency = mc
         if self.actor.max_concurrency > 1:
             self.exec_pool = ThreadPoolExecutor(
                 max_workers=self.actor.max_concurrency,
                 thread_name_prefix="actor-exec")
+            if getattr(self.actor, "sync_serial", False):
+                self._sync_exec = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="actor-sync")
         try:
             def construct():
                 cls = self.fns.get(spec["job_id"], spec["fid"])
@@ -485,9 +497,13 @@ class WorkerServer:
         caller = spec["caller"]
         try:
             method = getattr(self.actor.instance, spec["method"])
+            pool = self.exec_pool
+            if (getattr(self.actor, "sync_serial", False)
+                    and not asyncio.iscoroutinefunction(method)):
+                # sync method of an auto-detected async actor: serialize
+                pool = self._sync_exec
             returns = await self._loop.run_in_executor(
-                self.exec_pool, self._execute, spec,
-                method)
+                pool, self._execute, spec, method)
             return {"returns": returns}
         finally:
             if self.actor.max_concurrency == 1:

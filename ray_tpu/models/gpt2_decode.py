@@ -105,6 +105,13 @@ def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
     is one jitted program; call under jax.jit with static cfg/
     max_new_tokens for repeated use."""
     B, T0 = prompt.shape
+    if T0 + max_new_tokens > cfg.max_seq:
+        # Past max_seq JAX clamps dynamic_update_slice/gather indices, so
+        # KV writes would silently pile onto the last cache slot and
+        # wpe[pos] would saturate — error loudly instead.
+        raise ValueError(
+            f"prompt length {T0} + max_new_tokens {max_new_tokens} "
+            f"exceeds cfg.max_seq={cfg.max_seq}")
     if key is None:
         key = jax.random.PRNGKey(0)
     cache = init_cache(cfg, B)

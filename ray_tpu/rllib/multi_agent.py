@@ -81,7 +81,8 @@ class MultiAgentRolloutWorker:
             "obs": [], "act": [], "rew": [], "logp": [], "vf": []})
 
     def _flush_agent(self, agent: str, last_value: float,
-                     out: Dict[str, List[SampleBatch]]) -> None:
+                     out: Dict[str, List[SampleBatch]],
+                     terminal: bool = False) -> None:
         tr = self._traj.pop(agent, None)
         if not tr or not tr["obs"]:
             return
@@ -89,7 +90,7 @@ class MultiAgentRolloutWorker:
         rew = np.asarray(tr["rew"], np.float32)
         vf = np.asarray(tr["vf"], np.float32)
         dones = np.zeros(len(rew), np.bool_)
-        dones[-1] = last_value == 0.0
+        dones[-1] = terminal
         adv, vt = compute_gae(rew, vf, dones, last_value,
                               gamma=self.gamma, lam=self.lam)
         out.setdefault(pid, []).append(SampleBatch({
@@ -127,13 +128,17 @@ class MultiAgentRolloutWorker:
                 a_term = terms.get(agent, False)
                 a_trunc = truncs.get(agent, False)
                 if done_all or a_term or a_trunc:
+                    # truncation (time limit) bootstraps with the value
+                    # of the next obs; true termination does not
+                    bootstrap = (a_trunc or truncs.get("__all__", False)) \
+                        and not a_term and agent in obs2
                     last_v = 0.0
-                    if (a_trunc or truncs.get("__all__", False)) \
-                            and not a_term and agent in obs2:
+                    if bootstrap:
                         pol = self.policies[self.mapping(agent)]
                         last_v = float(pol.value(np.asarray(
                             obs2[agent], np.float32)[None])[0])
-                    self._flush_agent(agent, last_v, out)
+                    self._flush_agent(agent, last_v, out,
+                                      terminal=not bootstrap)
             if done_all:
                 self.episode_returns.append(self._ep_reward)
                 self._ep_reward = 0.0
@@ -145,12 +150,18 @@ class MultiAgentRolloutWorker:
         for agent in list(self._traj):
             if not self._traj[agent]["obs"]:
                 continue
+            pol = self.policies[self.mapping(agent)]
             if agent in self._obs:
-                pol = self.policies[self.mapping(agent)]
-                last_v = float(pol.value(np.asarray(
-                    self._obs[agent], np.float32)[None])[0])
+                boot_obs = self._obs[agent]
             else:
-                last_v = 0.0
+                # inactive-but-alive agent (turn-based env): it was not
+                # terminated/truncated (that path flushed above), so a
+                # 0.0 bootstrap would bias its advantages toward
+                # terminal.  Bootstrap with the value of its last seen
+                # observation instead.
+                boot_obs = self._traj[agent]["obs"][-1]
+            last_v = float(pol.value(np.asarray(
+                boot_obs, np.float32)[None])[0])
             self._flush_agent(agent, last_v, out)
         return {pid: SampleBatch.concat_samples(parts)
                 for pid, parts in out.items()}

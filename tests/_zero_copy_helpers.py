@@ -1,0 +1,44 @@
+"""Custom pickle-5 reducers used by the zero-copy span-matching tests.
+
+Module-level so workers can unpickle them by reference."""
+
+import pickle
+
+import numpy as np
+
+
+def _rebuild_two_views(buf, dtype, shape):
+    base = np.frombuffer(buf, dtype=dtype).reshape(shape)
+    half = shape[0] // 2
+    return [base[:half], base[half:]]
+
+
+class TwoViews:
+    """Serializes one array out-of-band; deserializes as a LIST of two
+    distinct views over that single buffer (so a shallow walk finds two
+    arrays for one oob span)."""
+
+    def __init__(self, arr):
+        self.arr = np.ascontiguousarray(arr)
+
+    def __reduce_ex__(self, protocol):
+        return (_rebuild_two_views,
+                (pickle.PickleBuffer(self.arr), self.arr.dtype.str,
+                 self.arr.shape))
+
+
+def _rebuild_hider(buf, dtype, shape):
+    return Hider(np.frombuffer(buf, dtype=dtype).reshape(shape))
+
+
+class Hider:
+    """Serializes its array out-of-band but rebuilds it inside an opaque
+    object the shallow zero-copy walk cannot see."""
+
+    def __init__(self, arr):
+        self.arr = np.ascontiguousarray(arr)
+
+    def __reduce_ex__(self, protocol):
+        return (_rebuild_hider,
+                (pickle.PickleBuffer(self.arr), self.arr.dtype.str,
+                 self.arr.shape))

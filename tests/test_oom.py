@@ -140,7 +140,11 @@ def test_pick_tpu_chips_prefers_contiguous_runs():
     assert pick_tpu_chips([0, 1, 2, 3, 6, 7], 4) == [0, 1, 2, 3]
     # fragmented: no run of 3 -> lowest indices
     assert pick_tpu_chips([0, 2, 4, 6], 3) == [0, 2, 4]
-    # single chip: first free
-    assert pick_tpu_chips([5, 1], 1) == [5]
+    # single chip: endpoint of the smallest run, so contiguous runs
+    # stay intact for future multi-chip grants
+    assert pick_tpu_chips([5, 1], 1) == [1]
+    assert pick_tpu_chips([0, 1, 2, 3, 7], 1) == [7]
+    assert pick_tpu_chips([0, 1, 2, 3], 1) == [3]
     # unsorted input handled
     assert pick_tpu_chips([7, 6, 3, 2, 1, 0], 2) == [6, 7]
+    assert pick_tpu_chips([], 0) == []
