@@ -355,8 +355,21 @@ def gpt2_hidden(params, tokens, cfg: GPT2Config,
     return_aux=True additionally returns the summed MoE load-balance
     loss (0.0 for dense configs)."""
     B, T = tokens.shape
-    x = params["wte"].astype(cfg.dtype)[tokens]
-    x = x + params["wpe"].astype(cfg.dtype)[:T]
+    # Stage the embedding lookup so GSPMD never faces a combined
+    # table-shard → activation-shard transition (it would fall back to
+    # "involuntary full rematerialization", b/433785288): replicate the
+    # casted table FIRST (one all-gather — the partitioner emits the
+    # same all-gather for a sharded-table gather anyway), then the local
+    # gather inherits the token sharding (batch, seq) directly.
+    wte = with_logical_constraint(params["wte"].astype(cfg.dtype),
+                                  (None, None), rules)
+    x = wte[tokens]
+    # wpe slice: shard over seq to match x (T, d) + (B, T, d) broadcast;
+    # constraining to its param sharding (embed→fsdp) would force an
+    # fsdp→seq reshard of the activation instead.
+    pos = with_logical_constraint(params["wpe"].astype(cfg.dtype)[:T],
+                                  ("seq", None), rules)
+    x = x + pos
     x = with_logical_constraint(x, ("batch", "seq", "embed"), rules)
 
     if cfg.remat and cfg.remat_policy == "mlp_only" and cfg.n_experts:
@@ -440,13 +453,25 @@ def gpt2_forward(params, tokens, cfg: GPT2Config,
 
 
 def _nll_from_logits(logits, targets, cfg: GPT2Config):
-    """Per-token negative log likelihood with the padded-vocab tail masked."""
+    """Per-token negative log likelihood with the padded-vocab tail masked.
+
+    Gather-free formulation: ``nll = logsumexp(logits) - logits[target]``
+    with the target pick as a masked reduction over an iota comparison.
+    A ``take_along_axis`` gather along a TENSOR-SHARDED vocab axis makes
+    the SPMD partitioner replicate the full (B,T,V) float32 logits; the
+    where/iota form partitions cleanly (local reduce + cross-shard sum),
+    and XLA fuses the comparison into the reduction so nothing V-sized
+    materializes beyond the logits themselves."""
+    vocab_iota = lax.broadcasted_iota(jnp.int32, logits.shape,
+                                      logits.ndim - 1)
     if cfg.padded_vocab != cfg.vocab_size:
-        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e9,
-                       dtype=logits.dtype)
-        logits = logits.at[..., cfg.vocab_size:].set(neg)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        logits = jnp.where(vocab_iota < cfg.vocab_size, logits,
+                           jnp.asarray(-1e9, logits.dtype))
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.sum(
+        jnp.where(vocab_iota == targets[..., None], logits, 0),
+        axis=-1)
+    return lse - target_logit
 
 
 def _chunked_ce(hidden, wte, targets, mask, cfg: GPT2Config):

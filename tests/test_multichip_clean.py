@@ -1,0 +1,92 @@
+"""The multichip program must compile WITHOUT SPMD fallback warnings.
+
+"Involuntary full rematerialization" (spmd_partitioner.cc) means a
+sharding transition the partitioner could only solve by replicating a
+tensor — correct but a perf cliff on real ICI.  Round-3 verdict: the
+embedding-lookup gather and the loss take_along_axis under seq/tensor
+sharding triggered it; these tests pin the fix.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models import gpt2_config, gpt2_init, gpt2_loss
+from ray_tpu.models.gpt2 import _nll_from_logits
+
+
+def test_nll_matches_gather_formulation():
+    """Gather-free nll == take_along_axis nll (incl. padded-vocab mask)."""
+    cfg = gpt2_config("nano", dtype=jnp.float32)
+    B, T, V = 2, 8, cfg.padded_vocab
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(B, T, V).astype(np.float32))
+    targets = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, T)))
+
+    got = _nll_from_logits(logits, targets, cfg)
+
+    masked = logits.at[..., cfg.vocab_size:].set(-1e9)
+    logp = jax.nn.log_softmax(masked, axis=-1)
+    want = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_sharded_train_step_compiles_without_spmd_fallback():
+    """Compile grad(gpt2_loss) over a dp×fsdp×seq×tensor mesh and assert
+    XLA's C++ stderr contains no involuntary-rematerialization warning."""
+    import optax
+
+    from ray_tpu.models import gpt2_logical_axes
+    from ray_tpu.parallel import MeshSpec, make_mesh
+    from ray_tpu.parallel.sharding import param_shardings, shard_params
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    spec = MeshSpec(data=1, fsdp=2, seq=2, tensor=2)
+    mesh = make_mesh(spec, devices=jax.devices()[:8])
+    cfg = gpt2_config("tiny", use_flash=False, remat=True,
+                      seq_parallel=True)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    axes = gpt2_logical_axes(cfg)
+    tx = optax.adamw(1e-3)
+
+    with jax.set_mesh(mesh):
+        params = shard_params(params, axes, mesh)
+        opt_state = tx.init(params)
+        p_shard = param_shardings(axes, mesh)
+
+        @jax.jit
+        def train_step(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(
+                lambda p: gpt2_loss(p, batch, cfg))(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        tokens = jnp.zeros((8, 65), jnp.int32)
+
+        # XLA emits the warning on C++ stderr — capture fd 2 around the
+        # compile (python-level capsys/capfd miss direct fd writes when
+        # pytest runs with -s or capture is reconfigured; dup2 is exact)
+        stderr_fd = 2
+        saved = os.dup(stderr_fd)
+        with tempfile.TemporaryFile(mode="w+b") as tf:
+            os.dup2(tf.fileno(), stderr_fd)
+            try:
+                compiled = train_step.lower(
+                    params, opt_state, {"tokens": tokens}).compile()
+            finally:
+                os.dup2(saved, stderr_fd)
+                os.close(saved)
+            tf.seek(0)
+            captured = tf.read().decode(errors="replace")
+        assert "Involuntary full rematerialization" not in captured, \
+            captured[-2000:]
+        # and the compiled step actually runs
+        _, _, loss = compiled(params, opt_state, {"tokens": tokens})
+        assert np.isfinite(np.asarray(loss))
