@@ -252,7 +252,11 @@ def main(args=None):
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / BASELINE_MFU, 3),
         "detail": {"chips": n_chips, "batch": batch, "seq": seq,
-                   "mesh": args.mesh,
+                   # effective layout: data_fsdp degrades to pure data
+                   # on odd chip counts (fsdp axis of 1) — record what
+                   # actually ran, not what was asked for
+                   "mesh": ("data" if args.mesh == "data_fsdp"
+                            and n_chips % 2 else args.mesh),
                    "mfu": round(mfu, 4),
                    "loss": round(final_loss, 3),
                    "backend": jax.default_backend(),

@@ -6,7 +6,8 @@ from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      MedianStoppingRule,
                                      PopulationBasedTraining,
                                      TrialScheduler)
-from ray_tpu.tune.search import (choice, grid_search, loguniform, randint,
+from ray_tpu.tune.search import (Searcher, TPESearcher, choice,
+                                 grid_search, loguniform, randint,
                                  uniform)
 from ray_tpu.tune.trial import Trial
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
@@ -14,6 +15,7 @@ from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
 __all__ = [
     "Tuner", "TuneConfig", "ResultGrid", "run", "Trial",
     "grid_search", "choice", "uniform", "loguniform", "randint",
+    "Searcher", "TPESearcher",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

@@ -28,6 +28,8 @@ class Trial:
     restore_checkpoint: Optional[Any] = None
     error: Optional[BaseException] = None
     iteration: int = 0
+    #: crash-restart count consumed against FailureConfig.max_failures
+    num_failures: int = 0
 
     @property
     def is_finished(self) -> bool:

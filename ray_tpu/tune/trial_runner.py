@@ -32,7 +32,9 @@ class TrialRunner:
                  max_concurrent: int = 0,
                  stop: Optional[Dict[str, Any]] = None,
                  resources_per_trial: Optional[Dict[str, float]] = None,
-                 experiment_dir: Optional[str] = None):
+                 experiment_dir: Optional[str] = None,
+                 failure_config=None,
+                 searcher=None, num_samples: int = 0):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
@@ -40,6 +42,14 @@ class TrialRunner:
         self.stop_criteria = stop or {}
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.experiment_dir = experiment_dir
+        from ray_tpu.air.config import FailureConfig
+
+        self.failure_config = failure_config or FailureConfig()
+        #: model-based searcher: proposes trial configs one at a time up
+        #: to ``num_samples`` total, conditioned on completed results
+        #: (reference: trial generation via SearchGenerator).
+        self.searcher = searcher
+        self.num_samples = num_samples
         if max_concurrent <= 0:
             cpus = ray_tpu.cluster_resources().get("CPU", 1)
             per = self.resources.get("CPU", 1.0) or 1.0
@@ -47,6 +57,8 @@ class TrialRunner:
         self.max_concurrent = max_concurrent
         self._actors: Dict[str, Any] = {}     # trial_id -> worker actor
         self._inflight: Dict[Any, Trial] = {}  # next_result ref -> trial
+        self._pending: List[Trial] = []       # (re)launch queue, see run()
+        self._searcher_done = False
 
     # -- experiment-level checkpoint/resume -------------------------------
     # (reference: trial_runner.py save/restore + Tuner.restore)
@@ -74,6 +86,7 @@ class TrialRunner:
                 "metrics_history": t.metrics_history,
                 "last_result": t.last_result, "checkpoint": t.checkpoint,
                 "iteration": t.iteration,
+                "num_failures": t.num_failures,
                 "error": repr(t.error) if t.error else None,
             })
         tmp = os.path.join(self.experiment_dir, _STATE_FILE + ".tmp")
@@ -97,6 +110,7 @@ class TrialRunner:
             t.last_result = s["last_result"]
             t.checkpoint = s["checkpoint"]
             t.iteration = s["iteration"]
+            t.num_failures = s.get("num_failures", 0)
             if s["status"] in (trial_mod.TERMINATED, trial_mod.STOPPED):
                 t.status = s["status"]
             else:  # PENDING/RUNNING/ERROR -> rerun from last checkpoint
@@ -107,9 +121,25 @@ class TrialRunner:
 
     # -- lifecycle --------------------------------------------------------
     def run(self) -> List[Trial]:
-        pending = [t for t in self.trials if not t.is_finished]
+        self._pending.extend(
+            t for t in self.trials if not t.is_finished)
+        pending = self._pending
         try:
-            while pending or self._inflight:
+            while pending or self._inflight or self._searcher_pending():
+                while (self._searcher_pending()
+                       and len(self._actors) + len(pending)
+                       < self.max_concurrent):
+                    trial = Trial(config={})
+                    cfg = self.searcher.suggest(trial.trial_id)
+                    if cfg is None:
+                        # exhausted: latch, or the outer loop spins on
+                        # _searcher_pending() forever
+                        self._searcher_done = True
+                        break
+                    trial.config = cfg
+                    self.trials.append(trial)
+                    self.scheduler.set_trials(self.trials)
+                    pending.append(trial)
                 while pending and len(self._actors) < self.max_concurrent:
                     trial = pending.pop(0)
                     try:
@@ -117,7 +147,7 @@ class TrialRunner:
                     except Exception as e:  # noqa: BLE001 - isolate trial
                         logger.warning("trial %s failed to launch: %s",
                                        trial.trial_id, e)
-                        self._finish(trial, trial_mod.ERROR, e)
+                        self._handle_failure(trial, e)
                 self._pump()
         finally:
             # never leak trial actors, whatever aborted the loop
@@ -150,6 +180,11 @@ class TrialRunner:
         self._actors[trial.trial_id] = actor
         self._inflight[actor.next_result.remote()] = trial
 
+    def _searcher_pending(self) -> bool:
+        return (self.searcher is not None
+                and not getattr(self, "_searcher_done", False)
+                and len(self.trials) < self.num_samples)
+
     def _finish(self, trial: Trial, status: str,
                 error: Optional[BaseException] = None) -> None:
         trial.status = status
@@ -160,6 +195,49 @@ class TrialRunner:
                 ray_tpu.kill(actor)
             except Exception:  # noqa: BLE001
                 pass
+        if self.searcher is not None and trial.is_finished:
+            try:
+                # config passed so restored trials (whose ids the
+                # searcher never suggested) still inform the model;
+                # error flag so crash-prone configs count as bad, not as
+                # their deceptively-good last report
+                self.searcher.on_trial_complete(
+                    trial.trial_id, trial.last_result,
+                    error=status == trial_mod.ERROR, config=trial.config)
+            except Exception:  # noqa: BLE001 - searcher bug ≠ run abort
+                logger.exception("searcher on_trial_complete failed")
+
+    def _handle_failure(self, trial: Trial, error: BaseException) -> None:
+        """Crash path: requeue the trial to restart from its last
+        checkpoint while FailureConfig.max_failures allows (reference:
+        tune/execution/trial_runner.py:236 _process_trial_failure —
+        -1 = unlimited, 0 = fail fast).  Requeue (not direct relaunch)
+        keeps retries iterative: persistent launch errors consume one
+        num_failures per loop pass instead of recursing."""
+        import time as _time
+
+        mf = self.failure_config.max_failures
+        if mf != -1 and trial.num_failures >= mf:
+            self._finish(trial, trial_mod.ERROR, error)
+            return
+        trial.num_failures += 1
+        logger.warning(
+            "trial %s failed (restart %d/%s): %s",
+            trial.trial_id, trial.num_failures,
+            "inf" if mf == -1 else mf, error)
+        # drop the dead actor without finishing the trial
+        actor = self._actors.pop(trial.trial_id, None)
+        if actor is not None:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
+        trial.status = trial_mod.PENDING
+        trial.restore_checkpoint = trial.checkpoint
+        # brief backoff so an always-failing launch with unlimited
+        # restarts doesn't busy-spin the run loop
+        _time.sleep(min(2.0, 0.05 * trial.num_failures))
+        self._pending.append(trial)
 
     def _pump(self) -> None:
         if not self._inflight:
@@ -170,13 +248,16 @@ class TrialRunner:
             trial = self._inflight.pop(ref)
             try:
                 res = ray_tpu.get([ref], timeout=60)[0]
-            except Exception as e:  # noqa: BLE001 - actor died
-                self._finish(trial, trial_mod.ERROR, e)
+            except Exception as e:  # noqa: BLE001 - actor died (crash,
+                # node loss, OOM kill): retriable per FailureConfig
+                self._handle_failure(trial, e)
                 continue
             if res.type == "done":
                 self._finish(trial, trial_mod.TERMINATED)
             elif res.type == "error":
-                self._finish(trial, trial_mod.ERROR, res.error)
+                # the trainable itself raised: also retriable (reference
+                # retries on any trial failure class)
+                self._handle_failure(trial, res.error)
             else:
                 self._on_report(trial, res)
 

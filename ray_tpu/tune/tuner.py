@@ -21,6 +21,10 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: int = 0
     scheduler: Optional[TrialScheduler] = None
+    #: model-based searcher (e.g. search.TPESearcher()); requires
+    #: ``metric``.  When set, trials are proposed one at a time
+    #: conditioned on completed results instead of pre-expanded.
+    search_alg: Optional[Any] = None
     seed: Optional[int] = None
 
 
@@ -85,12 +89,27 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         trials = getattr(self, "_restored_trials", None)
+        searcher = self.tune_config.search_alg
+        if searcher is not None:
+            if not self.tune_config.metric:
+                raise ValueError("search_alg requires "
+                                 "TuneConfig.metric to be set")
+            searcher.setup(self.param_space, self.tune_config.metric,
+                           self.tune_config.mode,
+                           seed=self.tune_config.seed)
+            if trials:  # resumed experiment: re-seed the model
+                for t in trials:
+                    if t.is_finished and t.last_result:
+                        searcher.observe(t.config, t.last_result)
         if trials is None:
-            gen = BasicVariantGenerator(
-                self.param_space,
-                num_samples=self.tune_config.num_samples,
-                seed=self.tune_config.seed)
-            trials = [Trial(config=c) for c in gen.variants()]
+            if searcher is not None:
+                trials = []  # proposed one at a time by the searcher
+            else:
+                gen = BasicVariantGenerator(
+                    self.param_space,
+                    num_samples=self.tune_config.num_samples,
+                    seed=self.tune_config.seed)
+                trials = [Trial(config=c) for c in gen.variants()]
         return self._run(trials)
 
     def _run(self, trials: List[Trial]) -> ResultGrid:
@@ -102,9 +121,12 @@ class Tuner:
             max_concurrent=self.tune_config.max_concurrent_trials,
             stop=stop,
             resources_per_trial=self.resources_per_trial,
-            experiment_dir=self._experiment_dir())
+            experiment_dir=self._experiment_dir(),
+            failure_config=self.run_config.failure_config,
+            searcher=self.tune_config.search_alg,
+            num_samples=self.tune_config.num_samples)
         runner.run()
-        return ResultGrid(trials)
+        return ResultGrid(runner.trials)
 
     @classmethod
     def restore(cls, path: str, trainable: Callable,

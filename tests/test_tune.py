@@ -175,3 +175,192 @@ def test_experiment_checkpoint_and_resume(ray_start_shared, tmp_path):
     # the finished trial was NOT re-run (its history kept exactly 6 rows)
     clean = [t for t in grid2.trials if not t.config["crash"]][0]
     assert len(clean.metrics_history) == 6
+
+
+def test_trial_fault_tolerance_retries_from_checkpoint(ray_start_shared):
+    """A trial whose TRAINABLE raises mid-run is restarted from its last
+    checkpoint when FailureConfig.max_failures allows, and the
+    experiment completes with no error (reference:
+    trial_runner.py:236 _process_trial_failure)."""
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.air.config import FailureConfig, RunConfig
+
+    def trainable(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 6):
+            if i == 3 and start == 0:
+                raise RuntimeError("mid-run crash")
+            session.report({"i": i},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    assert not grid.errors
+    for t in grid.trials:
+        assert t.metrics_history[-1]["i"] == 5
+        assert t.num_failures == 1  # exactly one restart consumed
+
+
+def test_trial_fault_tolerance_survives_actor_death(ray_start_shared):
+    """A trial whose ACTOR PROCESS dies (os._exit — no python exception
+    reaches the runner) is also restarted from its checkpoint."""
+    import os as _os
+
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    from ray_tpu.air.config import FailureConfig, RunConfig
+
+    def trainable(config):
+        ckpt = session.get_checkpoint()
+        start = ckpt.to_dict()["i"] + 1 if ckpt else 0
+        for i in range(start, 5):
+            session.report({"i": i},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+            if i == 2 and start == 0:
+                _os._exit(1)  # hard kill: actor dies mid-run
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([7])},
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=-1)),
+    ).fit()
+    assert not grid.errors
+    (t,) = grid.trials
+    assert t.metrics_history[-1]["i"] == 4
+    assert t.num_failures >= 1
+
+
+def test_failure_config_exhausted_marks_error(ray_start_shared):
+    """When restarts are exhausted the trial surfaces its error (and
+    max_failures=0 keeps the old fail-fast behavior)."""
+    from ray_tpu.air.config import FailureConfig, RunConfig
+
+    def always_crash(config):
+        raise RuntimeError("permanent")
+
+    grid = tune.Tuner(
+        always_crash,
+        param_space={"x": tune.grid_search([1])},
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=2)),
+    ).fit()
+    assert len(grid.errors) == 1
+    assert grid.trials[0].num_failures == 2  # both restarts consumed
+
+
+def test_tpe_beats_random_on_fixture():
+    """On a deterministic quadratic fixture, TPE's best-found value
+    after N trials beats random search's (same N, same seed family)."""
+    from ray_tpu.tune.search import (BasicVariantGenerator, TPESearcher,
+                                     uniform)
+
+    def objective(cfg):
+        return (cfg["x"] - 2.0) ** 2 + (cfg["y"] + 1.0) ** 2
+
+    n = 40
+    space = {"x": uniform(-10, 10), "y": uniform(-10, 10)}
+
+    tpe = TPESearcher(n_initial=8)
+    tpe.setup(space, "loss", "min", seed=1)
+    tpe_best = float("inf")
+    for i in range(n):
+        cfg = tpe.suggest(f"t{i}")
+        loss = objective(cfg)
+        tpe_best = min(tpe_best, loss)
+        tpe.on_trial_complete(f"t{i}", {"loss": loss})
+
+    rnd_best = min(
+        objective(c)
+        for c in BasicVariantGenerator(space, num_samples=n,
+                                       seed=1).variants())
+    assert tpe_best < rnd_best
+
+
+def test_tuner_with_tpe_search_alg(ray_start_shared):
+    """End-to-end: Tuner proposes trials via TPESearcher, one at a time,
+    and converges toward the optimum."""
+    from ray_tpu.air import session
+
+    def trainable(config):
+        session.report({"loss": (config["x"] - 3.0) ** 2})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-10, 10)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=20,
+            search_alg=tune.TPESearcher(n_initial=6), seed=0),
+    ).fit()
+    assert len(grid.trials) == 20
+    assert all(t.last_result is not None for t in grid.trials)
+    best = grid.get_best_result("loss", "min")
+    # wiring check only (concurrent suggestion lag makes the exact
+    # optimum seed-dependent); model quality is pinned deterministically
+    # by test_tpe_beats_random_on_fixture
+    assert best.metrics["loss"] < 10.0
+
+
+def test_searcher_exhaustion_ends_experiment(ray_start_shared):
+    """A searcher returning None before num_samples must end the run,
+    not spin the event loop forever."""
+    from ray_tpu.air import session
+    from ray_tpu.tune.search import Searcher
+
+    class TwoShot(Searcher):
+        def __init__(self):
+            self.n = 0
+
+        def suggest(self, trial_id):
+            if self.n >= 2:
+                return None
+            self.n += 1
+            return {"x": self.n}
+
+        def on_trial_complete(self, *a, **kw):
+            pass
+
+    def trainable(config):
+        session.report({"loss": config["x"]})
+
+    grid = tune.Tuner(
+        trainable, param_space={},
+        tune_config=tune.TuneConfig(metric="loss", mode="min",
+                                    num_samples=50,
+                                    search_alg=TwoShot()),
+    ).fit()
+    assert len(grid.trials) == 2  # returned promptly with what it got
+
+
+def test_errored_trials_count_as_bad_for_tpe():
+    """A config that reports a great metric then crashes must land in
+    TPE's bad set, not poison the good density."""
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    tpe = TPESearcher(n_initial=4)
+    tpe.setup({"x": uniform(0, 10)}, "loss", "min", seed=0)
+    # crashy region x<5 reports loss=0.0 then dies; honest region
+    # x>=5 reports its true loss (x-7)^2
+    for i in range(30):
+        cfg = tpe.suggest(f"t{i}")
+        if cfg["x"] < 5:
+            tpe.on_trial_complete(f"t{i}", {"loss": 0.0}, error=True)
+        else:
+            tpe.on_trial_complete(f"t{i}", {"loss": (cfg["x"] - 7) ** 2})
+    late = [c["x"] for c, _ in tpe._obs[-10:]]
+    assert sum(1 for x in late if x >= 5) >= 7, late
+
+
+def test_tpe_setup_resets_state():
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    tpe = TPESearcher(n_initial=2)
+    tpe.setup({"x": uniform(0, 1)}, "loss", "min", seed=0)
+    tpe.suggest("a")
+    tpe.on_trial_complete("a", {"loss": 0.5})
+    tpe.setup({"x": uniform(0, 1)}, "acc", "max", seed=0)
+    assert tpe._obs == [] and tpe._live == {}
