@@ -2,21 +2,27 @@
 emits GAE-processed SampleBatches.
 
 Reference analog: rllib/evaluation/rollout_worker.py:134 (:779 sample)
-with the SyncSampler loop (evaluation/sampler.py:145).  Kept
-deliberately lean: vectorized-by-loop gymnasium envs, batched policy
-inference per step, trajectory postprocessing (GAE) at episode/horizon
-boundaries — all numpy/CPU; the TPU never appears here.
+with the SyncSampler loop (evaluation/sampler.py:145).  The sampling
+loop is fully batched: a VectorEnv steps all copies in one call
+(vector_env.py — natively-batched numpy physics where available), a
+connector pipeline (connectors.py) adapts obs/actions in (N, ...)
+arrays, and the policy runs ONE forward per timestep.  No per-env
+python inside the hot loop — the TPU never appears here either; rollout
+workers are the horizontally-scaled CPU half of the design.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.connectors import (ObsFilter, default_action_pipeline,
+                                      default_obs_pipeline)
 from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
+from ray_tpu.rllib.vector_env import make_vector_env
 
 
 def _make_env(env_name_or_creator, env_config):
@@ -36,45 +42,34 @@ class RolloutWorker:
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        self.envs = [_make_env(env, env_config) for _ in range(num_envs)]
+        self.venv = make_vector_env(env, env_config, num_envs, seed=seed)
+        self.num_envs = self.venv.num_envs
         self.policy = JaxPolicy(policy_spec, seed=seed)
-        # Box-space metadata for continuous policies: executed actions are
-        # reshaped to the env's action shape and clipped to its bounds
-        # (the BATCH keeps the raw sampled action so the PPO ratio refers
-        # to what was actually sampled — reference clip_actions behavior)
-        space = getattr(self.envs[0], "action_space", None)
-        self._action_shape = tuple(getattr(space, "shape", ()) or ())
-        self._action_low = getattr(space, "low", None)
-        self._action_high = getattr(space, "high", None)
+        continuous = getattr(policy_spec, "continuous", False)
 
         self.gamma = gamma
         self.lam = lam
         self.fragment = rollout_fragment_length
-        self._obs = [e.reset(seed=seed + i)[0]
-                     for i, e in enumerate(self.envs)]
-        self._ep_rewards = [0.0] * num_envs
+        self._raw_obs = self.venv.vector_reset(seed=seed)
+        self._ep_rewards = np.zeros(self.num_envs, np.float64)
         self.episode_returns: List[float] = []
-        # Observation filter: the LOCAL filter normalizes (and keeps
-        # updating between syncs); the DELTA filter accumulates only the
-        # raw observations seen since the last sync — the
-        # FilterManager.synchronize buffer design, so the coordinator can
-        # Chan-merge disjoint deltas without double-counting history.
-        from ray_tpu.rllib.filters import make_filter
-
-        self._filter_name = observation_filter
-        obs_shape = np.shape(self._obs[0])
-        self.obs_filter = make_filter(observation_filter, obs_shape)
-        self._filter_delta = make_filter(observation_filter, obs_shape)
+        self.obs_pipeline = default_obs_pipeline(
+            np.shape(self._raw_obs[0]), observation_filter)
+        self.action_pipeline = default_action_pipeline(
+            self.venv.action_space, continuous)
 
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
 
     def sample(self) -> SampleBatch:
-        """One fragment per env, GAE-postprocessed and concatenated."""
-        n_env = len(self.envs)
+        """One fragment per env copy, GAE-postprocessed + concatenated.
+        Every step is batched: connector → one policy forward →
+        one vector_step."""
+        n_env = self.num_envs
         T = self.fragment
         continuous = getattr(self.policy.spec, "continuous", False)
-        obs_buf = np.zeros((T, n_env) + np.shape(self._obs[0]), np.float32)
+        obs0 = self.obs_pipeline(self._raw_obs, update=False)
+        obs_buf = np.zeros((T,) + obs0.shape, np.float32)
         if continuous:
             act_buf = np.zeros((T, n_env, self.policy.spec.n_actions),
                                np.float32)
@@ -86,44 +81,36 @@ class RolloutWorker:
         vf_buf = np.zeros((T, n_env), np.float32)
 
         for t in range(T):
-            raw = np.stack(self._obs).astype(np.float32)
-            self._filter_delta(raw)  # accumulate for the next sync
-            obs = self.obs_filter(raw)
+            obs = self.obs_pipeline(self._raw_obs)
             actions, logp, vf = self.policy.compute_actions(obs)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp
             vf_buf[t] = vf
-            for i, env in enumerate(self.envs):
-                if continuous:
-                    a = np.asarray(actions[i], np.float32)
-                    if self._action_low is not None:
-                        a = np.clip(a, self._action_low,
-                                    self._action_high)
-                    if self._action_shape:
-                        a = a.reshape(self._action_shape)
-                else:
-                    a = int(actions[i])
-                o2, r, term, trunc, _ = env.step(a)
-                rew_buf[t, i] = r
-                self._ep_rewards[i] += r
-                if trunc and not term:
-                    # truncation: bootstrap with V of the PRE-reset state
-                    # folded into the reward, then cut the GAE chain —
-                    # otherwise the next episode's reset value leaks in
-                    v_boot = float(self.policy.value(self.obs_filter(
-                        np.asarray(o2, np.float32)[None],
-                        update=False))[0])
-                    rew_buf[t, i] += self.gamma * v_boot
-                done_buf[t, i] = term or trunc
-                if term or trunc:
-                    self.episode_returns.append(self._ep_rewards[i])
-                    self._ep_rewards[i] = 0.0
-                    o2 = env.reset()[0]
-                self._obs[i] = o2
+            env_actions = self.action_pipeline(actions) \
+                if continuous else actions
+            raw2, rews, terms, truncs, infos = \
+                self.venv.vector_step(env_actions)
+            rew_buf[t] = rews
+            self._ep_rewards += rews
+            boot = truncs & ~terms
+            if boot.any():
+                # truncation: fold gamma*V(final_obs) into the reward,
+                # then cut the GAE chain — otherwise the next episode's
+                # reset value leaks across the boundary
+                fin = self.obs_pipeline(infos["final_obs"][boot],
+                                        update=False)
+                rew_buf[t, boot] += self.gamma * np.asarray(
+                    self.policy.value(fin), np.float32)
+            done = terms | truncs
+            done_buf[t] = done
+            if done.any():
+                self.episode_returns.extend(
+                    self._ep_rewards[done].tolist())
+                self._ep_rewards[done] = 0.0
+            self._raw_obs = raw2
 
-        last_obs = self.obs_filter(
-            np.stack(self._obs).astype(np.float32), update=False)
+        last_obs = self.obs_pipeline(self._raw_obs, update=False)
         last_vf = self.policy.value(last_obs)
 
         parts = []
@@ -144,20 +131,24 @@ class RolloutWorker:
         self.episode_returns = []
         return out
 
+    # -- observation-filter sync (FilterManager protocol) -----------------
+
+    def _obs_filter(self) -> Optional[ObsFilter]:
+        return self.obs_pipeline.find(ObsFilter)
+
     def pop_filter_delta(self):
         """Return + clear the since-last-sync delta state."""
-        from ray_tpu.rllib.filters import make_filter
-
-        state = self._filter_delta.get_state()
-        self._filter_delta = make_filter(self._filter_name,
-                                         np.shape(self._obs[0]))
-        return state
+        f = self._obs_filter()
+        return f.pop_delta() if f is not None else None
 
     def get_filter_state(self):
-        return self.obs_filter.get_state()
+        f = self._obs_filter()
+        return f.get_state() if f is not None else None
 
     def set_filter_state(self, state) -> None:
-        self.obs_filter.set_state(state)
+        f = self._obs_filter()
+        if f is not None:
+            f.set_state(state)
 
 
 class TrajectoryWorker(RolloutWorker):
@@ -174,37 +165,41 @@ class TrajectoryWorker(RolloutWorker):
         super().__init__(**kwargs)
 
     def sample_trajectory(self) -> Dict[str, np.ndarray]:
-        n_env = len(self.envs)
+        n_env = self.num_envs
         T = self.fragment
-        obs_buf = np.zeros((T, n_env) + np.shape(self._obs[0]), np.float32)
+        obs0 = self.obs_pipeline(self._raw_obs, update=False)
+        obs_buf = np.zeros((T,) + obs0.shape, np.float32)
         act_buf = np.zeros((T, n_env), np.int64)
         rew_buf = np.zeros((T, n_env), np.float32)
         done_buf = np.zeros((T, n_env), np.bool_)
         logp_buf = np.zeros((T, n_env), np.float32)
 
         for t in range(T):
-            obs = np.stack(self._obs).astype(np.float32)
+            obs = self.obs_pipeline(self._raw_obs)
             actions, logp, _ = self.policy.compute_actions(obs)
             obs_buf[t] = obs
             act_buf[t] = actions
             logp_buf[t] = logp
-            for i, env in enumerate(self.envs):
-                o2, r, term, trunc, _ = env.step(int(actions[i]))
-                rew_buf[t, i] = r
-                self._ep_rewards[i] += r
-                if trunc and not term:
-                    v_boot = float(self.policy.value(
-                        np.asarray(o2, np.float32)[None])[0])
-                    rew_buf[t, i] += self.gamma * v_boot
-                done_buf[t, i] = term or trunc
-                if term or trunc:
-                    self.episode_returns.append(self._ep_rewards[i])
-                    self._ep_rewards[i] = 0.0
-                    o2 = env.reset()[0]
-                self._obs[i] = o2
+            raw2, rews, terms, truncs, infos = \
+                self.venv.vector_step(actions)
+            rew_buf[t] = rews
+            self._ep_rewards += rews
+            boot = truncs & ~terms
+            if boot.any():
+                fin = self.obs_pipeline(infos["final_obs"][boot],
+                                        update=False)
+                rew_buf[t, boot] += self.gamma * np.asarray(
+                    self.policy.value(fin), np.float32)
+            done = terms | truncs
+            done_buf[t] = done
+            if done.any():
+                self.episode_returns.extend(
+                    self._ep_rewards[done].tolist())
+                self._ep_rewards[done] = 0.0
+            self._raw_obs = raw2
 
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
             "dones": done_buf, "behaviour_logp": logp_buf,
-            "last_obs": np.stack(self._obs).astype(np.float32),
+            "last_obs": self.obs_pipeline(self._raw_obs, update=False),
         }

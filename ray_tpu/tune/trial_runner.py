@@ -58,6 +58,8 @@ class TrialRunner:
         self._actors: Dict[str, Any] = {}     # trial_id -> worker actor
         self._inflight: Dict[Any, Trial] = {}  # next_result ref -> trial
         self._pending: List[Trial] = []       # (re)launch queue, see run()
+        #: failed trials waiting out their backoff: (monotonic_due, trial)
+        self._retry_at: List[tuple] = []
         self._searcher_done = False
 
     # -- experiment-level checkpoint/resume -------------------------------
@@ -121,11 +123,20 @@ class TrialRunner:
 
     # -- lifecycle --------------------------------------------------------
     def run(self) -> List[Trial]:
+        import time as _time
+
         self._pending.extend(
             t for t in self.trials if not t.is_finished)
         pending = self._pending
         try:
-            while pending or self._inflight or self._searcher_pending():
+            while (pending or self._inflight or self._retry_at
+                   or self._searcher_pending()):
+                # promote failed trials whose backoff has expired
+                now = _time.monotonic()
+                due = [t for at, t in self._retry_at if at <= now]
+                self._retry_at = [(at, t) for at, t in self._retry_at
+                                  if at > now]
+                pending.extend(due)
                 while (self._searcher_pending()
                        and len(self._actors) + len(pending)
                        < self.max_concurrent):
@@ -148,7 +159,14 @@ class TrialRunner:
                         logger.warning("trial %s failed to launch: %s",
                                        trial.trial_id, e)
                         self._handle_failure(trial, e)
-                self._pump()
+                if self._inflight:
+                    self._pump()
+                elif self._retry_at and not pending:
+                    # nothing running: wait out the nearest backoff
+                    # without spinning
+                    _time.sleep(max(0.0, min(
+                        at for at, _ in self._retry_at)
+                        - _time.monotonic()) + 0.01)
         finally:
             # never leak trial actors, whatever aborted the loop
             for trial in self.trials:
@@ -211,9 +229,11 @@ class TrialRunner:
         """Crash path: requeue the trial to restart from its last
         checkpoint while FailureConfig.max_failures allows (reference:
         tune/execution/trial_runner.py:236 _process_trial_failure —
-        -1 = unlimited, 0 = fail fast).  Requeue (not direct relaunch)
-        keeps retries iterative: persistent launch errors consume one
-        num_failures per loop pass instead of recursing."""
+        -1 = unlimited, 0 = fail fast).  The trial goes onto the
+        ``_retry_at`` backoff queue (NOT straight back to pending): the
+        run loop promotes it only after the backoff expires, so a
+        persistently failing launch can't monopolize the loop or block
+        pumping of healthy trials — no sleeping here."""
         import time as _time
 
         mf = self.failure_config.max_failures
@@ -234,10 +254,8 @@ class TrialRunner:
                 pass
         trial.status = trial_mod.PENDING
         trial.restore_checkpoint = trial.checkpoint
-        # brief backoff so an always-failing launch with unlimited
-        # restarts doesn't busy-spin the run loop
-        _time.sleep(min(2.0, 0.05 * trial.num_failures))
-        self._pending.append(trial)
+        backoff = min(2.0, 0.05 * trial.num_failures)
+        self._retry_at.append((_time.monotonic() + backoff, trial))
 
     def _pump(self) -> None:
         if not self._inflight:

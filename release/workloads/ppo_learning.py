@@ -1,7 +1,11 @@
 """PPO learning gate (reference: release/rllib_tests learning tests —
-reward threshold within a sample budget)."""
+reward threshold within a sample budget).  Also gates SAMPLING
+throughput: rollouts run on vectorized envs (vector_env.py), so a
+regression back to per-env stepping shows up as env_steps_per_s
+collapsing below the floor."""
 import json
 import os
+import time
 
 import ray_tpu
 from ray_tpu.rllib import PPO, PPOConfig
@@ -9,18 +13,28 @@ from ray_tpu.rllib import PPO, PPOConfig
 ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
 fast = bool(os.environ.get("RELEASE_FAST"))
 cfg = PPOConfig(env="CartPole-v1", num_workers=2,
+                num_envs_per_worker=16,
                 rollout_fragment_length=128,
-                train_batch_size=1024, seed=1)
+                train_batch_size=4096, seed=1)
 algo = PPO(cfg)
 best, steps = -1e9, 0
+t_steady = steps_at_steady = None
 for i in range(10 if fast else 60):
     res = algo.train()
     steps = res["timesteps_total"]
+    if t_steady is None:
+        # steady-state clock starts AFTER the first iteration so the
+        # one-time jit compile doesn't drown the throughput signal
+        t_steady, steps_at_steady = time.perf_counter(), steps
     best = max(best, res.get("episode_reward_mean", -1e9))
-    if best >= 120.0 or steps > 300_000:
+    if best >= 120.0 or steps > 500_000:
         break
+wall = max(time.perf_counter() - t_steady, 1e-9)
+rate = (steps - steps_at_steady) / wall
 print(json.dumps({"episode_reward_mean": best, "env_steps": steps,
-                  "max_env_steps": steps}), flush=True)
+                  "max_env_steps": steps,
+                  "env_steps_per_s": round(rate, 1)}),
+      flush=True)
 try:
     algo.stop()
     ray_tpu.shutdown()

@@ -364,3 +364,28 @@ def test_tpe_setup_resets_state():
     tpe.on_trial_complete("a", {"loss": 0.5})
     tpe.setup({"x": uniform(0, 1)}, "acc", "max", seed=0)
     assert tpe._obs == [] and tpe._live == {}
+
+
+def test_launch_failure_backoff_does_not_starve_pump(ray_start_shared):
+    """A persistently failing trial must not monopolize the run loop:
+    failures wait on a backoff queue while healthy trials keep running
+    to completion."""
+    from ray_tpu.air import session
+    from ray_tpu.air.config import FailureConfig, RunConfig
+
+    def trainable(config):
+        if config["x"] == "bad":
+            raise RuntimeError("always fails")
+        for i in range(3):
+            session.report({"i": i})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search(["bad", "ok"])},
+        tune_config=tune.TuneConfig(max_concurrent_trials=2),
+        run_config=RunConfig(failure_config=FailureConfig(max_failures=3)),
+    ).fit()
+    ok = [t for t in grid.trials if t.config["x"] == "ok"][0]
+    bad = [t for t in grid.trials if t.config["x"] == "bad"][0]
+    assert ok.error is None and ok.metrics_history[-1]["i"] == 2
+    assert bad.error is not None and bad.num_failures == 3
