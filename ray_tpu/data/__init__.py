@@ -13,6 +13,8 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
                                   from_pandas, range as range_, read_csv,
                                   read_json, read_numpy, read_parquet,
                                   read_text)
+from ray_tpu.data.datasource import (Datasource, RangeDatasource,
+                                     ReadTask, read_datasource)
 
 # `range` shadows the builtin only inside this namespace, as in the
 # reference's ray.data.range
@@ -21,4 +23,6 @@ range = range_
 __all__ = ["Dataset", "DatasetPipeline", "GroupedDataset",
            "ActorPoolStrategy", "from_items", "from_numpy",
            "from_pandas", "from_arrow", "range", "read_parquet",
-           "read_csv", "read_json", "read_text", "read_numpy"]
+           "read_csv", "read_json", "read_text", "read_numpy",
+           "Datasource", "ReadTask", "RangeDatasource",
+           "read_datasource"]

@@ -65,17 +65,21 @@ class Dataset:
     """A list of block ObjectRefs + pending (unfused) stages."""
 
     def __init__(self, block_refs: List, stages: Optional[List] = None,
-                 compute: Optional[ActorPoolStrategy] = None):
+                 compute: Optional[ActorPoolStrategy] = None,
+                 stats: Optional[List] = None):
         self._block_refs = list(block_refs)
         self._stages: List[Callable] = list(stages or [])
         self._compute = compute
+        #: ExecStats records, shared down the transform chain so
+        #: ds.map(...).iter_batches(); ds.stats() sees the execution
+        self._stats: List = stats if stats is not None else []
 
     # -- plan -------------------------------------------------------------
     def _with_stage(self, fn: Callable,
                     compute: Optional[ActorPoolStrategy] = None
                     ) -> "Dataset":
         return Dataset(self._block_refs, self._stages + [fn],
-                       compute or self._compute)
+                       compute or self._compute, stats=self._stats)
 
     def materialize(self) -> "Dataset":
         """Execute pending stages: one fused task per block (the stage-
@@ -84,11 +88,20 @@ class Dataset:
         iter_batches(), ...) never re-runs the pipeline."""
         if not self._stages:
             return self
+        import time as _time
+
+        from ray_tpu.data.streaming import ExecStats
+
+        stats = ExecStats(f"materialize[{len(self._stages)} fused stages]")
+        t0 = _time.perf_counter()
         if self._compute is not None:
             refs = self._materialize_on_actors()
         else:
             refs = [_run_stages.remote(b, self._stages)
                     for b in self._block_refs]
+        stats.blocks = len(refs)
+        stats.wall_s = _time.perf_counter() - t0  # submit (+actor wait)
+        self._stats.append(stats)
         self._block_refs = refs
         self._stages = []
         self._compute = None
@@ -276,7 +289,7 @@ class Dataset:
                      batch_format: str = "numpy",
                      drop_last: bool = False) -> Iterator:
         carry = None
-        for t in self._tables():
+        for t in self._iter_tables():
             if carry is not None and carry.num_rows:
                 t = block_util.concat_tables([carry, t])
             start = 0
@@ -287,6 +300,35 @@ class Dataset:
             carry = t.slice(start)
         if carry is not None and carry.num_rows and not drop_last:
             yield block_util.format_batch(carry, batch_format)
+
+    def _iter_tables(self) -> Iterator:
+        """Streaming table iterator: pending task-compute stages execute
+        through the bounded-in-flight StreamingExecutor — batches flow
+        while later blocks still compute, peak memory = the in-flight
+        window, not the dataset (reference: streaming_executor.py).  A
+        FULL consumption leaves the dataset materialized (cached), same
+        as materialize(); actor-compute stages keep the pooled path."""
+        if not self._stages or self._compute is not None:
+            yield from self._tables()
+            return
+        from ray_tpu.data.streaming import ExecStats, StreamingExecutor
+
+        stats = ExecStats(f"stream[{len(self._stages)} fused stages]")
+        out_refs = []
+        for ref in StreamingExecutor().execute(self._block_refs,
+                                               self._stages, stats):
+            out_refs.append(ref)
+            yield ray_tpu.get([ref], timeout=600)[0]
+        self._stats.append(stats)
+        self._block_refs = out_refs  # fully consumed: cache in place
+        self._stages = []
+
+    def stats(self) -> str:
+        """Execution summaries recorded on this dataset's lineage
+        (reference: Dataset.stats / _internal/stats.py)."""
+        if not self._stats:
+            return "(no executions recorded)"
+        return "\n".join(s.summary() for s in self._stats)
 
     def iter_jax_batches(self, *, batch_size: int = 256,
                          sharding=None, drop_last: bool = True,
@@ -321,7 +363,7 @@ class Dataset:
             yield jax.device_put(batch, sharding)
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
-        for t in self._tables():
+        for t in self._iter_tables():
             yield from t.to_pylist()
 
     def to_pandas(self):
@@ -330,6 +372,13 @@ class Dataset:
     def to_numpy_refs(self) -> List:
         ds = self.materialize()
         return list(ds._block_refs)
+
+    def write_datasource(self, source, **write_args) -> None:
+        """Fan blocks out to a Datasource's write_block tasks
+        (reference: Dataset.write_datasource)."""
+        from ray_tpu.data.datasource import write_datasource
+
+        write_datasource(self, source, **write_args)
 
     def write_parquet(self, path: str) -> None:
         import os

@@ -65,12 +65,12 @@ def _reduce_sorted(key, descending, *parts):
     return big.sort_by([(key, order)])
 
 
-def _two_phase(block_refs: List, n_out: int, map_remote,
+def _two_phase(block_refs: List, n_out: int, submit_map,
                reduce_remote, reduce_args=()) -> List:
-    """map: block -> n_out parts (multi-return); reduce: column of parts
-    -> one output block."""
-    maps = [map_remote.options(num_returns=n_out).remote(b)
-            for b in block_refs]
+    """map: block -> n_out parts (multi-return, submitted via the
+    ``submit_map(block)`` callable); reduce: column of parts -> one
+    output block."""
+    maps = [submit_map(b) for b in block_refs]
     if n_out == 1:
         maps = [[m] for m in maps]
     return [reduce_remote.remote(*reduce_args,
@@ -84,18 +84,28 @@ def _shuffle_map(table, seed: int, n_out: int):
         if n_out > 1 else table
 
 
+#: at/above this many input blocks the exchanges switch to the
+#: push-based plan (bounded fan-in, pipelined merges); below it the
+#: simple two-phase exchange has less task overhead
+PUSH_BASED_THRESHOLD = 16
+
+
 def shuffle_blocks(block_refs: List, n_out: int,
                    seed: Optional[int] = None) -> List:
     """Random shuffle: every output block gets rows from every input."""
     base = np.random.RandomState(seed).randint(0, 2**31) \
         if seed is not None else np.random.randint(0, 2**31)
-    maps = [_shuffle_map.options(num_returns=n_out).remote(
-        b, base + i, n_out) for i, b in enumerate(block_refs)]
-    if n_out == 1:
-        maps = [[m] for m in maps]
-    return [_reduce_concat.remote(*[maps[m][p]
-                                    for m in range(len(maps))])
-            for p in range(n_out)]
+
+    counter = iter(range(len(block_refs)))
+
+    def submit_map(b):
+        return _shuffle_map.options(num_returns=n_out).remote(
+            b, base + next(counter), n_out)
+
+    if len(block_refs) >= PUSH_BASED_THRESHOLD:
+        return push_based_shuffle(block_refs, n_out, submit_map,
+                                  _reduce_concat)
+    return _two_phase(block_refs, n_out, submit_map, _reduce_concat)
 
 
 def sort_blocks(block_refs: List, key: str, descending: bool,
@@ -127,15 +137,17 @@ def sort_blocks(block_refs: List, key: str, descending: bool,
         parts = _partition_range(table, key, cuts_arr, descending)
         return tuple(parts) if n_parts > 1 else parts[0]
 
-    maps = [_map.options(num_returns=n_parts).remote(b)
-            for b in block_refs]
-    if n_parts == 1:
-        maps = [[m] for m in maps]
+    def submit_map(b):
+        return _map.options(num_returns=n_parts).remote(b)
+
     # descending partitions are already emitted highest-first by
     # _partition_range's index flip
-    return [_reduce_sorted.remote(key, descending,
-                                  *[maps[m][p] for m in range(len(maps))])
-            for p in range(n_parts)]
+    if len(block_refs) >= PUSH_BASED_THRESHOLD:
+        return push_based_shuffle(block_refs, n_parts, submit_map,
+                                  _reduce_sorted,
+                                  reduce_args=(key, descending))
+    return _two_phase(block_refs, n_parts, submit_map, _reduce_sorted,
+                      reduce_args=(key, descending))
 
 
 def hash_partition_blocks(block_refs: List, key: str, n_out: int) -> List:
@@ -145,4 +157,85 @@ def hash_partition_blocks(block_refs: List, key: str, n_out: int) -> List:
         parts = _partition_hash(table, key, n_out)
         return tuple(parts) if n_out > 1 else parts[0]
 
-    return _two_phase(block_refs, n_out, _map, _reduce_concat)
+    submit_map = lambda b: _map.options(num_returns=n_out).remote(b)
+    if len(block_refs) >= PUSH_BASED_THRESHOLD:
+        return push_based_shuffle(block_refs, n_out, submit_map,
+                                  _reduce_concat)
+    return _two_phase(block_refs, n_out, submit_map, _reduce_concat)
+
+
+# ---------------------------------------------------------------------------
+# Push-based shuffle
+# ---------------------------------------------------------------------------
+
+@ray_tpu.remote
+def _merge_parts(k: int, n_maps: int, *parts):
+    """Merge one round's sub-blocks for k partitions.  ``parts`` is laid
+    out map-major: parts[m*k + i] is map m's piece of partition i."""
+    out = []
+    for i in range(k):
+        live = [parts[m * k + i] for m in range(n_maps)
+                if parts[m * k + i].num_rows]
+        out.append(block_util.concat_tables(live) if live
+                   else parts[i])
+    return tuple(out) if k > 1 else out[0]
+
+
+def push_based_shuffle(block_refs: List, n_out: int, submit_map,
+                       reduce_remote, reduce_args=(), *,
+                       round_size: int = 0,
+                       merge_factor: int = 2) -> List:
+    """Two-level pipelined exchange (reference:
+    data/_internal/push_based_shuffle.py:1 — redesigned around this
+    runtime's multi-return tasks instead of actor-pinned merge stages).
+
+    The naive two-phase exchange gives every reduce task ``n_maps``
+    arguments — at 1000 input blocks each reduce pulls 1000 tiny
+    objects, and the driver materializes an n_maps×n_out ref matrix.
+    Here maps run in ROUNDS of ``round_size``; each round's outputs are
+    immediately combined by merge tasks (each owning a contiguous range
+    of ~``merge_factor`` partitions) while the NEXT round's maps
+    already execute — map compute and merge I/O pipeline.  Fan-in is
+    bounded: merge tasks take round_size×k args, reduce tasks take one
+    merged piece per round."""
+    n_maps = len(block_refs)
+    if not n_maps:
+        return []
+    if round_size <= 0:
+        cpus = ray_tpu.cluster_resources().get("CPU", 2)
+        round_size = max(2, int(cpus))
+    n_rounds = -(-n_maps // round_size)
+    n_merge = max(1, n_out // max(1, merge_factor))
+    # contiguous partition ranges per merge task
+    bounds = [round(j * n_out / n_merge) for j in range(n_merge + 1)]
+    pieces: List[List] = [[] for _ in range(n_out)]  # per part, per round
+    prev_merges: List = []
+    for r in range(n_rounds):
+        blocks = block_refs[r * round_size:(r + 1) * round_size]
+        maps = [submit_map(b) for b in blocks]
+        if n_out == 1:
+            maps = [[m] for m in maps]
+        # backpressure: at most two rounds in flight — wait for the
+        # round-before-last's merges before growing the frontier
+        if prev_merges:
+            remaining = prev_merges
+            while remaining:
+                _, remaining = ray_tpu.wait(
+                    remaining, num_returns=len(remaining), timeout=60.0)
+        prev_merges = []
+        for j in range(n_merge):
+            lo, hi = bounds[j], bounds[j + 1]
+            k = hi - lo
+            if k <= 0:
+                continue
+            args = [maps[m][p] for m in range(len(maps))
+                    for p in range(lo, hi)]
+            merged = _merge_parts.options(num_returns=k).remote(
+                k, len(maps), *args)
+            if k == 1:
+                merged = [merged]
+            for i, p in enumerate(range(lo, hi)):
+                pieces[p].append(merged[i])
+            prev_merges.extend(merged)
+    return [reduce_remote.remote(*reduce_args, *pieces[p])
+            for p in range(n_out)]

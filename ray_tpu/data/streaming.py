@@ -1,0 +1,78 @@
+"""Streaming block execution with bounded in-flight work.
+
+Reference analog: data/_internal/execution/streaming_executor.py (the
+operator/backpressure engine behind Dataset.iter_batches).  Collapsed to
+the piece that matters for this runtime: stages are already fused into
+one task per block (dataset.py), so streaming = a submission window —
+at most ``max_in_flight`` block tasks run concurrently, results yield
+in order the moment they (and everything before them) finish, and later
+blocks are not even SUBMITTED until a slot frees.  Peak cluster memory
+is O(max_in_flight) blocks instead of O(dataset); first-batch latency
+is one block's work instead of the whole pipeline's.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+import ray_tpu
+
+
+class ExecStats:
+    """Wall-clock/throughput record of one execution (reference:
+    _internal/stats.py DatasetStats, driver-side portion)."""
+
+    def __init__(self, op: str):
+        self.op = op
+        self.blocks = 0
+        self.wall_s = 0.0
+        self.first_block_s: Optional[float] = None
+
+    def summary(self) -> str:
+        first = (f", first block {self.first_block_s:.3f}s"
+                 if self.first_block_s is not None else "")
+        return (f"{self.op}: {self.blocks} blocks in "
+                f"{self.wall_s:.3f}s{first}")
+
+
+class StreamingExecutor:
+    def __init__(self, max_in_flight: int = 0):
+        if max_in_flight <= 0:
+            cpus = ray_tpu.cluster_resources().get("CPU", 2)
+            max_in_flight = max(2, int(cpus) * 2)
+        self.max_in_flight = max_in_flight
+
+    def execute(self, block_refs: List, stages: List,
+                stats: Optional[ExecStats] = None) -> Iterator:
+        """Yield one result ref per input block, in input order, with at
+        most ``max_in_flight`` stage tasks alive at once."""
+        from ray_tpu.data.dataset import _run_stages
+
+        t0 = time.perf_counter()
+        n = len(block_refs)
+        inflight: Dict[Any, int] = {}
+        done: Dict[int, Any] = {}
+        submitted = 0
+        yielded = 0
+        while yielded < n:
+            while submitted < n and len(inflight) < self.max_in_flight:
+                ref = _run_stages.remote(block_refs[submitted], stages)
+                inflight[ref] = submitted
+                submitted += 1
+            while yielded in done:
+                if stats is not None:
+                    stats.blocks += 1
+                    if stats.first_block_s is None:
+                        stats.first_block_s = time.perf_counter() - t0
+                    stats.wall_s = time.perf_counter() - t0
+                yield done.pop(yielded)
+                yielded += 1
+            if yielded >= n:
+                break
+            if not inflight:
+                continue
+            ready, _ = ray_tpu.wait(list(inflight), num_returns=1,
+                                    timeout=600.0)
+            for r in ready:
+                done[inflight.pop(r)] = r

@@ -137,13 +137,15 @@ class RolloutWorker:
         return self.obs_pipeline.find(ObsFilter)
 
     def pop_filter_delta(self):
-        """Return + clear the since-last-sync delta state."""
+        """Return + clear the since-last-sync delta state.  Filterless
+        workers return the NoFilter state dict (NOT None) so
+        merge_filter_states can consume mixed worker sets."""
         f = self._obs_filter()
-        return f.pop_delta() if f is not None else None
+        return f.pop_delta() if f is not None else {"type": "NoFilter"}
 
     def get_filter_state(self):
         f = self._obs_filter()
-        return f.get_state() if f is not None else None
+        return f.get_state() if f is not None else {"type": "NoFilter"}
 
     def set_filter_state(self, state) -> None:
         f = self._obs_filter()

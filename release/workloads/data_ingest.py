@@ -1,0 +1,38 @@
+"""Data ingest throughput: object-store blocks → streamed batches →
+device arrays via iter_jax_batches (reference anchor: BASELINE.md data
+ingest class; the reference's release data benchmarks measure GiB/s of
+dataset → trainer ingest)."""
+import json
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+ray_tpu.init(num_cpus=4, object_store_memory=1024 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+
+rows = 40_000 if fast else 200_000
+dim = 256  # 1 KiB/row float32
+blocks = 16
+arr = np.random.RandomState(0).randn(rows, dim).astype(np.float32)
+ds = rdata.from_numpy(arr).repartition(blocks).materialize()
+
+def run_epoch():
+    n = 0
+    for batch in ds.iter_jax_batches(batch_size=4096, drop_last=False):
+        n += int(next(iter(batch.values())).shape[0])
+    return n
+
+run_epoch()  # warm (jax import, device transfer paths)
+t0 = time.perf_counter()
+n = run_epoch()
+dt = time.perf_counter() - t0
+gib = n * dim * 4 / dt / (1 << 30)
+print(json.dumps({"rows_per_s": round(n / dt, 1),
+                  "ingest_gib_per_s": round(gib, 3)}), flush=True)
+ray_tpu.shutdown()

@@ -18,6 +18,7 @@ cfg = PPOConfig(env="CartPole-v1", num_workers=2,
                 train_batch_size=4096, seed=1)
 algo = PPO(cfg)
 best, steps = -1e9, 0
+t_run0 = time.perf_counter()
 t_steady = steps_at_steady = None
 for i in range(10 if fast else 60):
     res = algo.train()
@@ -30,7 +31,12 @@ for i in range(10 if fast else 60):
     if best >= 120.0 or steps > 500_000:
         break
 wall = max(time.perf_counter() - t_steady, 1e-9)
-rate = (steps - steps_at_steady) / wall
+if steps > steps_at_steady:
+    rate = (steps - steps_at_steady) / wall
+else:
+    # converged within the very first iteration: no steady-state window
+    # exists, fall back to the whole-run rate (compile time included)
+    rate = steps / max(time.perf_counter() - t_run0, 1e-9)
 print(json.dumps({"episode_reward_mean": best, "env_steps": steps,
                   "max_env_steps": steps,
                   "env_steps_per_s": round(rate, 1)}),

@@ -262,3 +262,126 @@ def test_tensor_columns_roundtrip(ray_start_shared):
     doubled = ds.map_batches(lambda b: {"img2": b["img"] * 2})
     out2 = next(doubled.iter_batches(batch_size=2))
     np.testing.assert_array_equal(out2["img2"], imgs * 2)
+
+
+# -- round 4: streaming execution, push-based shuffle, datasources --------
+
+def test_streaming_executor_bounds_submission(ray_start_shared,
+                                              tmp_path):
+    """The executor must not SUBMIT more than its window (+1 refill)
+    ahead of the consumer — asserted structurally on the marker count
+    at first yield with an explicit small window, not on wall-clock."""
+    import time
+
+    from ray_tpu.data.streaming import StreamingExecutor
+
+    marker = str(tmp_path / "started")
+
+    def slow_stage(table, _m=marker):
+        with open(_m, "a") as f:
+            f.write("x")
+        time.sleep(0.1)
+        return table
+
+    ds = rdata.range(64, parallelism=8)
+    ex = StreamingExecutor(max_in_flight=2)
+    it = ex.execute(ds._block_refs, [slow_stage])
+    next(it)  # first block done
+    with open(marker) as f:
+        started_at_first = len(f.read())
+    # window 2 + at most one refill round before the first yield
+    assert started_at_first <= 3, started_at_first
+    assert len(list(it)) == 7  # remainder all arrives, in order
+
+
+def test_streaming_iter_batches_caches_on_full_consumption(
+        ray_start_shared, tmp_path):
+    marker = str(tmp_path / "executed")
+
+    def stage(table, _m=marker):
+        with open(_m, "a") as f:
+            f.write("x")
+        return table
+
+    ds = rdata.range(64, parallelism=8).map_batches(stage)
+    assert len(list(ds.iter_batches(batch_size=8))) == 8
+    # full consumption caches: re-iterating runs no new stage tasks
+    list(ds.iter_batches(batch_size=8))
+    with open(marker) as f:
+        assert len(f.read()) == 8
+
+
+def test_streaming_stats_recorded(ray_start_shared):
+    ds = rdata.range(20, parallelism=2).map(lambda r: r)
+    list(ds.iter_batches(batch_size=10))
+    assert "stream" in ds.stats()
+    assert "2 blocks" in ds.stats()
+
+
+def test_push_based_shuffle_matches_two_phase(ray_start_shared):
+    """Above the threshold the push-based plan runs — same row multiset
+    as the naive exchange, merge stages included."""
+    from ray_tpu.data import shuffle as sm
+
+    assert sm.PUSH_BASED_THRESHOLD <= 20
+    ds = rdata.range(400, parallelism=20)  # 20 blocks >= threshold
+    out = ds.random_shuffle(seed=7)
+    vals = sorted(r["id"] for r in out.take_all())
+    assert vals == list(range(400))
+    assert out.num_blocks == 20
+
+
+def test_push_based_sort(ray_start_shared):
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    items = [{"k": float(x)} for x in rng.randn(300)]
+    ds = rdata.from_items(items).repartition(20)
+    out = ds.sort("k")
+    got = [r["k"] for r in out.take_all()]
+    assert got == sorted(r["k"] for r in items)
+
+
+def test_push_based_groupby(ray_start_shared):
+    items = [{"g": i % 17, "v": i} for i in range(340)]
+    ds = rdata.from_items(items).repartition(20)
+    out = {r["g"]: r["count"] for r in ds.groupby("g").count().take_all()}
+    assert out == {g: 20 for g in range(17)}
+
+
+def test_read_datasource_and_write_datasource(ray_start_shared):
+    from ray_tpu.data import RangeDatasource, read_datasource
+    from ray_tpu.data.datasource import Datasource
+
+    ds = read_datasource(RangeDatasource(100), parallelism=5)
+    assert ds.num_blocks == 5
+    assert sorted(r["id"] for r in ds.take_all()) == list(range(100))
+
+    class CollectSink(Datasource):
+        def __init__(self, path):
+            self.path = path
+            self.total = None
+
+        def write_block(self, block, i, **kw):
+            import os
+
+            with open(os.path.join(self.path, f"{i}.txt"), "w") as f:
+                f.write(str(block.num_rows))
+            return block.num_rows
+
+        def on_write_complete(self, results):
+            self.total = sum(results)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        sink = CollectSink(d)
+        ds.write_datasource(sink)
+        assert sink.total == 100
+
+
+def test_custom_read_task_num_rows_metadata():
+    from ray_tpu.data import RangeDatasource
+
+    tasks = RangeDatasource(10).get_read_tasks(3)
+    assert sum(t.num_rows for t in tasks) == 10
