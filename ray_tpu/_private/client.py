@@ -1222,7 +1222,17 @@ class CoreWorker:
         if state.workers:
             self._dispatch(skey, state)
             return
-        if state.inflight_requests >= demand:
+        # Pipelining cap (reference: direct_task_transport's
+        # max_pending_lease_requests_per_scheduling_category): in-flight
+        # lease requests are bounded, NOT one-per-queued-task.  A 4k-task
+        # burst used to issue 4k requests; the node granted every one as
+        # workers freed (the client's own reuse raced the node's queue),
+        # and the ~4k queued return_worker calls then stalled the loop
+        # for tens of seconds after the burst (measured: first actor
+        # creation 62s late at 8k tasks).  Granted leases are reused
+        # across the whole queue, so a handful of requests suffices.
+        if state.inflight_requests >= min(demand,
+                                          self.config.max_pending_lease_requests):
             return
         state.inflight_requests += 1
         asyncio.get_running_loop().create_task(self._request_lease(skey, state))

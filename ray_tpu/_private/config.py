@@ -62,6 +62,11 @@ class Config:
     #: How long a cluster-wide-infeasible lease keeps retrying spillback
     #: picks (covers autoscaler node-launch latency) before failing.
     infeasible_lease_grace_s: float = 20.0
+    #: Pipelining cap on in-flight lease REQUESTS per scheduling key
+    #: (reference: max_pending_lease_requests_per_scheduling_category).
+    #: Without it a 100k-task burst issues 100k lease requests whose
+    #: granted-then-returned churn floods every event loop involved.
+    max_pending_lease_requests: int = 16
 
     #: GCS fault-tolerance snapshot file (empty = in-memory only; the
     #: reference's Redis-backed store, redis_store_client.h:28).

@@ -446,9 +446,34 @@ class GcsServer:
                         None, self.snapshot)
                 except Exception:  # noqa: BLE001 - disk hiccup; retry next tick
                     self._dirty = True
-            for node_id, info in list(self.nodes.items()):
-                if info.alive and now - info.last_heartbeat > self._heartbeat_timeout_s:
-                    await self._handle_node_death(node_id)
+            stale = [(node_id, info)
+                     for node_id, info in list(self.nodes.items())
+                     if info.alive and now - info.last_heartbeat
+                     > self._heartbeat_timeout_s]
+            if stale:
+                # Active probe before declaring death: on a saturated
+                # host the node's heartbeat task can starve behind a
+                # task-RPC flood while the process is perfectly alive
+                # (observed: 20k queued tasks on one core).  A direct
+                # ping rides the same connection and answers as soon as
+                # the node's loop drains; only a broken connection or a
+                # wedged loop stays silent (reference:
+                # gcs_heartbeat_manager declares on timeout alone — its
+                # raylet heartbeats from a dedicated thread, which this
+                # runtime's asyncio node manager doesn't).  Probes run
+                # CONCURRENTLY so N unreachable nodes cost one 10s
+                # window, not N.
+                async def probe(node_id, info):
+                    try:
+                        await asyncio.wait_for(info.conn.call("ping", {}),
+                                               timeout=10.0)
+                        info.last_heartbeat = time.monotonic()
+                    except Exception:  # noqa: BLE001 - dead for real
+                        await self._handle_node_death(node_id)
+
+                await asyncio.gather(
+                    *(probe(nid, info) for nid, info in stale),
+                    return_exceptions=True)
             for pg in list(self.placement_groups.values()):
                 if pg.state in ("PENDING", "INFEASIBLE"):
                     async with self._pg_lock:
@@ -467,7 +492,8 @@ class GcsServer:
                     pg = self.placement_groups.get(info.placement_group_id)
                     if pg is None or pg.state != "CREATED":
                         continue  # wait for the PG to re-place first
-                if (info.state == RESTARTING and not info.address
+                if (info.state in (RESTARTING, PENDING_CREATION)
+                        and not info.address and not info.node_id
                         and info.actor_id not in self._actor_scheduling
                         and self._pick_node(info.resources) is not None):
                     self._actor_scheduling.add(info.actor_id)
@@ -486,6 +512,12 @@ class GcsServer:
             return
         info.alive = False
         logger.warning("node dead: %s", NodeID(node_id))
+        from ray_tpu._private import events
+
+        events.report_event("gcs", "NODE_DEAD",
+                            f"node {NodeID(node_id)} marked dead",
+                            severity="ERROR",
+                            node_id=NodeID(node_id).hex())
         self._publish("node", {"event": "removed", "node_id": node_id})
         # Restart or fail actors that lived there (reference:
         # GcsActorManager::OnNodeDead, gcs_actor_manager.h:318).
@@ -630,10 +662,39 @@ class GcsServer:
             target_node = pg.bundle_nodes[idx]
         node = self._pick_node(info.resources, target_node)
         if node is None:
+            fits_some_node = any(
+                all(n.resources_total.get(k, 0.0) >= v
+                    for k, v in info.resources.items())
+                for n in self.nodes.values())
+            if fits_some_node or not self.nodes:
+                # Momentarily unschedulable (resources leased out, node
+                # briefly unhealthy, cluster still forming): stay
+                # PENDING_CREATION — the monitor loop retries when a
+                # node can host it (reference: GcsActorScheduler queues
+                # pending actors instead of failing them).  NOT silent:
+                # the shape is recorded as unschedulable demand (the
+                # autoscaler's launch trigger, so a dead-forever node
+                # gets REPLACED rather than the actor hanging) and an
+                # event marks the wait.
+                shape = tuple(sorted(info.resources.items()))
+                first = shape not in self._unschedulable
+                self._unschedulable[shape] = time.monotonic()
+                if first:
+                    from ray_tpu._private import events
+
+                    events.report_event(
+                        "gcs", "ACTOR_PENDING_RESOURCES",
+                        f"actor {ActorID(info.actor_id)} waiting for "
+                        f"{info.resources} (no alive node can host it "
+                        f"now; queued for retry + autoscaler demand)",
+                        severity="WARNING",
+                        actor_id=ActorID(info.actor_id).hex())
+                return
             info.state = DEAD
             info.death_cause = (
-                f"no node with resources {info.resources} "
-                f"(cluster: {[n.resources_total for n in self.nodes.values()]})")
+                f"actor shape {info.resources} exceeds every registered "
+                f"node (cluster: "
+                f"{[n.resources_total for n in self.nodes.values()]})")
             self._actor_state_changed(info)
             return
         info.node_id = node.node_id
@@ -696,10 +757,19 @@ class GcsServer:
             info.num_restarts += 1
             info.state = RESTARTING
             info.address = ""
+            info.node_id = b""  # monitor-loop requeue keys on this
             self._publish("actor", info.public())
             logger.info("restarting actor %s (%d/%s): %s",
                         ActorID(info.actor_id), info.num_restarts,
                         "inf" if unlimited else info.max_restarts, cause)
+            from ray_tpu._private import events
+
+            events.report_event(
+                "gcs", "ACTOR_RESTART",
+                f"actor {ActorID(info.actor_id)} restarting: {cause}",
+                severity="WARNING",
+                actor_id=ActorID(info.actor_id).hex(),
+                restarts=info.num_restarts)
             await self._schedule_actor(info)
         else:
             info.state = DEAD

@@ -220,6 +220,14 @@ class NodeManager:
             "address": self.node_address,
             "object_store": self.object_store_name,
         })
+        provider_id = os.environ.get("RAY_TPU_PROVIDER_ID", "")
+        if provider_id:
+            # cloud-provider handshake: the autoscaler's NodeProvider
+            # joins its provider ids to cluster NodeIDs through this key
+            # (autoscaler/gcp.py internal_id)
+            await self.gcs_conn.call("kv_put", {
+                "key": f"autoscaler.provider/{provider_id}",
+                "value": self.node_id.binary()})
         self._heartbeat_task = asyncio.get_running_loop().create_task(
             self._heartbeat_loop())
         self._log_monitor_task = asyncio.get_running_loop().create_task(
@@ -378,6 +386,13 @@ class NodeManager:
                     "will be retried/restarted per its retry policy",
                     usage * 100, self.config.memory_usage_threshold * 100,
                     WorkerID(victim.worker_id), victim.pid, victim.state)
+                from ray_tpu._private import events
+
+                events.report_event(
+                    "raylet", "WORKER_OOM_KILLED",
+                    f"worker {WorkerID(victim.worker_id)} killed at "
+                    f"{usage * 100:.0f}% node memory",
+                    severity="ERROR", pid=victim.pid, state=victim.state)
                 # mark_dead=False: _on_disconnect runs the full cleanup
                 # (resource release, actor-death report, lease return) so
                 # the kill is indistinguishable from a crash to the retry
@@ -524,6 +539,12 @@ class NodeManager:
                 self.idle_workers.remove(w)
                 self._kill_worker_process(w)
                 self._release_chips(w)
+
+    async def rpc_ping(self, conn, payload):
+        """GCS liveness probe: answered as soon as the event loop drains
+        — proves the process is alive even when the heartbeat task is
+        starved behind a task-RPC flood (see GCS._monitor_loop)."""
+        return True
 
     async def rpc_register_worker(self, conn, payload):
         worker_id = payload["worker_id"]

@@ -227,6 +227,47 @@ class DashboardActor:
         app.router.add_get("/api/jobs/{job_id}/logs", jobs_logs)
         app.router.add_post("/api/jobs/{job_id}/stop", jobs_stop)
 
+        # Declarative serve REST (reference: dashboard serve module,
+        # PUT /api/serve/applications/ consuming ServeApplicationSchema).
+        async def serve_apply(req):
+            from ray_tpu.serve import schema as serve_schema
+
+            body = await req.json()
+            try:
+                await loop.run_in_executor(
+                    None, lambda: serve_schema.apply(body))
+            except (ValueError, TypeError, KeyError, AttributeError,
+                    ImportError) as e:
+                # config/validation-class errors (bad types, unknown
+                # import paths) are the CLIENT's fault: 400, not 500
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=400)
+            return web.json_response(
+                await loop.run_in_executor(None, serve_schema.status))
+
+        async def serve_status(_req):
+            from ray_tpu.serve import schema as serve_schema
+
+            return web.json_response(
+                await loop.run_in_executor(None, serve_schema.status))
+
+        app.router.add_put("/api/serve/applications", serve_apply)
+        app.router.add_get("/api/serve/applications", serve_status)
+
+        # Structured events (reference: dashboard event module consuming
+        # RAY_EVENT files, src/ray/util/event.h:41).
+        async def events_list(req):
+            from ray_tpu._private import events as ev
+
+            recs = await loop.run_in_executor(
+                None, lambda: ev.read_events(
+                    limit=int(req.query.get("limit", 200)),
+                    severity=req.query.get("severity"),
+                    source=req.query.get("source")))
+            return web.json_response(recs)
+
+        app.router.add_get("/api/events", events_list)
+
         async def index(_req):
             return web.Response(text=_INDEX_HTML,
                                 content_type="text/html")

@@ -34,7 +34,8 @@ class Datasource:
     """Implement ``get_read_tasks`` for reading; override
     ``write_block`` for writing."""
 
-    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+    def get_read_tasks(self, parallelism: int,
+                       **read_args: Any) -> List[ReadTask]:
         raise NotImplementedError
 
     def write_block(self, block, task_index: int, **write_args) -> Any:
@@ -51,7 +52,8 @@ class RangeDatasource(Datasource):
     def __init__(self, n: int):
         self.n = n
 
-    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+    def get_read_tasks(self, parallelism: int,
+                       **read_args: Any) -> List[ReadTask]:
         per = -(-self.n // max(1, parallelism))
         tasks = []
         for lo in range(0, self.n, per):
@@ -78,7 +80,7 @@ def read_datasource(source: Datasource, *, parallelism: int = 8,
     in the object store without routing through the driver."""
     from ray_tpu.data.dataset import Dataset
 
-    tasks = source.get_read_tasks(parallelism)
+    tasks = source.get_read_tasks(parallelism, **read_args)
     if not tasks:
         return Dataset([_exec_read_task.remote(
             ReadTask(lambda: []))])

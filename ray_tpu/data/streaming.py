@@ -56,7 +56,13 @@ class StreamingExecutor:
         submitted = 0
         yielded = 0
         while yielded < n:
-            while submitted < n and len(inflight) < self.max_in_flight:
+            # window counts submitted-but-UNYIELDED blocks (running +
+            # completed-waiting), not just running tasks: under
+            # head-of-line blocking (block 0 slow, 1..N fast) counting
+            # only running tasks would submit — and materialize — the
+            # whole dataset while waiting to yield index 0
+            while submitted < n and \
+                    submitted - yielded < self.max_in_flight:
                 ref = _run_stages.remote(block_refs[submitted], stages)
                 inflight[ref] = submitted
                 submitted += 1

@@ -87,6 +87,23 @@ class JobSupervisor:
                 "runtime_envs")
             ctx = re_mod.materialize(self.runtime_env, cw.kv_get, cache)
             cwd = ctx.apply(env)
+            if ctx.command_prefix:
+                # container plugin: wrap the shell entrypoint, forwarding
+                # the cluster handshake + runtime-env vars INTO the
+                # container (the engine child doesn't inherit our env)
+                import shlex
+
+                fwd = dict(ctx.env_vars)
+                fwd["RAYTPU_ADDRESS"] = env["RAYTPU_ADDRESS"]
+                fwd["RAYTPU_JOB_ID"] = env["RAYTPU_JOB_ID"]
+                prefix = list(ctx.command_prefix)
+                image = prefix.pop()
+                for k, v in fwd.items():
+                    prefix += ["-e", f"{k}={v}"]
+                prefix.append(image)
+                self.entrypoint = " ".join(
+                    shlex.quote(p) for p in prefix
+                ) + " /bin/sh -c " + shlex.quote(self.entrypoint)
         return env, cwd
 
     def _run(self):

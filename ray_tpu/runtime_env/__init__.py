@@ -29,7 +29,8 @@ _URI_PREFIX = "gcs://runtimeenv/"
 _KV_PREFIX = "runtimeenv:"
 MAX_PACKAGE_BYTES = 100 * 1024 * 1024  # reference caps GCS packages at 100MB
 
-KNOWN_FIELDS = ("working_dir", "env_vars", "py_modules", "pip")
+KNOWN_FIELDS = ("working_dir", "env_vars", "py_modules", "pip",
+                "conda", "container")
 
 
 def validate(runtime_env: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -118,6 +119,9 @@ class RuntimeEnvContext:
         self.env_vars: Dict[str, str] = {}
         self.cwd: Optional[str] = None
         self.py_paths: List[str] = []
+        #: argv prefix wrapping the launched command (container plugin:
+        #: ["docker", "run", ..., image]); empty = run directly.
+        self.command_prefix: List[str] = []
 
     def apply(self, env: Dict[str, str]) -> Optional[str]:
         """Mutate a subprocess env dict; returns the cwd override."""
@@ -204,11 +208,108 @@ def _setup_pip(value, ctx, kv_get, cache_dir):
     ctx.py_paths.append(site)
 
 
+def _conda_base() -> str:
+    import shutil
+    import subprocess
+
+    exe = shutil.which("conda") or shutil.which("micromamba") or \
+        shutil.which("mamba")
+    if not exe:
+        raise RuntimeError(
+            "runtime_env 'conda' needs a conda/mamba binary on PATH "
+            "(none found on this host)")
+    r = subprocess.run([exe, "info", "--base"], capture_output=True,
+                       text=True, timeout=60)
+    if r.returncode != 0:
+        raise RuntimeError(f"conda info --base failed: {r.stderr[-300:]}")
+    return r.stdout.strip().splitlines()[-1]
+
+
+def _setup_conda(value, ctx, kv_get, cache_dir):
+    """Activate a conda env for the launched process (reference:
+    _private/runtime_env/conda.py — named env activation or creation
+    from an environment-yaml dict, cached by content hash).  Activation
+    is the environment-variable effect of ``conda activate``: env bin/
+    on PATH + CONDA_PREFIX/CONDA_DEFAULT_ENV set — no shell involved."""
+    import shutil
+    import subprocess
+
+    base = _conda_base()
+    if isinstance(value, str):
+        prefix = value if os.sep in value else os.path.join(
+            base, "envs", value)
+        if not os.path.isdir(prefix):
+            raise RuntimeError(f"conda env {value!r} not found at "
+                               f"{prefix}")
+        name = value
+    elif isinstance(value, dict):
+        key = hashlib.sha1(repr(sorted(value.items())).encode()
+                           ).hexdigest()[:12]
+        name = f"raytpu-{key}"
+        prefix = os.path.join(base, "envs", name)
+        if not os.path.isdir(prefix):
+            spec = os.path.join(cache_dir, f"conda-{key}.yml")
+            import json as _json
+
+            with open(spec, "w") as f:
+                # conda yaml is a JSON subset for the fields we emit
+                _json.dump(dict(value, name=name), f)
+            exe = shutil.which("conda") or shutil.which("mamba") or \
+                shutil.which("micromamba")
+            # create into a temp prefix, rename on success: a killed or
+            # failed create must never leave a half-built env that later
+            # materializations would silently activate
+            tmp_prefix = prefix + ".tmp"
+            shutil.rmtree(tmp_prefix, ignore_errors=True)
+            try:
+                r = subprocess.run(
+                    [exe, "env", "create", "-f", spec, "-p", tmp_prefix],
+                    capture_output=True, text=True, timeout=1800)
+            except subprocess.TimeoutExpired:
+                shutil.rmtree(tmp_prefix, ignore_errors=True)
+                raise RuntimeError("conda env create timed out")
+            if r.returncode != 0:
+                shutil.rmtree(tmp_prefix, ignore_errors=True)
+                raise RuntimeError(
+                    f"conda env create failed: {r.stderr[-500:]}")
+            os.rename(tmp_prefix, prefix)
+    else:
+        raise RuntimeError("runtime_env 'conda' must be an env name or "
+                           "an environment dict")
+    ctx.env_vars["CONDA_PREFIX"] = prefix
+    ctx.env_vars["CONDA_DEFAULT_ENV"] = name
+    ctx.env_vars["PATH"] = (os.path.join(prefix, "bin") + os.pathsep
+                            + os.environ.get("PATH", ""))
+
+
+def _setup_container(value, ctx, kv_get, cache_dir):
+    """Run the launched process inside a container image (reference:
+    _private/runtime_env/container.py — worker_process_setup via
+    podman).  Scope: JOB entrypoints (the job supervisor applies
+    ``command_prefix``); this runtime's forked task workers stay on the
+    host, documented divergence from the reference's containerized
+    workers."""
+    import shutil
+
+    if not isinstance(value, dict) or "image" not in value:
+        raise RuntimeError("runtime_env 'container' needs "
+                           "{'image': ..., 'run_options': [...]}")
+    engine = shutil.which("podman") or shutil.which("docker")
+    if not engine:
+        raise RuntimeError("runtime_env 'container' needs podman or "
+                           "docker on PATH (none found)")
+    ctx.command_prefix = [engine, "run", "--rm", "--network=host",
+                          *value.get("run_options", []),
+                          value["image"]]
+
+
 PLUGINS: Dict[str, Callable] = {
     "env_vars": _setup_env_vars,
     "working_dir": _setup_working_dir,
     "py_modules": _setup_py_modules,
     "pip": _setup_pip,
+    "conda": _setup_conda,
+    "container": _setup_container,
 }
 
 

@@ -5,7 +5,12 @@ from ray_tpu.serve.api import (Deployment, delete, deployment,
                                start_http_proxy, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.schema import (DeploymentSchema,
+                                  ServeApplicationSchema)
+from ray_tpu.serve.schema import apply as apply_config
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
-           "start_http_proxy", "batch", "status"]
+           "start_http_proxy", "batch", "status",
+           "ServeApplicationSchema", "DeploymentSchema",
+           "apply_config"]

@@ -65,38 +65,48 @@ def _get_or_create_controller():
     try:
         return ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:  # noqa: BLE001 - not created yet
+        # concurrency sized for long-poll: every PROCESS parks one
+        # listen_for_change call per deployment on a controller slot
+        # (handle._SharedListener; parked calls wait on a Condition —
+        # threads, not CPU)
         return ray_tpu.remote(num_cpus=0.1, lifetime="detached",
-                              name=CONTROLLER_NAME, max_concurrency=16)(
+                              name=CONTROLLER_NAME, max_concurrency=128)(
             ServeController).remote()
 
 
-def _graphify(obj, deployed: set, controller):
+def _graphify(obj, deployed: set, controller, overrides=None):
     """Deployment-graph support (reference: serve/deployment_graph.py on
     Ray DAG): bound deployments nested in init args deploy first and are
     replaced by handle markers the replica resolves at construction."""
     from ray_tpu.serve.replica import DeploymentHandleMarker
 
     if isinstance(obj, Deployment):
-        _deploy_one(obj, deployed, controller)
+        _deploy_one(obj, deployed, controller, overrides=overrides)
         return DeploymentHandleMarker(obj.name)
     if isinstance(obj, (list, tuple)):
-        return type(obj)(_graphify(x, deployed, controller) for x in obj)
+        return type(obj)(_graphify(x, deployed, controller, overrides)
+                         for x in obj)
     if isinstance(obj, dict):
-        return {k: _graphify(v, deployed, controller)
+        return {k: _graphify(v, deployed, controller, overrides)
                 for k, v in obj.items()}
     return obj
 
 
 def _deploy_one(target: Deployment, deployed: set, controller,
-                route_prefix: Optional[str] = None) -> None:
+                route_prefix: Optional[str] = None,
+                overrides=None) -> None:
     import ray_tpu
 
     if target.name in deployed:
         return
     deployed.add(target.name)
-    init_args = _graphify(target.init_args, deployed, controller)
+    ov = (overrides or {}).get(target.name)
+    if ov:
+        target = target.options(**ov)
+    init_args = _graphify(target.init_args, deployed, controller,
+                          overrides)
     init_kwargs = _graphify(target.init_kwargs or {}, deployed,
-                            controller)
+                            controller, overrides)
     ray_tpu.get(controller.deploy.remote(
         target.name, cloudpickle.dumps(target.func_or_class),
         init_args, init_kwargs,
@@ -108,15 +118,27 @@ def _deploy_one(target: Deployment, deployed: set, controller,
 
 
 def run(target: Deployment, *, route_prefix: Optional[str] = None,
-        http: bool = False, http_port: int = 8000) -> DeploymentHandle:
+        http: bool = False, http_port: int = 8000,
+        _overrides: Optional[Dict[str, Dict[str, Any]]] = None
+        ) -> DeploymentHandle:
     """Deploy (a graph of) deployments and return the root handle
     (reference serve.run, serve/api.py:455; graphs via .bind()
     composition as in serve/deployment_graph.py).  With http=True an
-    aiohttp ingress proxy is started as well."""
+    aiohttp ingress proxy is started as well.  ``_overrides`` (the
+    declarative-config path, serve/schema.py): per-deployment option
+    overlays applied to EVERY deployment in the graph by name."""
     controller = _get_or_create_controller()
     prefix = route_prefix or target.route_prefix or \
         (f"/{target.name}" if http else None)
-    _deploy_one(target, set(), controller, route_prefix=prefix)
+    deployed: set = set()
+    _deploy_one(target, deployed, controller, route_prefix=prefix,
+                overrides=_overrides)
+    if _overrides:
+        unmatched = set(_overrides) - deployed
+        if unmatched:
+            raise ValueError(
+                f"config deployments {sorted(unmatched)} matched no "
+                f"deployment in the graph (deployed: {sorted(deployed)})")
     if http:
         start_http_proxy(port=http_port)
     return DeploymentHandle(target.name, controller)

@@ -23,11 +23,25 @@ class ServeController:
         self.deployments: Dict[str, Dict[str, Any]] = {}
         self.routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._lock = threading.Lock()
+        #: long-poll push channel (reference: serve/_private/long_poll.py
+        #: :184 LongPollHost): every replica-set mutation bumps the
+        #: deployment version and wakes blocked listen_for_change calls,
+        #: so handles learn of changes push-style instead of on a poll
+        #: interval.
+        self._change = threading.Condition(self._lock)
         self._stop = False
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             daemon=True,
                                             name="serve_reconcile")
         self._reconciler.start()
+
+    def _bump_locked(self, name: str) -> None:
+        """Caller holds self._lock: record a replica-set change and wake
+        long-poll listeners."""
+        dep = self.deployments.get(name)
+        if dep is not None:
+            dep["version"] += 1
+        self._change.notify_all()
 
     # -- deploy path ------------------------------------------------------
     def deploy(self, name: str, serialized_def: bytes, init_args: tuple,
@@ -58,6 +72,7 @@ class ServeController:
             self.deployments[name] = {"config": cfg, "replicas": replicas,
                                       "version": version,
                                       "scale_pending_since": None}
+            self._change.notify_all()
             if route_prefix:
                 self.routes[route_prefix] = name
             if old:
@@ -70,6 +85,7 @@ class ServeController:
             dep = self.deployments.pop(name, None)
             self.routes = {p: d for p, d in self.routes.items()
                            if d != name}
+            self._change.notify_all()
         if dep:
             for r in dep["replicas"]:
                 self._kill_replica(r)
@@ -99,6 +115,31 @@ class ServeController:
         with self._lock:
             dep = self.deployments.get(name)
             return list(dep["replicas"]) if dep else []
+
+    def listen_for_change(self, name: str, known_version: int,
+                          timeout: float = 25.0) -> Dict[str, Any]:
+        """Long-poll push channel (reference:
+        serve/_private/long_poll.py:184 LongPollHost.listen_for_change):
+        blocks until the deployment's replica set differs from the
+        caller's ``known_version`` (returning immediately when it
+        already does), or until ``timeout`` — the caller re-issues the
+        call in a loop, so membership changes propagate push-style with
+        no polling interval.  A deleted deployment answers version -1.
+        Runs on one of the controller actor's concurrency slots; the
+        slot parks in Condition.wait, costing a thread but no CPU."""
+        deadline = time.monotonic() + timeout
+        with self._change:
+            while True:
+                dep = self.deployments.get(name)
+                if dep is None:
+                    return {"version": -1, "replicas": []}
+                if dep["version"] != known_version:
+                    return {"version": dep["version"],
+                            "replicas": list(dep["replicas"])}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"version": known_version, "replicas": None}
+                self._change.wait(remaining)
 
     def get_routing_table(self) -> Dict[str, Any]:
         with self._lock:
@@ -162,6 +203,7 @@ class ServeController:
                                                         dep["config"]))
                             except Exception:  # noqa: BLE001
                                 pass
+                            self._bump_locked(name)
                 self._autoscale_one(name, loads)
 
     def _autoscale_one(self, name: str,
@@ -209,6 +251,7 @@ class ServeController:
                             self._start_replica(name, dep["config"]))
                     except Exception:  # noqa: BLE001
                         break
+                self._bump_locked(name)
             else:
                 # Prefer least-loaded victims; stop routing to them now
                 # (removed from the table), then drain before killing so
@@ -219,6 +262,7 @@ class ServeController:
                 victims = ordered[:cur - desired]
                 dep["replicas"] = [r for r in dep["replicas"]
                                    if r not in victims]
+                self._bump_locked(name)
         for r in victims if desired < cur else ():
             threading.Thread(target=self._drain_and_kill, args=(r,),
                              daemon=True).start()

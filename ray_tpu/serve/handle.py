@@ -3,17 +3,101 @@
 Reference analog: serve/handle.py:77 RayServeHandle +
 _private/router.py:261 Router (:298 assign_request).  Routing is
 least-loaded-of-two (power of two choices by in-flight count tracked
-locally), with replica-list refresh from the controller on failure or
-staleness.
+locally).  Replica membership arrives PUSH-style: a daemon listener
+thread long-polls the controller's ``listen_for_change`` channel
+(reference: serve/_private/long_poll.py:184 LongPollClient) and swaps
+the local replica list the moment the controller mutates it — restarts,
+autoscaling, and redeploys propagate in one RPC round-trip instead of a
+polling interval.  A direct refresh remains the error-path fallback.
 """
 
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional
 
-_REFRESH_S = 5.0
+_REFRESH_S = 5.0  # fallback staleness bound if the listener dies
+
+
+class _SharedListener:
+    """ONE long-poll loop per (controller, deployment) per process,
+    fanned out to every registered handle via weakrefs.  Bounds the
+    controller concurrency slots parked on ``listen_for_change`` at
+    #processes × #deployments instead of #handles (reference: one
+    LongPollClient per router process, not per handle)."""
+
+    def __init__(self, controller, name: str):
+        self._controller = controller
+        self._name = name
+        self._handles: list = []  # weakrefs
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, handle: "DeploymentHandle") -> None:
+        import weakref
+
+        with self._lock:
+            self._handles.append(weakref.ref(handle))
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"serve-longpoll-{self._name}")
+                self._thread.start()
+
+    def _live_handles(self) -> list:
+        with self._lock:
+            out = []
+            keep = []
+            for ref in self._handles:
+                h = ref()
+                if h is not None and not h._closed:
+                    out.append(h)
+                    keep.append(ref)
+            self._handles = keep
+            return out
+
+    def _loop(self) -> None:
+        import ray_tpu
+
+        version = 0
+        while True:
+            handles = self._live_handles()
+            if not handles:
+                with self._lock:
+                    self._thread = None  # next register restarts us
+                return
+            del handles  # don't pin across the long poll
+            try:
+                out = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._name, version),
+                    timeout=60)
+            except Exception:  # noqa: BLE001 - controller briefly away
+                time.sleep(1.0)
+                continue
+            if out.get("version") == -1:
+                return  # deployment deleted; routing will error out
+            if out.get("replicas") is not None:
+                version = out["version"]
+                for h in self._live_handles():
+                    h._apply_membership(list(out["replicas"]), version)
+
+
+_listeners: dict = {}
+_listeners_lock = threading.Lock()
+
+
+def _shared_listener(controller, name: str) -> _SharedListener:
+    key = (getattr(controller, "_actor_id", None) or id(controller),
+           name)
+    with _listeners_lock:
+        lis = _listeners.get(key)
+        if lis is None:
+            lis = _SharedListener(controller, name)
+            _listeners[key] = lis
+        return lis
 
 
 class DeploymentHandle:
@@ -21,27 +105,50 @@ class DeploymentHandle:
         self.deployment_name = deployment_name
         self._controller = controller
         self._replicas: List = []
+        self._version = 0
         self._inflight: Dict[Any, int] = {}
         #: (ref, replica) of requests whose completion hasn't been
         #: observed yet — reaped (decrementing _inflight) on every route.
         self._outstanding: List = []
         self._fetched_at = 0.0
+        self._listener: Optional[_SharedListener] = None
+        self._closed = False
+
+    # -- membership -------------------------------------------------------
+
+    def _ensure_listener(self) -> None:
+        if self._listener is not None:
+            return
+        self._listener = _shared_listener(self._controller,
+                                          self.deployment_name)
+        self._listener.register(self)
+
+    def close(self) -> None:
+        """Detach from the long-poll listener (idempotent)."""
+        self._closed = True
+
+    def _apply_membership(self, replicas: List, version: int) -> None:
+        # Reset counters on membership change (a freshly restarted
+        # replica must not inherit stale load) and drop the matching
+        # outstanding entries so they can't decrement the fresh counters.
+        self._replicas = replicas
+        self._version = version
+        self._inflight = {r: 0 for r in replicas}
+        self._outstanding = []
+        self._fetched_at = time.monotonic()
 
     def _refresh(self, force: bool = False) -> None:
         import ray_tpu
 
+        self._ensure_listener()
         if not force and self._replicas and \
                 time.monotonic() - self._fetched_at < _REFRESH_S:
             return
-        self._replicas = ray_tpu.get(
+        if not force and self._replicas and self._listener is not None:
+            return  # shared listener keeps us fresh; no poll needed
+        self._apply_membership(ray_tpu.get(
             self._controller.get_replicas.remote(self.deployment_name),
-            timeout=30)
-        # Reset counters on membership refresh (a freshly restarted
-        # replica must not inherit stale load) and drop the matching
-        # outstanding entries so they can't decrement the fresh counters.
-        self._inflight = {r: 0 for r in self._replicas}
-        self._outstanding = []
-        self._fetched_at = time.monotonic()
+            timeout=30), self._version)
 
     def _reap(self) -> None:
         """Decrement in-flight counts for completed requests (the router

@@ -12,6 +12,8 @@ from ray_tpu.autoscaler import (AutoscalingCluster, FakeNodeProvider,
                                 NodeTypeConfig, StandardAutoscaler)
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
+pytestmark = pytest.mark.fast
+
 
 # ---- pure-unit: mocked provider + mocked GCS ------------------------------
 

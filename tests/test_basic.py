@@ -12,6 +12,8 @@ import pytest
 import ray_tpu
 from ray_tpu import exceptions
 
+pytestmark = pytest.mark.fast
+
 
 def test_put_get(ray_start_regular):
     ref = ray_tpu.put(42)

@@ -141,3 +141,53 @@ def test_gcs_restart_recovers_state(tmp_path):
         assert val == 42, f"restored actor answered {val}"
     finally:
         ray_tpu.shutdown()
+
+
+def test_actor_queues_until_node_returns():
+    """An actor whose shape fits a node TYPE in the cluster but has no
+    alive host right now must stay PENDING_CREATION and get created once
+    capacity returns — not die with a scheduling error (reference:
+    GcsActorScheduler queues pending actors; round-4 fix for the
+    false-fail observed under the scale envelope)."""
+    cluster = Cluster(head_num_cpus=0)
+    worker = cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        class A:
+            def ping(self):
+                return "pong"
+
+        # remove the only feasible node; A.remote() blocks in
+        # wait_actor_ready, so capacity returns from a timer thread
+        cluster.remove_node(worker)
+        time.sleep(0.5)
+        t = threading.Timer(3.0, cluster.add_node,
+                            kwargs={"num_cpus": 2})
+        t.start()
+        a = A.remote()  # stays PENDING until the node arrives
+        assert ray_tpu.get(a.ping.remote(), timeout=120) == "pong"
+        t.join()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_impossible_actor_shape_still_fails_fast():
+    """Shapes exceeding every registered node's TOTAL keep the loud
+    immediate error (typo-sized requests must not hang forever)."""
+    cluster = Cluster(head_num_cpus=0)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        @ray_tpu.remote(num_cpus=999)
+        class A:
+            def ping(self):
+                return 1
+
+        # the scheduling error surfaces at creation (wait_actor_ready)
+        with pytest.raises(Exception, match="exceeds every registered"):
+            A.remote()
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -2,6 +2,10 @@
 
 import ray_tpu
 
+import pytest
+
+pytestmark = pytest.mark.fast
+
 
 def test_client_builder():
     ctx = ray_tpu.client().connect()

@@ -7,6 +7,8 @@ import pytest
 import ray_tpu
 from ray_tpu import data as rdata
 
+pytestmark = pytest.mark.fast
+
 
 def test_range_count_take(ray_start_shared):
     ds = rdata.range(100, parallelism=4)

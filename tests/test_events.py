@@ -1,0 +1,79 @@
+"""Structured event export (reference: src/ray/util/event.h:41 RAY_EVENT
+-> per-source JSON-lines files -> dashboard event module)."""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu._private import events
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture(autouse=True)
+def _isolated_event_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYTPU_SESSION_DIR", str(tmp_path))
+    events.reset_for_tests()
+    yield
+    events.reset_for_tests()
+
+
+def test_report_and_read_roundtrip(tmp_path):
+    events.report_event("gcs", "NODE_DEAD", "node x died",
+                        severity="ERROR", node_id="abc")
+    events.report_event("raylet", "WORKER_OOM_KILLED", "killed",
+                        severity="ERROR", pid=123)
+    events.report_event("gcs", "ACTOR_RESTART", "restarting",
+                        severity="WARNING")
+    recs = events.read_events()
+    assert len(recs) == 3
+    assert [r["label"] for r in recs] == [
+        "NODE_DEAD", "WORKER_OOM_KILLED", "ACTOR_RESTART"]
+    assert recs[0]["custom_fields"]["node_id"] == "abc"
+    # files are valid JSON lines on disk
+    path = tmp_path / "events" / "event_gcs.log"
+    lines = path.read_text().strip().split("\n")
+    assert all(json.loads(ln)["source"] == "gcs" for ln in lines)
+
+
+def test_read_filters(tmp_path):
+    events.report_event("gcs", "A", "m1", severity="ERROR")
+    events.report_event("gcs", "B", "m2", severity="INFO")
+    events.report_event("raylet", "C", "m3", severity="ERROR")
+    assert {r["label"] for r in events.read_events(severity="ERROR")} \
+        == {"A", "C"}
+    assert {r["label"] for r in events.read_events(source="raylet")} \
+        == {"C"}
+    assert len(events.read_events(limit=2)) == 2
+
+
+def test_report_never_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAYTPU_SESSION_DIR", "/proc/no/such/dir")
+    events.reset_for_tests()
+    events.report_event("x", "Y", "z")  # must not raise
+
+
+def test_node_death_emits_event(tmp_path):
+    """End-to-end: a cluster node removal lands in the event log."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_num_cpus=1)
+    node = cluster.add_node(num_cpus=1)
+    cluster.connect()
+    try:
+        cluster.remove_node(node)
+        import time
+
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if any(r["label"] == "NODE_DEAD"
+                   for r in events.read_events()):
+                break
+            time.sleep(0.5)
+        assert any(r["label"] == "NODE_DEAD"
+                   for r in events.read_events())
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()

@@ -1,0 +1,166 @@
+"""GCE/TPU-pod node provider (reference:
+autoscaler/_private/gcp/node_provider.py) against a mocked cloud API."""
+
+from typing import Any, Dict, List
+
+import pytest
+
+from ray_tpu.autoscaler.autoscaler import NodeTypeConfig, StandardAutoscaler
+from ray_tpu.autoscaler.gcp import (TPU_TOPOLOGIES, GcpApi,
+                                    GCPNodeProvider, RestGcpApi)
+
+pytestmark = pytest.mark.fast
+
+
+class MockGcpApi(GcpApi):
+    def __init__(self):
+        self.tpu_nodes: Dict[str, Dict[str, Any]] = {}
+        self.instances: Dict[str, Dict[str, Any]] = {}
+        self.calls: List[str] = []
+
+    def create_tpu_node(self, name, accelerator_type, startup_script,
+                        labels):
+        self.calls.append(f"tpu.create:{name}")
+        assert "RAY_TPU_PROVIDER_ID=" in startup_script
+        self.tpu_nodes[name] = {"name": name, "state": "READY",
+                                "acceleratorType": accelerator_type,
+                                "labels": dict(labels)}
+
+    def delete_tpu_node(self, name):
+        self.calls.append(f"tpu.delete:{name}")
+        self.tpu_nodes.pop(name, None)
+
+    def list_tpu_nodes(self):
+        return list(self.tpu_nodes.values())
+
+    def create_instance(self, name, machine_type, startup_script, labels):
+        self.calls.append(f"gce.create:{name}")
+        self.instances[name] = {"name": name, "status": "RUNNING",
+                                "machineType": machine_type,
+                                "labels": dict(labels)}
+
+    def delete_instance(self, name):
+        self.calls.append(f"gce.delete:{name}")
+        self.instances.pop(name, None)
+
+    def list_instances(self):
+        return list(self.instances.values())
+
+
+CONFIGS = {
+    "tpu_v5e_16": {"accelerator_type": "v5litepod-16"},
+    "tpu_v5e_8": {"accelerator_type": "v5litepod-8"},
+    "cpu_worker": {"machine_type": "n2-standard-8", "cpus": 8},
+}
+
+
+def _provider(api=None, **kw):
+    return GCPNodeProvider(CONFIGS, api or MockGcpApi(),
+                           head_address="10.0.0.2:6379", **kw)
+
+
+def test_create_and_terminate_tpu_slice():
+    api = MockGcpApi()
+    p = _provider(api)
+    (pid,) = p.create_node("tpu_v5e_16", {}, 1)
+    assert p.non_terminated_nodes() == [pid]
+    assert p.node_type(pid) == "tpu_v5e_16"
+    # one provider node = the whole 2-host x 8-chip slice
+    assert p.node_resources(pid) == {"TPU": 16.0, "CPU": 16.0}
+    assert len(api.tpu_nodes) == 1
+    node = next(iter(api.tpu_nodes.values()))
+    assert node["labels"]["ray-provider-id"] == pid
+    p.terminate_node(pid)
+    assert p.non_terminated_nodes() == []
+    assert not api.tpu_nodes
+
+
+def test_create_gce_cpu_worker():
+    api = MockGcpApi()
+    p = _provider(api)
+    (pid,) = p.create_node("cpu_worker", {}, 1)
+    assert p.node_resources(pid) == {"CPU": 8.0}
+    assert len(api.instances) == 1
+    p.terminate_node(pid)
+    assert not api.instances
+
+
+def test_adopt_existing_after_head_restart():
+    api = MockGcpApi()
+    p1 = _provider(api)
+    pids = p1.create_node("tpu_v5e_8", {}, 2)
+    p1.create_node("cpu_worker", {}, 1)
+    # a fresh provider (head restarted) must re-adopt all labeled nodes
+    p2 = _provider(api)
+    assert sorted(p2.non_terminated_nodes()) == \
+        sorted(p1.non_terminated_nodes())
+    assert p2.node_type(pids[0]) == "tpu_v5e_8"
+    # foreign (unlabeled) cloud nodes are ignored
+    api.tpu_nodes["stranger"] = {"name": "stranger", "state": "READY",
+                                 "acceleratorType": "v5litepod-8",
+                                 "labels": {}}
+    p3 = _provider(api)
+    assert "stranger" not in " ".join(p3.non_terminated_nodes())
+
+
+def test_unknown_accelerator_rejected():
+    p = _provider()
+    with pytest.raises(ValueError, match="accelerator_type"):
+        p.create_node("bad", {}, 1)
+
+
+CONFIGS["bad"] = {"accelerator_type": "v99-512"}
+
+
+def test_internal_id_via_kv_handshake():
+    kv = {}
+    p = _provider(gcs_kv_get=lambda k: kv.get(k))
+    (pid,) = p.create_node("tpu_v5e_8", {}, 1)
+    assert p.internal_id(pid) is None  # node not booted yet
+    kv[f"autoscaler.provider/{pid}"] = b"\x01" * 14
+    assert p.internal_id(pid) == b"\x01" * 14
+
+
+def test_autoscaler_scales_tpu_demand_through_gcp_provider():
+    """TPU demand shapes launch whole slices via the mocked cloud."""
+    api = MockGcpApi()
+    p = _provider(api)
+
+    def gcs(method, payload):
+        if method == "autoscaler_demand":
+            return {"pending": [{"TPU": 8.0}] * 2, "infeasible": []}
+        if method == "node_list":
+            return []
+        if method == "kv_put":
+            return True
+        raise AssertionError(method)
+
+    a = StandardAutoscaler(
+        gcs, p,
+        [NodeTypeConfig("tpu_v5e_8", {"TPU": 8.0, "CPU": 8.0},
+                        max_workers=4)])
+    out = a.update()
+    assert out["launched"] == 2
+    assert len(api.tpu_nodes) == 2
+    assert all(n["acceleratorType"] == "v5litepod-8"
+               for n in api.tpu_nodes.values())
+
+
+def test_rest_api_url_shapes():
+    """The REST implementation builds the documented endpoint URLs (no
+    network: just string assembly)."""
+    api = RestGcpApi("proj-x", "us-central2-b")
+    assert api._tpu_base == ("https://tpu.googleapis.com/v2/projects/"
+                             "proj-x/locations/us-central2-b/nodes")
+    assert api._gce_base == ("https://compute.googleapis.com/compute/v1/"
+                             "projects/proj-x/zones/us-central2-b/"
+                             "instances")
+
+
+def test_topology_table_consistency():
+    """v5litepod-N counts chips; v4-N / v5p-N count TensorCores (2 per
+    chip) — the Cloud TPU naming convention."""
+    for acc, (hosts, chips) in TPU_TOPOLOGIES.items():
+        total = int(acc.rsplit("-", 1)[1])
+        per_chip = 1 if acc.startswith("v5litepod") else 2
+        assert hosts * chips * per_chip == total, acc

@@ -7,6 +7,10 @@ from ray_tpu._private.ids import (
     put_object_id,
 )
 
+import pytest
+
+pytestmark = pytest.mark.fast
+
 
 def test_job_id_roundtrip():
     j = JobID.from_int(7)

@@ -12,6 +12,8 @@ from ray_tpu._private.object_store import (
     ObjectStoreFull,
 )
 
+pytestmark = pytest.mark.fast
+
 CAP = 32 * 1024 * 1024
 
 

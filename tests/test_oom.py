@@ -15,6 +15,8 @@ import pytest
 import ray_tpu
 from ray_tpu._private.node_manager import pick_oom_victim
 
+pytestmark = pytest.mark.fast
+
 
 class FakeWorker:
     def __init__(self, state, started_at, lease_id=0):

@@ -7,6 +7,8 @@ import ray_tpu
 from ray_tpu.util import (PlacementGroup, placement_group,
                           remove_placement_group)
 
+pytestmark = pytest.mark.fast
+
 
 def test_pg_create_ready_and_actor_placement(ray_start_regular):
     pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")

@@ -16,6 +16,8 @@ import ray_tpu
 from ray_tpu._private.gcs import GcsServer
 from ray_tpu.cluster_utils import Cluster
 
+pytestmark = pytest.mark.fast
+
 NODE_A = b"A" * 16
 NODE_B = b"B" * 16
 

@@ -7,6 +7,8 @@ import pytest
 
 import ray_tpu
 
+pytestmark = pytest.mark.fast
+
 
 def test_async_actor_sync_methods_serialize(ray_start_shared):
     """An actor auto-detected as async (has a coroutine method) must

@@ -57,3 +57,71 @@ def test_custom_plugin(tmp_path):
         assert ctx.env_vars["PLUGGED"] == "7"
     finally:
         re_mod.PLUGINS.pop("myfield", None)
+
+
+def test_conda_plugin_activates_named_env(tmp_path, monkeypatch):
+    """conda plugin: named env -> activation env vars (PATH/CONDA_*),
+    driven through a fake conda binary (none is installed here)."""
+    import os
+    import stat
+
+    from ray_tpu import runtime_env as re_mod
+
+    base = tmp_path / "conda_base"
+    envdir = base / "envs" / "myenv" / "bin"
+    envdir.mkdir(parents=True)
+    fake = tmp_path / "bin"
+    fake.mkdir()
+    conda = fake / "conda"
+    conda.write_text(f"#!/bin/sh\necho {base}\n")
+    conda.chmod(conda.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{fake}:{os.environ['PATH']}")
+
+    ctx = re_mod.materialize({"conda": "myenv"}, lambda k: None,
+                             str(tmp_path / "cache"))
+    assert ctx.env_vars["CONDA_DEFAULT_ENV"] == "myenv"
+    assert ctx.env_vars["CONDA_PREFIX"] == str(base / "envs" / "myenv")
+    assert ctx.env_vars["PATH"].startswith(
+        str(base / "envs" / "myenv" / "bin"))
+
+
+def test_conda_plugin_missing_binary_fails_loudly(tmp_path, monkeypatch):
+    from ray_tpu import runtime_env as re_mod
+
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    with pytest.raises(RuntimeError, match="conda"):
+        re_mod.materialize({"conda": "x"}, lambda k: None,
+                           str(tmp_path / "cache"))
+
+
+def test_container_plugin_builds_command_prefix(tmp_path, monkeypatch):
+    import stat
+
+    from ray_tpu import runtime_env as re_mod
+
+    fake = tmp_path / "bin"
+    fake.mkdir()
+    podman = fake / "podman"
+    podman.write_text("#!/bin/sh\nexit 0\n")
+    podman.chmod(podman.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", str(fake))
+
+    ctx = re_mod.materialize(
+        {"container": {"image": "img:tag",
+                       "run_options": ["-v", "/data:/data"]}},
+        lambda k: None, str(tmp_path / "cache"))
+    assert ctx.command_prefix[0] == str(podman)
+    assert ctx.command_prefix[-1] == "img:tag"
+    assert "-v" in ctx.command_prefix
+
+    with pytest.raises(RuntimeError, match="image"):
+        re_mod.materialize({"container": {}}, lambda k: None,
+                           str(tmp_path / "cache"))
+
+
+def test_validate_accepts_conda_container():
+    from ray_tpu import runtime_env as re_mod
+
+    out = re_mod.validate({"conda": "env1",
+                           "container": {"image": "x"}})
+    assert out["conda"] == "env1"

@@ -1,0 +1,148 @@
+"""Declarative serve config + long-poll push (reference:
+serve/schema.py:1, serve/_private/long_poll.py:184)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.schema import ServeApplicationSchema
+
+pytestmark = pytest.mark.fast
+
+
+# module-level so the import-path machinery can resolve it
+@serve.deployment(name="echo_app")
+class EchoApp:
+    def __call__(self, x):
+        return {"echo": x}
+
+
+def build_app(scale: int = 1):
+    return EchoApp.options(num_replicas=scale).bind()
+
+
+def test_schema_validation_errors():
+    with pytest.raises(ValueError, match="import_path"):
+        ServeApplicationSchema.parse({})
+    with pytest.raises(ValueError, match="format"):
+        ServeApplicationSchema.parse({"import_path": "no_colon"})
+    with pytest.raises(ValueError, match="unknown deployment config"):
+        ServeApplicationSchema.parse({
+            "import_path": "m:a",
+            "deployments": [{"name": "x", "replicas": 3}]})
+    with pytest.raises(ValueError, match="num_replicas"):
+        ServeApplicationSchema.parse({
+            "import_path": "m:a",
+            "deployments": [{"name": "x", "num_replicas": -1}]})
+    with pytest.raises(ValueError, match="duplicate"):
+        ServeApplicationSchema.parse({
+            "import_path": "m:a",
+            "deployments": [{"name": "x"}, {"name": "x"}]})
+    with pytest.raises(ValueError, match="min_replicas"):
+        ServeApplicationSchema.parse({
+            "import_path": "m:a",
+            "deployments": [{"name": "x", "autoscaling_config":
+                             {"min_replicas": 5, "max_replicas": 2}}]})
+    ok = ServeApplicationSchema.parse({
+        "import_path": "tests.test_serve_config:EchoApp",
+        "deployments": [{"name": "echo_app", "num_replicas": 2}]})
+    assert ok.deployments[0].num_replicas == 2
+
+
+def test_apply_config_deploys_and_overrides(ray_start_shared):
+    from ray_tpu.serve import schema
+
+    try:
+        handle = schema.apply({
+            "import_path": "tests.test_serve_config:EchoApp",
+            "deployments": [{"name": "echo_app", "num_replicas": 2}]})
+        assert handle.call("hi")["echo"] == "hi"
+        st = serve.status()
+        assert st["echo_app"]["replicas"] == 2
+    finally:
+        serve.shutdown()
+
+
+def test_apply_config_builder_function(ray_start_shared):
+    from ray_tpu.serve import schema
+
+    try:
+        handle = schema.apply({
+            "import_path": "tests.test_serve_config:build_app",
+            "args": {"scale": 1}})
+        assert handle.call("yo")["echo"] == "yo"
+    finally:
+        serve.shutdown()
+
+
+def test_long_poll_pushes_membership(ray_start_shared):
+    """A redeploy must reach an existing handle via the push channel —
+    no 5s polling interval, no stale replica errors."""
+    try:
+        @serve.deployment(name="lp")
+        class V1:
+            def __call__(self, x):
+                return "v1"
+
+        handle = serve.run(V1.bind())
+        assert handle.call("x") == "v1"
+
+        @serve.deployment(name="lp")
+        class V2:
+            def __call__(self, x):
+                return "v2"
+
+        serve.run(V2.bind())  # same name: replica set fully replaced
+        # the OLD handle must pick up the new replicas push-style;
+        # allow a short beat for the long-poll round trip (well under
+        # the old 5s polling interval)
+        deadline = time.monotonic() + 4.0
+        got = None
+        while time.monotonic() < deadline:
+            try:
+                got = handle.call("x")
+                if got == "v2":
+                    break
+            except Exception:  # noqa: BLE001 - transient during swap
+                pass
+            time.sleep(0.2)
+        assert got == "v2"
+    finally:
+        serve.shutdown()
+
+
+def test_listen_for_change_semantics():
+    """Controller-level contract: immediate answer on version mismatch,
+    block-until-change otherwise, -1 for deleted deployments."""
+    import threading
+
+    from ray_tpu.serve.controller import ServeController
+
+    c = ServeController.__new__(ServeController)  # no reconcile thread
+    c.deployments = {}
+    c.routes = {}
+    c._lock = threading.Lock()
+    c._change = threading.Condition(c._lock)
+    c._stop = True
+
+    assert c.listen_for_change("ghost", 0)["version"] == -1
+    c.deployments["d"] = {"config": {}, "replicas": ["r1"], "version": 3,
+                          "scale_pending_since": None}
+    out = c.listen_for_change("d", 0)   # stale version: immediate
+    assert out == {"version": 3, "replicas": ["r1"]}
+    out = c.listen_for_change("d", 3, timeout=0.2)  # current: blocks
+    assert out["replicas"] is None
+
+    def mutate():
+        time.sleep(0.2)
+        with c._lock:
+            c.deployments["d"]["replicas"] = ["r1", "r2"]
+            c._bump_locked("d")
+
+    t = threading.Thread(target=mutate)
+    t.start()
+    out = c.listen_for_change("d", 3, timeout=5.0)
+    t.join()
+    assert out["version"] == 4 and out["replicas"] == ["r1", "r2"]

@@ -11,6 +11,8 @@ from ray_tpu.rllib.rollout_worker import RolloutWorker
 from ray_tpu.rllib.vector_env import (CartPoleVecEnv, SyncVectorEnv,
                                       make_vector_env)
 
+pytestmark = pytest.mark.fast
+
 
 def test_cartpole_vec_matches_gymnasium_physics():
     """The batched implementation must track gymnasium's CartPole-v1
