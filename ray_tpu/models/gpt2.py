@@ -60,6 +60,13 @@ class GPT2Config:
     #: lax.scan unroll factor for the layer stack: >1 lets XLA overlap one
     #: layer's weight loads with the previous layer's compute.
     scan_unroll: int = 1
+    #: Stream the lm-head + cross-entropy over vocab tiles
+    #: (ops/vocab_ce.py): the float32 (B,T,V) logits never materialize —
+    #: ~6.6 GB of HBM traffic per step at b32/V50k (PERF_NOTES lever 1).
+    #: Leave off when the seq axis is mesh-sharded (the (B,T)->(B*T)
+    #: flatten would reshard); mutually exclusive with loss_chunks>1.
+    use_streaming_ce: bool = False
+    vocab_tile: int = 8192
     seq_parallel: bool = False  # context parallelism over the "seq" axis
     #: context-parallel algorithm: "ring" (kv blocks rotate by ppermute,
     #: O(T/n) memory) or "ulysses" (head-scatter/seq-gather all-to-all —
@@ -515,6 +522,28 @@ def gpt2_loss(params, batch, cfg: GPT2Config,
     hidden, aux = gpt2_hidden(params, inputs, cfg, rules,
                               return_aux=True)
     aux_term = cfg.moe_aux_weight * aux if cfg.n_experts else 0.0
+    if cfg.use_streaming_ce:
+        from ray_tpu.ops.vocab_ce import streaming_ce
+
+        if cfg.loss_chunks > 1:
+            raise ValueError("use_streaming_ce and loss_chunks>1 are "
+                             "mutually exclusive (both bound the logits "
+                             "footprint; pick one)")
+        if cfg.seq_parallel:
+            raise ValueError("use_streaming_ce needs an unsharded seq "
+                             "axis (the (B,T)->(B*T) flatten would "
+                             "force a reshard under seq parallelism)")
+        B, T = targets.shape
+        nll = streaming_ce(
+            hidden.reshape(B * T, -1), params["wte"],
+            targets.reshape(-1).astype(jnp.int32), cfg.vocab_size,
+            min(cfg.vocab_tile, cfg.padded_vocab),
+            cfg.dtype).reshape(B, T)
+        if mask is not None:
+            m = mask.astype(jnp.float32)
+            return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m),
+                                                  1.0) + aux_term
+        return jnp.mean(nll) + aux_term
     if cfg.loss_chunks > 1:
         if mask is None:
             mask = jnp.ones(targets.shape, jnp.float32)

@@ -34,6 +34,10 @@ class _SharedListener:
         self._handles: list = []  # weakrefs
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        #: monotonic time of the last successful listen_for_change
+        #: round-trip — handles fall back to polling when this goes
+        #: stale (listener wedged/dead)
+        self.last_ok = 0.0
 
     def register(self, handle: "DeploymentHandle") -> None:
         import weakref
@@ -63,26 +67,42 @@ class _SharedListener:
 
         version = 0
         while True:
-            handles = self._live_handles()
-            if not handles:
+            if not self._live_handles():
                 with self._lock:
+                    if self._handles:
+                        # register() raced our empty snapshot: a fresh
+                        # handle appeared between the check and this
+                        # lock — keep looping for it (lost-wakeup fix)
+                        continue
                     self._thread = None  # next register restarts us
-                return
-            del handles  # don't pin across the long poll
+                    return
             try:
                 out = ray_tpu.get(
                     self._controller.listen_for_change.remote(
                         self._name, version),
                     timeout=60)
+                self.last_ok = time.monotonic()
             except Exception:  # noqa: BLE001 - controller briefly away
                 time.sleep(1.0)
                 continue
             if out.get("version") == -1:
-                return  # deployment deleted; routing will error out
+                # deployment deleted: drop out of the registry so a
+                # redeploy under the same name gets a FRESH listener
+                with self._lock:
+                    self._thread = None
+                with _listeners_lock:
+                    for k, v in list(_listeners.items()):
+                        if v is self:
+                            del _listeners[k]
+                return
             if out.get("replicas") is not None:
                 version = out["version"]
                 for h in self._live_handles():
                     h._apply_membership(list(out["replicas"]), version)
+
+    def healthy(self) -> bool:
+        return (self._thread is not None and self._thread.is_alive()
+                and time.monotonic() - self.last_ok < 90.0)
 
 
 _listeners: dict = {}
@@ -112,12 +132,15 @@ class DeploymentHandle:
         self._outstanding: List = []
         self._fetched_at = 0.0
         self._listener: Optional[_SharedListener] = None
+        #: serializes membership swaps (listener thread) against the
+        #: routing counters (request thread)
+        self._route_lock = threading.Lock()
         self._closed = False
 
     # -- membership -------------------------------------------------------
 
     def _ensure_listener(self) -> None:
-        if self._listener is not None:
+        if self._listener is not None and self._listener.healthy():
             return
         self._listener = _shared_listener(self._controller,
                                           self.deployment_name)
@@ -131,11 +154,12 @@ class DeploymentHandle:
         # Reset counters on membership change (a freshly restarted
         # replica must not inherit stale load) and drop the matching
         # outstanding entries so they can't decrement the fresh counters.
-        self._replicas = replicas
-        self._version = version
-        self._inflight = {r: 0 for r in replicas}
-        self._outstanding = []
-        self._fetched_at = time.monotonic()
+        with self._route_lock:
+            self._replicas = replicas
+            self._version = version
+            self._inflight = {r: 0 for r in replicas}
+            self._outstanding = []
+            self._fetched_at = time.monotonic()
 
     def _refresh(self, force: bool = False) -> None:
         import ray_tpu
@@ -144,8 +168,11 @@ class DeploymentHandle:
         if not force and self._replicas and \
                 time.monotonic() - self._fetched_at < _REFRESH_S:
             return
-        if not force and self._replicas and self._listener is not None:
-            return  # shared listener keeps us fresh; no poll needed
+        if not force and self._replicas and \
+                self._listener is not None and self._listener.healthy():
+            return  # live listener keeps us fresh; no poll needed
+        # fallback poll: no listener heartbeat (wedged thread, deleted+
+        # redeployed deployment) — _REFRESH_S staleness bound applies
         self._apply_membership(ray_tpu.get(
             self._controller.get_replicas.remote(self.deployment_name),
             timeout=30), self._version)
@@ -185,19 +212,22 @@ class DeploymentHandle:
     def remote(self, *args, _serve_method: str = "__call__", **kwargs):
         """Route one request; returns an ObjectRef."""
         self._refresh()
-        self._reap()
-        replica = self._pick()
-        self._inflight[replica] = self._inflight.get(replica, 0) + 1
+        with self._route_lock:
+            self._reap()
+            replica = self._pick()
+            self._inflight[replica] = self._inflight.get(replica, 0) + 1
         ref = replica.handle_request.remote(
             *args, _serve_method=_serve_method, **kwargs)
-        self._outstanding.append((ref, replica))
+        with self._route_lock:
+            self._outstanding.append((ref, replica))
         return ref
 
     def queue_len(self) -> int:
         """Unfinished requests routed through this handle (autoscaling
         signal)."""
-        self._reap()
-        return sum(self._inflight.values())
+        with self._route_lock:
+            self._reap()
+            return sum(self._inflight.values())
 
     def call(self, *args, timeout: float = 60.0, **kwargs):
         """Convenience: route + block for the result, with one retry
