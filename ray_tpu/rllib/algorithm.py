@@ -29,6 +29,11 @@ class AlgorithmConfig:
     num_cpus_per_worker: float = 1.0
     # learner placement: {"TPU": 1} puts the learner policy on the chip
     learner_resources: Optional[Dict[str, float]] = None
+    #: run greedy-policy evaluation every N train() iterations on a
+    #: dedicated worker (reference: evaluation_interval +
+    #: evaluation WorkerSet, algorithm.py evaluate()); 0 = off
+    evaluation_interval: int = 0
+    evaluation_num_episodes: int = 10
 
     def copy(self) -> "AlgorithmConfig":
         return copy.deepcopy(self)
@@ -76,7 +81,34 @@ class Algorithm:
             "episodes_total": len(self._episode_returns),
             "time_this_iter_s": time.monotonic() - start,
         })
+        interval = getattr(self.config, "evaluation_interval", 0)
+        if interval and self.iteration % interval == 0:
+            result["evaluation"] = self.evaluate()
         return result
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy-policy evaluation on a dedicated worker (reference:
+        Algorithm.evaluate over the evaluation WorkerSet).  Subclasses
+        that support it implement ``_make_eval_worker``; the worker is
+        created lazily and reused, with weights synced per call."""
+        import ray_tpu
+
+        factory = getattr(self, "_make_eval_worker", None)
+        if factory is None:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support evaluation")
+        if getattr(self, "_eval_worker", None) is None:
+            self._eval_worker = factory()
+        w = self._eval_worker
+        ray_tpu.get(w.set_weights.remote(
+            self._eval_weights()), timeout=60)
+        fs = getattr(self, "_filter_state", None)
+        if fs is not None:
+            # evaluation must normalize with the TRAINING statistics
+            ray_tpu.get(w.set_filter_state.remote(fs), timeout=60)
+        return ray_tpu.get(w.evaluate.remote(
+            getattr(self.config, "evaluation_num_episodes", 10)),
+            timeout=600)
 
     def stop(self) -> None:
         self.cleanup()

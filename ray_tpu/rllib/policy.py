@@ -220,6 +220,14 @@ class JaxPolicy:
                                                  self._rng)
         return (np.asarray(actions), np.asarray(logp), np.asarray(vf))
 
+    def compute_deterministic_actions(self, obs: np.ndarray) -> np.ndarray:
+        """Greedy/mean actions for evaluation (reference:
+        explore=False in Algorithm.evaluate's policy calls)."""
+        logits = _net_apply(self.params["pi"], np.asarray(obs, np.float32))
+        if getattr(self.spec, "continuous", False):
+            return np.asarray(logits)  # Gaussian mean
+        return np.asarray(logits).argmax(axis=-1)
+
     def value(self, obs: np.ndarray) -> np.ndarray:
         return np.asarray(_net_apply(self.params["vf"], obs)[..., 0])
 

@@ -133,5 +133,31 @@ class PPO(Algorithm):
         stats["timesteps_this_iter"] = batch.count
         return stats
 
+    def _make_eval_worker(self):
+        import ray_tpu
+        from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+        cfg = self.config
+        remote_cls = ray_tpu.remote(
+            num_cpus=cfg.num_cpus_per_worker)(RolloutWorker)
+        return remote_cls.remote(
+            env=cfg.env, env_config=cfg.env_config,
+            policy_spec=cfg.policy_spec(),
+            num_envs=max(1, cfg.num_envs_per_worker),
+            gamma=cfg.gamma, lam=cfg.lam,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            seed=cfg.seed + 424242,
+            observation_filter=cfg.observation_filter)
+
+    def _eval_weights(self):
+        return self.learner_policy.get_weights()
+
     def cleanup(self) -> None:
         self.workers.stop()
+        if getattr(self, "_eval_worker", None) is not None:
+            import ray_tpu
+
+            try:
+                ray_tpu.kill(self._eval_worker)
+            except Exception:  # noqa: BLE001
+                pass

@@ -44,6 +44,8 @@ class RolloutWorker:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         self.venv = make_vector_env(env, env_config, num_envs, seed=seed)
         self.num_envs = self.venv.num_envs
+        self._env_spec = (env, env_config, seed)  # for the lazy eval env
+        self._eval_env = None
         self.policy = JaxPolicy(policy_spec, seed=seed)
         continuous = getattr(policy_spec, "continuous", False)
 
@@ -130,6 +132,59 @@ class RolloutWorker:
         out = self.episode_returns
         self.episode_returns = []
         return out
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, num_episodes: int,
+                 max_steps: int = 10_000) -> Dict[str, float]:
+        """Greedy-policy evaluation episodes (reference: the dedicated
+        evaluation WorkerSet driven with explore=False,
+        algorithm.py evaluate()).  Runs on a separate env so training
+        rollout state is untouched; actions are deterministic
+        (argmax / Gaussian mean), observations pass through the same
+        connector pipeline with filter statistics FROZEN."""
+        if getattr(self, "_eval_env", None) is None:
+            self._eval_env = make_vector_env(
+                self._env_spec[0], self._env_spec[1],
+                min(num_episodes, 8), seed=self._env_spec[2] + 77_000)
+        venv = self._eval_env
+        n = venv.num_envs
+        # fixed-seed reset per call: same weights → same eval result
+        raw = venv.vector_reset(seed=self._env_spec[2] + 77_000)
+        ep_rew = np.zeros(n, np.float64)
+        ep_len = np.zeros(n, np.int64)
+        returns: List[float] = []
+        lengths: List[int] = []
+        continuous = getattr(self.policy.spec, "continuous", False)
+        for _ in range(max_steps):
+            obs = self.obs_pipeline(raw, update=False)
+            actions = self.policy.compute_deterministic_actions(obs)
+            env_actions = self.action_pipeline(actions) \
+                if continuous else actions
+            raw, rews, terms, truncs, _ = venv.vector_step(env_actions)
+            ep_rew += rews
+            ep_len += 1
+            done = terms | truncs
+            if done.any():
+                returns.extend(ep_rew[done].tolist())
+                lengths.extend(ep_len[done].tolist())
+                ep_rew[done] = 0.0
+                ep_len[done] = 0
+            if len(returns) >= num_episodes:
+                break
+        returns = returns[:num_episodes]
+        lengths = lengths[:num_episodes]
+        return {
+            "episode_reward_mean": float(np.mean(returns))
+            if returns else float("nan"),
+            "episode_reward_min": float(np.min(returns))
+            if returns else float("nan"),
+            "episode_reward_max": float(np.max(returns))
+            if returns else float("nan"),
+            "episode_len_mean": float(np.mean(lengths))
+            if lengths else float("nan"),
+            "episodes_this_eval": len(returns),
+        }
 
     # -- observation-filter sync (FilterManager protocol) -----------------
 

@@ -175,3 +175,30 @@ def test_sync_vector_env_reuses_probe():
     vec = make_vector_env(lambda cfg: CountingEnv(), None, 3)
     assert vec.num_envs == 3
     assert len(built) == 3  # probe reused, not 4 constructions
+
+
+def test_evaluation_worker_greedy_episodes(ray_start_shared):
+    """Algorithm.evaluate: dedicated worker, deterministic actions,
+    training rollout state untouched (reference: evaluation WorkerSet
+    with explore=False)."""
+    from ray_tpu.rllib import PPO, PPOConfig
+
+    cfg = PPOConfig(env="CartPole-v1", num_workers=1,
+                    num_envs_per_worker=4, rollout_fragment_length=64,
+                    train_batch_size=256, seed=0,
+                    evaluation_interval=2, evaluation_num_episodes=4)
+    algo = PPO(cfg)
+    try:
+        r1 = algo.train()
+        assert "evaluation" not in r1  # interval=2
+        r2 = algo.train()
+        ev = r2["evaluation"]
+        assert ev["episodes_this_eval"] == 4
+        assert np.isfinite(ev["episode_reward_mean"])
+        assert ev["episode_reward_min"] <= ev["episode_reward_max"]
+        # deterministic policy: direct evaluate() twice is repeatable
+        e1 = algo.evaluate()
+        e2 = algo.evaluate()
+        assert e1["episode_reward_mean"] == e2["episode_reward_mean"]
+    finally:
+        algo.stop()
