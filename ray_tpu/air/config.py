@@ -77,3 +77,7 @@ class RunConfig:
     checkpoint_config: Optional[CheckpointConfig] = None
     stop: Optional[Union[Dict[str, Any], int]] = None
     verbose: int = 1
+    #: tune.Callback instances fired on trial lifecycle events; when
+    #: None, Tuner attaches the default CSV/JSON/TensorBoard loggers
+    #: (reference air/config.py RunConfig.callbacks + DEFAULT_LOGGERS).
+    callbacks: Optional[list] = None

@@ -2,8 +2,11 @@
 python/ray/tune — Tuner.fit → TrialRunner event loop over trial actors,
 searchers + schedulers)."""
 
+from ray_tpu.tune.callback import Callback
+from ray_tpu.tune.logger import (CSVLoggerCallback, JsonLoggerCallback,
+                                 LoggerCallback, TBXLoggerCallback)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
-                                     MedianStoppingRule,
+                                     MedianStoppingRule, PB2,
                                      PopulationBasedTraining,
                                      TrialScheduler)
 from ray_tpu.tune.search import (Searcher, TPESearcher, choice,
@@ -17,5 +20,7 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "Searcher", "TPESearcher",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining",
+    "PopulationBasedTraining", "PB2",
+    "Callback", "LoggerCallback", "CSVLoggerCallback",
+    "JsonLoggerCallback", "TBXLoggerCallback",
 ]

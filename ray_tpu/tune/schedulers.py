@@ -184,6 +184,103 @@ class PopulationBasedTraining(TrialScheduler):
         return RESTART
 
 
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: tune/schedulers/pb2.py:210
+    PB2) — PBT's exploit/explore loop, but explore selects new
+    hyperparameter values by maximizing a GP-UCB acquisition fit to the
+    population's observed (config, reward-change) history instead of
+    random perturbation.  Data-efficient at small population sizes.
+
+    ``hyperparam_bounds`` maps each mutable key to ``[low, high]``
+    (continuous).  The GP is an RBF-kernel regression on normalized
+    configs; the acquisition is maximized over a random candidate sweep
+    — both pure numpy, matching the reference's GPy-free spirit at this
+    scale.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min", *,
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 seed: Optional[int] = None):
+        if not hyperparam_bounds:
+            raise ValueError("hyperparam_bounds is required")
+        super().__init__(
+            metric, mode, perturbation_interval=perturbation_interval,
+            hyperparam_mutations={k: (lambda lo=lo, hi=hi: lo)
+                                  for k, (lo, hi) in
+                                  hyperparam_bounds.items()},
+            quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        #: (normalized config vector, reward delta) observations
+        self._obs_x: List[List[float]] = []
+        self._obs_y: List[float] = []
+        self._last_score: Dict[str, float] = {}
+
+    def _norm(self, config: Dict[str, Any]) -> List[float]:
+        out = []
+        for k, (lo, hi) in self.bounds.items():
+            v = float(config.get(k, lo))
+            out.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return out
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        # record reward deltas for the GP before the PBT decision
+        s = result.get(self.metric)
+        if s is not None:
+            s = float(s) if self.mode == "max" else -float(s)
+            prev = self._last_score.get(trial.trial_id)
+            self._last_score[trial.trial_id] = s
+            if prev is not None:
+                self._obs_x.append(self._norm(trial.config))
+                self._obs_y.append(s - prev)
+                if len(self._obs_y) > 512:  # bound the GP solve
+                    self._obs_x.pop(0)
+                    self._obs_y.pop(0)
+        decision = super().on_trial_result(trial, result)
+        if decision == RESTART:
+            # the next report comes from the donor's checkpoint: its
+            # score jump reflects the exploit, not the explored config —
+            # don't let it contaminate the GP observations
+            self._last_score.pop(trial.trial_id, None)
+        return decision
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        import numpy as np
+
+        out = dict(config)
+        keys = list(self.bounds)
+        if len(self._obs_y) < 4:
+            for k in keys:  # cold start: uniform in bounds
+                lo, hi = self.bounds[k]
+                out[k] = lo + (hi - lo) * self._rng.random()
+            return out
+        X = np.asarray(self._obs_x)
+        y = np.asarray(self._obs_y)
+        ystd = y.std() or 1.0
+        y = (y - y.mean()) / ystd
+        # RBF GP posterior over 256 random candidates; UCB selection
+        ls, noise = 0.3, 1e-2
+        K = np.exp(-0.5 * ((X[:, None] - X[None]) ** 2).sum(-1) / ls**2)
+        Kinv_y = np.linalg.solve(K + noise * np.eye(len(X)), y)
+        cand = np.asarray([[self._rng.random() for _ in keys]
+                           for _ in range(256)])
+        Kc = np.exp(-0.5 * ((cand[:, None] - X[None]) ** 2).sum(-1)
+                    / ls**2)
+        mu = Kc @ Kinv_y
+        var = 1.0 - (Kc * np.linalg.solve(
+            K + noise * np.eye(len(X)), Kc.T).T).sum(-1)
+        ucb = mu + 2.0 * np.sqrt(np.maximum(var, 0.0))
+        best = cand[int(ucb.argmax())]
+        for i, k in enumerate(keys):
+            lo, hi = self.bounds[k]
+            v = lo + (hi - lo) * float(best[i])
+            out[k] = type(config[k])(v) if isinstance(
+                config.get(k), int) else v
+        return out
+
+
 class MedianStoppingRule(TrialScheduler):
     """Stop a trial whose running-best metric is worse than the median of
     other trials' running averages at the same step (reference:

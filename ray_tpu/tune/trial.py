@@ -28,6 +28,9 @@ class Trial:
     restore_checkpoint: Optional[Any] = None
     error: Optional[BaseException] = None
     iteration: int = 0
+    #: per-trial artifact directory (progress.csv / result.json /
+    #: tfevents) — assigned by the runner at first launch.
+    logdir: Optional[str] = None
     #: crash-restart count consumed against FailureConfig.max_failures
     num_failures: int = 0
 

@@ -34,7 +34,8 @@ class TrialRunner:
                  resources_per_trial: Optional[Dict[str, float]] = None,
                  experiment_dir: Optional[str] = None,
                  failure_config=None,
-                 searcher=None, num_samples: int = 0):
+                 searcher=None, num_samples: int = 0,
+                 callbacks=None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
@@ -61,6 +62,9 @@ class TrialRunner:
         #: failed trials waiting out their backoff: (monotonic_due, trial)
         self._retry_at: List[tuple] = []
         self._searcher_done = False
+        from ray_tpu.tune.callback import CallbackList
+
+        self.callbacks = CallbackList(callbacks or [])
 
     # -- experiment-level checkpoint/resume -------------------------------
     # (reference: trial_runner.py save/restore + Tuner.restore)
@@ -125,6 +129,7 @@ class TrialRunner:
     def run(self) -> List[Trial]:
         import time as _time
 
+        self.callbacks.setup(self.experiment_dir)
         self._pending.extend(
             t for t in self.trials if not t.is_finished)
         pending = self._pending
@@ -176,11 +181,15 @@ class TrialRunner:
                                  trial.error or RuntimeError(
                                      "experiment aborted"))
             self.save_state(force=True)
+            self.callbacks.on_experiment_end(self.trials)
         return self.trials
 
     def _launch(self, trial: Trial) -> None:
         from ray_tpu.train._internal.worker_group import RayTrainWorker
 
+        if trial.logdir is None and self.experiment_dir:
+            trial.logdir = os.path.join(self.experiment_dir,
+                                        f"trial_{trial.trial_id}")
         opts: Dict[str, Any] = {"num_cpus": self.resources.get("CPU", 1.0)}
         if self.resources.get("TPU"):
             opts["num_tpus"] = self.resources["TPU"]
@@ -197,6 +206,7 @@ class TrialRunner:
         trial.status = trial_mod.RUNNING
         self._actors[trial.trial_id] = actor
         self._inflight[actor.next_result.remote()] = trial
+        self.callbacks.on_trial_start(trial)
 
     def _searcher_pending(self) -> bool:
         return (self.searcher is not None
@@ -224,6 +234,8 @@ class TrialRunner:
                     error=status == trial_mod.ERROR, config=trial.config)
             except Exception:  # noqa: BLE001 - searcher bug ≠ run abort
                 logger.exception("searcher on_trial_complete failed")
+        if trial.is_finished:
+            self.callbacks.on_trial_complete(trial)
 
     def _handle_failure(self, trial: Trial, error: BaseException) -> None:
         """Crash path: requeue the trial to restart from its last
@@ -236,6 +248,7 @@ class TrialRunner:
         pumping of healthy trials — no sleeping here."""
         import time as _time
 
+        self.callbacks.on_trial_error(trial, error)
         mf = self.failure_config.max_failures
         if mf != -1 and trial.num_failures >= mf:
             self._finish(trial, trial_mod.ERROR, error)
@@ -287,6 +300,8 @@ class TrialRunner:
         trial.last_result = metrics
         if res.checkpoint is not None:
             trial.checkpoint = res.checkpoint
+            self.callbacks.on_checkpoint(trial, res.checkpoint)
+        self.callbacks.on_trial_result(trial, metrics)
         self.save_state()
 
         decision = CONTINUE if self._should_stop(metrics) is False else STOP

@@ -80,12 +80,24 @@ class Tuner:
         self.resources_per_trial = resources_per_trial
 
     def _experiment_dir(self) -> Optional[str]:
-        if not self.run_config.storage_path:
-            return None
         import os
+        import time
 
-        return os.path.join(self.run_config.storage_path,
-                            self.run_config.name or "tune_experiment")
+        cached = getattr(self, "_experiment_dir_cache", None)
+        if cached:
+            return cached
+        # Default storage mirrors the reference's ~/ray_results so
+        # Tuner.fit always leaves tailable per-trial artifacts
+        # (override with RunConfig.storage_path / RAYTPU_RESULTS_DIR).
+        # Unnamed experiments get a timestamped dir so two runs never
+        # interleave their trial artifacts / experiment state.
+        root = (self.run_config.storage_path
+                or os.environ.get("RAYTPU_RESULTS_DIR")
+                or os.path.expanduser("~/ray_tpu_results"))
+        name = self.run_config.name or time.strftime(
+            "tune_%Y-%m-%d_%H-%M-%S")
+        self._experiment_dir_cache = os.path.join(root, name)
+        return self._experiment_dir_cache
 
     def fit(self) -> ResultGrid:
         trials = getattr(self, "_restored_trials", None)
@@ -115,6 +127,13 @@ class Tuner:
     def _run(self, trials: List[Trial]) -> ResultGrid:
         stop = self.run_config.stop if isinstance(self.run_config.stop,
                                                   dict) else None
+        from ray_tpu.tune.logger import DEFAULT_LOGGERS, LoggerCallback
+
+        callbacks = list(self.run_config.callbacks or [])
+        if not any(isinstance(cb, LoggerCallback) for cb in callbacks):
+            # reference semantics: user callbacks ADD to the default
+            # loggers unless the user supplies their own LoggerCallback
+            callbacks += [cls() for cls in DEFAULT_LOGGERS]
         runner = TrialRunner(
             self._trainable, trials,
             scheduler=self.tune_config.scheduler,
@@ -124,7 +143,8 @@ class Tuner:
             experiment_dir=self._experiment_dir(),
             failure_config=self.run_config.failure_config,
             searcher=self.tune_config.search_alg,
-            num_samples=self.tune_config.num_samples)
+            num_samples=self.tune_config.num_samples,
+            callbacks=callbacks)
         runner.run()
         return ResultGrid(runner.trials)
 

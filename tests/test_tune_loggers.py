@@ -1,0 +1,182 @@
+"""Tune logger/callback surface: per-trial CSV/JSONL/TB artifacts and
+Callback lifecycle hooks (reference analogs: tune/logger/csv.py:69
+CSVLoggerCallback, logger/tensorboardx.py, tune/callback.py)."""
+
+import csv
+import glob
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.logger import read_tfevents
+
+
+def _objective(config):
+    from ray_tpu.air import session
+
+    for i in range(3):
+        session.report({"loss": config["x"] / (i + 1), "nested": {"a": i}})
+
+
+def test_default_loggers_leave_artifacts(ray_start_shared, tmp_path):
+    grid = tune.Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        run_config=ray_tpu.air.RunConfig(storage_path=str(tmp_path),
+                                         name="exp"),
+    ).fit()
+    assert not grid.errors
+    trial_dirs = sorted(glob.glob(str(tmp_path / "exp" / "trial_*")))
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        # params.json records the config
+        with open(os.path.join(d, "params.json")) as f:
+            params = json.load(f)
+        assert params["x"] in (1.0, 2.0)
+        # result.json: one JSON object per report
+        with open(os.path.join(d, "result.json")) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        assert len(rows) == 3
+        assert rows[0]["loss"] == params["x"]
+        # progress.csv: header + 3 rows, nested keys flattened
+        with open(os.path.join(d, "progress.csv")) as f:
+            crows = list(csv.DictReader(f))
+        assert len(crows) == 3
+        assert "nested/a" in crows[0]
+        assert float(crows[-1]["loss"]) == pytest.approx(params["x"] / 3)
+        # tfevents: scalars parse back out with increasing steps
+        ev_files = glob.glob(os.path.join(d, "events.out.tfevents.*"))
+        assert len(ev_files) == 1
+        scalars = list(read_tfevents(ev_files[0]))
+        loss_events = [(v, s) for tag, v, s in scalars
+                       if tag == "ray/tune/loss"]
+        assert len(loss_events) == 3
+        assert [s for _, s in loss_events] == [1, 2, 3]
+        assert loss_events[0][0] == pytest.approx(params["x"])
+
+
+class _Recorder(tune.Callback):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, experiment_dir):
+        self.events.append(("setup", experiment_dir))
+
+    def on_trial_start(self, trial):
+        self.events.append(("start", trial.trial_id))
+
+    def on_trial_result(self, trial, result):
+        self.events.append(("result", trial.trial_id, result["loss"]))
+
+    def on_checkpoint(self, trial, checkpoint):
+        self.events.append(("checkpoint", trial.trial_id))
+
+    def on_trial_error(self, trial, error):
+        self.events.append(("error", trial.trial_id))
+
+    def on_trial_complete(self, trial):
+        self.events.append(("complete", trial.trial_id, trial.status))
+
+    def on_experiment_end(self, trials):
+        self.events.append(("end", len(trials)))
+
+
+def _ckpt_objective(config):
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+
+    for i in range(2):
+        session.report({"loss": 1.0 / (i + 1)},
+                       checkpoint=Checkpoint.from_dict({"i": i}))
+
+
+def test_callback_observes_full_lifecycle(ray_start_shared, tmp_path):
+    rec = _Recorder()
+    tune.Tuner(
+        _ckpt_objective,
+        param_space={"x": tune.grid_search([1.0])},
+        run_config=ray_tpu.air.RunConfig(storage_path=str(tmp_path),
+                                         name="cb", callbacks=[rec]),
+    ).fit()
+    kinds = [e[0] for e in rec.events]
+    assert kinds[0] == "setup"
+    assert "start" in kinds and "result" in kinds
+    assert "checkpoint" in kinds
+    assert kinds.index("start") < kinds.index("result")
+    complete = [e for e in rec.events if e[0] == "complete"]
+    assert len(complete) == 1 and complete[0][2] == "TERMINATED"
+    assert kinds[-1] == "end"
+    assert rec.events.count(("result", rec.events[1][1], 1.0)) == 1
+
+
+def _crashing_objective(config):
+    from ray_tpu.air import session
+
+    session.report({"loss": 1.0})
+    raise RuntimeError("boom")
+
+
+def test_callback_sees_trial_error(ray_start_shared, tmp_path):
+    rec = _Recorder()
+    grid = tune.Tuner(
+        _crashing_objective,
+        param_space={"x": tune.grid_search([1.0])},
+        run_config=ray_tpu.air.RunConfig(
+            storage_path=str(tmp_path), name="err", callbacks=[rec],
+            failure_config=ray_tpu.air.FailureConfig(max_failures=0)),
+    ).fit()
+    assert grid.errors
+    kinds = [e[0] for e in rec.events]
+    assert "error" in kinds
+    complete = [e for e in rec.events if e[0] == "complete"]
+    assert complete and complete[0][2] == "ERROR"
+
+
+def test_callback_failure_does_not_abort_run(ray_start_shared, tmp_path):
+    class Bad(tune.Callback):
+        def on_trial_result(self, trial, result):
+            raise ValueError("callback bug")
+
+    grid = tune.Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1.0])},
+        run_config=ray_tpu.air.RunConfig(storage_path=str(tmp_path),
+                                         name="bad", callbacks=[Bad()]),
+    ).fit()
+    assert not grid.errors
+    assert grid.trials[0].iteration == 3
+
+
+def test_pb2_learns_toward_optimum(ray_start_shared, tmp_path):
+    # quadratic bandit: reward improves as lr approaches 0.5; PB2 should
+    # exploit+explore the population toward the peak and beat its start
+    def obj(config):
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        lr = config["lr"]
+        for i in range(12):
+            score = -((lr - 0.5) ** 2) * (i + 1)
+            session.report({"score": score, "lr": lr},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    sched = tune.PB2(metric="score", mode="max",
+                     perturbation_interval=3,
+                     hyperparam_bounds={"lr": [0.0, 1.0]}, seed=7)
+    grid = tune.Tuner(
+        obj,
+        param_space={"lr": tune.grid_search([0.05, 0.9, 0.95, 0.99])},
+        tune_config=tune.TuneConfig(scheduler=sched,
+                                    max_concurrent_trials=4),
+        run_config=ray_tpu.air.RunConfig(storage_path=str(tmp_path),
+                                         name="pb2"),
+    ).fit()
+    assert not grid.errors
+    assert sched.num_exploits >= 1
+    # at least one explored config moved strictly inside the bounds
+    # (evidence the GP/cold-start explore actually ran)
+    lrs = {t.config["lr"] for t in grid.trials}
+    assert any(lr not in (0.05, 0.9, 0.95, 0.99) for lr in lrs)
