@@ -268,6 +268,26 @@ class DashboardActor:
 
         app.router.add_get("/api/events", events_list)
 
+        # Workflow event provider (reference:
+        # workflow/http_event_provider.py — external systems POST an
+        # event; in-cluster KVEventListeners wake on it).
+        async def workflow_post_event(req):
+            body = await req.json()
+            name = body.get("name")
+            if not name:
+                return web.json_response(
+                    {"error": "missing 'name'"}, status=400)
+
+            def _post():
+                from ray_tpu.workflow.events import post_event
+
+                post_event(name, body.get("payload"))
+
+            await loop.run_in_executor(None, _post)
+            return web.json_response({"posted": name})
+
+        app.router.add_post("/api/workflows/events", workflow_post_event)
+
         async def index(_req):
             return web.Response(text=_INDEX_HTML,
                                 content_type="text/html")

@@ -4,7 +4,15 @@ results are durably persisted as each step finishes, so a crashed run
 resumes from the last completed step instead of recomputing.
 """
 
-from ray_tpu.workflow.api import (get_output, list_all, resume, run, step,
-                                  Step)
+from ray_tpu.workflow.api import (cancel, delete, get_output, get_status,
+                                  list_all, resume, resume_all, run, step,
+                                  Step, WorkflowCancelledError)
+from ray_tpu.workflow.events import (clear_event, EventListener,
+                                     KVEventListener, post_event,
+                                     TimerListener, wait_for_event)
 
-__all__ = ["step", "Step", "run", "resume", "get_output", "list_all"]
+__all__ = ["step", "Step", "run", "resume", "resume_all", "get_output",
+           "get_status", "cancel", "delete", "list_all",
+           "WorkflowCancelledError",
+           "EventListener", "KVEventListener", "TimerListener",
+           "wait_for_event", "post_event", "clear_event"]

@@ -23,16 +23,41 @@ from typing import Any, Callable, Dict, List, Optional
 _DEFAULT_STORAGE = os.path.expanduser("~/.ray_tpu_workflows")
 
 
+class WorkflowCancelledError(Exception):
+    """The workflow was cancelled via :func:`cancel`."""
+
+
+# workflow status values (reference: workflow/common.py WorkflowStatus)
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+CANCELED = "CANCELED"
+
+
 class Step:
     """A node in the workflow DAG: fn + (possibly Step-valued) args."""
 
     def __init__(self, fn: Callable, args: tuple, kwargs: dict,
-                 name: Optional[str] = None, num_cpus: float = 1.0):
+                 name: Optional[str] = None, num_cpus: float = 1.0,
+                 max_retries: int = 0, retry_delay_s: float = 0.2,
+                 timeout_s: float = 600.0):
         self.fn = fn
         self.args = args
         self.kwargs = kwargs
         self.name = name or getattr(fn, "__name__", "step")
         self.num_cpus = num_cpus
+        #: per-attempt execution deadline (wait_for_event derives it
+        #: from the listener's own timeout)
+        self.timeout_s = timeout_s
+        #: re-execute a crashed/raising step up to this many extra times
+        #: before failing the workflow (reference: step max_retries,
+        #: workflow/api.py step options)
+        self.max_retries = max_retries
+        self.retry_delay_s = retry_delay_s
+        #: optional callable(value) fired after the step result is
+        #: durably stored (used by wait_for_event's
+        #: EventListener.event_checkpointed commit hook)
+        self.on_committed: Optional[Callable[[Any], None]] = None
 
     def step_id(self) -> str:
         h = hashlib.sha1(self.name.encode())
@@ -71,19 +96,23 @@ class _StepFactory:
         return self.fn(*args, **kwargs)
 
 
-def step(_fn=None, *, name: Optional[str] = None, num_cpus: float = 1.0):
+def step(_fn=None, *, name: Optional[str] = None, num_cpus: float = 1.0,
+         max_retries: int = 0, retry_delay_s: float = 0.2):
     """Decorator: make a function a workflow step factory."""
 
     def wrap(fn):
-        return _StepFactory(fn, name=name, num_cpus=num_cpus)
+        return _StepFactory(fn, name=name, num_cpus=num_cpus,
+                            max_retries=max_retries,
+                            retry_delay_s=retry_delay_s)
 
     return wrap(_fn) if _fn is not None else wrap
 
 
 class _Storage:
-    def __init__(self, root: str, workflow_id: str):
+    def __init__(self, root: str, workflow_id: str, create: bool = True):
         self.dir = os.path.join(root, workflow_id)
-        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+        if create:
+            os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
 
     def _step_path(self, step_id: str) -> str:
         return os.path.join(self.dir, "steps", f"{step_id}.pkl")
@@ -102,8 +131,10 @@ class _Storage:
         os.replace(tmp, self._step_path(step_id))  # atomic commit
 
     def write_meta(self, meta: Dict[str, Any]) -> None:
-        with open(os.path.join(self.dir, "meta.json"), "w") as f:
+        tmp = os.path.join(self.dir, "meta.json.tmp")
+        with open(tmp, "w") as f:
             json.dump(meta, f)
+        os.replace(tmp, os.path.join(self.dir, "meta.json"))
 
     def read_meta(self) -> Dict[str, Any]:
         try:
@@ -112,15 +143,55 @@ class _Storage:
         except OSError:
             return {}
 
+    # -- DAG persistence: lets resume()/resume_all() rebuild the graph
+    # without the caller re-constructing it (reference: the DAG is part
+    # of workflow storage, workflow_storage.py save_workflow_execution)
+    def save_dag(self, dag: "Step") -> None:
+        import cloudpickle
+
+        tmp = os.path.join(self.dir, "dag.pkl.tmp")
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(dag, f)
+        os.replace(tmp, os.path.join(self.dir, "dag.pkl"))
+
+    def load_dag(self) -> Optional["Step"]:
+        try:
+            with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+                return pickle.load(f)
+        except OSError:
+            return None
+
+    # -- cancellation flag (polled between steps; also by long-poll
+    # event waits)
+    def _cancel_path(self) -> str:
+        return os.path.join(self.dir, "cancel")
+
+    def request_cancel(self) -> None:
+        with open(self._cancel_path(), "w") as f:
+            f.write("1")
+
+    def cancel_requested(self) -> bool:
+        return os.path.exists(self._cancel_path())
+
+    def clear_cancel(self) -> None:
+        try:
+            os.unlink(self._cancel_path())
+        except OSError:
+            pass
+
 
 def _execute(node: Step, storage: _Storage):
     """Post-order DAG execution; finished steps short-circuit from
     storage (this IS the resume mechanism)."""
+    import time
+
     import ray_tpu
 
     sid = node.step_id()
     if storage.has(sid):
         return storage.load(sid)
+    if storage.cancel_requested():
+        raise WorkflowCancelledError(os.path.basename(storage.dir))
 
     def resolve(v):
         return _execute(v, storage) if isinstance(v, Step) else v
@@ -128,8 +199,53 @@ def _execute(node: Step, storage: _Storage):
     args = [resolve(a) for a in node.args]
     kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
     remote_fn = ray_tpu.remote(num_cpus=node.num_cpus)(node.fn)
-    value = ray_tpu.get(remote_fn.remote(*args, **kwargs), timeout=600)
+    last_exc: Optional[BaseException] = None
+    for attempt in range(node.max_retries + 1):
+        if storage.cancel_requested():
+            raise WorkflowCancelledError(os.path.basename(storage.dir))
+        try:
+            ref = remote_fn.remote(*args, **kwargs)
+            # Poll completion so a cancel() preempts even a long-running
+            # step (e.g. an event wait) instead of only taking effect at
+            # the next step boundary (reference: workflow cancel kills
+            # in-flight step tasks).
+            deadline = time.monotonic() + node.timeout_s
+            while True:
+                ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=1.0)
+                if ready:
+                    value = ray_tpu.get(ref, timeout=60)
+                    break
+                if storage.cancel_requested():
+                    try:
+                        ray_tpu.cancel(ref, force=True)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise WorkflowCancelledError(
+                        os.path.basename(storage.dir))
+                if time.monotonic() > deadline:
+                    # kill the in-flight attempt or a retry would run
+                    # concurrently with it (duplicate side effects)
+                    try:
+                        ray_tpu.cancel(ref, force=True)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise TimeoutError(
+                        f"step {node.name} exceeded {node.timeout_s}s")
+            break
+        except WorkflowCancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 - step failed; maybe retry
+            last_exc = e
+            if attempt < node.max_retries:
+                time.sleep(node.retry_delay_s * (attempt + 1))
+    else:
+        raise last_exc
     storage.save(sid, value)  # durable BEFORE downstream runs
+    if node.on_committed is not None:
+        try:
+            node.on_committed(value)
+        except Exception:  # noqa: BLE001 - commit hook must not fail the run
+            pass
     return value
 
 
@@ -139,31 +255,115 @@ def run(dag: Step, *, workflow_id: str,
 
     ray_tpu._auto_init()
     store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
-    store.write_meta({"workflow_id": workflow_id, "status": "RUNNING",
+    store.clear_cancel()  # a re-run supersedes an old cancel request
+    store.save_dag(dag)
+    store.write_meta({"workflow_id": workflow_id, "status": RUNNING,
                       "output_step": dag.step_id()})
     try:
         result = _execute(dag, store)
-    except Exception:
-        store.write_meta({"workflow_id": workflow_id, "status": "FAILED",
+    except WorkflowCancelledError:
+        store.write_meta({"workflow_id": workflow_id, "status": CANCELED,
                           "output_step": dag.step_id()})
         raise
-    store.write_meta({"workflow_id": workflow_id, "status": "SUCCEEDED",
+    except Exception:
+        store.write_meta({"workflow_id": workflow_id, "status": FAILED,
+                          "output_step": dag.step_id()})
+        raise
+    store.write_meta({"workflow_id": workflow_id, "status": SUCCEEDED,
                       "output_step": dag.step_id()})
     return result
 
 
-def resume(dag: Step, *, workflow_id: str,
+def resume(dag: Optional[Step] = None, *, workflow_id: str,
            storage: Optional[str] = None) -> Any:
     """Re-run a workflow: completed steps load from storage, the rest
-    execute.  (The dag is re-built by the caller — step ids are
-    deterministic, so stored results line up.)"""
+    execute.  The dag may be re-built by the caller (step ids are
+    deterministic, so stored results line up) or omitted — then the
+    persisted DAG from the original run is loaded (reference:
+    workflow/api.py:  resume by workflow id alone)."""
+    if dag is None:
+        store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+        dag = store.load_dag()
+        if dag is None:
+            raise ValueError(
+                f"workflow {workflow_id!r} has no persisted DAG "
+                "(never ran here?)")
     return run(dag, workflow_id=workflow_id, storage=storage)
 
 
-def get_output(workflow_id: str, *, storage: Optional[str] = None):
-    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id)
+def resume_all(storage: Optional[str] = None,
+               include_failed: bool = False,
+               include_canceled: bool = False) -> Dict[str, Any]:
+    """Resume every workflow interrupted mid-run (status RUNNING with no
+    live driver); opt in to also re-running FAILED / deliberately
+    CANCELED ones.  Returns {workflow_id: result | exception}.
+    (Reference: workflow/api.py:533 resume_all.)"""
+    root = storage or _DEFAULT_STORAGE
+    out: Dict[str, Any] = {}
+    eligible = ({RUNNING}
+                | ({FAILED} if include_failed else set())
+                | ({CANCELED} if include_canceled else set()))
+    for meta in list_all(root):
+        if meta.get("status") not in eligible:
+            continue
+        wid = meta["workflow_id"]
+        try:
+            out[wid] = resume(workflow_id=wid, storage=root)
+        except Exception as e:  # noqa: BLE001 - isolate workflows
+            out[wid] = e
+    return out
+
+
+def get_status(workflow_id: str, *,
+               storage: Optional[str] = None) -> Optional[str]:
+    """Current status (RUNNING/SUCCEEDED/FAILED/CANCELED) or None if
+    unknown (reference: workflow/api.py:557 get_status)."""
+    meta = _Storage(storage or _DEFAULT_STORAGE, workflow_id,
+                    create=False).read_meta()
+    return meta.get("status")
+
+
+def cancel(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    """Request cancellation: a running driver kills the in-flight step
+    task (event waits included); completed step results stay durable
+    (reference: workflow/api.py:468 cancel)."""
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id,
+                     create=False)
     meta = store.read_meta()
-    if meta.get("status") != "SUCCEEDED":
+    if not meta:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    store.request_cancel()
+    meta = store.read_meta()
+    if meta.get("status") == RUNNING:
+        # The driver may be crashed (flag never honored) — mark CANCELED
+        # ourselves.  But if the final output is already durable the run
+        # actually finished and only the status write raced us: record
+        # SUCCEEDED, never shadow a completed result.
+        out_step = meta.get("output_step")
+        meta["status"] = (SUCCEEDED if out_step and store.has(out_step)
+                          else CANCELED)
+        store.write_meta(meta)
+
+
+def delete(workflow_id: str, *, storage: Optional[str] = None) -> None:
+    """Remove a finished workflow's storage (reference:
+    workflow/api.py delete)."""
+    import shutil
+
+    meta = _Storage(storage or _DEFAULT_STORAGE, workflow_id,
+                    create=False).read_meta()
+    if meta.get("status") == RUNNING:
+        raise ValueError(f"workflow {workflow_id!r} is RUNNING; "
+                         "cancel it first")
+    shutil.rmtree(os.path.join(storage or _DEFAULT_STORAGE, workflow_id),
+                  ignore_errors=True)
+
+
+def get_output(workflow_id: str, *, storage: Optional[str] = None):
+    store = _Storage(storage or _DEFAULT_STORAGE, workflow_id,
+                     create=False)
+    meta = store.read_meta()
+    if meta.get("status") != SUCCEEDED:
         raise ValueError(
             f"workflow {workflow_id} not finished "
             f"(status={meta.get('status')!r})")

@@ -1,0 +1,122 @@
+"""Workflow events: durable waits on external signals.
+
+Reference analogs: ``workflow/event_listener.py`` (EventListener ABC +
+TimerListener), ``workflow/api.py:557 wait_for_event``, and
+``workflow/http_event_provider.py`` (HTTP endpoint feeding listeners).
+
+``wait_for_event(ListenerCls, *args)`` builds a normal workflow *step*
+whose body instantiates the listener and blocks in
+``poll_for_event(*args)``.  Because it is a step, the received event
+value is durably checkpointed the moment it arrives: a workflow that
+crashes after the event landed resumes past the wait without re-waiting
+— the reference's exact semantics.
+
+The default transport is the cluster KV (GCS ``kv.*``): any process in
+the cluster (or the dashboard's ``POST /api/workflows/events``) can
+:func:`post_event`; listeners poll their key.  Events are single-slot
+per name: posting overwrites.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import Any
+
+_EVENT_KV_PREFIX = "workflow_events/"
+
+
+class EventListener:
+    """Subclass and implement ``poll_for_event`` (blocking) — called
+    inside a workflow step, so its return value is the step's durable
+    result.  ``event_checkpointed`` fires after the value is durable
+    (commit hook for at-most-once upstream acks)."""
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+    def event_checkpointed(self, event: Any) -> None:
+        """Optional: called once the event value is durably stored."""
+
+
+class KVEventListener(EventListener):
+    """Polls the cluster KV for an event posted under ``name``
+    (the in-cluster analog of the reference's HTTPEventProvider-fed
+    listener)."""
+
+    def __init__(self, poll_interval_s: float = 0.2,
+                 timeout_s: float = 600.0):
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def poll_for_event(self, name: str) -> Any:
+        from ray_tpu._private import worker_context
+
+        cw = worker_context.core_worker()
+        key = _EVENT_KV_PREFIX + name
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
+            raw = cw.kv_get(key)
+            if raw is not None:
+                return pickle.loads(raw)
+            time.sleep(self.poll_interval_s)
+        raise TimeoutError(f"no event {name!r} within {self.timeout_s}s")
+
+
+class TimerListener(EventListener):
+    """Resolves after a wall-clock delay (reference:
+    event_listener.py TimerListener)."""
+
+    def poll_for_event(self, delay_s: float) -> float:
+        time.sleep(float(delay_s))
+        return time.time()
+
+
+def post_event(name: str, payload: Any = None) -> None:
+    """Publish an event to the cluster KV; wakes any KVEventListener
+    polling ``name``.  Callable from any driver/worker in the cluster."""
+    from ray_tpu._private import worker_context
+
+    cw = worker_context.core_worker()
+    cw.kv_put(_EVENT_KV_PREFIX + name, pickle.dumps(payload))
+
+
+def clear_event(name: str) -> None:
+    from ray_tpu._private import worker_context
+
+    worker_context.core_worker().kv_del(_EVENT_KV_PREFIX + name)
+
+
+def wait_for_event(listener_cls=KVEventListener, *args,
+                   name: str | None = None, num_cpus: float = 0.01,
+                   **listener_kwargs):
+    """A workflow Step that resolves to the event payload.
+
+    ``listener_kwargs`` construct the listener; ``args`` go to
+    ``poll_for_event``.  The step occupies a (fractional) worker slot
+    while waiting, so waits are cheap to gang up.
+    (Reference: workflow/api.py wait_for_event.)
+    """
+    from ray_tpu.workflow.api import Step
+
+    if isinstance(listener_cls, str):
+        # shorthand: wait_for_event("name") == KV event by that name
+        args = (listener_cls, *args)
+        listener_cls = KVEventListener
+    listener = listener_cls(**listener_kwargs)
+
+    def _wait(*poll_args):
+        return listener.poll_for_event(*poll_args)
+
+    kw = "".join(f",{k}={v!r}" for k, v in sorted(listener_kwargs.items()))
+    step_name = name or f"wait_for_event[{listener_cls.__name__}{kw}]"
+    # the step's execution deadline must outlast the listener's own wait
+    # (TimerListener's delay / KV poll timeout), not the generic default
+    wait_budget = max(
+        (float(a) for a in (*args, listener_kwargs.get("timeout_s", 0))
+         if isinstance(a, (int, float))), default=0.0)
+    s = Step(_wait, args, {}, name=step_name, num_cpus=num_cpus,
+             timeout_s=max(600.0, wait_budget + 60.0))
+    # commit hook: _execute fires this after the event value is durable
+    s.on_committed = listener.event_checkpointed
+    return s
