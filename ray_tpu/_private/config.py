@@ -94,6 +94,12 @@ class Config:
     num_heartbeats_timeout: int = 30
     rpc_connect_timeout_s: float = 10.0
     worker_start_timeout_s: float = 60.0
+    #: Bound on concurrently-starting worker processes per node.  A
+    #: thousand-actor gang otherwise forks every worker at once and the
+    #: children starve each other through interpreter startup (imports
+    #: are CPU-bound), tripping registration timeouts (reference:
+    #: worker_pool maximum_startup_concurrency, worker_pool.cc:224).
+    max_concurrent_worker_starts: int = 8
     #: Poll interval for blocking get() in the driver.
     get_poll_interval_s: float = 0.005
     # How often get()/wait() re-issue a pull for a borrowed object (the

@@ -182,6 +182,12 @@ class NodeManager:
         self._tpu_chips_free: List[int] = list(
             range(int(resources.get("TPU", 0))))
         self._worker_registered: Dict[bytes, asyncio.Future] = {}
+        #: throttle concurrent worker-process startups (fork + interpreter
+        #: boot are CPU-bound; an unbounded gang start starves every
+        #: child through registration — reference: worker_pool.cc:224
+        #: maximum_startup_concurrency)
+        self._spawn_sem = asyncio.Semaphore(
+            max(1, config.max_concurrent_worker_starts))
         self._lease_queue: List[LeaseRequest] = []
         self._lease_counter = 0
         #: monotonic version for resource reports (syncer ordering)
@@ -463,6 +469,11 @@ class NodeManager:
         (and any TPU-plugin bootstrap hook disabled), so it can never
         claim the chip out from under the worker that owns it.
         """
+        async with self._spawn_sem:
+            return await self._start_worker_inner(actor_id, tpu_grant)
+
+    async def _start_worker_inner(self, actor_id: bytes = b"",
+                                  tpu_grant: float = 0.0) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
         chips: List[int] = []

@@ -38,6 +38,14 @@ class CastFlatten(Connector):
         return arr.reshape(arr.shape[0], -1)
 
 
+class Cast(Connector):
+    """float32 cast, shape-preserving (image observations feeding conv
+    stacks must keep their (N, H, W, C) layout)."""
+
+    def __call__(self, batch, update: bool = True):
+        return np.asarray(batch, np.float32)
+
+
 class ObsFilter(Connector):
     """MeanStd observation normalization with the local/delta split the
     cross-worker FilterManager sync protocol needs (rllib/filters.py)."""
@@ -113,15 +121,18 @@ class ConnectorPipeline(Connector):
         return None
 
 
-def default_obs_pipeline(obs_shape, observation_filter: str = "NoFilter"
+def default_obs_pipeline(obs_shape, observation_filter: str = "NoFilter",
+                         preserve_shape: bool = False
                          ) -> ConnectorPipeline:
     """env→module chain: cast/flatten (+ MeanStd filter when asked).
-    The filter sits AFTER CastFlatten, so its statistics run over the
-    flattened (N, prod(obs_shape)) rows — build it with that shape."""
-    chain: List[Connector] = [CastFlatten()]
+    ``preserve_shape`` keeps the env layout (conv policies);
+    otherwise rows flatten to (N, prod(obs_shape)).  The filter sits
+    after the cast, over whichever shape reaches it."""
+    chain: List[Connector] = [Cast() if preserve_shape else CastFlatten()]
     if observation_filter and observation_filter != "NoFilter":
-        flat = (int(np.prod(obs_shape)),) if obs_shape else (1,)
-        chain.append(ObsFilter(observation_filter, flat))
+        fshape = (tuple(obs_shape) if preserve_shape
+                  else ((int(np.prod(obs_shape)),) if obs_shape else (1,)))
+        chain.append(ObsFilter(observation_filter, fshape))
     return ConnectorPipeline(chain)
 
 

@@ -94,10 +94,20 @@ class IMPALAPolicy:
 
         self.cfg = cfg
         self.mesh = mesh
-        kp, kv = jax.random.split(jax.random.PRNGKey(seed))
+        from ray_tpu.rllib.models import Encoder, ModelConfig
+
+        kp, kv, kh1, kh2 = jax.random.split(jax.random.PRNGKey(seed), 4)
+        # JaxPolicy's feedforward tower layout (enc + linear head) via
+        # the SAME Encoder the rollout workers build, so the learner's
+        # weight broadcast can never structurally drift from them
+        self._encoder = Encoder(
+            (cfg.obs_dim,), ModelConfig(fcnet_hiddens=tuple(cfg.hidden)))
+        feat = self._encoder.feature_dim
         self.params = {
-            "pi": _net_init(kp, (cfg.obs_dim, *cfg.hidden, cfg.n_actions)),
-            "vf": _net_init(kv, (cfg.obs_dim, *cfg.hidden, 1)),
+            "pi": {"enc": self._encoder.init(kp),
+                   "head": _net_init(kh1, (feat, cfg.n_actions))},
+            "vf": {"enc": self._encoder.init(kv),
+                   "head": _net_init(kh2, (feat, 1))},
         }
         self.tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip),
                               optax.adam(cfg.lr))
@@ -113,9 +123,18 @@ class IMPALAPolicy:
         def loss_fn(params, batch):
             T, B = batch["actions"].shape
             obs = batch["obs"]                      # (T, B, D)
-            logits = _net_apply(params["pi"], obs)  # (T, B, A)
-            values = _net_apply(params["vf"], obs)[..., 0]
-            bootstrap = _net_apply(params["vf"], batch["last_obs"])[..., 0]
+            enc = self._encoder
+
+            def tower(p, x):
+                # encoder applies over the last dim; flatten (T, B) rows
+                lead = x.shape[:-1]
+                feats = enc.apply(p["enc"], x.reshape(-1, x.shape[-1]))
+                return _net_apply(p["head"],
+                                  feats.reshape(*lead, -1))
+
+            logits = tower(params["pi"], obs)       # (T, B, A)
+            values = tower(params["vf"], obs)[..., 0]
+            bootstrap = tower(params["vf"], batch["last_obs"])[..., 0]
             logp_all = jax.nn.log_softmax(logits)
             target_logp = jnp.take_along_axis(
                 logp_all, batch["actions"][..., None].astype(jnp.int32),

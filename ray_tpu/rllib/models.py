@@ -1,0 +1,194 @@
+"""Model catalog: configurable policy networks (MLP / CNN / LSTM).
+
+Reference analog: rllib/models/catalog.py:195 ``ModelCatalog`` — the
+config-driven mapping from observation shape + model options to a
+network — plus the conv stacks of models/torch/visionnet.py and the
+recurrent wrapper of models/torch/recurrent_net.py.  TPU-first
+re-design: models are pure-jax (init, apply) pairs over explicit
+param pytrees (no framework Module graph), so the whole policy update
+stays a single jitted scan; recurrence is expressed as a
+``lax.scan``-able cell.
+
+Conv filters spec: a tuple of (out_channels, kernel, stride) triples,
+NHWC layout.  ``None`` selects defaults by observation rank: rank-1 →
+MLP only, rank-3 → a MinAtar-scale conv stack for small boards or an
+Atari-scale stack for 84×84 frames (reference: catalog.py
+_get_filter_config).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Per-policy model options (reference: MODEL_DEFAULTS in
+    models/catalog.py)."""
+
+    fcnet_hiddens: Tuple[int, ...] = (64, 64)
+    #: ((out_ch, kernel, stride), ...) or None → defaults by obs rank
+    conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
+    use_lstm: bool = False
+    lstm_cell_size: int = 64
+    #: training-time BPTT chunk length (reference: rnn_sequencing
+    #: max_seq_len)
+    max_seq_len: int = 16
+
+
+def default_conv_filters(obs_shape: Sequence[int]
+                         ) -> Tuple[Tuple[int, int, int], ...]:
+    """Pick a conv stack for an (H, W, C) observation (reference:
+    catalog.py _get_filter_config: 84x84 Atari stack, small boards get
+    a 2-layer MinAtar-scale stack)."""
+    h = obs_shape[0]
+    if h >= 64:  # Atari-class frames
+        return ((16, 8, 4), (32, 4, 2), (64, 3, 2))
+    return ((16, 3, 1), (32, 3, 2))
+
+
+# ---------------------------------------------------------------------------
+# building blocks: each is an (init(key) -> params, apply(params, x))
+# pair over plain dict pytrees
+# ---------------------------------------------------------------------------
+
+def mlp_init(key, dims: Sequence[int]):
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    for d_in, d_out in zip(dims[:-1], dims[1:]):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (d_in, d_out)) * np.sqrt(2.0 / d_in)
+        layers.append({"w": w, "b": jnp.zeros((d_out,))})
+    return layers
+
+
+def mlp_apply(layers, x, final_linear: bool = True):
+    import jax
+
+    for i, l in enumerate(layers):
+        x = x @ l["w"] + l["b"]
+        if i < len(layers) - 1 or not final_linear:
+            x = jax.nn.tanh(x)
+    return x
+
+
+def conv_init(key, in_channels: int,
+              filters: Sequence[Tuple[int, int, int]]):
+    import jax
+    import jax.numpy as jnp
+
+    layers = []
+    c_in = in_channels
+    for (c_out, k, _s) in filters:
+        key, sub = jax.random.split(key)
+        fan_in = k * k * c_in
+        w = jax.random.normal(sub, (k, k, c_in, c_out)) * np.sqrt(
+            2.0 / fan_in)
+        layers.append({"w": w, "b": jnp.zeros((c_out,))})
+        c_in = c_out
+    return layers
+
+
+def conv_apply(layers, x, filters: Sequence[Tuple[int, int, int]]):
+    """x: (B, H, W, C) float32 → (B, features) after flatten."""
+    import jax
+    from jax import lax
+
+    for l, (_c, _k, s) in zip(layers, filters):
+        x = lax.conv_general_dilated(
+            x, l["w"], window_strides=(s, s), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + l["b"])
+    return x.reshape(x.shape[0], -1)
+
+
+def conv_out_dim(obs_shape: Sequence[int],
+                 filters: Sequence[Tuple[int, int, int]]) -> int:
+    h, w = obs_shape[0], obs_shape[1]
+    c = obs_shape[2]
+    for (c_out, _k, s) in filters:
+        h = -(-h // s)  # ceil: SAME padding
+        w = -(-w // s)
+        c = c_out
+    return h * w * c
+
+
+def lstm_init(key, in_dim: int, cell: int):
+    import jax
+    import jax.numpy as jnp
+
+    k1, _ = jax.random.split(key)
+    w = jax.random.normal(k1, (in_dim + cell, 4 * cell)) * np.sqrt(
+        1.0 / (in_dim + cell))
+    b = jnp.zeros((4 * cell,))
+    # forget-gate bias 1.0: standard initialization for gradient flow
+    b = b.at[cell:2 * cell].set(1.0)
+    return {"w": w, "b": b}
+
+
+def lstm_step(params, carry, x):
+    """One LSTM cell step.  carry = (h, c), x: (B, in_dim)."""
+    import jax
+    import jax.numpy as jnp
+
+    h, c = carry
+    z = jnp.concatenate([x, h], axis=-1) @ params["w"] + params["b"]
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return (h, c)
+
+
+# ---------------------------------------------------------------------------
+# encoder: obs -> feature vector (conv stack for rank-3 obs, then MLP)
+# ---------------------------------------------------------------------------
+
+class Encoder:
+    """Configured obs→features network; init/apply over a dict pytree.
+
+    ``obs_shape`` is the single-observation shape.  Rank-3 shapes get
+    the conv stack; the MLP tower follows in both cases.  The encoder
+    output dim is ``feature_dim``."""
+
+    def __init__(self, obs_shape: Sequence[int], config: ModelConfig):
+        self.obs_shape = tuple(obs_shape)
+        self.config = config
+        if len(self.obs_shape) == 3:
+            self.filters = (config.conv_filters
+                            or default_conv_filters(self.obs_shape))
+            flat = conv_out_dim(self.obs_shape, self.filters)
+        elif len(self.obs_shape) == 1:
+            self.filters = None
+            flat = self.obs_shape[0]
+        else:
+            raise ValueError(
+                f"unsupported observation rank: {self.obs_shape} "
+                "(flatten dict/tuple spaces in a connector)")
+        self.mlp_dims = (flat, *config.fcnet_hiddens)
+        self.feature_dim = (config.fcnet_hiddens[-1]
+                            if config.fcnet_hiddens else flat)
+
+    def init(self, key):
+        import jax
+
+        k_conv, k_mlp = jax.random.split(key)
+        params = {"mlp": mlp_init(k_mlp, self.mlp_dims)}
+        if self.filters is not None:
+            params["conv"] = conv_init(k_conv, self.obs_shape[2],
+                                       self.filters)
+        return params
+
+    def apply(self, params, obs):
+        """obs: (B, *obs_shape) → (B, feature_dim).  Leading batch dims
+        beyond one are flattened and restored by the caller."""
+        x = obs
+        if self.filters is not None:
+            x = conv_apply(params["conv"], x, self.filters)
+        # final_linear=False: features end in a nonlinearity; heads are
+        # the linear readouts
+        return mlp_apply(params["mlp"], x, final_linear=False)

@@ -7,6 +7,13 @@ minibatches — is ONE jitted call (`lax.scan` over minibatch indices), so
 a training_step does a single host→device transfer and a single
 dispatch, replacing the reference's loader-thread/tower-stack pipeline
 (multi_gpu_learner_thread.py:20) with an XLA-compiled loop.
+
+Networks come from the model catalog (models.py — reference analog
+rllib/models/catalog.py:195): MLP towers for vector observations, conv
+stacks for (H, W, C) pixels, and an optional LSTM wrapper
+(``PolicySpec.use_lstm``) trained with truncated BPTT over
+``max_seq_len`` chunks whose initial recurrent states were recorded at
+rollout time (reference: policy/rnn_sequencing.py).
 """
 
 from __future__ import annotations
@@ -18,7 +25,18 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.models import (Encoder, ModelConfig, lstm_init,
+                                  lstm_step, mlp_apply, mlp_init)
 from ray_tpu.rllib.sample_batch import SampleBatch
+
+#: sequence-batch keys for recurrent policies (chunk-initial states)
+STATE_H = "state_h"
+STATE_C = "state_c"
+
+# legacy aliases: earlier modules (dqn/impala) import the raw MLP
+# helpers from here
+_net_init = mlp_init
+_net_apply = mlp_apply
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,45 +56,45 @@ class PolicySpec:
     #: Box action spaces: diagonal-Gaussian policy (state-dependent mean,
     #: state-independent log_std — standard PPO parameterization).
     continuous: bool = False
+    #: full observation shape; None → (obs_dim,).  Rank-3 shapes select
+    #: the conv stack from the model catalog.
+    obs_shape: Optional[Tuple[int, ...]] = None
+    #: ((out_ch, kernel, stride), ...); None → catalog default by shape
+    conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
+    use_lstm: bool = False
+    lstm_cell_size: int = 64
+    #: BPTT chunk length for recurrent training
+    max_seq_len: int = 16
 
+    @property
+    def obs_shape_(self) -> Tuple[int, ...]:
+        return tuple(self.obs_shape) if self.obs_shape else (self.obs_dim,)
 
-def _net_init(key, dims):
-    import jax
-    import jax.numpy as jnp
-
-    layers = []
-    for d_in, d_out in zip(dims[:-1], dims[1:]):
-        key, sub = jax.random.split(key)
-        w = jax.random.normal(sub, (d_in, d_out)) * np.sqrt(2.0 / d_in)
-        layers.append({"w": w, "b": jnp.zeros((d_out,))})
-    return layers
-
-
-def _net_apply(layers, x, final_linear=True):
-    import jax
-
-    for i, l in enumerate(layers):
-        x = x @ l["w"] + l["b"]
-        if i < len(layers) - 1 or not final_linear:
-            x = jax.nn.tanh(x)
-    return x
+    def model_config(self) -> ModelConfig:
+        return ModelConfig(fcnet_hiddens=tuple(self.hidden),
+                           conv_filters=self.conv_filters,
+                           use_lstm=self.use_lstm,
+                           lstm_cell_size=self.lstm_cell_size,
+                           max_seq_len=self.max_seq_len)
 
 
 class JaxPolicy:
-    """Actor-critic MLP policy with a PPO-clip update.
+    """Actor-critic policy with a PPO-clip update.
 
     Parameters live wherever jax puts them (TPU on the learner, CPU on
     rollout workers); `get_weights`/`set_weights` move numpy pytrees so
     weight broadcast rides the object store.
+
+    Feedforward specs build two independent towers (pi, vf) as the
+    reference's default (vf_share_layers=False); recurrent specs share
+    one encoder+LSTM trunk with linear pi/vf heads (the reference's
+    LSTM wrapper shape, recurrent_net.py).
     """
 
     def __init__(self, spec: PolicySpec, seed: int = 0, mesh=None):
         """mesh: a jax Mesh with a "data" axis — the learner update then
         runs data-parallel across its devices (params replicated, batch
-        rows sharded, gradients psum'd by GSPMD).  The multi-chip
-        learner analog of the reference's multi-GPU tower stack
-        (multi_gpu_learner_thread.py), expressed as shardings instead
-        of explicit replicas."""
+        rows sharded, gradients psum'd by GSPMD)."""
         import jax
         import optax
 
@@ -84,13 +102,25 @@ class JaxPolicy:
 
         self.mesh = mesh
         self.spec = spec
+        self.encoder = Encoder(spec.obs_shape_, spec.model_config())
         key = jax.random.PRNGKey(seed)
-        kp, kv = jax.random.split(key)
-        self.params = {
-            "pi": _net_init(kp, (spec.obs_dim, *spec.hidden,
-                                 spec.n_actions)),
-            "vf": _net_init(kv, (spec.obs_dim, *spec.hidden, 1)),
-        }
+        kp, kv, kl, kh1, kh2 = jax.random.split(key, 5)
+        feat = self.encoder.feature_dim
+        if spec.use_lstm:
+            cell = spec.lstm_cell_size
+            self.params = {
+                "enc": self.encoder.init(kp),
+                "lstm": lstm_init(kl, feat, cell),
+                "pi": mlp_init(kh1, (cell, spec.n_actions)),
+                "vf": mlp_init(kh2, (cell, 1)),
+            }
+        else:
+            self.params = {
+                "pi": {"enc": self.encoder.init(kp),
+                       "head": mlp_init(kh1, (feat, spec.n_actions))},
+                "vf": {"enc": self.encoder.init(kv),
+                       "head": mlp_init(kh2, (feat, 1))},
+            }
         if spec.continuous:
             self.params["log_std"] = jnp.zeros((spec.n_actions,))
         self.tx = optax.chain(
@@ -98,6 +128,9 @@ class JaxPolicy:
             optax.adam(spec.lr))
         self.opt_state = self.tx.init(self.params)
         self._rng = jax.random.PRNGKey(seed + 1)
+        #: live rollout recurrent state (numpy, (N, cell) x2)
+        self._state: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._eval_state: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._build_fns()
 
     # -- weights ----------------------------------------------------------
@@ -112,17 +145,58 @@ class JaxPolicy:
 
         self.params = jax.tree.map(jnp.asarray, weights)
 
-    # -- inference --------------------------------------------------------
+    # -- recurrent state --------------------------------------------------
+    @property
+    def is_recurrent(self) -> bool:
+        return self.spec.use_lstm
+
+    def get_state(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Current rollout carry for n env copies (zero-init)."""
+        cell = self.spec.lstm_cell_size
+        if self._state is None or self._state[0].shape[0] != n:
+            self._state = (np.zeros((n, cell), np.float32),
+                           np.zeros((n, cell), np.float32))
+        return self._state
+
+    def reset_state_where(self, done: np.ndarray) -> None:
+        """Zero the carry rows of finished envs (mirrors the done-mask
+        reset inside the training scan)."""
+        if self._state is not None and done.any():
+            self._state[0][done] = 0.0
+            self._state[1][done] = 0.0
+
+    def reset_eval_state(self) -> None:
+        self._eval_state = None
+
+    def reset_eval_state_where(self, done: np.ndarray) -> None:
+        """Zero eval carries of finished episodes (the evaluation analog
+        of reset_state_where)."""
+        if self._eval_state is not None and done.any():
+            self._eval_state[0][done] = 0.0
+            self._eval_state[1][done] = 0.0
+
+    # -- network builders -------------------------------------------------
     def _build_fns(self):
         import jax
         import jax.numpy as jnp
 
         spec = self.spec
+        enc = self.encoder
 
-        def logits_vf(params, obs):
-            logits = _net_apply(params["pi"], obs)
-            vf = _net_apply(params["vf"], obs)[..., 0]
+        def ff_logits_vf(params, obs):
+            logits = mlp_apply(params["pi"]["head"],
+                               enc.apply(params["pi"]["enc"], obs))
+            vf = mlp_apply(params["vf"]["head"],
+                           enc.apply(params["vf"]["enc"], obs))[..., 0]
             return logits, vf
+
+        def rec_step(params, carry, obs):
+            """One recurrent forward: carry x obs -> (carry', logits, vf)."""
+            feats = enc.apply(params["enc"], obs)
+            h, c = lstm_step(params["lstm"], carry, feats)
+            logits = mlp_apply(params["pi"], h)
+            vf = mlp_apply(params["vf"], h)[..., 0]
+            return (h, c), logits, vf
 
         _half_log_2pi_e = 0.5 * (jnp.log(2 * jnp.pi) + 1.0)
 
@@ -132,36 +206,67 @@ class JaxPolicy:
                 -0.5 * jnp.square((actions - mean) / std)
                 - log_std - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
 
-        @jax.jit
-        def act(params, obs, rng):
-            logits, vf = logits_vf(params, obs)
-            rng, sub = jax.random.split(rng)
+        def _sample(logits, vf, params, sub, greedy=False):
             if spec.continuous:
                 log_std = params["log_std"]
-                noise = jax.random.normal(sub, logits.shape)
-                actions = logits + jnp.exp(log_std) * noise
+                if greedy:
+                    actions = logits
+                else:
+                    noise = jax.random.normal(sub, logits.shape)
+                    actions = logits + jnp.exp(log_std) * noise
                 logp = _gaussian_logp(logits, log_std, actions)
             else:
-                actions = jax.random.categorical(sub, logits)
+                if greedy:
+                    actions = jnp.argmax(logits, axis=-1)
+                else:
+                    actions = jax.random.categorical(sub, logits)
                 logp_all = jax.nn.log_softmax(logits)
                 logp = jnp.take_along_axis(logp_all, actions[:, None],
                                            axis=-1)[:, 0]
+            return actions, logp
+
+        @jax.jit
+        def act(params, obs, rng):
+            logits, vf = ff_logits_vf(params, obs)
+            rng, sub = jax.random.split(rng)
+            actions, logp = _sample(logits, vf, params, sub)
             return actions, logp, vf, rng
 
-        def ppo_loss(params, batch):
-            logits, vf = logits_vf(params, batch[sb.OBS])
+        @jax.jit
+        def act_greedy(params, obs):
+            logits, _ = ff_logits_vf(params, obs)
+            actions, _ = _sample(logits, None, params, None, greedy=True)
+            return actions
+
+        @jax.jit
+        def act_rec(params, obs, rng, h, c):
+            (h, c), logits, vf = rec_step(params, (h, c), obs)
+            rng, sub = jax.random.split(rng)
+            actions, logp = _sample(logits, vf, params, sub)
+            return actions, logp, vf, rng, h, c
+
+        @jax.jit
+        def act_rec_greedy(params, obs, h, c):
+            (h, c), logits, _ = rec_step(params, (h, c), obs)
+            actions, _ = _sample(logits, None, params, None, greedy=True)
+            return actions, h, c
+
+        def _logp_entropy(params, logits, actions):
             if spec.continuous:
                 log_std = params["log_std"]
-                logp = _gaussian_logp(logits, log_std, batch[sb.ACTIONS])
+                logp = _gaussian_logp(logits, log_std, actions)
                 entropy = jnp.sum(log_std + _half_log_2pi_e)
             else:
                 logp_all = jax.nn.log_softmax(logits)
                 logp = jnp.take_along_axis(
                     logp_all,
-                    batch[sb.ACTIONS][:, None].astype(jnp.int32),
-                    axis=-1)[:, 0]
+                    actions[..., None].astype(jnp.int32),
+                    axis=-1)[..., 0]
                 entropy = -jnp.mean(
                     jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            return logp, entropy
+
+        def _ppo_objective(params, logp, entropy, vf, batch):
             ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
             adv = batch[sb.ADVANTAGES]
             surr = jnp.minimum(
@@ -175,6 +280,43 @@ class JaxPolicy:
             return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
                            "entropy": entropy, "total_loss": total}
 
+        def ppo_loss(params, batch):
+            logits, vf = ff_logits_vf(params, batch[sb.OBS])
+            logp, entropy = _logp_entropy(params, logits,
+                                          batch[sb.ACTIONS])
+            return _ppo_objective(params, logp, entropy, vf, batch)
+
+        def ppo_loss_seq(params, batch):
+            """Recurrent loss over (S, L, ...) sequence chunks: encoder
+            on the flattened steps, lax.scan over time with done-masked
+            carry resets (reference: rnn_sequencing + LSTM loss)."""
+            obs = batch[sb.OBS]
+            S, L = obs.shape[0], obs.shape[1]
+            feats = enc.apply(
+                params["enc"],
+                obs.reshape((S * L,) + tuple(enc.obs_shape)))
+            feats = feats.reshape(S, L, -1)
+            feats_t = jnp.swapaxes(feats, 0, 1)          # (L, S, F)
+            dones_t = jnp.swapaxes(
+                batch[sb.DONES].astype(jnp.float32), 0, 1)
+
+            def step(carry, xs):
+                feat, done = xs
+                h, c = lstm_step(params["lstm"], carry, feat)
+                mask = (1.0 - done)[:, None]
+                return (h * mask, c * mask), h
+
+            _, hs = jax.lax.scan(
+                step, (batch[STATE_H], batch[STATE_C]),
+                (feats_t, dones_t))
+            hs = jnp.swapaxes(hs, 0, 1)                  # (S, L, cell)
+            logits = mlp_apply(params["pi"], hs)
+            vf = mlp_apply(params["vf"], hs)[..., 0]
+            logp, entropy = _logp_entropy(params, logits,
+                                          batch[sb.ACTIONS])
+            return _ppo_objective(params, logp, entropy, vf, batch)
+
+        loss_fn = ppo_loss_seq if spec.use_lstm else ppo_loss
         mb = spec.minibatch_size
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -193,7 +335,7 @@ class JaxPolicy:
                     params, opt_state = carry
                     mini = {k: v[rows] for k, v in batch.items()}
                     (loss, stats), grads = jax.value_and_grad(
-                        ppo_loss, has_aux=True)(params, mini)
+                        loss_fn, has_aux=True)(params, mini)
                     updates, opt_state = self.tx.update(grads, opt_state,
                                                         params)
                     import optax
@@ -211,11 +353,36 @@ class JaxPolicy:
             last = jax.tree.map(lambda s: s[-1, -1], stats)
             return params, opt_state, last, rng
 
-        self._act = act
-        self._update = update
-        self._loss = jax.jit(ppo_loss)
+        @jax.jit
+        def value_ff(params, obs):
+            return mlp_apply(params["vf"]["head"],
+                             enc.apply(params["vf"]["enc"], obs))[..., 0]
 
+        @jax.jit
+        def value_rec(params, obs, h, c):
+            _, _, vf = rec_step(params, (h, c), obs)
+            return vf
+
+        self._act = act
+        self._act_greedy = act_greedy
+        self._act_rec = act_rec
+        self._act_rec_greedy = act_rec_greedy
+        self._update = update
+        self._loss = jax.jit(loss_fn)
+        self._value_ff = value_ff
+        self._value_rec = value_rec
+
+    # -- inference --------------------------------------------------------
     def compute_actions(self, obs: np.ndarray):
+        if self.spec.use_lstm:
+            h, c = self.get_state(obs.shape[0])
+            actions, logp, vf, self._rng, h2, c2 = self._act_rec(
+                self.params, obs, self._rng, h, c)
+            # np.array (copy): reset_state_where writes into these rows,
+            # and np.asarray on a jax array is a read-only view
+            self._state = (np.array(h2), np.array(c2))
+            return (np.asarray(actions), np.asarray(logp),
+                    np.asarray(vf))
         actions, logp, vf, self._rng = self._act(self.params, obs,
                                                  self._rng)
         return (np.asarray(actions), np.asarray(logp), np.asarray(vf))
@@ -223,13 +390,38 @@ class JaxPolicy:
     def compute_deterministic_actions(self, obs: np.ndarray) -> np.ndarray:
         """Greedy/mean actions for evaluation (reference:
         explore=False in Algorithm.evaluate's policy calls)."""
-        logits = _net_apply(self.params["pi"], np.asarray(obs, np.float32))
-        if getattr(self.spec, "continuous", False):
-            return np.asarray(logits)  # Gaussian mean
-        return np.asarray(logits).argmax(axis=-1)
+        obs = np.asarray(obs, np.float32)
+        if self.spec.use_lstm:
+            cell = self.spec.lstm_cell_size
+            n = obs.shape[0]
+            if (self._eval_state is None
+                    or self._eval_state[0].shape[0] != n):
+                self._eval_state = (np.zeros((n, cell), np.float32),
+                                    np.zeros((n, cell), np.float32))
+            actions, h, c = self._act_rec_greedy(
+                self.params, obs, *self._eval_state)
+            # np.array (copy): reset_eval_state_where writes these rows
+            self._eval_state = (np.array(h), np.array(c))
+            return np.asarray(actions)
+        return np.asarray(self._act_greedy(self.params, obs))
 
-    def value(self, obs: np.ndarray) -> np.ndarray:
-        return np.asarray(_net_apply(self.params["vf"], obs)[..., 0])
+    def value(self, obs: np.ndarray, rows=None) -> np.ndarray:
+        """State values; for recurrent policies ``rows`` selects which
+        env copies' live carries pair with ``obs`` (bootstrapping a
+        done subset mid-rollout)."""
+        obs = np.asarray(obs, np.float32)
+        if self.spec.use_lstm:
+            n = obs.shape[0]
+            if self._state is not None:
+                h, c = self._state
+                if rows is not None:
+                    h, c = h[rows], c[rows]
+            else:
+                cell = self.spec.lstm_cell_size
+                h = np.zeros((n, cell), np.float32)
+                c = h
+            return np.asarray(self._value_rec(self.params, obs, h, c))
+        return np.asarray(self._value_ff(self.params, obs))
 
     # -- learning ---------------------------------------------------------
     def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:

@@ -35,6 +35,13 @@ class PPOConfig(AlgorithmConfig):
     # set from the env when obs/action spaces are introspectable
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
+    #: full observation shape (rank-3 selects the conv stack from the
+    #: model catalog — reference catalog.py _get_filter_config)
+    obs_shape: Optional[Tuple[int, ...]] = None
+    conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
+    use_lstm: bool = False
+    lstm_cell_size: int = 64
+    max_seq_len: int = 16
     #: Box action spaces: diagonal-Gaussian policy (auto-detected)
     continuous: bool = False
     #: >1: the learner update runs data-parallel over this many local
@@ -55,17 +62,25 @@ class PPOConfig(AlgorithmConfig):
             entropy_coeff=self.entropy_coeff,
             num_sgd_iter=self.num_sgd_iter,
             minibatch_size=self.minibatch_size, grad_clip=self.grad_clip,
-            continuous=self.continuous)
+            continuous=self.continuous,
+            obs_shape=(tuple(self.obs_shape) if self.obs_shape
+                       else None),
+            conv_filters=self.conv_filters, use_lstm=self.use_lstm,
+            lstm_cell_size=self.lstm_cell_size,
+            max_seq_len=self.max_seq_len)
 
 
 def _introspect_spaces(cfg: PPOConfig) -> None:
     if cfg.obs_dim is not None and cfg.n_actions is not None:
         return
-    from ray_tpu.rllib.rollout_worker import _make_env
+    from ray_tpu.rllib.vector_env import make_vector_env
 
-    env = _make_env(cfg.env, cfg.env_config)
+    env = make_vector_env(cfg.env, cfg.env_config, 1, seed=0)
     try:
         cfg.obs_dim = int(np.prod(env.observation_space.shape))
+        shape = tuple(env.observation_space.shape)
+        if getattr(cfg, "obs_shape", None) is None and len(shape) == 3:
+            cfg.obs_shape = shape  # pixels: hand the conv stack its layout
         space = env.action_space
         if hasattr(space, "n"):
             cfg.n_actions = int(space.n)
@@ -111,10 +126,13 @@ class PPO(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         batches = []
         steps = 0
+        # recurrent batches are rows of max_seq_len-step sequences
+        steps_per_row = (self.config.max_seq_len
+                         if getattr(self.config, "use_lstm", False) else 1)
         while steps < self.config.train_batch_size:
             parts = self.workers.sample()
             batches.extend(parts)
-            steps += sum(b.count for b in parts)
+            steps += sum(b.count for b in parts) * steps_per_row
         batch = SampleBatch.concat_samples(batches)
 
         # standardize advantages (reference ppo.py standardize_fields)
@@ -130,7 +148,7 @@ class PPO(Algorithm):
                 self._filter_state = self.workers.sync_filters(
                     getattr(self, "_filter_state", None))
         self._episode_returns.extend(self.workers.episode_returns())
-        stats["timesteps_this_iter"] = batch.count
+        stats["timesteps_this_iter"] = batch.count * steps_per_row
         return stats
 
     def _make_eval_worker(self):

@@ -20,7 +20,8 @@ import numpy as np
 from ray_tpu.rllib import sample_batch as sb
 from ray_tpu.rllib.connectors import (ObsFilter, default_action_pipeline,
                                       default_obs_pipeline)
-from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.policy import (JaxPolicy, PolicySpec, STATE_C,
+                                  STATE_H)
 from ray_tpu.rllib.sample_batch import SampleBatch, compute_gae
 from ray_tpu.rllib.vector_env import make_vector_env
 
@@ -52,11 +53,21 @@ class RolloutWorker:
         self.gamma = gamma
         self.lam = lam
         self.fragment = rollout_fragment_length
+        if self.policy.is_recurrent:
+            L = self.policy.spec.max_seq_len
+            if rollout_fragment_length % L:
+                raise ValueError(
+                    f"rollout_fragment_length {rollout_fragment_length} "
+                    f"must be a multiple of max_seq_len {L} for "
+                    "recurrent policies")
         self._raw_obs = self.venv.vector_reset(seed=seed)
         self._ep_rewards = np.zeros(self.num_envs, np.float64)
         self.episode_returns: List[float] = []
+        policy_obs_shape = getattr(policy_spec, "obs_shape_", None) or \
+            (getattr(policy_spec, "obs_dim", 0),)
         self.obs_pipeline = default_obs_pipeline(
-            np.shape(self._raw_obs[0]), observation_filter)
+            np.shape(self._raw_obs[0]), observation_filter,
+            preserve_shape=len(policy_obs_shape) == 3)
         self.action_pipeline = default_action_pipeline(
             self.venv.action_space, continuous)
 
@@ -81,9 +92,20 @@ class RolloutWorker:
         done_buf = np.zeros((T, n_env), np.bool_)
         logp_buf = np.zeros((T, n_env), np.float32)
         vf_buf = np.zeros((T, n_env), np.float32)
+        recurrent = self.policy.is_recurrent
+        if recurrent:
+            cell = self.policy.spec.lstm_cell_size
+            # carry entering each step, recorded so training chunks can
+            # start BPTT from the true rollout state (reference:
+            # rnn_sequencing state_in columns)
+            sh_buf = np.zeros((T, n_env, cell), np.float32)
+            sc_buf = np.zeros((T, n_env, cell), np.float32)
 
         for t in range(T):
             obs = self.obs_pipeline(self._raw_obs)
+            if recurrent:
+                h, c = self.policy.get_state(n_env)
+                sh_buf[t], sc_buf[t] = h, c
             actions, logp, vf = self.policy.compute_actions(obs)
             obs_buf[t] = obs
             act_buf[t] = actions
@@ -103,13 +125,15 @@ class RolloutWorker:
                 fin = self.obs_pipeline(infos["final_obs"][boot],
                                         update=False)
                 rew_buf[t, boot] += self.gamma * np.asarray(
-                    self.policy.value(fin), np.float32)
+                    self.policy.value(fin, rows=boot), np.float32)
             done = terms | truncs
             done_buf[t] = done
             if done.any():
                 self.episode_returns.extend(
                     self._ep_rewards[done].tolist())
                 self._ep_rewards[done] = 0.0
+                if recurrent:
+                    self.policy.reset_state_where(done)
             self._raw_obs = raw2
 
         last_obs = self.obs_pipeline(self._raw_obs, update=False)
@@ -120,12 +144,28 @@ class RolloutWorker:
             adv, vt = compute_gae(rew_buf[:, i], vf_buf[:, i],
                                   done_buf[:, i], float(last_vf[i]),
                                   gamma=self.gamma, lam=self.lam)
-            parts.append(SampleBatch({
+            data = {
                 sb.OBS: obs_buf[:, i], sb.ACTIONS: act_buf[:, i],
                 sb.REWARDS: rew_buf[:, i], sb.DONES: done_buf[:, i],
                 sb.ACTION_LOGP: logp_buf[:, i], sb.VF_PREDS: vf_buf[:, i],
                 sb.ADVANTAGES: adv, sb.VALUE_TARGETS: vt,
-            }))
+            }
+            if recurrent:
+                # chunk the fragment into max_seq_len sequences whose
+                # rows are (L, ...) slices; initial carries come from
+                # the recorded per-step states at each chunk start
+                L = self.policy.spec.max_seq_len
+                if T % L:
+                    raise ValueError(
+                        f"rollout_fragment_length {T} must be a "
+                        f"multiple of max_seq_len {L}")
+                n_chunks = T // L
+                data = {k: v.reshape((n_chunks, L) + v.shape[1:])
+                        for k, v in data.items()}
+                starts = np.arange(0, T, L)
+                data[STATE_H] = sh_buf[starts, i]
+                data[STATE_C] = sc_buf[starts, i]
+            parts.append(SampleBatch(data))
         return SampleBatch.concat_samples(parts)
 
     def pop_episode_returns(self) -> List[float]:
@@ -150,6 +190,8 @@ class RolloutWorker:
         venv = self._eval_env
         n = venv.num_envs
         # fixed-seed reset per call: same weights → same eval result
+        # (the recurrent eval carry must reset with it)
+        self.policy.reset_eval_state()
         raw = venv.vector_reset(seed=self._env_spec[2] + 77_000)
         ep_rew = np.zeros(n, np.float64)
         ep_len = np.zeros(n, np.int64)
@@ -170,6 +212,7 @@ class RolloutWorker:
                 lengths.extend(ep_len[done].tolist())
                 ep_rew[done] = 0.0
                 ep_len[done] = 0
+                self.policy.reset_eval_state_where(done)
             if len(returns) >= num_episodes:
                 break
         returns = returns[:num_episodes]

@@ -180,6 +180,19 @@ def make_vector_env(env: Any, env_config: Optional[Dict], num_envs: int,
                              first_env=probe)
     if env == "CartPole-v1":
         return CartPoleVecEnv(num_envs, seed=seed)
+    if env == "MinAtarBreakout":
+        from ray_tpu.rllib.envs import MinAtarBreakoutVecEnv
+
+        return MinAtarBreakoutVecEnv(
+            num_envs, size=int((env_config or {}).get("size", 10)),
+            seed=seed)
+    if env == "RepeatPrev":
+        from ray_tpu.rllib.envs import RepeatPrevVecEnv
+
+        return RepeatPrevVecEnv(
+            num_envs,
+            n_symbols=int((env_config or {}).get("n_symbols", 3)),
+            seed=seed)
     import gymnasium as gym
 
     return SyncVectorEnv(lambda: gym.make(env), num_envs)

@@ -1,0 +1,36 @@
+"""PPO-on-pixels learning gate: the conv-policy analog of the
+reference's Atari pass bar (release/rllib_tests/learning_tests/
+yaml_files/ppo/ppo-breakoutnoframeskip-v4.yaml — PPO must learn
+Breakout from pixels within a budget).  Here the pixel env is the
+in-repo MinAtar-class breakout (rllib/envs.py) and the policy is the
+catalog conv stack (rllib/models.py); the gate is reward well past the
+noop/random floor (~0.2) within the step budget."""
+import json
+import os
+import time
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig
+
+ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
+fast = bool(os.environ.get("RELEASE_FAST"))
+cfg = PPOConfig(env="MinAtarBreakout", env_config={"size": 8},
+                num_workers=2, num_envs_per_worker=8,
+                rollout_fragment_length=128, train_batch_size=2048,
+                num_sgd_iter=4, minibatch_size=256, hidden=(128,),
+                lr=7e-4, entropy_coeff=0.02, seed=1)
+algo = PPO(cfg)
+best, steps = -1e9, 0
+for i in range(12 if fast else 60):
+    res = algo.train()
+    steps = res["timesteps_total"]
+    best = max(best, res.get("episode_reward_mean", -1e9))
+    if best >= 3.0 or steps > 200_000:
+        break
+print(json.dumps({"episode_reward_mean": best, "env_steps": steps}),
+      flush=True)
+try:
+    algo.stop()
+    ray_tpu.shutdown()
+except BaseException:
+    pass

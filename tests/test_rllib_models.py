@@ -1,0 +1,181 @@
+"""RLlib model catalog: conv stacks, LSTM wrapper, pixel env + learning
+gates (reference analogs: rllib/models/catalog.py:195 ModelCatalog,
+models/torch/visionnet.py, recurrent_net.py + rnn_sequencing.py, and
+the PPO-pixels pass bar of
+release/rllib_tests/.../ppo-breakoutnoframeskip-v4.yaml)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.envs import MinAtarBreakoutVecEnv, RepeatPrevVecEnv
+from ray_tpu.rllib.models import (Encoder, ModelConfig, conv_out_dim,
+                                  default_conv_filters)
+from ray_tpu.rllib.policy import (JaxPolicy, PolicySpec, STATE_C,
+                                  STATE_H)
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+
+def test_catalog_picks_conv_for_rank3():
+    enc = Encoder((10, 10, 3), ModelConfig(fcnet_hiddens=(32,)))
+    assert enc.filters == default_conv_filters((10, 10, 3))
+    assert enc.feature_dim == 32
+    import jax
+
+    params = enc.init(jax.random.PRNGKey(0))
+    assert "conv" in params
+    out = enc.apply(params, np.zeros((4, 10, 10, 3), np.float32))
+    assert out.shape == (4, 32)
+
+
+def test_catalog_atari_scale_stack():
+    filters = default_conv_filters((84, 84, 4))
+    assert len(filters) == 3  # Atari-class three-layer stack
+    assert conv_out_dim((84, 84, 4), filters) > 0
+
+
+def test_mlp_for_rank1_unchanged():
+    enc = Encoder((7,), ModelConfig(fcnet_hiddens=(16, 8)))
+    assert enc.filters is None and enc.feature_dim == 8
+
+
+def test_conv_policy_forward_and_update():
+    spec = PolicySpec(obs_dim=8 * 8 * 3, n_actions=3, hidden=(32,),
+                      obs_shape=(8, 8, 3), minibatch_size=16,
+                      num_sgd_iter=2)
+    pol = JaxPolicy(spec, seed=0)
+    obs = np.random.RandomState(0).rand(16, 8, 8, 3).astype(np.float32)
+    actions, logp, vf = pol.compute_actions(obs)
+    assert actions.shape == (16,) and vf.shape == (16,)
+    assert set(np.asarray(actions)) <= {0, 1, 2}
+    batch_data = {
+        sb.OBS: obs, sb.ACTIONS: actions, sb.ACTION_LOGP: logp,
+        sb.ADVANTAGES: np.random.randn(16).astype(np.float32),
+        sb.VALUE_TARGETS: np.zeros(16, np.float32),
+        sb.DONES: np.zeros(16, bool),
+    }
+    from ray_tpu.rllib.sample_batch import SampleBatch
+
+    stats = pol.learn_on_batch(SampleBatch(batch_data))
+    assert np.isfinite(stats["total_loss"])
+
+
+def test_minatar_env_mechanics():
+    env = MinAtarBreakoutVecEnv(2, size=8, seed=3)
+    obs = env.vector_reset(seed=3)
+    assert obs.shape == (2, 8, 8, 3)
+    assert obs[:, 1:4, :, 2].all()  # brick rows filled
+    assert obs[:, :, :, 1].sum(axis=(1, 2)).tolist() == [1.0, 1.0]
+    total_rew = np.zeros(2)
+    terms_seen = False
+    for _ in range(300):
+        obs, rew, terms, truncs, infos = env.vector_step(
+            np.zeros(2, np.int64))
+        total_rew += rew
+        assert obs.shape == (2, 8, 8, 3)
+        assert "final_obs" in infos
+        terms_seen = terms_seen or terms.any()
+    # a noop policy must eventually lose the ball (termination path) —
+    # and the ball bouncing straight up/down off the center paddle can
+    # also break bricks (reward path exercised in the learning test)
+    assert terms_seen
+
+
+def test_repeat_prev_reward_semantics():
+    env = RepeatPrevVecEnv(4, n_symbols=3, seed=0)
+    obs = env.vector_reset(seed=0)
+    # acting with the CURRENT symbol on the first step scores (prev is
+    # seeded equal to the first symbol)
+    sym = obs.argmax(axis=1)
+    _, rew, *_ = env.vector_step(sym)
+    assert rew.tolist() == [1.0] * 4
+    # echoing the previous symbol always scores
+    prev = env._prev.copy()
+    _, rew, *_ = env.vector_step(prev)
+    assert rew.tolist() == [1.0] * 4
+
+
+def test_recurrent_logp_alignment(ray_start_shared):
+    """Replaying a recorded fragment through the seq loss with unchanged
+    params must reproduce the rollout logp exactly (state columns line
+    up) — the invariant rnn_sequencing exists for."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.models import lstm_step, mlp_apply
+    from ray_tpu.rllib.rollout_worker import RolloutWorker
+
+    spec = PolicySpec(obs_dim=3, n_actions=3, hidden=(16,),
+                      use_lstm=True, lstm_cell_size=8, max_seq_len=8,
+                      minibatch_size=4)
+    w = RolloutWorker(env="RepeatPrev", policy_spec=spec, num_envs=4,
+                      rollout_fragment_length=32, seed=0)
+    batch = w.sample()
+    assert batch[sb.OBS].shape == (16, 8, 3)  # 4 envs x 4 chunks
+    assert batch[STATE_H].shape == (16, 8)
+
+    params = w.policy.params
+    enc = w.policy.encoder
+    obs = jnp.asarray(batch[sb.OBS])
+    S, L = obs.shape[:2]
+    feats = enc.apply(params["enc"],
+                      obs.reshape((S * L,) + enc.obs_shape))
+    feats_t = jnp.swapaxes(feats.reshape(S, L, -1), 0, 1)
+    dones_t = jnp.swapaxes(
+        jnp.asarray(batch[sb.DONES], jnp.float32), 0, 1)
+
+    def step(carry, xs):
+        f, d = xs
+        h, c = lstm_step(params["lstm"], carry, f)
+        m = (1.0 - d)[:, None]
+        return (h * m, c * m), h
+
+    _, hs = jax.lax.scan(step, (jnp.asarray(batch[STATE_H]),
+                                jnp.asarray(batch[STATE_C])),
+                         (feats_t, dones_t))
+    logits = mlp_apply(params["pi"], jnp.swapaxes(hs, 0, 1))
+    logp_all = jax.nn.log_softmax(logits)
+    logp = jnp.take_along_axis(
+        logp_all, jnp.asarray(batch[sb.ACTIONS])[..., None].astype(
+            jnp.int32), axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(logp),
+                               batch[sb.ACTION_LOGP], atol=1e-5)
+
+
+@pytest.mark.slow
+def test_lstm_solves_memory_task(ray_start_shared):
+    """The LSTM policy must clearly beat the feedforward information
+    ceiling on RepeatPrev (chance ≈ ep_len/n_symbols ≈ 22 of 64)."""
+    cfg = PPOConfig(env="RepeatPrev", num_workers=2,
+                    num_envs_per_worker=8, rollout_fragment_length=64,
+                    train_batch_size=2048, num_sgd_iter=6,
+                    minibatch_size=32, hidden=(64,), use_lstm=True,
+                    lstm_cell_size=64, max_seq_len=16, lr=1e-3,
+                    entropy_coeff=0.003, gamma=0.9, seed=1)
+    algo = PPO(cfg)
+    reward = 0.0
+    for _ in range(25):
+        r = algo.train()
+        reward = r.get("episode_reward_mean", 0.0)
+    algo.cleanup()
+    assert reward > 40.0, f"LSTM stuck at chance: {reward}"
+
+
+@pytest.mark.slow
+def test_cnn_ppo_learns_pixels(ray_start_shared):
+    """PPO through the conv policy must learn MinAtar breakout well past
+    the noop/random floor (~0.2) — the in-repo analog of the
+    reference's PPO-on-Breakout-pixels pass bar."""
+    cfg = PPOConfig(env="MinAtarBreakout", env_config={"size": 8},
+                    num_workers=2, num_envs_per_worker=8,
+                    rollout_fragment_length=128, train_batch_size=2048,
+                    num_sgd_iter=4, minibatch_size=256, hidden=(128,),
+                    lr=7e-4, entropy_coeff=0.02, seed=1)
+    algo = PPO(cfg)
+    reward = 0.0
+    for _ in range(16):
+        r = algo.train()
+        reward = max(reward, r.get("episode_reward_mean", 0.0))
+    algo.cleanup()
+    assert reward > 0.9, f"conv policy failed to learn: {reward}"
