@@ -939,6 +939,17 @@ class NodeManager:
             self._spill = SpillManager(self._store_client, self.spill_dir)
         return self._store_client
 
+    async def _spill_op(self, fn, *args):
+        """Run a spill-manager call from this event loop.  Remote spill
+        backends (kv://, s3://) block on network/RPC — and kv:// rides
+        the GCS, which on a head node shares THIS loop — so remote ops
+        hop to an executor thread; local-disk ops stay inline."""
+        self._store()
+        if self._spill.is_remote:
+            return await asyncio.get_running_loop().run_in_executor(
+                None, fn, *args)
+        return fn(*args)
+
     async def _pull_remote(self, oid: bytes, remote_addr: str):
         """Cross-node transfer: stream the object from the remote node
         manager into the local store in bounded chunks with admission
@@ -951,7 +962,8 @@ class NodeManager:
 
         store = self._store()
         object_id = ObjectID(oid)
-        if store.contains(object_id) or self._spill.contains(oid):
+        if store.contains(object_id) or await self._spill_op(
+                self._spill.contains, oid):
             return {"in_store": True}
         if remote_addr.startswith("/"):
             peer = await asyncio.wait_for(
@@ -1013,7 +1025,7 @@ class NodeManager:
         if buf is not None:
             with buf:
                 return {"size": len(buf.data) + len(buf.metadata)}
-        size = self._spill.size(oid)
+        size = await self._spill_op(self._spill.size, oid)
         if size is not None:
             return {"size": size}
         # Brief wait: the pull can race the producer's seal.
@@ -1042,7 +1054,8 @@ class NodeManager:
                     parts.append(bytes(
                         buf.metadata[max(0, off - d):off + length - d]))
                 return {"data": b"".join(parts)}
-        data = self._spill.read_range(oid, off, length)
+        data = await self._spill_op(self._spill.read_range, oid, off,
+                                    length)
         if data is not None:
             return {"data": data}
         raise RuntimeError("object no longer in store")
@@ -1057,7 +1070,7 @@ class NodeManager:
         if buf is not None:
             with buf:
                 return {"data": bytes(buf.data) + bytes(buf.metadata)}
-        data = self._spill.read(oid)
+        data = await self._spill_op(self._spill.read, oid)
         if data is None:
             raise RuntimeError("object not in store")
         return {"data": data}
@@ -1067,7 +1080,7 @@ class NodeManager:
     async def rpc_node_stats(self, conn, payload):
         try:
             store_stats = self._store().stats()
-            spilled = self._spill.list()
+            spilled = await self._spill_op(self._spill.list)
         except Exception:  # noqa: BLE001 - store mid-teardown
             store_stats, spilled = {}, []
         return {

@@ -13,8 +13,12 @@ from ray_tpu.data.dataset import (ActorPoolStrategy, Dataset,
                                   from_pandas, range as range_, read_csv,
                                   read_json, read_numpy, read_parquet,
                                   read_text)
-from ray_tpu.data.datasource import (Datasource, RangeDatasource,
-                                     ReadTask, read_datasource)
+from ray_tpu.data.datasource import (Datasource, FileDatasource,
+                                     RangeDatasource, ReadTask,
+                                     read_datasource)
+from ray_tpu.data.filesystem import (FileSystem, KVFileSystem,
+                                     LocalFileSystem, MemoryFileSystem,
+                                     register_filesystem)
 
 # `range` shadows the builtin only inside this namespace, as in the
 # reference's ray.data.range
@@ -25,4 +29,6 @@ __all__ = ["Dataset", "DatasetPipeline", "GroupedDataset",
            "from_pandas", "from_arrow", "range", "read_parquet",
            "read_csv", "read_json", "read_text", "read_numpy",
            "Datasource", "ReadTask", "RangeDatasource",
-           "read_datasource"]
+           "FileDatasource", "read_datasource",
+           "FileSystem", "LocalFileSystem", "MemoryFileSystem",
+           "KVFileSystem", "register_filesystem"]

@@ -381,13 +381,15 @@ class Dataset:
         write_datasource(self, source, **write_args)
 
     def write_parquet(self, path: str) -> None:
-        import os
-
         import pyarrow.parquet as pq
 
-        os.makedirs(path, exist_ok=True)
+        from ray_tpu.data import filesystem as fs_mod
+
         for i, t in enumerate(self._tables()):
-            pq.write_table(t, os.path.join(path, f"part-{i:05d}.parquet"))
+            fs, p = fs_mod.resolve(
+                fs_mod.join(path, f"part-{i:05d}.parquet"))
+            with fs.open_output(p) as f:
+                pq.write_table(t, f)
 
     # -- pipelining -------------------------------------------------------
     def window(self, *, blocks_per_window: int = 2) -> "DatasetPipeline":
@@ -562,76 +564,87 @@ def _read_file_task(fmt: str, path: str):
     """One file -> one block, parsed INSIDE a task so reads parallelize
     across the cluster instead of serializing through the driver
     (reference: read tasks from read_api.py:227 read_datasource).
-    Requires the path to be readable on every node (shared filesystem),
-    like the reference's file-based datasources."""
+    The path resolves through the filesystem seam (local / kv:// /
+    s3:// …, filesystem.py) — local paths must be readable on every
+    node, like the reference's file-based datasources."""
+    return _parse_file(fmt, path)
+
+
+def _parse_file(fmt: str, path: str):
+    from ray_tpu.data import filesystem as fs_mod
+
+    fs, p = fs_mod.resolve(path)
     if fmt == "parquet":
         import pyarrow.parquet as pq
 
-        return pq.read_table(path)
+        with fs.open_input(p) as f:
+            return pq.read_table(f)
     if fmt == "csv":
         from pyarrow import csv as pa_csv
 
-        return pa_csv.read_csv(path)
+        with fs.open_input(p) as f:
+            return pa_csv.read_csv(f)
     if fmt == "json":
         from pyarrow import json as pa_json
 
-        return pa_json.read_json(path)
+        with fs.open_input(p) as f:
+            return pa_json.read_json(f)
+    if fmt == "text":
+        with fs.open_input(p) as f:
+            lines = f.read().decode().splitlines()
+        return block_util.to_table({"text": lines})
+    if fmt == "numpy":
+        import io as _io
+
+        with fs.open_input(p) as f:
+            arr = np.load(_io.BytesIO(f.read()))
+        return block_util.to_table({"value": arr})
     raise ValueError(f"unknown format {fmt!r}")
 
 
-def read_parquet(path: str, *, parallelism: int = 8) -> Dataset:
-    import glob
-    import os
-
-    files = sorted(glob.glob(os.path.join(path, "*.parquet"))) \
-        if os.path.isdir(path) else [path]
-    if not files:
-        raise FileNotFoundError(f"no parquet files under {path}")
-    return Dataset([_read_file_task.remote("parquet", f) for f in files])
-
-
-def read_csv(path: str, *, parallelism: int = 8) -> Dataset:
-    import glob
-    import os
-
-    files = sorted(glob.glob(os.path.join(path, "*.csv"))) \
-        if os.path.isdir(path) else [path]
-    if not files:
-        raise FileNotFoundError(f"no csv files under {path}")
-    return Dataset([_read_file_task.remote("csv", f) for f in files])
-
-
 def _list_files(path: str, suffix: str) -> List[str]:
-    import glob
-    import os
+    from ray_tpu.data import filesystem as fs_mod
 
-    files = sorted(glob.glob(os.path.join(path, f"*{suffix}"))) \
-        if os.path.isdir(path) else [path]
+    fs, p = fs_mod.resolve(path)
+    files = fs.list(p, suffix)
     if not files:
         raise FileNotFoundError(f"no {suffix} files under {path}")
+    # re-attach the scheme so worker-side resolve() routes the same way
+    if "://" in path and "://" not in files[0]:
+        scheme = path.split("://", 1)[0]
+        files = [f"{scheme}://{f}" for f in files]
     return files
 
 
-def read_json(path: str, *, parallelism: int = 8) -> Dataset:
+def _read_files(fmt: str, suffix: str, path: str) -> Dataset:
+    """Shared body of the read_* helpers: list via the filesystem seam,
+    parse per-file in remote tasks (driver-side for process-local
+    mem:// paths, which workers cannot see)."""
+    files = _list_files(path, suffix)
+    if path.startswith("mem://"):
+        return Dataset([ray_tpu.put(_parse_file(fmt, f))
+                        for f in files])
+    return Dataset([_read_file_task.remote(fmt, f) for f in files])
+
+
+def read_parquet(path: str) -> Dataset:
+    return _read_files("parquet", ".parquet", path)
+
+
+def read_csv(path: str) -> Dataset:
+    return _read_files("csv", ".csv", path)
+
+
+def read_json(path: str) -> Dataset:
     """Newline-delimited JSON records (reference: read_json)."""
-    return Dataset([_read_file_task.remote("json", f)
-                    for f in _list_files(path, ".json")])
+    return _read_files("json", ".json", path)
 
 
-def read_text(path: str, *, parallelism: int = 8) -> Dataset:
+def read_text(path: str) -> Dataset:
     """One row per line, column "text" (reference: read_text)."""
-    refs = []
-    for f in _list_files(path, ".txt"):
-        with open(f, "r") as fh:
-            lines = [ln.rstrip("\n") for ln in fh]
-        refs.append(ray_tpu.put(block_util.to_table({"text": lines})))
-    return Dataset(refs)
+    return _read_files("text", ".txt", path)
 
 
-def read_numpy(path: str, *, parallelism: int = 8) -> Dataset:
+def read_numpy(path: str) -> Dataset:
     """.npy files, column "value" (reference: read_numpy)."""
-    refs = []
-    for f in _list_files(path, ".npy"):
-        arr = np.load(f)
-        refs.append(ray_tpu.put(block_util.to_table({"value": arr})))
-    return Dataset(refs)
+    return _read_files("numpy", ".npy", path)

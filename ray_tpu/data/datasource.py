@@ -46,6 +46,61 @@ class Datasource:
         """Called on the driver after every block write finished."""
 
 
+class FileDatasource(Datasource):
+    """File-format datasource over the pluggable filesystem seam
+    (reference: file_based_datasource.py:181 FileBasedDatasource — every
+    path resolves through a filesystem, so local / kv:// / s3:// sources
+    all flow through the same read/write tasks).
+
+    ``fmt``: parquet | csv | json | text | numpy.
+    """
+
+    _SUFFIX = {"parquet": ".parquet", "csv": ".csv", "json": ".json",
+               "text": ".txt", "numpy": ".npy"}
+
+    def __init__(self, path: str, fmt: str = "parquet"):
+        if fmt not in self._SUFFIX:
+            raise ValueError(f"unknown format {fmt!r}")
+        self.path = path
+        self.fmt = fmt
+
+    def get_read_tasks(self, parallelism: int,
+                       **read_args: Any) -> List[ReadTask]:
+        from ray_tpu.data.dataset import _list_files, _parse_file
+
+        files = _list_files(self.path, self._SUFFIX[self.fmt])
+        fmt = self.fmt
+        return [ReadTask(lambda f=f: _parse_file(fmt, f))
+                for f in files]
+
+    def write_block(self, block, task_index: int, **write_args) -> str:
+        from ray_tpu.data import filesystem as fs_mod
+
+        out = fs_mod.join(self.path,
+                          f"part-{task_index:05d}{self._SUFFIX[self.fmt]}")
+        fs, p = fs_mod.resolve(out)
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            with fs.open_output(p) as f:
+                pq.write_table(block, f)
+        elif self.fmt == "csv":
+            from pyarrow import csv as pa_csv
+
+            with fs.open_output(p) as f:
+                pa_csv.write_csv(block, f)
+        elif self.fmt == "json":
+            with fs.open_output(p) as f:
+                import json as _json
+
+                for row in block.to_pylist():
+                    f.write((_json.dumps(row) + "\n").encode())
+        else:
+            raise ValueError(
+                f"writes not supported for format {self.fmt!r}")
+        return out
+
+
 class RangeDatasource(Datasource):
     """Example/testing datasource: integers [0, n)."""
 

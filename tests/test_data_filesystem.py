@@ -1,0 +1,136 @@
+"""Filesystem seam under Data IO and spill (reference analogs:
+file_based_datasource.py:181 filesystem plumbing,
+external_storage.py:445 remote spill)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+from ray_tpu.data import filesystem as fs_mod
+
+
+def test_resolve_schemes():
+    fs, p = fs_mod.resolve("/tmp/x")
+    assert isinstance(fs, fs_mod.LocalFileSystem) and p == "/tmp/x"
+    fs, p = fs_mod.resolve("file:///tmp/x")
+    assert isinstance(fs, fs_mod.LocalFileSystem) and p == "/tmp/x"
+    fs, p = fs_mod.resolve("mem://bucket/a.csv")
+    assert isinstance(fs, fs_mod.MemoryFileSystem)
+    assert p == "bucket/a.csv"
+
+
+def test_register_filesystem_plugin():
+    class MyFS(fs_mod.MemoryFileSystem):
+        pass
+
+    fs_mod.register_filesystem("myscheme", MyFS)
+    fs, p = fs_mod.resolve("myscheme://data/x")
+    assert isinstance(fs, MyFS) and p == "data/x"
+
+
+def test_memory_fs_roundtrip():
+    fs = fs_mod.MemoryFileSystem()
+    with fs.open_output("b/one.txt") as f:
+        f.write(b"hello")
+    assert fs.exists("b/one.txt")
+    with fs.open_input("b/one.txt") as f:
+        assert f.read() == b"hello"
+    assert fs.list("b", ".txt") == ["b/one.txt"]
+    fs.delete("b/one.txt")
+    assert not fs.exists("b/one.txt")
+    with pytest.raises(FileNotFoundError):
+        fs.open_input("b/one.txt")
+
+
+def test_read_write_mem_scheme(ray_start_shared):
+    ds = rdata.from_items([{"a": i, "b": i * 2} for i in range(10)])
+    ds.write_parquet("mem://out/pq")
+    back = rdata.read_parquet("mem://out/pq")
+    rows = sorted(back.take_all(), key=lambda r: r["a"])
+    assert rows[3] == {"a": 3, "b": 6}
+    assert len(rows) == 10
+
+
+def test_kv_scheme_read_shuffle_iter_roundtrip(ray_start_shared):
+    """The full loop through a remote scheme: write parquet to the
+    cluster KV, read it back via remote tasks (workers resolve kv://
+    through the GCS), shuffle, iterate jax batches."""
+    ds = rdata.from_items([{"x": float(i)} for i in range(32)])
+    ds.write_parquet("kv://ds1")
+    back = rdata.read_parquet("kv://ds1")
+    shuffled = back.random_shuffle(seed=3)
+    got = []
+    for batch in shuffled.iter_jax_batches(batch_size=8):
+        arr = np.asarray(batch["x"])
+        assert arr.shape == (8,)
+        got.extend(arr.tolist())
+    assert sorted(got) == [float(i) for i in range(32)]
+
+
+def test_file_datasource_read_and_write(ray_start_shared, tmp_path):
+    src = rdata.FileDatasource(str(tmp_path / "csvs"), fmt="csv")
+    ds = rdata.from_items([{"v": i} for i in range(6)])
+    ds.write_datasource(src)
+    files = fs_mod.LocalFileSystem().list(str(tmp_path / "csvs"), ".csv")
+    assert files, "write_datasource produced no files"
+    back = rdata.read_datasource(
+        rdata.FileDatasource(str(tmp_path / "csvs"), fmt="csv"))
+    assert sorted(r["v"] for r in back.take_all()) == list(range(6))
+
+
+def test_text_and_numpy_via_seam(ray_start_shared, tmp_path):
+    d = tmp_path / "texts"
+    d.mkdir()
+    (d / "a.txt").write_text("one\ntwo\n")
+    ds = rdata.read_text(str(d))
+    assert sorted(r["text"] for r in ds.take_all()) == ["one", "two"]
+
+    nd = tmp_path / "np"
+    nd.mkdir()
+    np.save(nd / "x.npy", np.arange(4))
+    ds2 = rdata.read_numpy(str(nd))
+    assert sorted(r["value"] for r in ds2.take_all()) == [0, 1, 2, 3]
+
+
+def test_remote_spill_kv(ray_start_shared):
+    """Spill targeting a remote scheme: write through, read back, list,
+    delete (external_storage.py:445 analog).  Uses the live cluster's
+    KV through a SpillManager pointed at kv://."""
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.spill import SpillManager
+
+    cw = worker_context.core_worker()
+    sm = SpillManager(cw.store, "kv://spilltest")
+    oid = b"\x01" * 28
+    sm.write_direct(oid, b"payload-bytes")
+    assert sm.contains(oid)
+    assert sm.read(oid) == b"payload-bytes"
+    assert sm.read_range(oid, 8, 5) == b"bytes"
+    assert sm.size(oid) == 13
+    assert (oid, 13) in sm.list()
+    sm.delete(oid)
+    assert not sm.contains(oid)
+    assert sm.read(oid) is None
+
+
+def test_remote_spill_under_pressure(ray_start_shared):
+    """End-to-end: a SpillManager with a kv:// dir spills real LRU
+    objects out of the shm store and serves reads back."""
+    from ray_tpu._private import worker_context
+    from ray_tpu._private.spill import SpillManager
+
+    cw = worker_context.core_worker()
+    sm = SpillManager(cw.store, "kv://spill2")
+    # place an object in the store, then force-spill it
+    ref = ray_tpu.put(np.arange(1000))
+    oid = ref._info.oid
+    freed = 0
+    for cand, size in cw.store.lru_candidates(1):
+        if cand.binary() == oid:
+            assert sm._spill_one(cand)
+            freed = size
+            break
+    if freed:  # candidate selection is LRU — our object may be pinned
+        assert sm.contains(oid)
+        assert sm.read(oid) is not None
