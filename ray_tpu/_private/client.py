@@ -270,19 +270,41 @@ class CoreWorker:
         #: True while _flush_store_deletes is inside store calls on an
         #: executor thread (shutdown waits on it before unmapping).
         self._flushing = False
-        self.io.run(self._connect(), timeout=self.config.rpc_connect_timeout_s + 5)
+        # Workers get the full worker-start window to connect: on a
+        # saturated host the head answers registration late, and a
+        # worker that gives up at the short RPC timeout wastes the whole
+        # spawn (the node manager kills+retries it anyway at ITS
+        # deadline).  Drivers keep the short timeout — a human is
+        # waiting on init() errors.
+        connect_timeout = (
+            max(self.config.rpc_connect_timeout_s,
+                self.config.worker_start_timeout_s)
+            if mode == "worker" else self.config.rpc_connect_timeout_s)
+        self.io.run(self._connect(), timeout=connect_timeout + 5)
         self.io.post(self._decref_pump())
 
     async def _decref_pump(self):
         """Periodic drain so refs dropped by GC free promptly even when no
-        other API call comes along to drain the queue."""
+        other API call comes along to drain the queue.
+
+        The tick BACKS OFF exponentially (50ms → 2s) while the queues
+        stay empty: the pump is only the fallback for lock-contended
+        drains (every queue append also drains inline), and a fixed
+        20 Hz tick is ruinous in aggregate — measured: ~350 idle actor
+        workers' pumps alone saturated a CI core, stretching each new
+        worker spawn to seconds."""
+        idle_sleep = 0.05
         while not self._closed:
-            await asyncio.sleep(0.05)
+            await asyncio.sleep(idle_sleep)
+            busy = False
             if self._decref_queue and not self._closed:
                 self._drain_decrefs(block=False)
+                busy = True
             if self._store_delete_q and not self._closed:
                 await asyncio.get_running_loop().run_in_executor(
                     None, self._flush_store_deletes)
+                busy = True
+            idle_sleep = 0.05 if busy else min(idle_sleep * 2, 2.0)
 
     def _flush_store_deletes(self):
         # Runs on an executor thread: it must never touch the store after
@@ -1715,6 +1737,10 @@ class CoreWorker:
 
     def kv_keys(self, prefix: str = "") -> List[str]:
         return self.io.run(self.gcs.call("kv_keys", {"prefix": prefix}))
+
+    def kv_len(self, key: str) -> Optional[int]:
+        """Value size in bytes without fetching the payload."""
+        return self.io.run(self.gcs.call("kv_len", {"key": key}))
 
 
 class ObjectRefInfo:

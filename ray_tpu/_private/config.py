@@ -99,7 +99,9 @@ class Config:
     #: children starve each other through interpreter startup (imports
     #: are CPU-bound), tripping registration timeouts (reference:
     #: worker_pool maximum_startup_concurrency, worker_pool.cc:224).
-    max_concurrent_worker_starts: int = 8
+    #: 0 = auto: max(2, 2 x cores) — interpreter boot is CPU-bound, so
+    #: wider than the core count only inflates per-spawn latency.
+    max_concurrent_worker_starts: int = 0
     #: Poll interval for blocking get() in the driver.
     get_poll_interval_s: float = 0.005
     # How often get()/wait() re-issue a pull for a borrowed object (the

@@ -49,6 +49,7 @@ class ActorInfo:
         "actor_id", "name", "state", "node_id", "worker_id", "address",
         "spec", "resources", "max_restarts", "num_restarts", "death_cause",
         "lifetime_detached", "placement_group_id", "bundle_index",
+        "creation_attempts",
     )
 
     def __init__(self, actor_id: bytes, spec: dict, name: str,
@@ -67,6 +68,7 @@ class ActorInfo:
         self.num_restarts = 0
         self.death_cause = ""
         self.lifetime_detached = lifetime_detached
+        self.creation_attempts = 0
         self.placement_group_id = placement_group_id
         self.bundle_index = bundle_index
 
@@ -88,7 +90,7 @@ class NodeInfo:
     __slots__ = ("node_id", "conn", "resources_total", "resources_available",
                  "address", "object_store_name", "last_heartbeat", "alive",
                  "labels", "pending_demand", "num_busy_workers",
-                 "resource_version")
+                 "resource_version", "probe_renewals")
 
     def __init__(self, node_id: bytes, conn: protocol.Connection,
                  resources: Dict[str, float], address: str,
@@ -101,6 +103,10 @@ class NodeInfo:
         self.object_store_name = object_store_name
         self.last_heartbeat = time.monotonic()
         self.alive = True
+        #: consecutive liveness windows renewed by ping probe alone —
+        #: bounded so a wedged heartbeat task can't stay "alive" with
+        #: permanently stale resource reports
+        self.probe_renewals = 0
         self.labels = labels
         #: queued lease shapes from the node's last heartbeat (autoscaler
         #: demand signal).
@@ -131,6 +137,118 @@ class PlacementGroupInfo:
         return {"pg_id": self.pg_id, "name": self.name, "bundles": self.bundles,
                 "strategy": self.strategy, "state": self.state,
                 "bundle_nodes": self.bundle_nodes}
+
+
+class _WAL:
+    """Append-only write-ahead log between snapshots (reference: the
+    continuous persistence a Redis-backed GCS store gives,
+    store_client/redis_store_client.h:28 — collapsed to a local
+    length-prefixed record file).  Records are flushed per append, so a
+    killed GCS process loses nothing it acknowledged; a torn tail
+    record (killed mid-write) is detected by its length prefix and
+    dropped on replay."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def append(self, rec: tuple) -> None:
+        import pickle
+        import struct
+
+        if self._f is None:
+            self._f = open(self.path, "ab")
+        data = pickle.dumps(rec)
+        self._f.write(struct.pack("<I", len(data)) + data)
+        self._f.flush()
+
+    def replay(self):
+        import os
+        import pickle
+        import struct
+
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            while True:
+                header = f.read(4)
+                if len(header) < 4:
+                    return
+                (n,) = struct.unpack("<I", header)
+                data = f.read(n)
+                if len(data) < n:
+                    return  # torn tail record: drop
+                try:
+                    yield pickle.loads(data)
+                except Exception:  # noqa: BLE001 - corrupt tail
+                    return
+
+    def reset(self) -> None:
+        """Truncate after a successful snapshot (its contents are now
+        folded into the snapshot)."""
+        import os
+
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+    # -- snapshot coordination (crash-safe in every window) -------------
+    # rotate(): called on the loop at state-capture time — records so
+    # far move to <path>.old, new appends land in a fresh file.
+    # commit_rotation(): snapshot write succeeded; the .old records are
+    # folded in, delete them.  abort_rotation(): write failed; splice
+    # the fresh records back onto .old so nothing is lost.
+    # Replay order (.old then current) makes every crash window safe;
+    # records are idempotent so a crash between snapshot-rename and
+    # commit_rotation only causes a harmless double-apply.
+
+    def rotate(self) -> None:
+        import os
+
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        if os.path.exists(self.path):
+            os.replace(self.path, self.path + ".old")
+
+    def commit_rotation(self) -> None:
+        import os
+
+        try:
+            os.unlink(self.path + ".old")
+        except OSError:
+            pass
+
+    def abort_rotation(self) -> None:
+        import os
+
+        old = self.path + ".old"
+        if not os.path.exists(old):
+            return
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        with open(old, "ab") as dst:
+            try:
+                with open(self.path, "rb") as src:
+                    dst.write(src.read())
+            except OSError:
+                pass
+        os.replace(old, self.path)
+
+    def replay_all(self):
+        """Yield .old records (pre-rotation, possibly mid-snapshot
+        crash) then current ones."""
+        import os
+
+        old = self.path + ".old"
+        if os.path.exists(old):
+            yield from _WAL(old).replay()
+        yield from self.replay()
 
 
 class GcsServer:
@@ -167,7 +285,18 @@ class GcsServer:
         self._actor_scheduling: Set[bytes] = set()
         #: snapshot throttle: mutators set this; the monitor loop writes.
         self._dirty = False
+        #: continuous persistence: every recoverable mutation appends a
+        #: WAL record immediately; snapshots fold + truncate it.
+        self._wal = _WAL(persist_path + ".wal") if persist_path else None
         self._closing = False
+
+    def _log(self, *rec) -> None:
+        if self._wal is not None:
+            try:
+                self._wal.append(rec)
+            except Exception:  # noqa: BLE001 - disk hiccup: snapshot
+                # remains the fallback; don't fail the control call
+                logger.warning("GCS WAL append failed", exc_info=True)
 
     async def start_unix(self, path: str):
         self._restore()
@@ -192,12 +321,16 @@ class GcsServer:
         GCS failover, test_gcs_fault_tolerance.py)."""
         if not self.persist_path:
             return
-        import os
-        import pickle
+        self._write_snapshot(self._capture_state())
 
+    def _capture_state(self) -> dict:
+        """Plain-dict copy of recoverable state; runs ON the event loop
+        so it is a consistent point-in-time cut."""
         actors = {}
         for aid, a in self.actors.items():
-            if not a.lifetime_detached:
+            if not a.lifetime_detached or a.state == DEAD:
+                # killed/errored detached actors must STAY dead across
+                # restarts (the WAL's detached_actor_dead analog)
                 continue
             actors[aid] = {
                 "spec": a.spec, "name": a.name,
@@ -205,7 +338,7 @@ class GcsServer:
                 "placement_group_id": a.placement_group_id,
                 "bundle_index": a.bundle_index,
             }
-        state = {
+        return {
             "kv": dict(self.kv),
             "job_counter": self._job_counter,
             "detached_actors": actors,
@@ -214,7 +347,6 @@ class GcsServer:
                       "strategy": pg.strategy}
                 for pid, pg in self.placement_groups.items()},
         }
-        self._write_snapshot(state)
 
     def _write_snapshot(self, state: dict) -> None:
         import os
@@ -232,6 +364,9 @@ class GcsServer:
         import pickle
 
         if not os.path.exists(self.persist_path):
+            # crashed before the first snapshot: the WAL alone may still
+            # carry acknowledged mutations
+            self._replay_wal()
             return
         with open(self.persist_path, "rb") as f:
             state = pickle.load(f)
@@ -259,6 +394,54 @@ class GcsServer:
                     "actors, %d placement groups", self.persist_path,
                     len(self.kv), len(state.get("detached_actors", {})),
                     len(state.get("placement_groups", {})))
+        self._replay_wal()
+
+    def _replay_wal(self) -> None:
+        """Fold WAL records newer than the snapshot back in (mutations
+        acknowledged between the last snapshot and the crash)."""
+        if self._wal is None:
+            return
+        n = 0
+        for rec in self._wal.replay_all():
+            n += 1
+            kind = rec[0]
+            if kind == "kv_put":
+                self.kv[rec[1]] = rec[2]
+            elif kind == "kv_del":
+                self.kv.pop(rec[1], None)
+            elif kind == "kv_del_prefix":
+                for k in [k for k in self.kv if k.startswith(rec[1])]:
+                    del self.kv[k]
+            elif kind == "job_counter":
+                self._job_counter = max(self._job_counter, rec[1])
+            elif kind == "detached_actor":
+                aid, a = rec[1], rec[2]
+                info = ActorInfo(aid, a["spec"], a["name"],
+                                 a["resources"], a["max_restarts"], True,
+                                 a["placement_group_id"],
+                                 a["bundle_index"])
+                info.state = RESTARTING
+                self.actors[aid] = info
+                if a["name"]:
+                    self.named_actors[a["name"]] = aid
+            elif kind == "detached_actor_dead":
+                info = self.actors.pop(rec[1], None)
+                if info is not None and info.name:
+                    self.named_actors.pop(info.name, None)
+            elif kind == "pg":
+                pid, p = rec[1], rec[2]
+                pg = PlacementGroupInfo(pid, p["name"], p["bundles"],
+                                        p["strategy"])
+                pg.state = "PENDING"
+                self.placement_groups[pid] = pg
+                if p["name"]:
+                    self.named_pgs[p["name"]] = pid
+            elif kind == "pg_removed":
+                pg = self.placement_groups.pop(rec[1], None)
+                if pg is not None and pg.name:
+                    self.named_pgs.pop(pg.name, None)
+        if n:
+            logger.info("GCS WAL replay: %d records", n)
 
     async def close(self):
         self._closing = True
@@ -294,6 +477,7 @@ class GcsServer:
             return False
         self.kv[key] = payload["value"]
         self._dirty = True
+        self._log("kv_put", key, payload["value"])
         return True
 
     async def rpc_kv_get(self, conn, payload):
@@ -304,10 +488,17 @@ class GcsServer:
 
     async def rpc_kv_del(self, conn, payload):
         self._dirty = True
+        self._log("kv_del", payload["key"])
         return self.kv.pop(payload["key"], None) is not None
 
     async def rpc_kv_exists(self, conn, payload):
         return payload["key"] in self.kv
+
+    async def rpc_kv_len(self, conn, payload):
+        """Value size without the payload (kv:// filesystem size probes
+        — a spill stats poll must not move object bytes)."""
+        v = self.kv.get(payload["key"])
+        return None if v is None else len(v)
 
     async def rpc_kv_incr(self, conn, payload):
         """Atomic counter (single-threaded event loop = atomicity).  Used
@@ -316,6 +507,8 @@ class GcsServer:
         cur = int(self.kv.get(key, b"0"))
         cur += int(payload.get("by", 1))
         self.kv[key] = str(cur).encode()
+        self._dirty = True
+        self._log("kv_put", key, self.kv[key])
         return cur
 
     async def rpc_kv_del_prefix(self, conn, payload):
@@ -323,6 +516,9 @@ class GcsServer:
         doomed = [k for k in self.kv if k.startswith(prefix)]
         for k in doomed:
             del self.kv[k]
+        if doomed:
+            self._dirty = True
+            self._log("kv_del_prefix", prefix)
         return len(doomed)
 
     async def rpc_kv_keys(self, conn, payload):
@@ -334,6 +530,7 @@ class GcsServer:
     async def rpc_job_register(self, conn, payload):
         self._job_counter += 1
         self._dirty = True
+        self._log("job_counter", self._job_counter)
         job_id = JobID.from_int(self._job_counter)
         return {"job_id": job_id.binary()}
 
@@ -376,6 +573,7 @@ class GcsServer:
         if info is None:
             return {"reregister": True}
         info.last_heartbeat = time.monotonic()
+        info.probe_renewals = 0  # a REAL heartbeat resets the probe cap
         self._apply_resource_report(info, payload)
         info.pending_demand = payload.get("pending_demand", [])
         info.num_busy_workers = payload.get("num_busy_workers", 0)
@@ -437,15 +635,22 @@ class GcsServer:
             now = time.monotonic()
             if self._dirty and self.persist_path:
                 self._dirty = False
+                # Capture ON the loop (consistent cut) and rotate the
+                # WAL at the same instant; the slow pickle+write runs on
+                # an executor thread.  Success folds the rotated records
+                # into the snapshot (delete); failure splices them back.
+                state = self._capture_state()
+                if self._wal is not None:
+                    self._wal.rotate()
                 try:
-                    # Pickle+write can be large (KV holds runtime-env
-                    # packages): keep the event loop responsive by doing
-                    # the IO on an executor thread.  State is captured
-                    # into plain dicts on the loop first.
                     await asyncio.get_running_loop().run_in_executor(
-                        None, self.snapshot)
+                        None, lambda: self._write_snapshot(state))
+                    if self._wal is not None:
+                        self._wal.commit_rotation()
                 except Exception:  # noqa: BLE001 - disk hiccup; retry next tick
                     self._dirty = True
+                    if self._wal is not None:
+                        self._wal.abort_rotation()
             stale = [(node_id, info)
                      for node_id, info in list(self.nodes.items())
                      if info.alive and now - info.last_heartbeat
@@ -467,6 +672,27 @@ class GcsServer:
                     try:
                         await asyncio.wait_for(info.conn.call("ping", {}),
                                                timeout=10.0)
+                        # ping answers prove the loop is alive, but they
+                        # must not substitute for real heartbeats forever
+                        # — a permanently wedged heartbeat task means the
+                        # node's resource/load reports are stale and the
+                        # scheduler is flying blind (bounded here)
+                        info.probe_renewals = getattr(
+                            info, "probe_renewals", 0) + 1
+                        if info.probe_renewals >= 10:
+                            logger.warning(
+                                "node %s: %d consecutive liveness "
+                                "windows renewed by ping probe alone "
+                                "(heartbeat task wedged?) — declaring "
+                                "dead", NodeID(node_id),
+                                info.probe_renewals)
+                            await self._handle_node_death(node_id)
+                            return
+                        if info.probe_renewals >= 3:
+                            logger.warning(
+                                "node %s heartbeats stalled for %d "
+                                "windows; ping probe keeping it alive",
+                                NodeID(node_id), info.probe_renewals)
                         info.last_heartbeat = time.monotonic()
                     except Exception:  # noqa: BLE001 - dead for real
                         await self._handle_node_death(node_id)
@@ -644,9 +870,46 @@ class GcsServer:
             bundle_index=spec.get("bundle_index", -1),
         )
         self.actors[actor_id] = info
+        # Fail-fast feasibility check stays SYNCHRONOUS (typo-sized
+        # shapes must error at creation), but scheduling + worker spawn
+        # run in the background: actor creation returns a handle
+        # immediately and method calls park on actor_get_info
+        # wait_ready (reference semantics — GcsActorManager schedules
+        # async; ray.remote never blocks on the ctor).
+        # dead nodes count as feasible: a node of that shape existed and
+        # may be replaced (matches _schedule_actor's queue-vs-fail rule)
+        if not info.placement_group_id and self.nodes and not any(
+                all(n.resources_total.get(k, 0.0) >= v
+                    for k, v in info.resources.items())
+                for n in self.nodes.values()):
+            info.state = DEAD
+            info.death_cause = (
+                f"actor shape {info.resources} exceeds every registered "
+                f"node (cluster: "
+                f"{[n.resources_total for n in self.nodes.values()]})")
+            self._actor_state_changed(info)
+            raise ValueError(info.death_cause)
         if info.lifetime_detached:
+            # durably record AFTER the feasibility gate: an errored
+            # registration must not resurrect on restart (or squat its
+            # name forever)
             self._dirty = True
-        await self._schedule_actor(info)
+            self._log("detached_actor", actor_id, {
+                "spec": info.spec, "name": info.name,
+                "resources": info.resources,
+                "max_restarts": info.max_restarts,
+                "placement_group_id": info.placement_group_id,
+                "bundle_index": info.bundle_index,
+            })
+        self._actor_scheduling.add(actor_id)
+
+        async def sched(info=info):
+            try:
+                await self._schedule_actor(info)
+            finally:
+                self._actor_scheduling.discard(info.actor_id)
+
+        asyncio.get_running_loop().create_task(sched())
         return True
 
     async def _schedule_actor(self, info: ActorInfo):
@@ -702,12 +965,55 @@ class GcsServer:
             reply = await node.conn.call(
                 "create_actor",
                 {"actor_id": info.actor_id, "spec": info.spec})
+            if info.state == DEAD:
+                # killed while creation was in flight (creation is
+                # async now): the fresh worker must die, not serve
+                try:
+                    await node.conn.call(
+                        "kill_worker",
+                        {"worker_id": reply["worker_id"],
+                         "actor_id": info.actor_id})
+                except Exception:  # noqa: BLE001 - node mid-death
+                    pass
+                return
             info.worker_id = reply["worker_id"]
             info.address = reply["address"]
             info.state = ALIVE
-        except Exception as e:  # noqa: BLE001 - scheduling failure -> actor death
+        except protocol.RpcError as e:
+            # The node answered with a failure.  Worker-spawn hiccups
+            # (start timeout under load, transient resource contention)
+            # are RETRIED on a fresh scheduling pass instead of killing
+            # the actor (reference: GcsActorScheduler reschedules on
+            # lease/creation failure); a ctor raise is not retriable —
+            # re-running user __init__ would duplicate side effects.
+            info.creation_attempts += 1
+            retriable = "actor constructor failed" not in str(e)
+            if retriable and info.creation_attempts <= 5:
+                logger.warning(
+                    "actor %s creation attempt %d failed (%s); requeued",
+                    ActorID(info.actor_id), info.creation_attempts, e)
+                info.node_id = b""
+                info.address = ""
+                return  # monitor loop reschedules PENDING actors
             info.state = DEAD
             info.death_cause = f"creation failed: {e}"
+        except Exception as e:  # noqa: BLE001 - transport-level failure
+            # AMBIGUOUS window: the node may have received the dispatch
+            # and be running the user ctor.  Requeue only when the node
+            # is confirmed dead/gone (its workers died with it, so a
+            # re-run cannot double-execute); a healthy node whose reply
+            # was lost is fail-stop, like the pre-async path.
+            node_info = self.nodes.get(info.node_id)
+            node_gone = node_info is None or not node_info.alive
+            info.creation_attempts += 1
+            if node_gone and info.creation_attempts <= 5 \
+                    and info.state != DEAD:
+                info.node_id = b""
+                info.address = ""
+                return
+            if info.state != DEAD:
+                info.state = DEAD
+                info.death_cause = f"creation failed: {e}"
         self._actor_state_changed(info)
 
     def _actor_state_changed(self, info: ActorInfo):
@@ -797,6 +1103,9 @@ class GcsServer:
             info.death_cause = "killed via kill()"
             if info.name:
                 self.named_actors.pop(info.name, None)
+            if info.lifetime_detached:
+                self._dirty = True
+                self._log("detached_actor_dead", actor_id)
             self._actor_state_changed(info)
         return True
 
@@ -811,6 +1120,11 @@ class GcsServer:
         pg = PlacementGroupInfo(pg_id, name, payload["bundles"],
                                 payload.get("strategy", "PACK"))
         self.placement_groups[pg_id] = pg
+        self._dirty = True
+        self._log("pg", pg_id, {"name": name,
+                                "bundles": payload["bundles"],
+                                "strategy": payload.get("strategy",
+                                                        "PACK")})
         if name:
             self.named_pgs[name] = pg_id
         async with self._pg_lock:
@@ -908,6 +1222,8 @@ class GcsServer:
         pg = self.placement_groups.pop(payload["pg_id"], None)
         if pg is None:
             return False
+        self._dirty = True
+        self._log("pg_removed", payload["pg_id"])
         if pg.name:
             self.named_pgs.pop(pg.name, None)
         for i, node_id in enumerate(pg.bundle_nodes):

@@ -161,6 +161,12 @@ class NodeManager:
         self.node_id = node_id
         self.session_dir = session_dir
         self.config = config
+        # per-node affinity resource (reference: the automatic
+        # ``node:<ip>`` resource, scheduling_resources.cc) — lets a
+        # caller pin an actor to THIS node (serve's per-node proxy
+        # fleet, log/metrics agents)
+        resources = dict(resources)
+        resources.setdefault(f"node:{node_id.hex()}", 1.0)
         self.resources = ResourceSet(resources)
         self.object_store_name = object_store_name
         self.gcs_address = gcs_address
@@ -186,8 +192,9 @@ class NodeManager:
         #: boot are CPU-bound; an unbounded gang start starves every
         #: child through registration — reference: worker_pool.cc:224
         #: maximum_startup_concurrency)
-        self._spawn_sem = asyncio.Semaphore(
-            max(1, config.max_concurrent_worker_starts))
+        spawn_width = config.max_concurrent_worker_starts or max(
+            2, 2 * (os.cpu_count() or 1))
+        self._spawn_sem = asyncio.Semaphore(spawn_width)
         self._lease_queue: List[LeaseRequest] = []
         self._lease_counter = 0
         #: monotonic version for resource reports (syncer ordering)

@@ -161,10 +161,15 @@ class WorkerServer:
         await asyncio.Event().wait()  # serve forever
 
     async def _flush_events_loop(self):
+        idle_sleep = 1.0
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(idle_sleep)
             if not self._events:
+                # back off while idle: hundreds of workers' 1 Hz ticks
+                # add up on small hosts (see _decref_pump)
+                idle_sleep = min(idle_sleep * 2, 8.0)
                 continue
+            idle_sleep = 1.0
             batch, self._events = self._events, []
             try:
                 await asyncio.wrap_future(

@@ -147,5 +147,9 @@ class ActorClass:
             max_concurrency=opts.get("max_concurrency", 0),
             pg=_pg_option(opts),
         )
-        cw.wait_actor_ready(actor_id)
+        # Creation is ASYNC (reference semantics): the handle returns
+        # immediately; worker spawn + ctor run in the background and the
+        # first method call parks until the actor is ALIVE (or raises
+        # ActorDiedError if the ctor failed).  Infeasible shapes still
+        # fail fast — the GCS checks feasibility inside actor_register.
         return ActorHandle(actor_id)

@@ -69,8 +69,14 @@ def test_actor_ctor_failure(ray_start_regular):
         def __init__(self):
             raise ValueError("ctor boom")
 
+        def ping(self):
+            return 1
+
+    # creation is async (reference semantics): the handle returns
+    # immediately and the ctor error surfaces on the first method call
+    h = FailsInit.remote()
     with pytest.raises(exceptions.RayActorError):
-        FailsInit.remote()
+        ray_tpu.get(h.ping.remote(), timeout=60)
 
 
 def test_named_actor(ray_start_regular):
