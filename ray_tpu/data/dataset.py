@@ -302,26 +302,50 @@ class Dataset:
             yield block_util.format_batch(carry, batch_format)
 
     def _iter_tables(self) -> Iterator:
-        """Streaming table iterator: pending task-compute stages execute
-        through the bounded-in-flight StreamingExecutor — batches flow
-        while later blocks still compute, peak memory = the in-flight
-        window, not the dataset (reference: streaming_executor.py).  A
-        FULL consumption leaves the dataset materialized (cached), same
-        as materialize(); actor-compute stages keep the pooled path."""
-        if not self._stages or self._compute is not None:
+        """Streaming table iterator: pending stages execute through the
+        bounded-in-flight, bytes-backpressured StreamingExecutor —
+        batches flow while later blocks still compute, peak memory = the
+        in-flight window, not the dataset (reference:
+        streaming_executor.py).  Actor-pool compute streams through the
+        SAME window over a pool of stage actors (reference:
+        ActorPoolMapOperator) instead of a materialize barrier.  A FULL
+        consumption leaves the dataset materialized (cached), same as
+        materialize()."""
+        if not self._stages:
             yield from self._tables()
             return
         from ray_tpu.data.streaming import ExecStats, StreamingExecutor
 
-        stats = ExecStats(f"stream[{len(self._stages)} fused stages]")
+        pool = None
+        stages_ser = None
+        if self._compute is not None:
+            import cloudpickle
+
+            strat = self._compute
+            cls = ray_tpu.remote(num_cpus=strat.num_cpus,
+                                 num_tpus=strat.num_tpus)(_StageActor)
+            pool = [cls.remote() for _ in builtins.range(strat.size)]
+            stages_ser = cloudpickle.dumps(self._stages)
+        label = ("actor-pool" if pool is not None else "stream")
+        stats = ExecStats(f"{label}[{len(self._stages)} fused stages]")
         out_refs = []
-        for ref in StreamingExecutor().execute(self._block_refs,
-                                               self._stages, stats):
-            out_refs.append(ref)
-            yield ray_tpu.get([ref], timeout=600)[0]
+        try:
+            for ref in StreamingExecutor().execute(
+                    self._block_refs, self._stages, stats,
+                    pool=pool, stages_ser=stages_ser):
+                out_refs.append(ref)
+                yield ray_tpu.get([ref], timeout=600)[0]
+        finally:
+            if pool is not None:
+                for a in pool:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
         self._stats.append(stats)
         self._block_refs = out_refs  # fully consumed: cache in place
         self._stages = []
+        self._compute = None
 
     def stats(self) -> str:
         """Execution summaries recorded on this dataset's lineage

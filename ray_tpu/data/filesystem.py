@@ -210,7 +210,12 @@ class KVFileSystem(FileSystem):
         self._cw().kv_del(self._PREFIX + path)
 
     def exists(self, path: str) -> bool:
-        return self._cw().kv_get(self._PREFIX + path) is not None
+        return self._cw().kv_len(self._PREFIX + path) is not None
+
+    def size(self, path: str) -> Optional[int]:
+        # metadata-only: a spill stats poll must not move payloads
+        # through the control plane
+        return self._cw().kv_len(self._PREFIX + path)
 
 
 class ArrowFileSystem(FileSystem):
