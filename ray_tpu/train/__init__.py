@@ -15,6 +15,8 @@ from ray_tpu.train.backend_executor import (BackendExecutor,
                                             TrainingWorkerError)
 from ray_tpu.train.base_trainer import BaseTrainer
 from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
+from ray_tpu.train.gbdt_trainer import (GBDTModel, GBDTTrainer,
+                                        LightGBMTrainer, XGBoostTrainer)
 from ray_tpu.train.jax_backend import JaxConfig
 from ray_tpu.train.jax_trainer import JaxTrainer, jax_utils
 from ray_tpu.train.torch_backend import (TorchConfig, TorchTrainer,
@@ -35,4 +37,5 @@ __all__ = [
     "BackendExecutor", "TrainingWorkerError", "BaseTrainer",
     "DataParallelTrainer", "JaxConfig", "JaxTrainer", "jax_utils",
     "TorchConfig", "TorchTrainer", "prepare_model", "prepare_data_loader",
+    "GBDTTrainer", "GBDTModel", "XGBoostTrainer", "LightGBMTrainer",
 ]

@@ -1,0 +1,52 @@
+"""GBDT training at scale on CPU actor gangs (reference anchors: the
+XGBoost train/predict rows of BASELINE.md and
+train/gbdt_trainer.py:70).  Generates a synthetic wide regression
+matrix, trains the native distributed histogram GBDT, and gates on
+fit quality + wall time."""
+import json
+import os
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.train import GBDTModel, GBDTTrainer
+
+fast = bool(os.environ.get("RELEASE_FAST"))
+N_ROWS = 200_000 if fast else 2_000_000
+N_FEAT = 20
+
+ray_tpu.init(num_cpus=4, object_store_memory=1024 * 1024 * 1024)
+rng = np.random.RandomState(0)
+X = rng.uniform(-1, 1, size=(N_ROWS, N_FEAT)).astype(np.float64)
+y = (np.where(X[:, 0] > 0.2, 2.0, -2.0) + X[:, 1] * X[:, 2]
+     + 0.1 * rng.randn(N_ROWS))
+
+t0 = time.perf_counter()
+result = GBDTTrainer(
+    params={"objective": "reg:squarederror", "max_depth": 6,
+            "eta": 0.3},
+    datasets={"train": (X, y)},
+    num_boost_round=10 if fast else 30,
+    num_workers=3,
+).fit()
+train_s = time.perf_counter() - t0
+
+model = GBDTModel.from_checkpoint(result.checkpoint)
+t0 = time.perf_counter()
+pred = model.predict(X)
+predict_s = time.perf_counter() - t0
+mse = float(np.mean((pred - y) ** 2))
+var = float(np.var(y))
+
+print(json.dumps({
+    "rows": N_ROWS, "features": N_FEAT,
+    "train_s": round(train_s, 1),
+    "predict_rows_per_s": round(N_ROWS / predict_s, 1),
+    "train_mse": round(mse, 4), "label_variance": round(var, 4),
+    "r2": round(1 - mse / var, 4),
+}), flush=True)
+try:
+    ray_tpu.shutdown()
+except BaseException:
+    pass
