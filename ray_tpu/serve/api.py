@@ -128,8 +128,14 @@ def run(target: Deployment, *, route_prefix: Optional[str] = None,
     declarative-config path, serve/schema.py): per-deployment option
     overlays applied to EVERY deployment in the graph by name."""
     controller = _get_or_create_controller()
-    prefix = route_prefix or target.route_prefix or \
-        (f"/{target.name}" if http else None)
+    # config-over-code precedence: a declarative route_prefix override
+    # on the root deployment wins over the code-level default
+    root_ov = (_overrides or {}).get(target.name) or {}
+    # route_prefix always defaults to /<name> (reference semantics) so
+    # a proxy started later — e.g. the per-node fleet — can route to
+    # deployments created before it
+    prefix = route_prefix or root_ov.get("route_prefix") or \
+        target.route_prefix or f"/{target.name}"
     deployed: set = set()
     _deploy_one(target, deployed, controller, route_prefix=prefix,
                 overrides=_overrides)
@@ -148,19 +154,44 @@ def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name, _get_or_create_controller())
 
 
-def start_http_proxy(port: int = 8000, host: str = "127.0.0.1") -> str:
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1",
+                     per_node: bool = False) -> str:
+    """Start the HTTP ingress.  ``per_node=True`` starts one proxy actor
+    on EVERY alive node, pinned via each node's affinity resource
+    (reference: serve/_private/http_state.py HTTPProxyStateManager —
+    one proxy per node, node:<ip> affinity), so ingress scales
+    horizontally with the cluster and requests enter on the node they
+    hit.  Returns the local (first) proxy's address."""
     import ray_tpu
     from ray_tpu.serve.http_proxy import HTTPProxyActor
 
     controller = _get_or_create_controller()
-    try:
-        proxy = ray_tpu.get_actor(_PROXY_NAME)
-    except Exception:  # noqa: BLE001
-        proxy = ray_tpu.remote(num_cpus=0.1, lifetime="detached",
-                               name=_PROXY_NAME)(HTTPProxyActor).remote(
-            controller, host, port)
-    ray_tpu.get(proxy.ping.remote(), timeout=60)
-    return ray_tpu.get(proxy.address.remote(), timeout=30)
+    if not per_node:
+        try:
+            proxy = ray_tpu.get_actor(_PROXY_NAME)
+        except Exception:  # noqa: BLE001
+            proxy = ray_tpu.remote(num_cpus=0.1, lifetime="detached",
+                                   name=_PROXY_NAME)(HTTPProxyActor).remote(
+                controller, host, port)
+        ray_tpu.get(proxy.ping.remote(), timeout=60)
+        return ray_tpu.get(proxy.address.remote(), timeout=30)
+
+    proxies = []
+    for i, node in enumerate(n for n in ray_tpu.nodes() if n["Alive"]):
+        node_hex = node["NodeID"]
+        name = f"{_PROXY_NAME}:{node_hex[:12]}"
+        try:
+            proxy = ray_tpu.get_actor(name)
+        except Exception:  # noqa: BLE001
+            proxy = ray_tpu.remote(
+                num_cpus=0.1, lifetime="detached", name=name,
+                resources={f"node:{node_hex}": 0.01},
+            )(HTTPProxyActor).remote(controller, host, port + i)
+        proxies.append(proxy)
+    addrs = ray_tpu.get([p.address.remote() for p in proxies],
+                        timeout=60)
+    ray_tpu.get([p.ping.remote() for p in proxies], timeout=60)
+    return addrs[0]
 
 
 def status() -> dict:

@@ -126,20 +126,41 @@ class ServeController:
         call in a loop, so membership changes propagate push-style with
         no polling interval.  A deleted deployment answers version -1.
         Runs on one of the controller actor's concurrency slots; the
-        slot parks in Condition.wait, costing a thread but no CPU."""
+        slot parks in Condition.wait, costing a thread but no CPU.
+        Slots are BOUNDED: past ~100 parked listeners the call answers
+        immediately with a backoff hint instead of parking, so
+        control-plane calls (deploy/delete/status) never queue behind a
+        wall of long-polls (the remaining concurrency slots stay
+        free)."""
         deadline = time.monotonic() + timeout
         with self._change:
-            while True:
-                dep = self.deployments.get(name)
-                if dep is None:
-                    return {"version": -1, "replicas": []}
-                if dep["version"] != known_version:
-                    return {"version": dep["version"],
-                            "replicas": list(dep["replicas"])}
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return {"version": known_version, "replicas": None}
-                self._change.wait(remaining)
+            dep = self.deployments.get(name)
+            if dep is None:
+                return {"version": -1, "replicas": []}
+            if dep["version"] != known_version:
+                return {"version": dep["version"],
+                        "replicas": list(dep["replicas"])}
+            if getattr(self, "_parked", 0) >= 100:
+                # saturated: answer now with a backoff hint rather than
+                # consuming one of the few remaining slots
+                return {"version": known_version, "replicas": None,
+                        "backoff": True}
+            self._parked = getattr(self, "_parked", 0) + 1
+            try:
+                while True:
+                    dep = self.deployments.get(name)
+                    if dep is None:
+                        return {"version": -1, "replicas": []}
+                    if dep["version"] != known_version:
+                        return {"version": dep["version"],
+                                "replicas": list(dep["replicas"])}
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return {"version": known_version,
+                                "replicas": None}
+                    self._change.wait(remaining)
+            finally:
+                self._parked -= 1
 
     def get_routing_table(self) -> Dict[str, Any]:
         with self._lock:

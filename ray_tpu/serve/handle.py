@@ -43,7 +43,12 @@ class _SharedListener:
         import weakref
 
         with self._lock:
-            self._handles.append(weakref.ref(handle))
+            # dedupe: a handle re-registers on every request while the
+            # listener is unhealthy — without this the list grows
+            # unboundedly during a controller outage and each update
+            # then fans out once per duplicate
+            if not any(ref() is handle for ref in self._handles):
+                self._handles.append(weakref.ref(handle))
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True,
@@ -99,6 +104,9 @@ class _SharedListener:
                 version = out["version"]
                 for h in self._live_handles():
                     h._apply_membership(list(out["replicas"]), version)
+            elif out.get("backoff"):
+                # controller long-poll slots saturated: don't hot-loop
+                time.sleep(0.5)
 
     def healthy(self) -> bool:
         return (self._thread is not None and self._thread.is_alive()

@@ -50,6 +50,14 @@ class DeploymentSchema:
                 or out.num_replicas < 0):
             raise ValueError(f"{out.name}: num_replicas must be an int "
                              f">= 0, got {out.num_replicas!r}")
+        if out.num_replicas == 0 and not (
+                isinstance(out.autoscaling_config, dict)
+                and out.autoscaling_config.get("min_replicas") == 0):
+            # zero replicas with no autoscaler can never serve a request
+            raise ValueError(
+                f"{out.name}: num_replicas=0 requires an "
+                "autoscaling_config with min_replicas=0 (scale-to-zero); "
+                "a fixed zero-replica deployment can never serve")
         if out.max_concurrent_queries is not None and (
                 not isinstance(out.max_concurrent_queries, int)
                 or out.max_concurrent_queries < 1):

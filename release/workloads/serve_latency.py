@@ -1,7 +1,12 @@
-"""Serve latency probe (reference: doc/source/serve/performance.md)."""
+"""Serve latency probe (reference: doc/source/serve/performance.md:47 —
+published 8.84 ms cluster P50 through HTTP).  Measures BOTH paths:
+- handle: in-process DeploymentHandle call (router + replica RPC)
+- http: full ingress through the aiohttp proxy actor
+"""
 import json
 import os
 import time
+import urllib.request
 
 import numpy as np
 
@@ -14,7 +19,7 @@ ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
 def echo(x):
     return x
 
-h = serve.run(echo)
+h = serve.run(echo, http=True, http_port=8123)
 n = 50 if os.environ.get("RELEASE_FAST") else 300
 lat = []
 for i in range(n):
@@ -22,8 +27,23 @@ for i in range(n):
     assert h.call(i, timeout=60) == i
     lat.append((time.perf_counter() - t0) * 1e3)
 lat = np.asarray(lat[5:])  # drop warmup
-print(json.dumps({"p50_ms": float(np.percentile(lat, 50)),
-                  "p99_ms": float(np.percentile(lat, 99))}), flush=True)
+
+http_lat = []
+for i in range(n):
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(
+            "http://127.0.0.1:8123/echo", data=json.dumps(i).encode(),
+            timeout=60) as r:
+        assert json.loads(r.read())["result"] == i
+    http_lat.append((time.perf_counter() - t0) * 1e3)
+http_lat = np.asarray(http_lat[5:])
+
+print(json.dumps({
+    "p50_ms": float(np.percentile(lat, 50)),
+    "p99_ms": float(np.percentile(lat, 99)),
+    "http_p50_ms": float(np.percentile(http_lat, 50)),
+    "http_p99_ms": float(np.percentile(http_lat, 99)),
+}), flush=True)
 try:
     serve.shutdown()
     ray_tpu.shutdown()
