@@ -11,8 +11,8 @@ import ray_tpu
 class ActorPool:
     def __init__(self, actors: List):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
+        self._pending_owner = {}
+        self._result_slots = {}
         self._next_task_index = 0
         self._next_return_index = 0
 
@@ -27,42 +27,42 @@ class ActorPool:
                     "wait timeout; all actors still have pending tasks")
         actor = self._idle.pop()
         ref = fn(actor, value)
-        self._future_to_actor[ref] = actor
-        self._index_to_future[self._next_task_index] = ref
+        self._pending_owner[ref] = actor
+        self._result_slots[self._next_task_index] = ref
         self._next_task_index += 1
 
     def _wait_one(self) -> None:
-        refs = list(self._future_to_actor)
+        refs = list(self._pending_owner)
         ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=300)
         for ref in ready:
-            self._idle.append(self._future_to_actor[ref])
-            del self._future_to_actor[ref]
+            self._idle.append(self._pending_owner[ref])
+            del self._pending_owner[ref]
 
     def get_next(self, timeout: float = 300.0):
         """Next result in submission order."""
         idx = self._next_return_index
-        if idx not in self._index_to_future:
+        if idx not in self._result_slots:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(idx)
+        ref = self._result_slots.pop(idx)
         self._next_return_index += 1
         value = ray_tpu.get(ref, timeout=timeout)
-        actor = self._future_to_actor.pop(ref, None)
+        actor = self._pending_owner.pop(ref, None)
         if actor is not None:
             self._idle.append(actor)
         return value
 
     def get_next_unordered(self, timeout: float = 300.0):
-        refs = [r for r in self._index_to_future.values()
-                if r in self._future_to_actor] or \
-            list(self._index_to_future.values())
+        refs = [r for r in self._result_slots.values()
+                if r in self._pending_owner] or \
+            list(self._result_slots.values())
         if not refs:
             raise StopIteration("no pending results")
         ready, _ = ray_tpu.wait(refs, num_returns=1, timeout=timeout)
         ref = ready[0]
-        for idx, r in list(self._index_to_future.items()):
+        for idx, r in list(self._result_slots.items()):
             if r == ref:
-                del self._index_to_future[idx]
-        actor = self._future_to_actor.pop(ref, None)
+                del self._result_slots[idx]
+        actor = self._pending_owner.pop(ref, None)
         if actor is not None:
             self._idle.append(actor)
         return ray_tpu.get(ref, timeout=timeout)
@@ -82,4 +82,4 @@ class ActorPool:
             yield self.get_next_unordered()
 
     def has_next(self) -> bool:
-        return bool(self._index_to_future)
+        return bool(self._result_slots)
