@@ -19,6 +19,7 @@ facades that post coroutines to the loop — the analog of the reference's
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import hashlib
 import logging
 import threading
@@ -270,6 +271,12 @@ class CoreWorker:
         #: True while _flush_store_deletes is inside store calls on an
         #: executor thread (shutdown waits on it before unmapping).
         self._flushing = False
+        #: threads currently inside shm-store calls; shutdown drains
+        #: this before unmapping the store.  Lock-guarded: '+=' is NOT
+        #: atomic under the GIL, and a lost increment here is exactly
+        #: the unmap-during-read segfault this exists to prevent.
+        self._store_readers = 0
+        self._store_readers_lock = threading.Lock()
         # Workers get the full worker-start window to connect: on a
         # saturated host the head answers registration late, and a
         # worker that gives up at the short RPC timeout wastes the whole
@@ -429,9 +436,12 @@ class CoreWorker:
         if self._own_loop:
             self.io.stop()
         # An in-flight delete pass on the executor thread must leave the
-        # store before we unmap it (it checks _closed per iteration).
+        # store before we unmap it (it checks _closed per iteration), and
+        # so must any thread inside a store read (_read_ready's reader
+        # count) — a background get() racing the unmap is a segfault.
         deadline = time.monotonic() + 2.0
-        while self._flushing and time.monotonic() < deadline:
+        while (self._flushing or self._store_readers) and \
+                time.monotonic() < deadline:
             time.sleep(0.01)
         self.store.close()
 
@@ -852,24 +862,47 @@ class CoreWorker:
     def _read_ready(self, oid: bytes) -> Optional[Tuple[Any, bool]]:
         """Non-blocking read: memory store, then shared store, then the
         node's spill directory (restore-on-get without re-inserting, so a
-        read never triggers further spilling)."""
+        read never triggers further spilling).
+
+        Store access is reader-counted against shutdown(): a background
+        thread (serve's long-poll listener, a user thread in get())
+        reading the shm store while shutdown unmaps it is a segfault in
+        the C client — shutdown waits for readers to drain first."""
         entry = self.memory_store.get(oid)
         if entry is not None and entry.event.is_set() and not entry.in_store:
             return serialization.deserialize(entry.data)
-        buf = self.store.get(ObjectID(oid), timeout_ms=0)
-        if buf is not None:
-            return self._deserialize_store_buffer(buf)
-        data = self.spill.read(oid)
-        if data is not None:
-            return serialization.deserialize(data)
+        with self._store_access():
+            buf = self.store.get(ObjectID(oid), timeout_ms=0)
+            if buf is not None:
+                return self._deserialize_store_buffer(buf)
+            data = self.spill.read(oid)
+            if data is not None:
+                return serialization.deserialize(data)
         return None
+
+    @contextlib.contextmanager
+    def _store_access(self):
+        """Guard around every shm-store call from arbitrary threads:
+        registers the caller so shutdown() waits for it before unmapping
+        (touching the store after munmap is a segfault in the C
+        client), and refuses entry once closed."""
+        with self._store_readers_lock:
+            self._store_readers += 1
+        try:
+            if self._closed:
+                raise exceptions.RayError("client is shut down")
+            yield
+        finally:
+            with self._store_readers_lock:
+                self._store_readers -= 1
 
     def is_ready(self, ref: "ObjectRefInfo") -> bool:
         entry = self.memory_store.get(ref.oid)
         if entry is not None and entry.event.is_set():
             return True
-        return self.store.contains(ObjectID(ref.oid)) or \
-            self.spill.contains(ref.oid)
+        with self._store_access():
+            return self.store.contains(ObjectID(ref.oid)) or \
+                self.spill.contains(ref.oid)
 
     def get(self, refs: Sequence["ObjectRefInfo"],
             timeout: Optional[float] = None) -> List[Any]:
@@ -1040,10 +1073,11 @@ class CoreWorker:
             with self._ms_lock:
                 self.memory_store.pop(ref.oid, None)
             try:
-                self.store.delete(ObjectID(ref.oid))
+                with self._store_access():
+                    self.store.delete(ObjectID(ref.oid))
+                    self.spill.delete(ref.oid)
             except Exception:  # noqa: BLE001
                 pass
-            self.spill.delete(ref.oid)
 
     def _raise_error(self, err: Any):
         if isinstance(err, BaseException):
@@ -1517,13 +1551,23 @@ class CoreWorker:
         # READY is exact.
         pins = self._pin_refs(
             list(spec["args"]) + list(spec["kwargs"].values()), nested)
-        self.io.post(self._unpin_on_actor_ready(actor_id.binary(), pins))
         if pg is not None:
             spec["placement_group_id"] = pg[0]
             spec["bundle_index"] = pg[1]
-        self.io.run(self.gcs.call("actor_register", {
-            "actor_id": actor_id.binary(), "spec": spec, "name": name,
-            "max_restarts": max_restarts, "lifetime": lifetime}))
+        try:
+            self.io.run(self.gcs.call("actor_register", {
+                "actor_id": actor_id.binary(), "spec": spec,
+                "name": name, "max_restarts": max_restarts,
+                "lifetime": lifetime}))
+        except Exception:
+            self._unpin_now(pins)  # actor will never exist
+            raise
+        # The unpin waiter posts only AFTER registration is acked: its
+        # actor_get_info must find the actor and PARK on wait_ready —
+        # posted earlier it can race the register frame, get "no such
+        # actor", and release the ctor-arg pins while the (now async)
+        # creation is still fetching them.
+        self.io.post(self._unpin_on_actor_ready(actor_id.binary(), pins))
         return actor_id.binary()
 
     async def _unpin_on_actor_ready(self, actor_id: bytes,

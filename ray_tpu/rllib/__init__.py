@@ -8,10 +8,13 @@ from ray_tpu.rllib.dqn import DQN, DQNConfig, QPolicy
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
 from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
                                        MultiAgentPPOConfig)
-from ray_tpu.rllib.offline import BC, BCConfig, JsonReader, JsonWriter
+from ray_tpu.rllib.offline import (BC, BCConfig, JsonReader, JsonWriter,
+                                   MARWIL, MARWILConfig)
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
+from ray_tpu.rllib.td3 import (DDPG, DDPGConfig, TD3, TD3Config,
+                              TD3Policy)
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -25,4 +28,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "ReplayBuffer", "PrioritizedReplayBuffer", "JsonReader",
            "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
            "MultiAgentPPO", "MultiAgentPPOConfig", "SAC", "SACConfig",
-           "SACPolicy"]
+           "SACPolicy", "TD3", "TD3Config", "TD3Policy", "DDPG",
+           "DDPGConfig", "MARWIL", "MARWILConfig"]

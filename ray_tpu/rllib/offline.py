@@ -172,3 +172,117 @@ class BC(Algorithm):
     def compute_actions(self, obs: np.ndarray) -> np.ndarray:
         logits = _net_apply(self.params, np.asarray(obs, np.float32))
         return np.asarray(logits).argmax(axis=-1)
+
+
+@dataclasses.dataclass
+class MARWILConfig(BCConfig):
+    #: advantage-weighting temperature; 0 degrades to plain BC
+    beta: float = 1.0
+    vf_coeff: float = 1.0
+
+
+class MARWIL(Algorithm):
+    """Monotonic advantage re-weighted imitation learning (reference:
+    rllib/algorithms/marwil — BC whose per-sample loss is scaled by
+    exp(beta * normalized advantage), plus a learned value baseline).
+    The logged data must carry rewards + dones; monte-carlo returns are
+    computed once at setup, advantages = returns - V(s)."""
+
+    _config_cls = MARWILConfig
+
+    def setup(self, config: MARWILConfig) -> None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        data = JsonReader(config.input_path).read_all()
+        if sb.REWARDS not in data or sb.DONES not in data:
+            raise ValueError(
+                "MARWIL needs rewards+dones in the offline data "
+                "(use BC for action-only logs)")
+        if config.obs_dim is None:
+            config.obs_dim = int(np.prod(data[sb.OBS].shape[1:]))
+        if config.n_actions is None:
+            config.n_actions = int(data[sb.ACTIONS].max()) + 1
+        # monte-carlo returns, episode-cut on dones (logged fragments
+        # are time-ordered within each fragment)
+        rew = np.asarray(data[sb.REWARDS], np.float64)
+        done = np.asarray(data[sb.DONES], bool)
+        ret = np.zeros_like(rew)
+        acc = 0.0
+        for i in range(len(rew) - 1, -1, -1):
+            if done[i]:
+                acc = 0.0
+            acc = rew[i] + config.gamma * acc
+            ret[i] = acc
+        self._obs = jnp.asarray(data[sb.OBS], jnp.float32)
+        self._acts = jnp.asarray(data[sb.ACTIONS], jnp.int32)
+        self._rets = jnp.asarray(ret, jnp.float32)
+        kp, kv = jax.random.split(jax.random.PRNGKey(config.seed))
+        self.params = {
+            "pi": _net_init(kp, (config.obs_dim, *config.hidden,
+                                 config.n_actions)),
+            "vf": _net_init(kv, (config.obs_dim, *config.hidden, 1)),
+        }
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(config.seed + 1)
+        n = len(self._acts)
+        mb = min(config.train_batch_size, n)
+        steps = config.sgd_steps_per_iter
+        beta = config.beta
+        vf_coeff = config.vf_coeff
+
+        def loss_fn(params, obs, acts, rets):
+            logits = _net_apply(params["pi"], obs)
+            v = _net_apply(params["vf"], obs)[..., 0]
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(logp, acts[:, None],
+                                       axis=-1)[:, 0]
+            adv = rets - jax.lax.stop_gradient(v)
+            # normalize the advantage scale (reference: MARWIL's moving
+            # average of squared advantages; batch-local here)
+            adv = adv / (jnp.sqrt(jnp.mean(jnp.square(adv))) + 1e-8)
+            w = jnp.exp(jnp.clip(beta * adv, -10.0, 10.0))
+            pi_loss = jnp.mean(jax.lax.stop_gradient(w) * nll)
+            vf_loss = jnp.mean(jnp.square(v - rets))
+            return pi_loss + vf_coeff * vf_loss, (pi_loss, vf_loss)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def run_iter(params, opt_state, obs, acts, rets, rng):
+            def step(carry, key):
+                params, opt_state = carry
+                idx = jax.random.randint(key, (mb,), 0, n)
+                (loss, (pl, vl)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, obs[idx], acts[idx],
+                                           rets[idx])
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (loss, pl, vl)
+
+            rng, *keys = jax.random.split(rng, steps + 1)
+            (params, opt_state), (losses, pls, vls) = jax.lax.scan(
+                step, (params, opt_state), jnp.stack(keys))
+            return (params, opt_state, losses.mean(), pls.mean(),
+                    vls.mean(), rng)
+
+        self._run_iter = run_iter
+
+    def training_step(self) -> Dict[str, Any]:
+        (self.params, self.opt_state, loss, pl, vl,
+         self._rng) = self._run_iter(self.params, self.opt_state,
+                                     self._obs, self._acts, self._rets,
+                                     self._rng)
+        return {"loss": float(loss), "policy_loss": float(pl),
+                "vf_loss": float(vl),
+                "timesteps_this_iter":
+                    self.config.sgd_steps_per_iter *
+                    self.config.train_batch_size}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits = _net_apply(self.params["pi"],
+                            np.asarray(obs, np.float32))
+        return np.asarray(logits).argmax(axis=-1)

@@ -230,7 +230,8 @@ class ContinuousTransitionWorker:
 
     def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
                  spec: SACSpec, num_envs: int = 1,
-                 rollout_fragment_length: int = 50, seed: int = 0):
+                 rollout_fragment_length: int = 50, seed: int = 0,
+                 policy_cls=None):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -241,7 +242,9 @@ class ContinuousTransitionWorker:
                 "ContinuousTransitionWorker steps one env per actor; "
                 "scale with num_workers instead of num_envs_per_worker")
         self.env = _make_env(env, env_config)
-        self.policy = SACPolicy(spec, seed=seed)
+        # any continuous policy with the SACPolicy surface drives this
+        # worker (TD3Policy reuses it)
+        self.policy = (policy_cls or SACPolicy)(spec, seed=seed)
         self.fragment = rollout_fragment_length
         space = getattr(self.env, "action_space", None)
         self._low = np.asarray(getattr(space, "low", -1.0))
@@ -315,10 +318,22 @@ class SACConfig(AlgorithmConfig):
                        target_entropy=self.target_entropy)
 
 
-class SAC(Algorithm):
-    _config_cls = SACConfig
+class ContinuousOffPolicy(Algorithm):
+    """Shared driver for continuous off-policy learners (SAC / TD3 /
+    DDPG): probe Box spaces, gang up transition workers, and per
+    training_step sample → replay-add → one jitted update burst →
+    weight broadcast.  Subclasses set ``_policy_cls`` and
+    ``_make_spec``; ``_mesh`` optionally supplies a learner mesh."""
 
-    def setup(self, config: SACConfig) -> None:
+    _policy_cls = None
+
+    def _make_spec(self, config):
+        raise NotImplementedError
+
+    def _mesh(self, config):
+        return None
+
+    def setup(self, config) -> None:
         if config.obs_dim is None or config.action_dim is None:
             from ray_tpu.rllib.rollout_worker import _make_env
 
@@ -330,21 +345,15 @@ class SAC(Algorithm):
                 if hasattr(space, "n") or not getattr(space, "shape",
                                                       None):
                     raise TypeError(
-                        "SAC supports continuous (Box) action spaces "
-                        "only; use DQN/PPO for discrete envs")
+                        f"{type(self).__name__} supports continuous "
+                        "(Box) action spaces only; use DQN/PPO for "
+                        "discrete envs")
                 config.action_dim = int(np.prod(space.shape))
             finally:
                 env.close() if hasattr(env, "close") else None
-        spec = config.sac_spec()
-        if config.learner_devices > 1 and \
-                config.train_batch_size % config.learner_devices:
-            raise ValueError(
-                f"train_batch_size={config.train_batch_size} must divide "
-                f"by learner_devices={config.learner_devices}")
-        from ray_tpu.rllib.algorithm import learner_mesh
-
-        self.policy = SACPolicy(spec, seed=config.seed,
-                                mesh=learner_mesh(config.learner_devices))
+        spec = self._make_spec(config)
+        self.policy = self._policy_cls(spec, seed=config.seed,
+                                       mesh=self._mesh(config))
         self.buffer = ReplayBuffer(config.buffer_size, seed=config.seed)
         remote_cls = ray_tpu.remote(
             num_cpus=config.num_cpus_per_worker)(
@@ -354,7 +363,8 @@ class SAC(Algorithm):
                 env=config.env, env_config=config.env_config, spec=spec,
                 num_envs=config.num_envs_per_worker,
                 rollout_fragment_length=config.rollout_fragment_length,
-                seed=config.seed + 1000 * (i + 1))
+                seed=config.seed + 1000 * (i + 1),
+                policy_cls=self._policy_cls)
             for i in range(config.num_workers)]
 
     def training_step(self) -> Dict[str, Any]:
@@ -388,3 +398,21 @@ class SAC(Algorithm):
             except Exception:  # noqa: BLE001
                 pass
         self.workers = []
+
+
+class SAC(ContinuousOffPolicy):
+    _config_cls = SACConfig
+    _policy_cls = SACPolicy
+
+    def _make_spec(self, config: SACConfig) -> SACSpec:
+        return config.sac_spec()
+
+    def _mesh(self, config: SACConfig):
+        if config.learner_devices > 1 and \
+                config.train_batch_size % config.learner_devices:
+            raise ValueError(
+                f"train_batch_size={config.train_batch_size} must divide "
+                f"by learner_devices={config.learner_devices}")
+        from ray_tpu.rllib.algorithm import learner_mesh
+
+        return learner_mesh(config.learner_devices)

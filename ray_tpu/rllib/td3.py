@@ -1,0 +1,242 @@
+"""TD3 (and DDPG) for continuous control.
+
+Reference analog: rllib/algorithms/td3 + rllib/algorithms/ddpg —
+deterministic tanh actor, twin critics, target-policy smoothing,
+delayed actor updates, polyak targets.  Same TPU-first learner shape as
+SAC here (sac.py): `train_intensity` SGD steps per training_step
+compile into ONE jitted lax.scan over presampled replay minibatches.
+``policy_delay=1`` with ``smoothing_sigma=0`` degrades to plain DDPG
+(exposed as :class:`DDPG`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import AlgorithmConfig
+from ray_tpu.rllib.policy import _net_apply, _net_init
+from ray_tpu.rllib.sac import ContinuousOffPolicy
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class TD3Spec:
+    obs_dim: int
+    action_dim: int
+    hidden: Tuple[int, ...] = (128, 128)
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    gamma: float = 0.99
+    tau: float = 0.005
+    #: exploration noise std (rollouts, [-1,1] action scale)
+    expl_sigma: float = 0.1
+    #: target policy smoothing noise std + clip (TD3's regularizer)
+    smoothing_sigma: float = 0.2
+    smoothing_clip: float = 0.5
+    #: actor (and target) updates every N critic steps
+    policy_delay: int = 2
+
+
+class TD3Policy:
+    """Deterministic tanh actor + twin critics; same worker-facing
+    surface as SACPolicy (compute_actions / get_weights / set_weights)
+    so the continuous rollout worker drives either."""
+
+    def __init__(self, spec: TD3Spec, seed: int = 0, mesh=None):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.spec = spec
+        self.mesh = mesh
+        ka, k1, k2 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        obs, act = spec.obs_dim, spec.action_dim
+        self.params = {
+            "actor": _net_init(ka, (obs, *spec.hidden, act)),
+            "q1": _net_init(k1, (obs + act, *spec.hidden, 1)),
+            "q2": _net_init(k2, (obs + act, *spec.hidden, 1)),
+        }
+        self.target = jax.tree.map(lambda x: jnp.array(x, copy=True),
+                                   self.params)
+        self.tx = optax.multi_transform(
+            {"actor": optax.adam(spec.actor_lr),
+             "critic": optax.adam(spec.critic_lr)},
+            {"actor": "actor", "q1": "critic", "q2": "critic"})
+        self.opt_state = self.tx.init(self.params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+    def _build_fns(self):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+
+        def mu(params, obs):
+            return jnp.tanh(_net_apply(params["actor"], obs))
+
+        def q_val(net, obs, act):
+            return _net_apply(net, jnp.concatenate([obs, act],
+                                                   axis=-1))[..., 0]
+
+        @jax.jit
+        def act_fn(params, obs, key, deterministic):
+            a = mu(params, obs)
+            noise = spec.expl_sigma * jax.random.normal(key, a.shape)
+            return jnp.where(deterministic, a,
+                             jnp.clip(a + noise, -1.0, 1.0))
+
+        def critic_loss_fn(params, target, mini, key):
+            # target action with clipped smoothing noise (TD3 trick #3)
+            eps = jnp.clip(
+                spec.smoothing_sigma
+                * jax.random.normal(key, mini[sb.ACTIONS].shape),
+                -spec.smoothing_clip, spec.smoothing_clip)
+            a2 = jnp.clip(mu(target, mini[sb.NEXT_OBS]) + eps,
+                          -1.0, 1.0)
+            tq = jnp.minimum(                       # twin-min (trick #1)
+                q_val(target["q1"], mini[sb.NEXT_OBS], a2),
+                q_val(target["q2"], mini[sb.NEXT_OBS], a2))
+            nonterminal = 1.0 - mini[sb.DONES].astype(jnp.float32)
+            backup = jax.lax.stop_gradient(
+                mini[sb.REWARDS] + spec.gamma * nonterminal * tq)
+            q1 = q_val(params["q1"], mini[sb.OBS], mini[sb.ACTIONS])
+            q2 = q_val(params["q2"], mini[sb.OBS], mini[sb.ACTIONS])
+            return jnp.mean(jnp.square(q1 - backup)
+                            + jnp.square(q2 - backup))
+
+        def actor_loss_fn(params, mini):
+            a = mu(params, mini[sb.OBS])
+            return -jnp.mean(q_val(
+                jax.lax.stop_gradient(params["q1"]), mini[sb.OBS], a))
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def update(params, opt_state, target, stacked, rng):
+            import optax
+
+            def step(carry, xs):
+                params, opt_state, target, rng = carry
+                mini, step_i = xs
+                rng, key = jax.random.split(rng)
+                closs, cgrads = jax.value_and_grad(critic_loss_fn)(
+                    params, target, mini, key)
+                aloss, agrads = jax.value_and_grad(actor_loss_fn)(
+                    params, mini)
+                # delayed policy updates (trick #2): the actor moves
+                # only every policy_delay steps.  Both the grads AND
+                # the final updates are masked — Adam momentum alone
+                # would otherwise keep nudging the actor on skipped
+                # steps (nonzero m_hat with zero grads)
+                do_actor = (step_i % spec.policy_delay == 0).astype(
+                    jnp.float32)
+                grads = {
+                    "actor": jax.tree.map(lambda g: g * do_actor,
+                                          agrads["actor"]),
+                    "q1": cgrads["q1"], "q2": cgrads["q2"],
+                }
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                updates = dict(updates)
+                updates["actor"] = jax.tree.map(
+                    lambda u: u * do_actor, updates["actor"])
+                params = optax.apply_updates(params, updates)
+                target = jax.tree.map(
+                    lambda t, p: t + do_actor * spec.tau * (p - t),
+                    target, params)
+                return (params, opt_state, target, rng), {
+                    "critic_loss": closs, "actor_loss": aloss}
+
+            steps = jnp.arange(
+                next(iter(stacked.values())).shape[0])
+            (params, opt_state, target, rng), stats = jax.lax.scan(
+                step, (params, opt_state, target, rng),
+                (stacked, steps))
+            last = jax.tree.map(lambda s: s[-1], stats)
+            return params, opt_state, target, last, rng
+
+        self._act = act_fn
+        self._update = update
+
+    def compute_actions(self, obs: np.ndarray,
+                        deterministic: bool = False) -> np.ndarray:
+        import jax
+
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(self._act(self.params, obs, key,
+                                    deterministic))
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]
+                             ) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([m[k] for m in minis])
+                   for k in minis[0].keys()}
+        (self.params, self.opt_state, self.target, stats,
+         self._rng) = self._update(self.params, self.opt_state,
+                                   self.target, stacked, self._rng)
+        return {k: float(v) for k, v in stats.items()}
+
+
+@dataclasses.dataclass
+class TD3Config(AlgorithmConfig):
+    hidden: Tuple[int, ...] = (128, 128)
+    buffer_size: int = 100_000
+    learning_starts: int = 500
+    train_batch_size: int = 128
+    train_intensity: int = 16
+    tau: float = 0.005
+    expl_sigma: float = 0.1
+    smoothing_sigma: float = 0.2
+    smoothing_clip: float = 0.5
+    policy_delay: int = 2
+    rollout_fragment_length: int = 50
+    obs_dim: Optional[int] = None
+    action_dim: Optional[int] = None
+
+    def td3_spec(self) -> TD3Spec:
+        return TD3Spec(obs_dim=self.obs_dim,
+                       action_dim=self.action_dim,
+                       hidden=tuple(self.hidden), actor_lr=self.lr,
+                       critic_lr=self.lr, gamma=self.gamma,
+                       tau=self.tau, expl_sigma=self.expl_sigma,
+                       smoothing_sigma=self.smoothing_sigma,
+                       smoothing_clip=self.smoothing_clip,
+                       policy_delay=self.policy_delay)
+
+
+class TD3(ContinuousOffPolicy):
+    _config_cls = TD3Config
+    _policy_cls = TD3Policy
+
+    def _make_spec(self, config: TD3Config) -> TD3Spec:
+        return config.td3_spec()
+
+
+@dataclasses.dataclass
+class DDPGConfig(TD3Config):
+    """DDPG = TD3 minus the three tricks (reference:
+    rllib/algorithms/ddpg)."""
+
+    smoothing_sigma: float = 0.0
+    policy_delay: int = 1
+
+
+class DDPG(TD3):
+    _config_cls = DDPGConfig

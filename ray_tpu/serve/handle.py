@@ -81,6 +81,16 @@ class _SharedListener:
                         continue
                     self._thread = None  # next register restarts us
                     return
+            from ray_tpu._private import worker_context
+
+            cw = worker_context.maybe_core_worker()
+            if cw is None or getattr(cw, "_closed", False):
+                # the cluster shut down under us: a daemon listener
+                # retrying forever against a closed client would touch
+                # the unmapped shm store (segfault class) — exit
+                with self._lock:
+                    self._thread = None
+                return
             try:
                 out = ray_tpu.get(
                     self._controller.listen_for_change.remote(
