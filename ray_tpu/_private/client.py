@@ -408,12 +408,13 @@ class CoreWorker:
             return {"in_store": True}
         if entry is None or entry.data is None:
             raise RuntimeError(f"owner does not have object {oid.hex()[:16]}")
-        if not self.store.contains(ObjectID(oid)):
-            try:
-                self.store.put_bytes(ObjectID(oid), entry.data)
-            except Exception as e:  # noqa: BLE001
-                if "exists" not in str(e):
-                    raise
+        with self._store_access():
+            if not self.store.contains(ObjectID(oid)):
+                try:
+                    self.store.put_bytes(ObjectID(oid), entry.data)
+                except Exception as e:  # noqa: BLE001
+                    if "exists" not in str(e):
+                        raise
         return {"in_store": True}
 
     def shutdown(self):
@@ -738,6 +739,14 @@ class CoreWorker:
                 self._contained.setdefault(outer_oid, []).extend(infos)
 
     def _put_shm(self, oid: ObjectID, ser: serialization.SerializedObject):
+        # writers need the shutdown guard as much as readers: a put
+        # racing store.close() would run create/seal against the
+        # unmapped segment
+        with self._store_access():
+            return self._put_shm_inner(oid, ser)
+
+    def _put_shm_inner(self, oid: ObjectID,
+                       ser: serialization.SerializedObject):
         if self.spill.enabled and \
                 ser.total_size > self.store.stats()["capacity"]:
             # can never fit: skip the futile spill/evict backpressure loop
@@ -875,9 +884,11 @@ class CoreWorker:
             buf = self.store.get(ObjectID(oid), timeout_ms=0)
             if buf is not None:
                 return self._deserialize_store_buffer(buf)
-            data = self.spill.read(oid)
-            if data is not None:
-                return serialization.deserialize(data)
+        # spill reads are disk/network IO with no shm exposure — keep
+        # them OUTSIDE the guard or a slow remote read stalls shutdown
+        data = self.spill.read(oid)
+        if data is not None:
+            return serialization.deserialize(data)
         return None
 
     @contextlib.contextmanager

@@ -6,6 +6,7 @@ from ray_tpu.tune.callback import Callback
 from ray_tpu.tune.logger import (CSVLoggerCallback, JsonLoggerCallback,
                                  LoggerCallback, TBXLoggerCallback)
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     HyperBandForBOHB,
                                      MedianStoppingRule, PB2,
                                      PopulationBasedTraining,
                                      TrialScheduler)
@@ -20,7 +21,7 @@ __all__ = [
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "Searcher", "TPESearcher",
     "TrialScheduler", "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "PB2",
+    "PopulationBasedTraining", "PB2", "HyperBandForBOHB",
     "Callback", "LoggerCallback", "CSVLoggerCallback",
     "JsonLoggerCallback", "TBXLoggerCallback",
 ]

@@ -16,6 +16,9 @@ STOP = "STOP"
 #: restart the trial's actor with trial.config + trial.restore_checkpoint
 #: (PBT exploitation).
 RESTART = "RESTART"
+#: checkpoint + release the trial's resources; the scheduler resumes it
+#: later via actions() (HyperBand rung barriers).
+PAUSE = "PAUSE"
 
 
 class TrialScheduler:
@@ -24,6 +27,15 @@ class TrialScheduler:
 
     def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
         return CONTINUE
+
+    def on_trial_complete(self, trial) -> None:
+        """Runner hook on terminal trial states (barrier schedulers
+        must re-evaluate rungs a dead member can no longer report to)."""
+
+    def actions(self):
+        """Polled by the runner each loop tick: (resume, stop) lists of
+        PAUSED trials the scheduler has decided about."""
+        return [], []
 
 
 class FIFOScheduler(TrialScheduler):
@@ -182,6 +194,135 @@ class PopulationBasedTraining(TrialScheduler):
         trial.restore_checkpoint = donor.checkpoint
         self.num_exploits += 1
         return RESTART
+
+
+class HyperBandForBOHB(TrialScheduler):
+    """Synchronous HyperBand with rung barriers (reference:
+    tune/schedulers/hb_bohb.py:14 HyperBandForBOHB).  Trials round-robin
+    into brackets; within a bracket every trial PAUSES (checkpoint +
+    resources released) when it reaches the current rung budget, and
+    once the whole rung has reported, the top 1/eta resume into the next
+    rung while the rest stop.  Pair with a model-based searcher
+    (e.g. search.TPESearcher) for BOHB: the searcher proposes configs,
+    this scheduler allocates budgets.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min", *,
+                 max_t: int = 81, reduction_factor: int = 3,
+                 num_brackets: int = 1,
+                 time_attr: str = "training_iteration"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min or max")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.eta = reduction_factor
+        self.num_brackets = max(1, num_brackets)
+        self.time_attr = time_attr
+        #: bracket index -> {"rung": k, "budget": t, "members": set,
+        #:  "reported": {trial_id: score}, "paused": {trial_id: trial}}
+        self._brackets: List[Dict[str, Any]] = []
+        levels = int(math.log(self.max_t, self.eta))
+        self._start_budget = max(1, int(
+            self.max_t / (self.eta ** max(0, levels))))
+        for s in range(self.num_brackets):
+            # bracket s starts at budget max_t / eta^(levels-s)
+            start = max(1, int(self.max_t
+                               / (self.eta ** max(0, levels - s))))
+            self._brackets.append({
+                "rung": 0, "budget": start, "members": set(),
+                "reported": {}, "paused": {}})
+        self._assigned: Dict[str, int] = {}
+        self._resume: List = []
+        self._stop: List = []
+
+    def _bracket_of(self, trial) -> Dict[str, Any]:
+        b = self._assigned.get(trial.trial_id)
+        if b is None:
+            # join only rung-0 brackets: a late-arriving trial (model-
+            # based searchers trickle suggestions) must compete from the
+            # first rung, not parachute into an advanced budget
+            open_brackets = [i for i, br in enumerate(self._brackets)
+                             if br["rung"] == 0]
+            if not open_brackets:
+                self._brackets.append({
+                    "rung": 0, "budget": self._start_budget,
+                    "members": set(), "reported": {}, "paused": {}})
+                open_brackets = [len(self._brackets) - 1]
+            b = open_brackets[len(self._assigned) % len(open_brackets)]
+            self._assigned[trial.trial_id] = b
+            self._brackets[b]["members"].add(trial.trial_id)
+        return self._brackets[b]
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr, trial.iteration)
+        val = result.get(self.metric)
+        if val is None:
+            return CONTINUE
+        br = self._bracket_of(trial)
+        if t >= self.max_t:
+            return STOP
+        if t < br["budget"]:
+            return CONTINUE
+        # rung boundary: record the score and pause at the barrier
+        br["reported"][trial.trial_id] = float(val)
+        br["paused"][trial.trial_id] = trial
+        self._maybe_close_rung(br)
+        return PAUSE
+
+    def on_trial_complete(self, trial) -> None:
+        """Runner hook: a bracket member finished WITHOUT pausing at the
+        rung (errored out, hit stop_criteria) — re-evaluate the rung or
+        the remaining paused members would wait on it forever."""
+        b = self._assigned.get(trial.trial_id)
+        if b is not None:
+            br = self._brackets[b]
+            br["paused"].pop(trial.trial_id, None)
+            self._maybe_close_rung(br)
+
+    def _finished(self, tid: str) -> bool:
+        for t in getattr(self, "_trials", []):
+            if t.trial_id == tid:
+                return t.is_finished
+        return False
+
+    def set_trials(self, trials) -> None:
+        self._trials = list(trials)
+        # assign brackets UP FRONT: membership must exist before any
+        # trial reports, or the first reporter closes a one-member rung
+        # and elimination never happens
+        for t in trials:
+            if not t.is_finished:
+                self._bracket_of(t)
+
+    def _maybe_close_rung(self, br) -> None:
+        # the rung closes when every live member has reported
+        pending = [tid for tid in br["members"]
+                   if tid not in br["reported"]
+                   and not self._finished(tid)]
+        if pending:
+            return
+        scored = sorted(br["reported"].items(), key=lambda kv: kv[1],
+                        reverse=(self.mode == "max"))
+        keep = max(1, len(scored) // self.eta)
+        winners = {tid for tid, _ in scored[:keep]}
+        for tid, trial in list(br["paused"].items()):
+            if tid in winners:
+                trial.restore_checkpoint = trial.checkpoint
+                self._resume.append(trial)
+            else:
+                br["members"].discard(tid)
+                self._stop.append(trial)
+        br["paused"].clear()
+        br["reported"].clear()
+        br["rung"] += 1
+        br["budget"] = min(self.max_t, br["budget"] * self.eta)
+        br["members"] &= winners
+
+    def actions(self):
+        resume, self._resume = self._resume, []
+        stop, self._stop = self._stop, []
+        return resume, stop
 
 
 class PB2(PopulationBasedTraining):

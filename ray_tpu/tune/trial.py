@@ -11,6 +11,9 @@ RUNNING = "RUNNING"
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 STOPPED = "STOPPED"  # early-stopped by a scheduler
+#: checkpointed + released resources, awaiting a scheduler resume
+#: (HyperBand rung barriers — reference: trial PAUSED state)
+PAUSED = "PAUSED"
 
 
 @dataclasses.dataclass

@@ -17,7 +17,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.tune import trial as trial_mod
-from ray_tpu.tune.schedulers import (CONTINUE, RESTART, STOP,
+from ray_tpu.tune.schedulers import (CONTINUE, PAUSE, RESTART, STOP,
                                      TrialScheduler)
 from ray_tpu.tune.trial import Trial
 
@@ -62,6 +62,11 @@ class TrialRunner:
         #: failed trials waiting out their backoff: (monotonic_due, trial)
         self._retry_at: List[tuple] = []
         self._searcher_done = False
+        #: trials paused at a scheduler barrier (HyperBand rungs):
+        #: checkpointed, actor released, waiting on scheduler.actions()
+        self._paused: Dict[str, Trial] = {}
+        #: seconds the loop has idled with only paused trials left
+        self._paused_idle = 0.0
         from ray_tpu.tune.callback import CallbackList
 
         self.callbacks = CallbackList(callbacks or [])
@@ -135,13 +140,45 @@ class TrialRunner:
         pending = self._pending
         try:
             while (pending or self._inflight or self._retry_at
-                   or self._searcher_pending()):
+                   or self._paused or self._searcher_pending()):
                 # promote failed trials whose backoff has expired
                 now = _time.monotonic()
                 due = [t for at, t in self._retry_at if at <= now]
                 self._retry_at = [(at, t) for at, t in self._retry_at
                                   if at > now]
                 pending.extend(due)
+                # scheduler barrier decisions (HyperBand rung close)
+                resume, stop = self.scheduler.actions()
+                for t in stop:
+                    self._paused.pop(t.trial_id, None)
+                    self._finish(t, trial_mod.STOPPED)
+                for t in resume:
+                    if self._paused.pop(t.trial_id, None) is not None:
+                        t.status = trial_mod.PENDING
+                        pending.append(t)
+                if (self._paused and not pending and not self._inflight
+                        and not self._retry_at and not resume
+                        and not stop):
+                    # barrier can't progress without us: wait briefly
+                    # for the scheduler; a wedged barrier (>60s with
+                    # zero movement) force-resumes everyone rather than
+                    # hanging the experiment
+                    self._paused_idle += 0.05
+                    _time.sleep(0.05)
+                    if self._paused_idle > 60.0:
+                        logger.warning(
+                            "scheduler barrier stuck; force-resuming "
+                            "%d paused trials", len(self._paused))
+                        for t in list(self._paused.values()):
+                            # resume from the rung checkpoint — a
+                            # from-scratch restart would poison the
+                            # bracket with untrained-model scores
+                            t.restore_checkpoint = t.checkpoint
+                            t.status = trial_mod.PENDING
+                            pending.append(t)
+                        self._paused.clear()
+                    continue
+                self._paused_idle = 0.0
                 while (self._searcher_pending()
                        and len(self._actors) + len(pending)
                        < self.max_concurrent):
@@ -235,6 +272,10 @@ class TrialRunner:
             except Exception:  # noqa: BLE001 - searcher bug ≠ run abort
                 logger.exception("searcher on_trial_complete failed")
         if trial.is_finished:
+            try:
+                self.scheduler.on_trial_complete(trial)
+            except Exception:  # noqa: BLE001 - scheduler bug ≠ run abort
+                logger.exception("scheduler on_trial_complete failed")
             self.callbacks.on_trial_complete(trial)
 
     def _handle_failure(self, trial: Trial, error: BaseException) -> None:
@@ -309,6 +350,19 @@ class TrialRunner:
             decision = self.scheduler.on_trial_result(trial, metrics)
         if decision == STOP:
             self._finish(trial, trial_mod.STOPPED)
+            return
+        if decision == PAUSE:
+            # scheduler barrier (HyperBand rung): checkpointed already
+            # (the scheduler pauses AT a report), release the actor and
+            # park until scheduler.actions() resumes or stops us
+            trial.status = trial_mod.PAUSED
+            actor = self._actors.pop(trial.trial_id, None)
+            if actor is not None:
+                try:
+                    ray_tpu.kill(actor)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._paused[trial.trial_id] = trial
             return
         if decision == RESTART:
             # PBT exploitation: replace the trial's actor with one running

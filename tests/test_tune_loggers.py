@@ -180,3 +180,37 @@ def test_pb2_learns_toward_optimum(ray_start_shared, tmp_path):
     # (evidence the GP/cold-start explore actually ran)
     lrs = {t.config["lr"] for t in grid.trials}
     assert any(lr not in (0.05, 0.9, 0.95, 0.99) for lr in lrs)
+
+
+def test_hyperband_bohb_rung_barrier(ray_start_shared, tmp_path):
+    """Synchronous HyperBand: trials pause at the rung budget, the rung
+    closes when all report, top 1/eta resume from checkpoint, the rest
+    stop (reference: hb_bohb.py HyperBandForBOHB)."""
+    def obj(config):
+        from ray_tpu.air import session
+        from ray_tpu.air.checkpoint import Checkpoint
+
+        start = 0
+        for i in range(start, 9):
+            session.report({"loss": config["q"] * (9 - i)},
+                           checkpoint=Checkpoint.from_dict({"i": i}))
+
+    sched = tune.HyperBandForBOHB(metric="loss", mode="min", max_t=9,
+                                  reduction_factor=3)
+    grid = tune.Tuner(
+        obj,
+        param_space={"q": tune.grid_search([1.0, 2.0, 4.0, 8.0, 16.0,
+                                            32.0])},
+        tune_config=tune.TuneConfig(scheduler=sched,
+                                    max_concurrent_trials=3),
+        run_config=ray_tpu.air.RunConfig(storage_path=str(tmp_path),
+                                         name="hb"),
+    ).fit()
+    assert len(grid) == 6
+    iters = {t.config["q"]: t.iteration for t in grid.trials}
+    # the best configs run longest; the worst are cut at the first rung
+    best_iters = max(iters[1.0], iters[2.0])
+    worst_iters = min(iters[16.0], iters[32.0])
+    assert best_iters > worst_iters, iters
+    stopped = [t for t in grid.trials if t.status == "STOPPED"]
+    assert stopped, "no trial was cut at a rung barrier"
