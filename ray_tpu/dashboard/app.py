@@ -17,54 +17,6 @@ from typing import Optional
 
 DASHBOARD_NAME = "RAYTPU_DASHBOARD"
 
-#: Minimal single-page frontend over the JSON APIs (the reference ships
-#: a React app, dashboard/client/; this is the dependency-free analog).
-_INDEX_HTML = """<!doctype html>
-<html><head><title>ray_tpu dashboard</title><style>
-body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa}
-h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
-table{border-collapse:collapse;width:100%;background:#fff}
-th,td{border:1px solid #ddd;padding:4px 8px;font-size:.85rem;
-text-align:left} th{background:#f0f0f0}
-.state-ALIVE,.state-RUNNING,.state-SUCCEEDED{color:#0a7d24}
-.state-DEAD,.state-FAILED{color:#c02020}
-#err{color:#c02020}</style></head><body>
-<h1>ray_tpu dashboard</h1><div id=err></div>
-<h2>Summary</h2><div id=summary></div>
-<h2>Nodes</h2><table id=nodes></table>
-<h2>Actors</h2><table id=actors></table>
-<h2>Jobs</h2><table id=jobs></table>
-<script>
-function row(cells,th){const tr=document.createElement('tr');
-for(const c of cells){const td=document.createElement(th?'th':'td');
-if(typeof c==='object'){td.textContent=c.text;td.className=c.cls||''}
-else td.textContent=c;tr.appendChild(td)}return tr}
-function fill(id,heads,rows){const t=document.getElementById(id);
-t.innerHTML='';t.appendChild(row(heads,true));
-for(const r of rows)t.appendChild(row(r))}
-async function refresh(){try{
-const s=await (await fetch('/api/summary')).json();
-document.getElementById('summary').textContent=
- `nodes: ${s.nodes} | tasks finished: ${s.tasks.total} | actors: `+
- Object.entries(s.actors.by_state).map(([k,v])=>`${k}=${v}`).join(' ');
-const nodes=await (await fetch('/api/nodes')).json();
-fill('nodes',['node','alive','resources','available'],nodes.map(n=>[
- n.node_id.slice(0,12),String(n.alive),JSON.stringify(n.resources),
- JSON.stringify(n.available)]));
-const actors=await (await fetch('/api/actors')).json();
-fill('actors',['actor','name','state','restarts'],actors.map(a=>[
- a.actor_id.slice(0,12),a.name||'',{text:a.state,
- cls:'state-'+a.state},a.num_restarts]));
-const jobs=await (await fetch('/api/jobs')).json();
-fill('jobs',['job','entrypoint','status','message'],jobs.map(j=>[
- j.job_id,j.entrypoint,{text:j.status,cls:'state-'+j.status},
- j.message||'']));
-document.getElementById('err').textContent='';
-}catch(e){document.getElementById('err').textContent='refresh failed: '+e}}
-refresh();setInterval(refresh,3000);
-</script></body></html>"""
-
-
 class DashboardActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         self.host = host
@@ -289,7 +241,9 @@ class DashboardActor:
         app.router.add_post("/api/workflows/events", workflow_post_event)
 
         async def index(_req):
-            return web.Response(text=_INDEX_HTML,
+            from ray_tpu.dashboard.frontend import INDEX_HTML
+
+            return web.Response(text=INDEX_HTML,
                                 content_type="text/html")
 
         app.router.add_get("/", index)
