@@ -80,7 +80,7 @@ function table(heads,rows){
  </table>`}
 function searchBox(ph){return `<input type=search id=flt value="${esc(filter)}"
  placeholder="filter ${esc(ph)}..."
- oninput="filter=this.value;render(false)">`}
+ oninput="filter=this.value;render(false,true)">`}
 // filter on RAW record values (never on generated markup)
 function flt(recs){if(!filter)return recs;const f=filter.toLowerCase();
  return recs.filter(r=>r.raw.join(' ').toLowerCase().includes(f))}
@@ -106,14 +106,14 @@ const renderers={
      cls(n.alive?'ALIVE':'DEAD'),
      bar((n.resources.CPU||0)-(n.available.CPU||0),n.resources.CPU||0),
      fmtRes(n.resources),fmtRes(n.available)]))}`},
- async nodes(){const nodes=await j('/api/nodes');
+ async nodes(){const nodes=await cj('/api/nodes');
   const recs=nodes.map(n=>({raw:[n.node_id,n.alive?'alive':'dead',
     n.address||''],html:[`<span class=mono>${esc(n.node_id)}</span>`,
     cls(n.alive?'ALIVE':'DEAD'),esc(n.address||''),
     fmtRes(n.resources),fmtRes(n.available)]}));
   return searchBox('nodes')+table(
    ['node id','alive','address','total','available'],rows(flt(recs)))},
- async actors(){const a=await j('/api/actors');
+ async actors(){const a=await cj('/api/actors');
   const recs=a.map(x=>({raw:[x.actor_id,x.name||'',x.state],
    html:[`<span class=mono>${esc(x.actor_id.slice(0,16))}</span>`,
     esc(x.name||''),cls(x.state),
@@ -122,7 +122,7 @@ const renderers={
   return searchBox('actors')+table(
    ['actor id','name','state','node','restarts','resources'],
    rows(flt(recs)))},
- async tasks(){const t=await j('/api/tasks');
+ async tasks(){const t=await cj('/api/tasks');
   const recs=t.slice(-500).reverse().map(x=>({
    raw:[x.name||x.task_id,x.actor_id?'actor':'task'],
    html:[esc(x.name||x.task_id.slice(0,16)),
@@ -155,7 +155,7 @@ const renderers={
     `${esc(d.status||'')}</span>`,
     `${d.replicas||0} / ${d.target_replicas||0}`,
     d.autoscaling?'yes':'no',esc(d.route||'')]))},
- async events(){const ev=await j('/api/events?limit=200');
+ async events(){const ev=await cj('/api/events?limit=200');
   const recs=ev.map(e=>({raw:[e.severity,e.source,e.message],
    html:[new Date(e.timestamp*1000).toLocaleTimeString(),
     cls(e.severity),esc(e.source),esc(e.message)]}));
@@ -166,8 +166,15 @@ const renderers={
   return `<pre>${esc(await r.text())}</pre>`},
 };
 let lastJobs=[];
-async function render(renav=true){if(renav)nav();
+// per-view data cache: filter keystrokes re-render from it instead of
+// re-downloading the full list on every character
+const cache={};
+let useCache=false;
+async function cj(u){if(useCache&&cache[u])return cache[u];
+ const d=await j(u);cache[u]=d;return d}
+async function render(renav=true,fromFilter=false){if(renav)nav();
  const myGen=++gen;
+ useCache=fromFilter;
  try{$('#err').textContent='';
   if(view==='jobs'&&logsFor===null)
    lastJobs=await j('/api/jobs');

@@ -15,6 +15,7 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.td3 import (DDPG, DDPGConfig, TD3, TD3Config,
                               TD3Policy)
+from ray_tpu.rllib.cql_es import CQL, CQLConfig, ES, ESConfig
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -29,4 +30,4 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
            "MultiAgentPPO", "MultiAgentPPOConfig", "SAC", "SACConfig",
            "SACPolicy", "TD3", "TD3Config", "TD3Policy", "DDPG",
-           "DDPGConfig", "MARWIL", "MARWILConfig"]
+           "DDPGConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "ES", "ESConfig"]

@@ -1,0 +1,318 @@
+"""CQL (offline continuous control) and ES (evolution strategies).
+
+Reference analogs: rllib/algorithms/cql (SAC + conservative Q penalty
+on a static dataset) and rllib/algorithms/es (OpenAI-ES: population of
+parameter perturbations evaluated in parallel, fitness-weighted update).
+
+TPU-first shapes:
+- CQL reuses the SACPolicy learner verbatim — the conservative penalty
+  is a loss-term wrapper, and the whole iteration (N minibatch steps
+  over a device-resident dataset) is one jitted scan, like BC/MARWIL.
+- ES is embarrassingly parallel BY DESIGN: each rollout actor
+  evaluates a slice of the perturbation population; the learner's
+  update is one vectorized numpy expression over the fitness vector
+  (no backprop at all — the reference's es.py shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy import _net_apply
+from ray_tpu.rllib.sac import SACPolicy, SACSpec
+
+
+# ---------------------------------------------------------------------------
+# CQL
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CQLConfig(AlgorithmConfig):
+    input_path: str = ""
+    hidden: Tuple[int, ...] = (128, 128)
+    train_batch_size: int = 128
+    sgd_steps_per_iter: int = 50
+    tau: float = 0.005
+    #: conservative penalty weight (reference cql.py min_q_weight)
+    min_q_weight: float = 1.0
+    #: actions sampled per state for the logsumexp penalty
+    num_penalty_actions: int = 4
+    obs_dim: Optional[int] = None
+    action_dim: Optional[int] = None
+
+
+class CQL(Algorithm):
+    """Conservative Q-Learning on logged continuous-control data
+    (reference: rllib/algorithms/cql/cql.py — SAC whose critic loss adds
+    ``min_q_weight * (logsumexp_a Q(s,a) - Q(s, a_data))``, pushing Q
+    down on out-of-distribution actions).  Dataset-resident training:
+    the offline batch ships to the device once; each train() is one
+    jitted scan of minibatch steps."""
+
+    _config_cls = CQLConfig
+
+    def setup(self, config: CQLConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        data = JsonReader(config.input_path).read_all()
+        for key in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                    sb.NEXT_OBS):
+            if key not in data:
+                raise ValueError(f"CQL offline data needs {key!r}")
+        if config.obs_dim is None:
+            config.obs_dim = int(np.prod(data[sb.OBS].shape[1:]))
+        if config.action_dim is None:
+            config.action_dim = int(np.prod(data[sb.ACTIONS].shape[1:]))
+        spec = SACSpec(obs_dim=config.obs_dim,
+                       action_dim=config.action_dim,
+                       hidden=tuple(config.hidden), actor_lr=config.lr,
+                       critic_lr=config.lr, gamma=config.gamma,
+                       tau=config.tau)
+        #: the SAC learner provides actor/critic nets, targets, and the
+        #: base loss machinery; CQL adds its penalty around it
+        self.policy = SACPolicy(spec, seed=config.seed)
+        self._data = {k: jnp.asarray(np.asarray(data[k], np.float32))
+                      for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.NEXT_OBS)}
+        self._data[sb.DONES] = jnp.asarray(
+            np.asarray(data[sb.DONES], bool))
+        n = len(data[sb.ACTIONS])
+        mb = min(config.train_batch_size, n)
+        steps = config.sgd_steps_per_iter
+        n_pen = config.num_penalty_actions
+        w_pen = config.min_q_weight
+        act_dim = config.action_dim
+
+        pol = self.policy
+
+        def q_val(net, obs, act):
+            return _net_apply(net, jnp.concatenate([obs, act],
+                                                   axis=-1))[..., 0]
+
+        def penalty(params, obs, data_act, key):
+            """logsumexp over uniform AND current-policy actions minus
+            the data action's Q — the conservative gap, per critic
+            (policy actions matter: that is where an overestimating
+            critic drives the actor)."""
+            k1, k2 = jax.random.split(key)
+            B = obs.shape[0]
+            rand = jax.random.uniform(k1, (n_pen, B, act_dim),
+                                      minval=-1.0, maxval=1.0)
+            pi_act, _ = pol._sample_action(params, obs, k2)
+            # candidates are WHERE to evaluate Q, not a path for actor
+            # gradients
+            pi_act = jax.lax.stop_gradient(pi_act)
+            cand = jnp.concatenate([rand, pi_act[None]], axis=0)
+            obs_t = jnp.broadcast_to(obs, (n_pen + 1,) + obs.shape)
+            out = 0.0
+            for net_key in ("q1", "q2"):
+                q_cand = q_val(params[net_key],
+                               obs_t.reshape(-1, obs.shape[-1]),
+                               cand.reshape(-1, act_dim))
+                q_cand = q_cand.reshape(n_pen + 1, B)
+                lse = jax.scipy.special.logsumexp(q_cand, axis=0)
+                q_data = q_val(params[net_key], obs, data_act)
+                out = out + jnp.mean(lse - q_data)
+            return out
+
+        def cql_loss(params, target, mini, key):
+            # SAC's critic/actor/alpha losses + the conservative term
+            k1, k2 = jax.random.split(key)
+            base, stats = pol._loss_fn(params, target, mini, k1)
+            pen = penalty(params, mini[sb.OBS], mini[sb.ACTIONS], k2)
+            stats = dict(stats, cql_penalty=pen)
+            return base + w_pen * pen, stats
+
+        # SAC's whole optimizer/polyak scan, with the wrapped loss
+        self._update = pol._make_update(cql_loss)
+        self._mb = mb
+        self._n = n
+        self._steps = steps
+        self._idx_rng = np.random.RandomState(config.seed + 5)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        pol = self.policy
+        # presample this iteration's minibatch indices; one device-side
+        # gather builds the (steps, mb, ...) stack the SAC scan consumes
+        idx = self._idx_rng.randint(0, self._n,
+                                    size=(self._steps, self._mb))
+        stacked = {k: v[jnp.asarray(idx)]
+                   for k, v in self._data.items()}
+        (pol.params, pol.opt_state, pol.target, stats,
+         pol._rng) = self._update(pol.params, pol.opt_state, pol.target,
+                                  stacked, pol._rng)
+        out = {k: float(v) for k, v in stats.items()}
+        out["timesteps_this_iter"] = (self.config.sgd_steps_per_iter
+                                      * self.config.train_batch_size)
+        return out
+
+    def compute_actions(self, obs: np.ndarray,
+                        deterministic: bool = True) -> np.ndarray:
+        return self.policy.compute_actions(obs, deterministic)
+
+    def cleanup(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# ES
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ESConfig(AlgorithmConfig):
+    hidden: Tuple[int, ...] = (32, 32)
+    #: perturbations per iteration (mirrored sampling doubles this)
+    population: int = 16
+    sigma: float = 0.1
+    episodes_per_eval: int = 1
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class _ESWorker:
+    """Evaluates parameter perturbations: given the flat base vector and
+    a list of seeds, plays episodes with params = base + sigma*eps(seed)
+    and returns fitness per seed (reference: es worker loop)."""
+
+    def __init__(self, env, env_config, obs_dim, n_actions, hidden,
+                 sigma, episodes, seed):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ray_tpu.rllib.rollout_worker import _make_env
+
+        self.env = _make_env(env, env_config)
+        self.dims = (obs_dim, *hidden, n_actions)
+        self.sigma = sigma
+        self.episodes = episodes
+        self._rng = np.random.RandomState(seed)
+
+    def _unflatten(self, flat: np.ndarray):
+        params = []
+        i = 0
+        for d_in, d_out in zip(self.dims[:-1], self.dims[1:]):
+            w = flat[i:i + d_in * d_out].reshape(d_in, d_out)
+            i += d_in * d_out
+            b = flat[i:i + d_out]
+            i += d_out
+            params.append({"w": w, "b": b})
+        return params
+
+    def _fitness(self, flat: np.ndarray) -> Tuple[float, int]:
+        params = self._unflatten(flat)
+        total = 0.0
+        steps = 0
+        for _ in range(self.episodes):
+            obs, _ = self.env.reset(
+                seed=int(self._rng.randint(0, 2**31 - 1)))
+            done = False
+            while not done:
+                x = np.asarray(obs, np.float32).ravel()[None]
+                for j, l in enumerate(params):
+                    x = x @ l["w"] + l["b"]
+                    if j < len(params) - 1:
+                        x = np.tanh(x)
+                a = int(np.argmax(x[0]))
+                obs, r, term, trunc, _ = self.env.step(a)
+                total += float(r)
+                steps += 1
+                done = term or trunc
+        return total / self.episodes, steps
+
+    def evaluate(self, base_flat: np.ndarray, seeds: List[int]):
+        """Mirrored sampling: (fitness+, fitness-, env_steps) per seed."""
+        out = []
+        for s in seeds:
+            eps = np.random.RandomState(s).standard_normal(
+                base_flat.shape).astype(np.float64)
+            fp, sp = self._fitness(base_flat + self.sigma * eps)
+            fm, sm = self._fitness(base_flat - self.sigma * eps)
+            out.append((fp, fm, sp + sm))
+        return out
+
+
+class ES(Algorithm):
+    """OpenAI evolution strategies (reference: rllib/algorithms/es):
+    gradient-free — N mirrored parameter perturbations evaluate in
+    parallel on rollout actors; the update is the rank-normalized
+    fitness-weighted sum of the noise vectors."""
+
+    _config_cls = ESConfig
+
+    def setup(self, config: ESConfig) -> None:
+        if config.obs_dim is None or config.n_actions is None:
+            from ray_tpu.rllib.rollout_worker import _make_env
+
+            env = _make_env(config.env, config.env_config)
+            try:
+                config.obs_dim = int(
+                    np.prod(env.observation_space.shape))
+                config.n_actions = int(env.action_space.n)
+            finally:
+                env.close() if hasattr(env, "close") else None
+        dims = (config.obs_dim, *config.hidden, config.n_actions)
+        n_params = sum(di * do + do
+                       for di, do in zip(dims[:-1], dims[1:]))
+        rng = np.random.RandomState(config.seed)
+        self.theta = (rng.standard_normal(n_params)
+                      * 0.05).astype(np.float64)
+        self._rng = np.random.RandomState(config.seed + 1)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(_ESWorker)
+        self.workers = [
+            remote_cls.remote(config.env, config.env_config,
+                              config.obs_dim, config.n_actions,
+                              tuple(config.hidden), config.sigma,
+                              config.episodes_per_eval,
+                              config.seed + 7_000 * (i + 1))
+            for i in range(max(1, config.num_workers))]
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        seeds = [int(s) for s in
+                 self._rng.randint(0, 2**31 - 1, size=c.population)]
+        theta_ref = ray_tpu.put(self.theta)
+        shards = np.array_split(seeds, len(self.workers))
+        results = ray_tpu.get(
+            [w.evaluate.remote(theta_ref, [int(s) for s in shard])
+             for w, shard in zip(self.workers, shards)], timeout=600)
+        triples = [p for part in results for p in part]
+        env_steps = sum(t[2] for t in triples)
+        # rank normalization (reference: es utils.compute_centered_ranks)
+        fits = np.asarray([f for t in triples for f in t[:2]])
+        ranks = np.empty_like(fits)
+        ranks[np.argsort(fits)] = np.arange(len(fits))
+        ranks = ranks / (len(fits) - 1) - 0.5
+        plus = ranks[0::2]
+        minus = ranks[1::2]
+        grad = np.zeros_like(self.theta)
+        for s, wgt in zip(seeds, plus - minus):
+            eps = np.random.RandomState(s).standard_normal(
+                self.theta.shape)
+            grad += wgt * eps
+        grad /= (len(seeds) * c.sigma)
+        self.theta = self.theta + c.lr * grad
+        # every perturbation's mean episode return feeds the rolling
+        # metric (the base Algorithm computes episode_reward_mean from
+        # these, like every other algorithm here)
+        self._episode_returns.extend(float(f) for f in fits)
+        return {"es_mean_fitness": float(np.mean(fits)),
+                "timesteps_this_iter": env_steps}
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -160,31 +160,45 @@ class SACPolicy:
                 "critic_loss": critic_loss, "actor_loss": actor_loss,
                 "alpha": alpha}
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
-        def update(params, opt_state, target, stacked, rng):
-            import optax
+        def make_update(the_loss_fn):
+            """Build the jitted epoch scan for ANY loss with SAC's
+            (params, target, mini, key) signature — loss-wrapping
+            learners (CQL's conservative penalty) reuse the whole
+            optimizer/polyak machinery instead of copying it."""
 
-            def step(carry, mini):
-                params, opt_state, target, rng = carry
-                rng, key = jax.random.split(rng)
-                (loss, stats), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, target, mini, key)
-                updates, opt_state = self.tx.update(grads, opt_state,
-                                                    params)
-                params = optax.apply_updates(params, updates)
-                # polyak target update every SGD step
-                target = jax.tree.map(
-                    lambda t, p: t * (1 - spec.tau) + p * spec.tau,
-                    target, {"q1": params["q1"], "q2": params["q2"]})
-                return (params, opt_state, target, rng), stats
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+            def update(params, opt_state, target, stacked, rng):
+                import optax
 
-            (params, opt_state, target, rng), stats = jax.lax.scan(
-                step, (params, opt_state, target, rng), stacked)
-            last = jax.tree.map(lambda s: s[-1], stats)
-            return params, opt_state, target, last, rng
+                def step(carry, mini):
+                    params, opt_state, target, rng = carry
+                    rng, key = jax.random.split(rng)
+                    (loss, stats), grads = jax.value_and_grad(
+                        the_loss_fn, has_aux=True)(params, target,
+                                                   mini, key)
+                    updates, opt_state = self.tx.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    # polyak target update every SGD step
+                    target = jax.tree.map(
+                        lambda t, p: t * (1 - spec.tau) + p * spec.tau,
+                        target, {"q1": params["q1"],
+                                 "q2": params["q2"]})
+                    return (params, opt_state, target, rng), stats
+
+                (params, opt_state, target, rng), stats = jax.lax.scan(
+                    step, (params, opt_state, target, rng), stacked)
+                last = jax.tree.map(lambda s: s[-1], stats)
+                return params, opt_state, target, last, rng
+
+            return update
 
         self._act = act_fn
-        self._update = update
+        #: exposed for loss-wrapping learners (CQL)
+        self._loss_fn = loss_fn
+        self._sample_action = sample_action
+        self._make_update = make_update
+        self._update = make_update(loss_fn)
 
     def compute_actions(self, obs: np.ndarray,
                         deterministic: bool = False) -> np.ndarray:

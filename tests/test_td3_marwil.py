@@ -145,3 +145,60 @@ def test_marwil_requires_rewards(ray_start_shared, tmp_path):
             sb.ACTIONS: np.zeros(4, np.int64)}))
     with pytest.raises(ValueError, match="rewards"):
         MARWIL(MARWILConfig(input_path=str(log)))
+
+
+def _log_continuous(path, n=1500, seed=2):
+    """Logged transitions on the 1-D point env from a decent behavior
+    policy (a = -0.7x + noise) for offline CQL."""
+    rng = np.random.RandomState(seed)
+    env = _PointEnv(seed=seed)
+    with JsonWriter(str(path)) as w:
+        obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+        o, _ = env.reset(seed=seed)
+        for t in range(n):
+            a = np.clip(-0.7 * o + 0.3 * rng.randn(1), -1, 1)
+            o2, r, term, trunc, _ = env.step(a)
+            obs_l.append(o); act_l.append(a.astype(np.float32))
+            rew_l.append(r); done_l.append(term); next_l.append(o2)
+            o = o2
+            if term or trunc:
+                o, _ = env.reset()
+        w.write(SampleBatch({
+            sb.OBS: np.asarray(obs_l, np.float32),
+            sb.ACTIONS: np.asarray(act_l, np.float32),
+            sb.REWARDS: np.asarray(rew_l, np.float32),
+            sb.DONES: np.asarray(done_l, bool),
+            sb.NEXT_OBS: np.asarray(next_l, np.float32)}))
+
+
+def test_cql_trains_offline(ray_start_shared, tmp_path):
+    from ray_tpu.rllib import CQL, CQLConfig
+
+    log = tmp_path / "cont.json"
+    _log_continuous(log)
+    algo = CQL(CQLConfig(input_path=str(log), hidden=(32, 32),
+                         sgd_steps_per_iter=100, lr=1e-3, seed=0))
+    stats = None
+    for _ in range(10):
+        stats = algo.train()
+    assert np.isfinite(stats["critic_loss"])
+    assert np.isfinite(stats["cql_penalty"])
+    # the learned deterministic policy pushes the point toward 0
+    obs = np.asarray([[1.5], [-1.5]], np.float32)
+    acts = algo.compute_actions(obs)
+    assert acts[0, 0] < 0 and acts[1, 0] > 0, acts
+
+
+@pytest.mark.slow
+def test_es_improves_cartpole(ray_start_shared):
+    from ray_tpu.rllib import ES, ESConfig
+
+    algo = ES(ESConfig(env="CartPole-v1", num_workers=2,
+                       population=12, sigma=0.1, lr=0.05,
+                       hidden=(16,), seed=3))
+    first = algo.train()["es_mean_fitness"]
+    best = first
+    for _ in range(12):
+        best = max(best, algo.train()["es_mean_fitness"])
+    algo.cleanup()
+    assert best > first + 20, (first, best)
