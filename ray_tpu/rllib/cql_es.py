@@ -152,8 +152,7 @@ class CQL(Algorithm):
          pol._rng) = self._update(pol.params, pol.opt_state, pol.target,
                                   stacked, pol._rng)
         out = {k: float(v) for k, v in stats.items()}
-        out["timesteps_this_iter"] = (self.config.sgd_steps_per_iter
-                                      * self.config.train_batch_size)
+        out["timesteps_this_iter"] = self._steps * self._mb
         return out
 
     def compute_actions(self, obs: np.ndarray,

@@ -118,6 +118,111 @@ def conv_out_dim(obs_shape: Sequence[int],
     return h * w * c
 
 
+def attention_init(key, dim: int, n_heads: int, context_len: int = 0):
+    if dim % n_heads:
+        raise ValueError(
+            f"attention dim {dim} must divide by n_heads {n_heads}")
+    """GTrXL-style gated causal self-attention block (reference:
+    models/torch/attention_net.py:37 GTrXLNet — transformer layers with
+    GRU-type gating for RL stability).  One block: LN → causal MHA →
+    GRU gate → LN → MLP → GRU gate."""
+    import jax
+    import jax.numpy as jnp
+
+    ks = jax.random.split(key, 8)
+    scale = np.sqrt(1.0 / dim)
+
+    def lin(k, d_in, d_out):
+        return {"w": jax.random.normal(k, (d_in, d_out)) * scale,
+                "b": jnp.zeros((d_out,))}
+
+    def gate(k):
+        # GRU-style gate params (GTrXL: g = GRU(x, y))
+        k1, k2, k3 = jax.random.split(k, 3)
+        return {"wr": jax.random.normal(k1, (2 * dim, dim)) * scale,
+                "wz": jax.random.normal(k2, (2 * dim, dim)) * scale,
+                "wh": jax.random.normal(k3, (2 * dim, dim)) * scale,
+                # bias >0 biases the gate toward identity at init —
+                # the GTrXL trick that makes RL training stable
+                "bz": jnp.full((dim,), 2.0),
+                "br": jnp.zeros((dim,))}
+
+    out = {
+        "qkv": lin(ks[0], dim, 3 * dim),
+        "proj": lin(ks[1], dim, dim),
+        "mlp1": lin(ks[2], dim, 2 * dim),
+        "mlp2": lin(ks[3], 2 * dim, dim),
+        "gate1": gate(ks[4]),
+        "gate2": gate(ks[5]),
+        "ln1": {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))},
+        "ln2": {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))},
+    }
+    if context_len:
+        # learned absolute positions over the chunk-local context:
+        # without them attention is permutation-invariant over the
+        # window and cannot express "the previous step"
+        out["pos"] = (jax.random.normal(ks[6], (context_len, dim))
+                      * scale)
+    return out
+
+
+def _ln(p, x):
+    import jax.numpy as jnp
+
+    m = x.mean(-1, keepdims=True)
+    v = x.var(-1, keepdims=True)
+    return (x - m) / jnp.sqrt(v + 1e-5) * p["g"] + p["b"]
+
+
+def _gru_gate(p, x, y):
+    """g = GRU-style gate combining residual x with sublayer output y."""
+    import jax
+    import jax.numpy as jnp
+
+    xy = jnp.concatenate([x, y], axis=-1)
+    r = jax.nn.sigmoid(xy @ p["wr"] + p["br"])
+    z = jax.nn.sigmoid(xy @ p["wz"] - p["bz"])
+    h = jnp.tanh(jnp.concatenate([r * x, y], axis=-1) @ p["wh"])
+    return (1 - z) * x + z * h
+
+
+def attention_apply(params, x, n_heads: int, mask=None):
+    """x: (B, T, dim) → (B, T, dim); causal (position t attends ≤ t).
+    ``mask``: optional extra (B, T, T) bool, ANDed with the causal mask
+    (segment cuts at episode boundaries, validity windows)."""
+    import jax
+    import jax.numpy as jnp
+
+    B, T, D = x.shape
+    hd = D // n_heads
+    if "pos" in params:
+        x = x + params["pos"][:T]
+    h = _ln(params["ln1"], x)
+    qkv = h @ params["qkv"]["w"] + params["qkv"]["b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, n_heads, hd).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)
+    scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    allow = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if mask is not None:
+        allow = allow & mask[:, None]
+    # a fully-masked row (pre-episode padding) must not NaN: keep the
+    # diagonal open
+    allow = allow | jnp.eye(T, dtype=bool)[None, None]
+    scores = jnp.where(allow, scores, -1e9)
+    att = jax.nn.softmax(scores, axis=-1) @ v      # (B, H, T, hd)
+    att = att.transpose(0, 2, 1, 3).reshape(B, T, D)
+    att = att @ params["proj"]["w"] + params["proj"]["b"]
+    x = _gru_gate(params["gate1"], x, att)
+    h2 = _ln(params["ln2"], x)
+    m = jax.nn.relu(h2 @ params["mlp1"]["w"] + params["mlp1"]["b"])
+    m = m @ params["mlp2"]["w"] + params["mlp2"]["b"]
+    return _gru_gate(params["gate2"], x, m)
+
+
 def lstm_init(key, in_dim: int, cell: int):
     import jax
     import jax.numpy as jnp

@@ -25,7 +25,8 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from ray_tpu.rllib import sample_batch as sb
-from ray_tpu.rllib.models import (Encoder, ModelConfig, lstm_init,
+from ray_tpu.rllib.models import (attention_apply, attention_init,
+                                  Encoder, ModelConfig, lstm_init,
                                   lstm_step, mlp_apply, mlp_init)
 from ray_tpu.rllib.sample_batch import SampleBatch
 
@@ -63,8 +64,15 @@ class PolicySpec:
     conv_filters: Optional[Tuple[Tuple[int, int, int], ...]] = None
     use_lstm: bool = False
     lstm_cell_size: int = 64
-    #: BPTT chunk length for recurrent training
+    #: BPTT / attention-context chunk length
     max_seq_len: int = 16
+    #: GTrXL-style gated causal self-attention over the last
+    #: max_seq_len steps (reference: attention_net.py:37 GTrXLNet).
+    #: Context is chunk-local (resets every max_seq_len steps and at
+    #: episode boundaries) so training replays rollouts EXACTLY.
+    use_attention: bool = False
+    attention_dim: int = 64
+    attention_heads: int = 4
 
     @property
     def obs_shape_(self) -> Tuple[int, ...]:
@@ -106,6 +114,8 @@ class JaxPolicy:
         key = jax.random.PRNGKey(seed)
         kp, kv, kl, kh1, kh2 = jax.random.split(key, 5)
         feat = self.encoder.feature_dim
+        if spec.use_lstm and spec.use_attention:
+            raise ValueError("use_lstm and use_attention are exclusive")
         if spec.use_lstm:
             cell = spec.lstm_cell_size
             self.params = {
@@ -113,6 +123,16 @@ class JaxPolicy:
                 "lstm": lstm_init(kl, feat, cell),
                 "pi": mlp_init(kh1, (cell, spec.n_actions)),
                 "vf": mlp_init(kh2, (cell, 1)),
+            }
+        elif spec.use_attention:
+            dim = spec.attention_dim
+            self.params = {
+                "enc": self.encoder.init(kp),
+                "att_in": mlp_init(kl, (feat, dim)),
+                "att": attention_init(kh1, dim, spec.attention_heads,
+                                      context_len=spec.max_seq_len),
+                "pi": mlp_init(kh2, (dim, spec.n_actions)),
+                "vf": mlp_init(kv, (dim, 1)),
             }
         else:
             self.params = {
@@ -131,6 +151,13 @@ class JaxPolicy:
         #: live rollout recurrent state (numpy, (N, cell) x2)
         self._state: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._eval_state: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        #: attention rollout memory: encoded-feature ring of the current
+        #: chunk, (N, L, dim); _mem_pos is chunk-global (the worker
+        #: aligns fragments to max_seq_len), _mem_start[i] marks where
+        #: env i's current episode began inside the chunk
+        self._mem: Optional[np.ndarray] = None
+        self._mem_pos = 0
+        self._mem_start: Optional[np.ndarray] = None
         self._build_fns()
 
     # -- weights ----------------------------------------------------------
@@ -150,6 +177,22 @@ class JaxPolicy:
     def is_recurrent(self) -> bool:
         return self.spec.use_lstm
 
+    @property
+    def needs_sequences(self) -> bool:
+        """Training batches must be (S, max_seq_len, ...) chunks."""
+        return self.spec.use_lstm or self.spec.use_attention
+
+    # -- attention memory -------------------------------------------------
+    def reset_memory(self, n: int) -> None:
+        """New attention chunk: the worker calls this every max_seq_len
+        steps so rollout context matches the chunk-local context
+        training recomputes."""
+        dim = self.spec.attention_dim
+        self._mem = np.zeros((n, self.spec.max_seq_len, dim),
+                             np.float32)
+        self._mem_pos = 0
+        self._mem_start = np.zeros(n, np.int64)
+
     def get_state(self, n: int) -> Tuple[np.ndarray, np.ndarray]:
         """Current rollout carry for n env copies (zero-init)."""
         cell = self.spec.lstm_cell_size
@@ -160,13 +203,19 @@ class JaxPolicy:
 
     def reset_state_where(self, done: np.ndarray) -> None:
         """Zero the carry rows of finished envs (mirrors the done-mask
-        reset inside the training scan)."""
+        reset inside the training scan); attention policies advance the
+        episode-start marker instead (mirrors the segment mask)."""
         if self._state is not None and done.any():
             self._state[0][done] = 0.0
             self._state[1][done] = 0.0
+        if self._mem_start is not None and done.any():
+            self._mem_start[done] = self._mem_pos
 
     def reset_eval_state(self) -> None:
         self._eval_state = None
+        self._eval_mem = None
+        self._eval_pos = 0
+        self._eval_start = None
 
     def reset_eval_state_where(self, done: np.ndarray) -> None:
         """Zero eval carries of finished episodes (the evaluation analog
@@ -174,6 +223,8 @@ class JaxPolicy:
         if self._eval_state is not None and done.any():
             self._eval_state[0][done] = 0.0
             self._eval_state[1][done] = 0.0
+        if getattr(self, "_eval_start", None) is not None and done.any():
+            self._eval_start[done] = self._eval_pos
 
     # -- network builders -------------------------------------------------
     def _build_fns(self):
@@ -286,6 +337,71 @@ class JaxPolicy:
                                           batch[sb.ACTIONS])
             return _ppo_objective(params, logp, entropy, vf, batch)
 
+        n_heads = spec.attention_heads
+
+        def att_features(params, obs_flat, S, L):
+            feats = enc.apply(params["enc"], obs_flat)
+            x = mlp_apply(params["att_in"], feats,
+                          final_linear=False)
+            return x.reshape(S, L, -1)
+
+        def att_step(params, mem, pos, start, obs):
+            """Shared context builder for every single-step attention
+            path (act / greedy eval / value): encode obs, write slot
+            ``pos``, attend causally over [start_i, pos], return the
+            attended feature at ``pos`` plus the updated memory."""
+            f = mlp_apply(
+                params["att_in"], enc.apply(params["enc"], obs),
+                final_linear=False)
+            mem = mem.at[:, pos].set(f)
+            L = mem.shape[1]
+            idx = jnp.arange(L)
+            valid = (idx[None, :] <= pos) & \
+                    (idx[None, :] >= start[:, None])       # (N, L)
+            mask = valid[:, None, :] & valid[:, :, None]   # (N, L, L)
+            out = attention_apply(params["att"], mem, n_heads,
+                                  mask=mask)
+            return out[:, pos], mem
+
+        @jax.jit
+        def act_att(params, mem, pos, start, obs, rng):
+            h, mem = att_step(params, mem, pos, start, obs)
+            logits = mlp_apply(params["pi"], h)
+            vf = mlp_apply(params["vf"], h)[..., 0]
+            rng, sub = jax.random.split(rng)
+            actions, logp = _sample(logits, vf, params, sub)
+            return actions, logp, vf, rng, mem
+
+        @jax.jit
+        def act_att_greedy(params, mem, pos, start, obs):
+            h, mem = att_step(params, mem, pos, start, obs)
+            actions, _ = _sample(mlp_apply(params["pi"], h), None,
+                                 params, None, greedy=True)
+            return actions, mem
+
+        def ppo_loss_att(params, batch):
+            """Attention loss over (S, L, ...) chunks: causal attention
+            with a segment mask cut at episode boundaries — the exact
+            context the rollout used (chunk-local, start-marker
+            resets)."""
+            obs = batch[sb.OBS]
+            S, L = obs.shape[0], obs.shape[1]
+            x = att_features(
+                params, obs.reshape((S * L,) + tuple(enc.obs_shape)),
+                S, L)
+            dones = batch[sb.DONES].astype(jnp.int32)
+            # segment id = number of dones BEFORE each position
+            seg = jnp.cumsum(
+                jnp.concatenate([jnp.zeros((S, 1), jnp.int32),
+                                 dones[:, :-1]], axis=1), axis=1)
+            same = seg[:, :, None] == seg[:, None, :]
+            out = attention_apply(params["att"], x, n_heads, mask=same)
+            logits = mlp_apply(params["pi"], out)
+            vf = mlp_apply(params["vf"], out)[..., 0]
+            logp, entropy = _logp_entropy(params, logits,
+                                          batch[sb.ACTIONS])
+            return _ppo_objective(params, logp, entropy, vf, batch)
+
         def ppo_loss_seq(params, batch):
             """Recurrent loss over (S, L, ...) sequence chunks: encoder
             on the flattened steps, lax.scan over time with done-masked
@@ -316,7 +432,9 @@ class JaxPolicy:
                                           batch[sb.ACTIONS])
             return _ppo_objective(params, logp, entropy, vf, batch)
 
-        loss_fn = ppo_loss_seq if spec.use_lstm else ppo_loss
+        loss_fn = (ppo_loss_seq if spec.use_lstm
+                   else ppo_loss_att if spec.use_attention
+                   else ppo_loss)
         mb = spec.minibatch_size
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -354,6 +472,11 @@ class JaxPolicy:
             return params, opt_state, last, rng
 
         @jax.jit
+        def value_att(params, mem, pos, start, obs):
+            h, _ = att_step(params, mem, pos, start, obs)
+            return mlp_apply(params["vf"], h)[..., 0]
+
+        @jax.jit
         def value_ff(params, obs):
             return mlp_apply(params["vf"]["head"],
                              enc.apply(params["vf"]["enc"], obs))[..., 0]
@@ -367,13 +490,28 @@ class JaxPolicy:
         self._act_greedy = act_greedy
         self._act_rec = act_rec
         self._act_rec_greedy = act_rec_greedy
+        self._act_att = act_att
+        self._act_att_greedy = act_att_greedy
         self._update = update
         self._loss = jax.jit(loss_fn)
         self._value_ff = value_ff
         self._value_rec = value_rec
+        self._value_att = value_att
 
     # -- inference --------------------------------------------------------
     def compute_actions(self, obs: np.ndarray):
+        if self.spec.use_attention:
+            n = obs.shape[0]
+            if self._mem is None or self._mem.shape[0] != n \
+                    or self._mem_pos >= self.spec.max_seq_len:
+                self.reset_memory(n)
+            actions, logp, vf, self._rng, mem = self._act_att(
+                self.params, self._mem, self._mem_pos,
+                self._mem_start, obs, self._rng)
+            self._mem = np.array(mem)
+            self._mem_pos += 1
+            return (np.asarray(actions), np.asarray(logp),
+                    np.asarray(vf))
         if self.spec.use_lstm:
             h, c = self.get_state(obs.shape[0])
             actions, logp, vf, self._rng, h2, c2 = self._act_rec(
@@ -391,6 +529,22 @@ class JaxPolicy:
         """Greedy/mean actions for evaluation (reference:
         explore=False in Algorithm.evaluate's policy calls)."""
         obs = np.asarray(obs, np.float32)
+        if self.spec.use_attention:
+            n = obs.shape[0]
+            L = self.spec.max_seq_len
+            if (getattr(self, "_eval_mem", None) is None
+                    or self._eval_mem.shape[0] != n
+                    or self._eval_pos >= L):
+                self._eval_mem = np.zeros(
+                    (n, L, self.spec.attention_dim), np.float32)
+                self._eval_pos = 0
+                self._eval_start = np.zeros(n, np.int64)
+            actions, mem = self._act_att_greedy(
+                self.params, self._eval_mem, self._eval_pos,
+                self._eval_start, obs)
+            self._eval_mem = np.array(mem)
+            self._eval_pos += 1
+            return np.asarray(actions)
         if self.spec.use_lstm:
             cell = self.spec.lstm_cell_size
             n = obs.shape[0]
@@ -410,6 +564,25 @@ class JaxPolicy:
         env copies' live carries pair with ``obs`` (bootstrapping a
         done subset mid-rollout)."""
         obs = np.asarray(obs, np.float32)
+        if self.spec.use_attention:
+            n = obs.shape[0]
+            L = self.spec.max_seq_len
+            if self._mem is not None and self._mem_pos < L:
+                mem, start = self._mem, self._mem_start
+                if rows is not None:
+                    mem, start = mem[rows], start[rows]
+                pos = self._mem_pos
+            else:
+                # no context yet, or the chunk just filled: the NEXT
+                # policy step sees a fresh chunk, so V(s) must be
+                # computed in that same fresh context (overwriting the
+                # last slot would silently drop obs_{T-1})
+                mem = np.zeros((n, L, self.spec.attention_dim),
+                               np.float32)
+                start = np.zeros(n, np.int64)
+                pos = 0
+            return np.asarray(self._value_att(
+                self.params, mem, pos, start, obs))
         if self.spec.use_lstm:
             n = obs.shape[0]
             if self._state is not None:

@@ -42,6 +42,9 @@ class PPOConfig(AlgorithmConfig):
     use_lstm: bool = False
     lstm_cell_size: int = 64
     max_seq_len: int = 16
+    use_attention: bool = False
+    attention_dim: int = 64
+    attention_heads: int = 4
     #: Box action spaces: diagonal-Gaussian policy (auto-detected)
     continuous: bool = False
     #: >1: the learner update runs data-parallel over this many local
@@ -67,7 +70,10 @@ class PPOConfig(AlgorithmConfig):
                        else None),
             conv_filters=self.conv_filters, use_lstm=self.use_lstm,
             lstm_cell_size=self.lstm_cell_size,
-            max_seq_len=self.max_seq_len)
+            max_seq_len=self.max_seq_len,
+            use_attention=self.use_attention,
+            attention_dim=self.attention_dim,
+            attention_heads=self.attention_heads)
 
 
 def _introspect_spaces(cfg: PPOConfig) -> None:
@@ -128,7 +134,9 @@ class PPO(Algorithm):
         steps = 0
         # recurrent batches are rows of max_seq_len-step sequences
         steps_per_row = (self.config.max_seq_len
-                         if getattr(self.config, "use_lstm", False) else 1)
+                         if getattr(self.config, "use_lstm", False)
+                         or getattr(self.config, "use_attention", False)
+                         else 1)
         while steps < self.config.train_batch_size:
             parts = self.workers.sample()
             batches.extend(parts)

@@ -53,13 +53,13 @@ class RolloutWorker:
         self.gamma = gamma
         self.lam = lam
         self.fragment = rollout_fragment_length
-        if self.policy.is_recurrent:
+        if getattr(self.policy, "needs_sequences", False):
             L = self.policy.spec.max_seq_len
             if rollout_fragment_length % L:
                 raise ValueError(
                     f"rollout_fragment_length {rollout_fragment_length} "
                     f"must be a multiple of max_seq_len {L} for "
-                    "recurrent policies")
+                    "recurrent/attention policies")
         self._raw_obs = self.venv.vector_reset(seed=seed)
         self._ep_rewards = np.zeros(self.num_envs, np.float64)
         self.episode_returns: List[float] = []
@@ -93,6 +93,7 @@ class RolloutWorker:
         logp_buf = np.zeros((T, n_env), np.float32)
         vf_buf = np.zeros((T, n_env), np.float32)
         recurrent = self.policy.is_recurrent
+        chunked = getattr(self.policy, "needs_sequences", recurrent)
         if recurrent:
             cell = self.policy.spec.lstm_cell_size
             # carry entering each step, recorded so training chunks can
@@ -132,7 +133,9 @@ class RolloutWorker:
                 self.episode_returns.extend(
                     self._ep_rewards[done].tolist())
                 self._ep_rewards[done] = 0.0
-                if recurrent:
+                if chunked:
+                    # LSTM: zero carries; attention: advance the
+                    # episode-start marker (segment mask alignment)
                     self.policy.reset_state_where(done)
             self._raw_obs = raw2
 
@@ -150,10 +153,11 @@ class RolloutWorker:
                 sb.ACTION_LOGP: logp_buf[:, i], sb.VF_PREDS: vf_buf[:, i],
                 sb.ADVANTAGES: adv, sb.VALUE_TARGETS: vt,
             }
-            if recurrent:
+            if chunked:
                 # chunk the fragment into max_seq_len sequences whose
-                # rows are (L, ...) slices; initial carries come from
-                # the recorded per-step states at each chunk start
+                # rows are (L, ...) slices; LSTM chunks also carry their
+                # recorded initial states (attention context rebuilds
+                # from obs + dones alone)
                 L = self.policy.spec.max_seq_len
                 if T % L:
                     raise ValueError(
@@ -162,9 +166,10 @@ class RolloutWorker:
                 n_chunks = T // L
                 data = {k: v.reshape((n_chunks, L) + v.shape[1:])
                         for k, v in data.items()}
-                starts = np.arange(0, T, L)
-                data[STATE_H] = sh_buf[starts, i]
-                data[STATE_C] = sc_buf[starts, i]
+                if recurrent:
+                    starts = np.arange(0, T, L)
+                    data[STATE_H] = sh_buf[starts, i]
+                    data[STATE_C] = sc_buf[starts, i]
             parts.append(SampleBatch(data))
         return SampleBatch.concat_samples(parts)
 
