@@ -5,7 +5,8 @@ learners run on TPU while rollout workers stay CPU actors")."""
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.dqn import DQN, DQNConfig, QPolicy
-from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, vtrace
+from ray_tpu.rllib.impala import (APPO, APPOConfig, IMPALA,
+                                  IMPALAConfig, vtrace)
 from ray_tpu.rllib.multi_agent import (MultiAgentEnv, MultiAgentPPO,
                                        MultiAgentPPOConfig)
 from ray_tpu.rllib.offline import (BC, BCConfig, JsonReader, JsonWriter,
@@ -30,4 +31,4 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
            "MultiAgentPPO", "MultiAgentPPOConfig", "SAC", "SACConfig",
            "SACPolicy", "TD3", "TD3Config", "TD3Policy", "DDPG",
-           "DDPGConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "ES", "ESConfig"]
+           "DDPGConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "ES", "ESConfig", "APPO", "APPOConfig"]

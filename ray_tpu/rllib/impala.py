@@ -114,6 +114,13 @@ class IMPALAPolicy:
         self.opt_state = self.tx.init(self.params)
         self._build()
 
+    def _policy_loss(self, target_logp, behaviour_logp, pg_adv):
+        """Vanilla IMPALA policy gradient on v-trace advantages;
+        APPO overrides with the clipped PPO surrogate."""
+        import jax.numpy as jnp
+
+        return -jnp.mean(target_logp * pg_adv)
+
     def _build(self):
         import jax
         import jax.numpy as jnp
@@ -143,7 +150,8 @@ class IMPALAPolicy:
                 batch["behaviour_logp"], target_logp, batch["rewards"],
                 batch["dones"], values, bootstrap, gamma=cfg.gamma,
                 rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
-            pi_loss = -jnp.mean(target_logp * pg_adv)
+            pi_loss = self._policy_loss(target_logp,
+                                        batch["behaviour_logp"], pg_adv)
             vf_loss = 0.5 * jnp.mean(jnp.square(vs - values))
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
@@ -206,6 +214,7 @@ class IMPALAPolicy:
 
 class IMPALA(Algorithm):
     _config_cls = IMPALAConfig
+    _policy_cls = IMPALAPolicy
 
     def setup(self, config: IMPALAConfig) -> None:
         import ray_tpu
@@ -221,7 +230,7 @@ class IMPALA(Algorithm):
                 f"(the fragment batch axis shards across the mesh)")
         from ray_tpu.rllib.algorithm import learner_mesh
 
-        self.policy = IMPALAPolicy(
+        self.policy = self._policy_cls(
             config, seed=config.seed,
             mesh=learner_mesh(config.learner_devices))
         spec = PolicySpec(obs_dim=config.obs_dim,
@@ -316,3 +325,28 @@ class IMPALA(Algorithm):
             except Exception:  # noqa: BLE001
                 pass
         self.workers = []
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    """APPO (reference: rllib/algorithms/appo/appo.py) — IMPALA's async
+    architecture with the PPO clipped surrogate on v-trace advantages."""
+
+    clip_param: float = 0.2
+
+
+class APPOPolicy(IMPALAPolicy):
+    def _policy_loss(self, target_logp, behaviour_logp, pg_adv):
+        import jax.numpy as jnp
+
+        ratio = jnp.exp(target_logp - behaviour_logp)
+        clip = self.cfg.clip_param
+        surr = jnp.minimum(
+            ratio * pg_adv,
+            jnp.clip(ratio, 1 - clip, 1 + clip) * pg_adv)
+        return -jnp.mean(surr)
+
+
+class APPO(IMPALA):
+    _config_cls = APPOConfig
+    _policy_cls = APPOPolicy

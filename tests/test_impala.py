@@ -141,3 +141,25 @@ def test_impala_learner_mesh_matches_single_device():
                     jax.tree.leaves(multi.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_appo_learns_cartpole(ray_start_regular):
+    """APPO: IMPALA's async pipeline with the PPO clipped surrogate on
+    v-trace advantages (reference: rllib/algorithms/appo)."""
+    from ray_tpu.rllib import APPO, APPOConfig
+
+    cfg = APPOConfig(
+        env="CartPole-v1", num_workers=2, num_envs_per_worker=2,
+        rollout_fragment_length=64, train_batch_size=512,
+        lr=5e-3, clip_param=0.2, entropy_coeff=0.01, seed=7)
+    algo = APPO(cfg)
+    try:
+        best = -np.inf
+        for _ in range(60):
+            res = algo.train()
+            best = max(best, res.get("episode_reward_mean", -np.inf))
+            if best >= 100.0:
+                break
+        assert best >= 100.0, f"APPO failed to learn: best={best}"
+    finally:
+        algo.stop()
