@@ -81,3 +81,9 @@ class RunConfig:
     #: None, Tuner attaches the default CSV/JSON/TensorBoard loggers
     #: (reference air/config.py RunConfig.callbacks + DEFAULT_LOGGERS).
     callbacks: Optional[list] = None
+    #: remote URI (kv:// / s3:// / mem://, via the Data filesystem seam)
+    #: the experiment directory syncs to — experiment state + per-trial
+    #: artifacts upload on every throttled experiment checkpoint, so a
+    #: lost head can Tuner.restore from the remote copy (reference:
+    #: tune/syncer.py SyncConfig cloud upload).
+    sync_to: Optional[str] = None

@@ -77,6 +77,11 @@ class FileSystem:
         except FileNotFoundError:
             return None
 
+    def list_tree(self, path: str) -> List[str]:
+        """Every file under ``path`` recursively (sync/restore walks).
+        Flat-keyed backends (kv/mem) already list recursively."""
+        return self.list(path)
+
 
 class LocalFileSystem(FileSystem):
     def open_input(self, path: str):
@@ -109,6 +114,14 @@ class LocalFileSystem(FileSystem):
             return os.path.getsize(path)
         except OSError:
             return None
+
+    def list_tree(self, path: str) -> List[str]:
+        if not os.path.isdir(path):
+            return [path] if os.path.exists(path) else []
+        out = []
+        for root, _dirs, files in os.walk(path):
+            out.extend(os.path.join(root, f) for f in files)
+        return sorted(out)
 
 
 class _MemFile(io.BytesIO):
@@ -284,6 +297,19 @@ class ArrowFileSystem(FileSystem):
 
         info = self._fs.get_file_info(self._op(path))
         return None if info.type == pafs.FileType.NotFound else info.size
+
+    def list_tree(self, path: str) -> List[str]:
+        from pyarrow import fs as pafs
+
+        base = self._op(path)
+        info = self._fs.get_file_info(base)
+        if info.type == pafs.FileType.File:
+            return [f"{self._scheme}://{base}"]
+        sel = pafs.FileSelector(base, recursive=True,
+                                allow_not_found=True)
+        return sorted(f"{self._scheme}://{f.path}"
+                      for f in self._fs.get_file_info(sel)
+                      if f.type == pafs.FileType.File)
 
 
 def join(base: str, *parts: str) -> str:

@@ -35,7 +35,7 @@ class TrialRunner:
                  experiment_dir: Optional[str] = None,
                  failure_config=None,
                  searcher=None, num_samples: int = 0,
-                 callbacks=None):
+                 callbacks=None, sync_to: Optional[str] = None):
         self.trainable = trainable
         self.trials = trials
         self.scheduler = scheduler or TrialScheduler()
@@ -70,6 +70,13 @@ class TrialRunner:
         from ray_tpu.tune.callback import CallbackList
 
         self.callbacks = CallbackList(callbacks or [])
+        #: remote experiment sync (reference: tune/syncer.py cloud
+        #: upload) — pushed on every throttled experiment checkpoint
+        self._syncer = None
+        if sync_to and self.experiment_dir:
+            from ray_tpu.tune.syncer import Syncer
+
+            self._syncer = Syncer(self.experiment_dir, sync_to)
 
     # -- experiment-level checkpoint/resume -------------------------------
     # (reference: trial_runner.py save/restore + Tuner.restore)
@@ -104,6 +111,29 @@ class TrialRunner:
         with open(tmp, "wb") as f:
             cloudpickle.dump(snap, f)
         os.replace(tmp, os.path.join(self.experiment_dir, _STATE_FILE))
+        if self._syncer is not None:
+            # directory-backed checkpoints live OUTSIDE the experiment
+            # dir: the remote copy cannot restore them — warn loudly
+            # rather than fail silently after a head loss
+            if not getattr(self, "_warned_dir_ckpt", False):
+                for t in self.trials:
+                    p = getattr(t.checkpoint, "_path", None)
+                    if p and not str(p).startswith(
+                            str(self.experiment_dir)):
+                        self._warned_dir_ckpt = True
+                        logger.warning(
+                            "sync_to is set but trial %s uses a "
+                            "directory checkpoint outside the "
+                            "experiment dir (%s): it will NOT be in "
+                            "the remote copy; use dict checkpoints or "
+                            "checkpoint under the experiment dir for "
+                            "full head-loss recovery", t.trial_id, p)
+                        break
+            try:
+                self._syncer.sync_up()
+            except Exception:  # noqa: BLE001 - remote hiccup: next tick
+                logger.warning("experiment sync_up failed",
+                               exc_info=True)
 
     @staticmethod
     def load_trials(experiment_dir: str) -> List[Trial]:

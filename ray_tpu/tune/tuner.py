@@ -144,7 +144,8 @@ class Tuner:
             failure_config=self.run_config.failure_config,
             searcher=self.tune_config.search_alg,
             num_samples=self.tune_config.num_samples,
-            callbacks=callbacks)
+            callbacks=callbacks,
+            sync_to=getattr(self.run_config, "sync_to", None))
         runner.run()
         return ResultGrid(runner.trials)
 
@@ -155,10 +156,29 @@ class Tuner:
         (reference: tune/tuner.py Tuner.restore + trial_runner
         save/restore).  Finished trials keep their results; calling
         .fit() re-runs only the unfinished ones, each from its last
-        checkpoint."""
+        checkpoint.  ``path`` may be a remote URI (kv:// / s3://):
+        the synced experiment downloads to local storage first —
+        head-loss recovery through RunConfig.sync_to."""
         import os
 
+        remote_uri = None
+        if "://" in path:
+            import tempfile
+
+            from ray_tpu.tune.syncer import Syncer
+
+            remote_uri = path
+            local = os.path.join(tempfile.mkdtemp(prefix="tune_restore_"),
+                                 path.rstrip("/").rsplit("/", 1)[-1])
+            os.makedirs(local, exist_ok=True)
+            Syncer.sync_down(path, local)
+            path = local
         tuner = cls(trainable, **tuner_kwargs)
+        if remote_uri and not getattr(tuner.run_config, "sync_to", None):
+            # keep syncing the RESUMED run to the same remote — without
+            # this a second head loss after restore loses all progress
+            # since the first one
+            tuner.run_config.sync_to = remote_uri
         tuner.run_config.storage_path = os.path.dirname(path) or "."
         tuner.run_config.name = os.path.basename(path)
         tuner._restored_trials = TrialRunner.load_trials(path)
