@@ -281,6 +281,250 @@ def _bwd(res, do3, *, scale, block_q, block_k, causal, interpret):
 
 
 # ---------------------------------------------------------------------------
+# Resident-kv kernels: k/v live whole-T in VMEM and the kv loop runs
+# INSIDE the kernel as a lax.fori_loop whose trip count depends on the
+# q-tile index.  This gets causal work-skipping (only ~(qi+1)/nq of the
+# score matrix is computed per q tile) without making kv a grid
+# dimension — the online-softmax scratch revisit across kv grid steps is
+# a measured ~10x cliff on this toolchain (see PERF_NOTES).  k+v at
+# bf16 T=4096 is 1 MiB of VMEM, so residency also unlocks long
+# single-chip sequences that the whole-T score tile cannot compile.
+# ---------------------------------------------------------------------------
+
+def _fwd_res_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                    bq: int, chunk: int, causal: bool, T: int):
+    qi = pl.program_id(1)
+    D = q_ref.shape[-1]
+    q = q_ref[:]                                   # (bq, D)
+    nchunks = T // chunk
+    if causal:
+        nvis = jnp.minimum((qi * bq + bq + chunk - 1) // chunk, nchunks)
+    else:
+        nvis = nchunks
+    rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, chunk), 0)
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(i * chunk, chunk), :]
+        v = v_ref[pl.ds(i * chunk, chunk), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            cols = i * chunk + lax.broadcasted_iota(jnp.int32, (bq, chunk),
+                                                    1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        return m_new, l, alpha * acc + pv
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    a0 = jnp.zeros((bq, D), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nvis, body, (m0, l0, a0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, :] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd_res(q3, k3, v3, *, scale, bq, chunk, causal, interpret):
+    BH, T, D = q3.shape
+    nq = T // bq
+    kern = functools.partial(_fwd_res_kernel, scale=scale, bq=bq,
+                             chunk=chunk, causal=causal, T=T)
+    return pl.pallas_call(
+        kern,
+        grid=(BH, nq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _bwd_dq_res_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dq_ref, *, scale: float, bq: int, chunk: int,
+                       causal: bool, T: int):
+    qi = pl.program_id(1)
+    D = q_ref.shape[-1]
+    q = q_ref[:]
+    do = do_ref[:]
+    lse = lse_ref[0, :][:, None]
+    delta = delta_ref[0, :][:, None]
+    nchunks = T // chunk
+    if causal:
+        nvis = jnp.minimum((qi * bq + bq + chunk - 1) // chunk, nchunks)
+    else:
+        nvis = nchunks
+    rows = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, chunk), 0)
+
+    def body(i, dq):
+        k = k_ref[pl.ds(i * chunk, chunk), :]
+        v = v_ref[pl.ds(i * chunk, chunk), :]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            cols = i * chunk + lax.broadcasted_iota(jnp.int32, (bq, chunk),
+                                                    1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + lax.dot_general(ds.astype(k.dtype), k,
+                                    (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, nvis, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_res_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, *, scale: float, bk: int,
+                        chunk: int, causal: bool, T: int):
+    ki = pl.program_id(1)
+    D = k_ref.shape[-1]
+    k = k_ref[:]                                   # (bk, D)
+    v = v_ref[:]
+    nchunks = T // chunk
+    start = (ki * bk) // chunk if causal else 0
+    cols = ki * bk + lax.broadcasted_iota(jnp.int32, (chunk, bk), 1)
+
+    def body(j, carry):
+        dk, dv = carry
+        qj = q_ref[pl.ds(j * chunk, chunk), :]
+        doj = do_ref[pl.ds(j * chunk, chunk), :]
+        lse = lse_ref[0, pl.ds(j * chunk, chunk)][:, None]
+        delta = delta_ref[0, pl.ds(j * chunk, chunk)][:, None]
+        s = lax.dot_general(qj, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = j * chunk + lax.broadcasted_iota(jnp.int32, (chunk, bk),
+                                                    0)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # (chunk, bk)
+        dv = dv + lax.dot_general(p.astype(doj.dtype), doj,
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(doj, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + lax.dot_general(ds.astype(qj.dtype), qj,
+                                  (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    z = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = lax.fori_loop(start, nchunks, body, (z, z))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_res(res, do3, *, scale, bq, bk, chunk, causal, interpret):
+    q3, k3, v3, o3, lse = res
+    BH, T, D = q3.shape
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]           # (BH, 1, T)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_res_kernel, scale=scale, bq=bq,
+                          chunk=chunk, causal=causal, T=T),
+        grid=(BH, T // bq),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((None, 1, bq), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_res_kernel, scale=scale, bk=bk,
+                          chunk=chunk, causal=causal, T=T),
+        grid=(BH, T // bk),
+        in_specs=[
+            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, T, D), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, T), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((None, 1, T), lambda bh, ki: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((None, bk, D), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_res(q3, k3, v3, scale, bq, bk, chunk, causal, interpret):
+    o, _ = _fwd_res(q3, k3, v3, scale=scale, bq=bq, chunk=chunk,
+                    causal=causal, interpret=interpret)
+    return o
+
+
+def _flash_res_fwd(q3, k3, v3, scale, bq, bk, chunk, causal, interpret):
+    o, lse = _fwd_res(q3, k3, v3, scale=scale, bq=bq, chunk=chunk,
+                      causal=causal, interpret=interpret)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_res_bwd(scale, bq, bk, chunk, causal, interpret, res, do3):
+    return _bwd_res(res, do3, scale=scale, bq=bq, bk=bk, chunk=chunk,
+                    causal=causal, interpret=interpret)
+
+
+_flash_res.defvjp(_flash_res_fwd, _flash_res_bwd)
+
+
+RESIDENT_BLOCK_Q = 256
+RESIDENT_CHUNK = 512
+
+
+def _resident_plan(T: int, causal: bool):
+    """Pick the resident-kv configuration for seq length T, or None when
+    the classic grid kernels should run instead.  Measured v5e policy:
+    at T=1024 resident+causal-skip beats the whole-T tile (6.1ms vs
+    7.5ms fwd at B=24 H=12); at T=2048 the whole-T tile's bigger MXU
+    tiles win, so the classic path keeps it; past T=2048 the whole-T
+    score tile no longer compiles (scoped-vmem OOM at (1024, 4096)) and
+    resident kv is what makes long single-chip sequences viable at all.
+    Returns (bq, bk, chunk) or None."""
+    if not causal:
+        return None                 # no skip to win; classic path
+    if T == 2048:
+        return None                 # whole-T tile measured faster
+    if T % RESIDENT_CHUNK or T % RESIDENT_BLOCK_Q:
+        return None
+    return RESIDENT_BLOCK_Q, RESIDENT_BLOCK_Q, RESIDENT_CHUNK
+
+
+# ---------------------------------------------------------------------------
 # Public API with custom VJP
 # ---------------------------------------------------------------------------
 
@@ -333,15 +577,39 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_k: Optional[int] = None,
                     block_q_bwd: Optional[int] = None,
                     block_k_bwd: Optional[int] = None,
+                    resident_kv: Optional[bool] = None,
                     interpret: bool = False) -> jnp.ndarray:
     """Flash attention on (B, T, H, D) tensors.  Differentiable; VMEM use
     is O(block), HBM use O(T); causal masking skips ~half the tiles.
     Defaults (None) come from auto_blocks(T) — the measured v5e policy;
     explicitly set forward blocks also govern the backward unless
     backward blocks are set too (an explicit VMEM-budget tuning governs
-    both passes)."""
+    both passes).
+
+    resident_kv: True = whole-T k/v resident in VMEM with an in-kernel
+    causal-early-stop kv loop (skips ~(1 - (qi+1)/nq) of the score work
+    per q tile); False = classic grid kernels; None = measured auto
+    policy (_resident_plan).  Explicit block settings imply the classic
+    path unless resident_kv=True."""
     B, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    def to3(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    if resident_kv is None:
+        # any explicit block tuning (fwd or bwd) pins the classic path
+        resident_kv = (block_q is None and block_k is None
+                       and block_q_bwd is None and block_k_bwd is None
+                       and _resident_plan(T, causal) is not None)
+    if resident_kv:
+        bq_r, bk_r, chunk = _resident_plan(T, causal) or (
+            _blocks(T, RESIDENT_BLOCK_Q), _blocks(T, RESIDENT_BLOCK_Q),
+            _blocks(T, RESIDENT_CHUNK))
+        o3 = _flash_res(to3(q), to3(k), to3(v), scale, bq_r, bk_r,
+                        chunk, causal, interpret)
+        return o3.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
     auto_q, auto_k, auto_qb, auto_kb = auto_blocks(T)
     if block_q is None and block_k is None:
         block_q, block_k = auto_q, auto_k
@@ -356,9 +624,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
             block_q_bwd = block_q
         if block_k_bwd is None:
             block_k_bwd = block_k
-
-    def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
     o3 = _flash(to3(q), to3(k), to3(v), scale, block_q, block_k, causal,
                 interpret, block_q_bwd, block_k_bwd)

@@ -63,6 +63,58 @@ def test_flash_gradients_match_reference(causal):
                                    rtol=1e-4, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_resident_kv_forward_matches_reference(causal):
+    # whole-kv-resident kernel with the in-kernel causal-early-stop loop
+    q, k, v = _rand_qkv(jax.random.PRNGKey(4), 2, 256, 2, 64)
+    got = flash_attention(q, k, v, causal=causal, resident_kv=True,
+                          interpret=True)
+    want = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_resident_kv_gradients_match_reference(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(5), 1, 256, 2, 32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, resident_kv=True,
+                            interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
+def test_resident_kv_multi_chunk_gradients():
+    # T large enough that bq=256/chunk=512 runs multiple loop trips with
+    # a qi-dependent bound — exercises the dynamic-trip-count path.
+    q, k, v = _rand_qkv(jax.random.PRNGKey(6), 1, 1024, 1, 32)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True, resident_kv=True,
+                            interpret=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = reference_attention(q, k, v, causal=True)
+        return jnp.sum(o * o)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"d{name}")
+
+
 def test_flash_bf16_close_to_f32_reference():
     q, k, v = _rand_qkv(jax.random.PRNGKey(3), 1, 128, 2, 64, jnp.bfloat16)
     got = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
