@@ -17,6 +17,13 @@ from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.td3 import (DDPG, DDPGConfig, TD3, TD3Config,
                               TD3Policy)
 from ray_tpu.rllib.cql_es import CQL, CQLConfig, ES, ESConfig
+from ray_tpu.rllib.ars import ARS, ARSConfig
+from ray_tpu.rllib.bandit import (LinTS, LinTSConfig, LinUCB,
+                                  LinUCBConfig)
+from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig, SimpleQ,
+                                        SimpleQConfig)
+from ray_tpu.rllib.pg import (A2C, A2CConfig, A3C, A3CConfig, PG,
+                              PGConfig)
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -31,4 +38,8 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "JsonWriter", "BC", "BCConfig", "MultiAgentEnv",
            "MultiAgentPPO", "MultiAgentPPOConfig", "SAC", "SACConfig",
            "SACPolicy", "TD3", "TD3Config", "TD3Policy", "DDPG",
-           "DDPGConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "ES", "ESConfig", "APPO", "APPOConfig"]
+           "DDPGConfig", "MARWIL", "MARWILConfig", "CQL", "CQLConfig",
+           "ES", "ESConfig", "APPO", "APPOConfig", "ARS", "ARSConfig",
+           "PG", "PGConfig", "A2C", "A2CConfig", "A3C", "A3CConfig",
+           "SimpleQ", "SimpleQConfig", "ApexDQN", "ApexDQNConfig",
+           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig"]

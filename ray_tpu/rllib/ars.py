@@ -1,0 +1,76 @@
+"""ARS — Augmented Random Search.
+
+Reference analog: rllib/algorithms/ars/ars.py (Mania et al. 2018): like
+ES, mirrored random directions are evaluated in parallel on rollout
+actors, but the update (1) keeps only the top-k directions by
+max(f+, f-), (2) weights them by the RAW reward difference f+ - f-
+(no rank normalization), and (3) scales the step by the standard
+deviation of the rewards actually used — the three "augmentations" over
+basic random search.  The canonical ARS policy is linear
+(hidden=()).
+
+Shares the ES evaluation actors (_ESWorker) — the two algorithms differ
+only in the update rule, which is a few lines of numpy on the fitness
+vector.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.cql_es import ES, ESConfig
+
+
+@dataclasses.dataclass
+class ARSConfig(ESConfig):
+    #: canonical ARS trains a LINEAR policy
+    hidden: tuple = ()
+    #: directions sampled per iteration
+    population: int = 16
+    #: directions kept for the update (top by max(f+, f-));
+    #: 0 or >= population keeps all
+    top_k: int = 8
+    sigma: float = 0.05
+    lr: float = 0.02
+
+
+class ARS(ES):
+    _config_cls = ARSConfig
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        seeds = [int(s) for s in
+                 self._rng.randint(0, 2**31 - 1, size=c.population)]
+        theta_ref = ray_tpu.put(self.theta)
+        shards = np.array_split(seeds, len(self.workers))
+        results = ray_tpu.get(
+            [w.evaluate.remote(theta_ref, [int(s) for s in shard])
+             for w, shard in zip(self.workers, shards)], timeout=600)
+        triples = [p for part in results for p in part]
+        env_steps = sum(t[2] for t in triples)
+        f_plus = np.asarray([t[0] for t in triples], np.float64)
+        f_minus = np.asarray([t[1] for t in triples], np.float64)
+
+        # augmentation 1: top-k directions by best-of-pair reward
+        k = c.top_k if 0 < c.top_k < len(seeds) else len(seeds)
+        order = np.argsort(-np.maximum(f_plus, f_minus))[:k]
+        # augmentation 2: raw reward differences as weights
+        # augmentation 3: step scaled by the std of the rewards used
+        used = np.concatenate([f_plus[order], f_minus[order]])
+        sigma_r = max(float(used.std()), 1e-8)
+        grad = np.zeros_like(self.theta)
+        for j in order:
+            eps = np.random.RandomState(seeds[j]).standard_normal(
+                self.theta.shape)
+            grad += (f_plus[j] - f_minus[j]) * eps
+        self.theta = self.theta + c.lr / (k * sigma_r) * grad
+
+        fits = np.concatenate([f_plus, f_minus])
+        self._episode_returns.extend(float(f) for f in fits)
+        return {"ars_mean_fitness": float(np.mean(fits)),
+                "ars_sigma_r": sigma_r,
+                "timesteps_this_iter": env_steps}

@@ -321,6 +321,36 @@ class DQN(Algorithm):
         return c.epsilon_initial + frac * (c.epsilon_final -
                                            c.epsilon_initial)
 
+    def _replay_learn_round(self) -> Optional[float]:
+        """One learner round off the replay buffer: train_intensity
+        jitted TD steps, priority feedback, scheduled target sync.
+        Returns the mean loss, or None while the buffer is warming up.
+        Shared by sync DQN and the async variants (ApexDQN)."""
+        c = self.config
+        if len(self.buffer) < max(c.learning_starts,
+                                  c.train_batch_size):
+            return None
+        minis, idx_w = [], []
+        for _ in range(c.train_intensity):
+            if isinstance(self.buffer, PrioritizedReplayBuffer):
+                mini, idx, w = self.buffer.sample(c.train_batch_size)
+                mini["is_weights"] = w
+                idx_w.append(idx)
+            else:
+                mini = self.buffer.sample(c.train_batch_size)
+            minis.append(mini)
+        loss, tds = self.policy.learn_on_minibatches(minis)
+        if idx_w:
+            # feed back every step's TD errors (tds rows align with
+            # the sampled minibatches in order)
+            for idx, td in zip(idx_w, tds):
+                self.buffer.update_priorities(idx, td)
+        if (self._env_steps - self._last_target_sync
+                >= c.target_update_freq):
+            self.policy.sync_target()
+            self._last_target_sync = self._env_steps
+        return loss
+
     def training_step(self) -> Dict[str, Any]:
         c = self.config
         eps = self._epsilon()
@@ -334,28 +364,9 @@ class DQN(Algorithm):
                                  "buffer_size": len(self.buffer),
                                  "timesteps_this_iter":
                                      sum(p.count for p in parts)}
-        if len(self.buffer) >= max(c.learning_starts,
-                                   c.train_batch_size):
-            minis, idx_w = [], []
-            for _ in range(c.train_intensity):
-                if isinstance(self.buffer, PrioritizedReplayBuffer):
-                    mini, idx, w = self.buffer.sample(c.train_batch_size)
-                    mini["is_weights"] = w
-                    idx_w.append(idx)
-                else:
-                    mini = self.buffer.sample(c.train_batch_size)
-                minis.append(mini)
-            loss, tds = self.policy.learn_on_minibatches(minis)
+        loss = self._replay_learn_round()
+        if loss is not None:
             stats["loss"] = loss
-            if idx_w:
-                # feed back every step's TD errors (tds rows align with
-                # the sampled minibatches in order)
-                for idx, td in zip(idx_w, tds):
-                    self.buffer.update_priorities(idx, td)
-            if (self._env_steps - self._last_target_sync
-                    >= c.target_update_freq):
-                self.policy.sync_target()
-                self._last_target_sync = self._env_steps
             weights = self.policy.get_weights()
             ref = ray_tpu.put(weights)
             ray_tpu.get([w.set_weights.remote(ref) for w in self.workers],

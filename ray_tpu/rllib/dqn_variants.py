@@ -1,0 +1,113 @@
+"""DQN family variants: SimpleQ and Ape-X DQN.
+
+Reference analogs: rllib/algorithms/simple_q (the pedagogical baseline —
+no double-Q, no prioritized replay) and rllib/algorithms/apex_dqn
+(distributed prioritized experience replay: many exploration actors on
+a per-actor epsilon ladder feed a prioritized buffer while the learner
+updates continuously and pushes weights back asynchronously).
+
+TPU-first shape: the learner update stays the one jitted TD scan of
+QPolicy; Ape-X's contribution is pure task-layer asynchrony —
+`ray_tpu.wait` keeps every exploration actor's next fragment in flight
+while the learner trains, so chip utilization does not gate on rollout
+round-trips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.dqn import DQN, DQNConfig, TransitionWorker
+from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
+
+
+@dataclasses.dataclass
+class SimpleQConfig(DQNConfig):
+    """Reference rllib/algorithms/simple_q/simple_q.py: vanilla
+    Q-learning — single estimator, uniform replay."""
+    double_q: bool = False
+    prioritized_replay: bool = False
+
+
+class SimpleQ(DQN):
+    _config_cls = SimpleQConfig
+
+
+@dataclasses.dataclass
+class ApexDQNConfig(DQNConfig):
+    """Reference rllib/algorithms/apex_dqn/apex_dqn.py."""
+    prioritized_replay: bool = True
+    num_workers: int = 2
+    #: Ape-X epsilon ladder: worker i explores at
+    #: base ** (1 + i/(N-1) * exponent) — a fixed spread of exploration
+    #: rates instead of a global decay schedule.
+    epsilon_base: float = 0.4
+    epsilon_exponent: float = 7.0
+    #: SGD rounds applied per training_step (each round consumes
+    #: whichever worker fragment lands first)
+    updates_per_iter: int = 4
+
+
+class ApexDQN(DQN):
+    """Distributed prioritized DQN.  Differences from sync DQN, per the
+    reference design: (1) per-worker FIXED epsilons on the Ape-X ladder,
+    (2) fragments are consumed as they arrive — every worker always has
+    a sample task in flight, (3) weights are pushed back only to the
+    worker whose fragment was just consumed (the others keep acting on
+    slightly stale weights), (4) prioritized replay is mandatory and
+    every learner round feeds TD errors back as fresh priorities."""
+
+    _config_cls = ApexDQNConfig
+
+    def setup(self, config: ApexDQNConfig) -> None:
+        if not config.prioritized_replay:
+            raise ValueError("ApexDQN requires prioritized_replay=True")
+        super().setup(config)
+        n = max(1, len(self.workers))
+        self._worker_eps = [
+            float(config.epsilon_base
+                  ** (1.0 + (i / max(1, n - 1)) *
+                      config.epsilon_exponent))
+            for i in range(n)]
+        self._inflight = {
+            w.sample.remote(self._worker_eps[i]): (w, i)
+            for i, w in enumerate(self.workers)}
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        stats: Dict[str, Any] = {"buffer_size": len(self.buffer)}
+        steps = 0
+        losses = []
+        for _ in range(c.updates_per_iter):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=300.0)
+            if not ready:
+                raise TimeoutError("no rollout arrived within 300s")
+            ref = ready[0]
+            worker, wid = self._inflight.pop(ref)
+            part = ray_tpu.get(ref)
+            self.buffer.add(part)
+            self._env_steps += part.count
+            steps += part.count
+
+            loss = self._replay_learn_round()
+            if loss is not None:
+                losses.append(loss)
+                worker.set_weights.remote(
+                    ray_tpu.put(self.policy.get_weights()))
+            self._inflight[worker.sample.remote(
+                self._worker_eps[wid])] = (worker, wid)
+
+        if losses:
+            stats["loss"] = float(np.mean(losses))
+        stats["timesteps_this_iter"] = steps
+        stats["epsilons"] = list(self._worker_eps)
+        returns = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in returns for r in p)
+        return stats

@@ -22,6 +22,14 @@ from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
 
 
+def standardize_advantages(batch: SampleBatch) -> None:
+    """In-place zero-mean/unit-std advantages (reference ppo.py
+    standardize_fields) — shared by PPO._prepare_batch and A3C."""
+    adv = batch[sb.ADVANTAGES]
+    batch[sb.ADVANTAGES] = ((adv - adv.mean()) /
+                            max(adv.std(), 1e-6)).astype(np.float32)
+
+
 @dataclasses.dataclass
 class PPOConfig(AlgorithmConfig):
     clip_param: float = 0.2
@@ -129,6 +137,12 @@ class PPO(Algorithm):
             observation_filter=config.observation_filter)
         self.workers.sync_weights(self.learner_policy.get_weights())
 
+    def _prepare_batch(self, batch: SampleBatch) -> None:
+        """In-place batch prep before the learner update.  PPO
+        standardizes advantages (reference ppo.py standardize_fields);
+        variants (PG) override."""
+        standardize_advantages(batch)
+
     def training_step(self) -> Dict[str, Any]:
         batches = []
         steps = 0
@@ -142,11 +156,7 @@ class PPO(Algorithm):
             batches.extend(parts)
             steps += sum(b.count for b in parts) * steps_per_row
         batch = SampleBatch.concat_samples(batches)
-
-        # standardize advantages (reference ppo.py standardize_fields)
-        adv = batch[sb.ADVANTAGES]
-        batch[sb.ADVANTAGES] = ((adv - adv.mean()) /
-                                max(adv.std(), 1e-6)).astype(np.float32)
+        self._prepare_batch(batch)
 
         stats = self.learner_policy.learn_on_batch(batch)
         self.workers.sync_weights(self.learner_policy.get_weights())
