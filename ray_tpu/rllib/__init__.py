@@ -22,8 +22,13 @@ from ray_tpu.rllib.bandit import (LinTS, LinTSConfig, LinUCB,
                                   LinUCBConfig)
 from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig, SimpleQ,
                                         SimpleQConfig)
+from ray_tpu.rllib.crr import CRR, CRRConfig
+from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
+from ray_tpu.rllib.qmix import QMIX, QMIXConfig, QMIXPolicy
 from ray_tpu.rllib.pg import (A2C, A2CConfig, A3C, A3CConfig, PG,
                               PGConfig)
+from ray_tpu.rllib.r2d2 import R2D2, R2D2Config, R2D2Policy
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -42,4 +47,7 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "ES", "ESConfig", "APPO", "APPOConfig", "ARS", "ARSConfig",
            "PG", "PGConfig", "A2C", "A2CConfig", "A3C", "A3CConfig",
            "SimpleQ", "SimpleQConfig", "ApexDQN", "ApexDQNConfig",
-           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig"]
+           "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
+           "CRR", "CRRConfig", "R2D2", "R2D2Config", "R2D2Policy",
+           "QMIX", "QMIXConfig", "QMIXPolicy", "MADDPG",
+           "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig"]

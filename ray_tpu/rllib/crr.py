@@ -1,0 +1,177 @@
+"""CRR — Critic-Regularized Regression (offline continuous control).
+
+Reference analog: rllib/algorithms/crr (Wang et al. 2020): learn a
+critic by ordinary TD on the logged transitions, and train the actor by
+ADVANTAGE-WEIGHTED behavior cloning — maximize ``w(s,a)·log π(a|s)``
+over the DATA actions with
+``w = 1[A(s,a) > 0]`` ("bin") or ``w = exp(A(s,a)/β)`` ("exp"),
+``A(s,a) = Q(s,a) − (1/m) Σ_j Q(s, a_j),  a_j ~ π(·|s)`` — so the
+policy only imitates actions its own critic scores above the policy's
+current behavior, never evaluating Q on out-of-distribution actions the
+way a deterministic-gradient actor would.
+
+TPU-first shape: rides the SAC learner exactly like CQL — the CRR loss
+wraps SACPolicy's twin-critic machinery via the `_make_update` factory,
+the dataset lives device-resident, and each train() is one jitted scan
+of minibatch steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.offline import JsonReader
+from ray_tpu.rllib.policy import _net_apply
+from ray_tpu.rllib.sac import SACPolicy, SACSpec
+
+
+@dataclasses.dataclass
+class CRRConfig(AlgorithmConfig):
+    input_path: str = ""
+    hidden: Tuple[int, ...] = (128, 128)
+    train_batch_size: int = 128
+    sgd_steps_per_iter: int = 50
+    tau: float = 0.005
+    #: "bin" = indicator weights, "exp" = exponential weights
+    weight_mode: str = "bin"
+    #: temperature for exp weights
+    beta: float = 1.0
+    #: cap on exp weights (reference: ratio clipping)
+    max_weight: float = 20.0
+    #: policy action samples per state for the advantage baseline
+    n_action_samples: int = 4
+    obs_dim: Optional[int] = None
+    action_dim: Optional[int] = None
+
+
+class CRR(Algorithm):
+    _config_cls = CRRConfig
+
+    def setup(self, config: CRRConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if config.weight_mode not in ("bin", "exp"):
+            raise ValueError("weight_mode must be 'bin' or 'exp'")
+        data = JsonReader(config.input_path).read_all()
+        for key in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES,
+                    sb.NEXT_OBS):
+            if key not in data:
+                raise ValueError(f"CRR offline data needs {key!r}")
+        if config.obs_dim is None:
+            config.obs_dim = int(np.prod(data[sb.OBS].shape[1:]))
+        if config.action_dim is None:
+            config.action_dim = int(np.prod(data[sb.ACTIONS].shape[1:]))
+        spec = SACSpec(obs_dim=config.obs_dim,
+                       action_dim=config.action_dim,
+                       hidden=tuple(config.hidden), actor_lr=config.lr,
+                       critic_lr=config.lr, gamma=config.gamma,
+                       tau=config.tau)
+        self.policy = SACPolicy(spec, seed=config.seed)
+        self._data = {k: jnp.asarray(np.asarray(data[k], np.float32))
+                      for k in (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                sb.NEXT_OBS)}
+        self._data[sb.DONES] = jnp.asarray(
+            np.asarray(data[sb.DONES], bool))
+        n = len(data[sb.ACTIONS])
+        mb = min(config.train_batch_size, n)
+        pol = self.policy
+        act_dim = config.action_dim
+        m = config.n_action_samples
+        mode = config.weight_mode
+        beta = config.beta
+        w_max = config.max_weight
+        gamma = config.gamma
+
+        def q_val(net, obs, act):
+            return _net_apply(net, jnp.concatenate([obs, act],
+                                                   axis=-1))[..., 0]
+
+        def data_logp(params, obs, act):
+            """log π(a_data|s) for the tanh-squashed Gaussian — invert
+            the squash, then the same change-of-variables density the
+            sampler uses."""
+            out = _net_apply(params["actor"], obs)
+            mean, log_std = out[..., :act_dim], out[..., act_dim:]
+            log_std = jnp.clip(log_std, -10.0, 2.0)
+            a = jnp.clip(act, -1.0 + 1e-6, 1.0 - 1e-6)
+            pre = jnp.arctanh(a)
+            std = jnp.exp(log_std)
+            return jnp.sum(
+                -0.5 * jnp.square((pre - mean) / std) - log_std
+                - 0.5 * jnp.log(2 * jnp.pi)
+                - jnp.log(1 - jnp.square(a) + 1e-6), axis=-1)
+
+        def crr_loss(params, target, mini, key):
+            k1, k2 = jax.random.split(key)
+            obs = mini[sb.OBS]
+            act = mini[sb.ACTIONS]
+            # --- critic: plain TD toward min twin target Q at the
+            # policy's next action (no entropy term — CRR's critic is
+            # standard expected-SARSA-style, not max-entropy)
+            a2, _ = pol._sample_action(params, mini[sb.NEXT_OBS], k1)
+            a2 = jax.lax.stop_gradient(a2)
+            tq = jnp.minimum(
+                q_val(target["q1"], mini[sb.NEXT_OBS], a2),
+                q_val(target["q2"], mini[sb.NEXT_OBS], a2))
+            nonterminal = 1.0 - mini[sb.DONES].astype(jnp.float32)
+            backup = jax.lax.stop_gradient(
+                mini[sb.REWARDS] + gamma * nonterminal * tq)
+            q1 = q_val(params["q1"], obs, act)
+            q2 = q_val(params["q2"], obs, act)
+            critic_loss = jnp.mean(jnp.square(q1 - backup)
+                                   + jnp.square(q2 - backup))
+            # --- advantage of the DATA action over the policy's own
+            B = obs.shape[0]
+            keys = jax.random.split(k2, m)
+            samples = jnp.stack([
+                jax.lax.stop_gradient(
+                    pol._sample_action(params, obs, kk)[0])
+                for kk in keys])                       # (m, B, act)
+            obs_t = jnp.broadcast_to(obs, (m,) + obs.shape)
+            q_pi = q_val(params["q1"],
+                         obs_t.reshape(-1, obs.shape[-1]),
+                         samples.reshape(-1, act_dim)).reshape(m, B)
+            adv = jax.lax.stop_gradient(q1 - jnp.mean(q_pi, axis=0))
+            if mode == "bin":
+                w = (adv > 0).astype(jnp.float32)
+            else:
+                w = jnp.minimum(jnp.exp(adv / beta), w_max)
+            # --- actor: weighted behavior cloning of the data action
+            actor_loss = -jnp.mean(w * data_logp(params, obs, act))
+            return critic_loss + actor_loss, {
+                "critic_loss": critic_loss, "actor_loss": actor_loss,
+                "mean_weight": jnp.mean(w)}
+
+        self._update = pol._make_update(crr_loss)
+        self._mb = mb
+        self._n = n
+        self._steps = config.sgd_steps_per_iter
+        self._idx_rng = np.random.RandomState(config.seed + 5)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        pol = self.policy
+        idx = self._idx_rng.randint(0, self._n,
+                                    size=(self._steps, self._mb))
+        stacked = {k: v[jnp.asarray(idx)]
+                   for k, v in self._data.items()}
+        (pol.params, pol.opt_state, pol.target, stats,
+         pol._rng) = self._update(pol.params, pol.opt_state, pol.target,
+                                  stacked, pol._rng)
+        out = {k: float(v) for k, v in stats.items()}
+        out["timesteps_this_iter"] = self._steps * self._mb
+        return out
+
+    def compute_actions(self, obs: np.ndarray,
+                        deterministic: bool = True) -> np.ndarray:
+        return self.policy.compute_actions(obs, deterministic)
+
+    def cleanup(self) -> None:
+        pass

@@ -249,6 +249,14 @@ class TransitionWorker:
         return out
 
 
+def linear_epsilon(env_steps: int, cfg) -> float:
+    """The linear exploration schedule shared by DQN/R2D2/QMIX: decay
+    epsilon_initial → epsilon_final over epsilon_decay_steps."""
+    frac = min(1.0, env_steps / max(1, cfg.epsilon_decay_steps))
+    return cfg.epsilon_initial + frac * (cfg.epsilon_final -
+                                         cfg.epsilon_initial)
+
+
 @dataclasses.dataclass
 class DQNConfig(AlgorithmConfig):
     hidden: Tuple[int, ...] = (64, 64)
@@ -316,10 +324,7 @@ class DQN(Algorithm):
         self._last_target_sync = 0
 
     def _epsilon(self) -> float:
-        c = self.config
-        frac = min(1.0, self._env_steps / max(1, c.epsilon_decay_steps))
-        return c.epsilon_initial + frac * (c.epsilon_final -
-                                           c.epsilon_initial)
+        return linear_epsilon(self._env_steps, self.config)
 
     def _replay_learn_round(self) -> Optional[float]:
         """One learner round off the replay buffer: train_intensity

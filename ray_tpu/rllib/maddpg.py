@@ -1,0 +1,346 @@
+"""MADDPG — multi-agent DDPG with centralized critics.
+
+Reference analog: rllib/algorithms/maddpg (Lowe et al. 2017): each
+agent keeps a deterministic actor over its OWN observation, but its
+critic scores the JOINT observation-action vector — centralized
+training, decentralized execution.  Critic targets use every agent's
+target actor; each actor ascends its own critic with the other agents'
+actions held at the logged data (the standard MADDPG actor update).
+
+TPU-first shape: per-agent parameters are STACKED pytrees with a
+leading agent axis and every per-agent net evaluation is a `jax.vmap`
+over that axis — one compiled update covers all agents, no Python loop
+over agent ids inside the learner.  Actions live in [-1, 1]
+(worker-side rescaling, as in SAC/TD3 here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+from ray_tpu.rllib.multi_agent import MultiAgentEnv
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass
+class MADDPGSpec:
+    obs_dim: int                  # per-agent
+    act_dim: int                  # per-agent
+    n_agents: int
+    hidden: Tuple[int, ...] = (64, 64)
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    gamma: float = 0.95
+    tau: float = 0.01
+
+
+def _stack_init(key, n: int, dims: Tuple[int, ...]):
+    import jax
+
+    keys = jax.random.split(key, n)
+    inits = [mlp_init(k, dims) for k in keys]
+    return jax.tree.map(lambda *xs: np.stack(xs), *inits)
+
+
+class MADDPGPolicy:
+    def __init__(self, spec: MADDPGSpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        ka, kc = jax.random.split(jax.random.PRNGKey(seed))
+        n = spec.n_agents
+        joint = n * (spec.obs_dim + spec.act_dim)
+        self.params = {
+            "actor": _stack_init(ka, n, (spec.obs_dim, *spec.hidden,
+                                         spec.act_dim)),
+            "critic": _stack_init(kc, n, (joint, *spec.hidden, 1)),
+        }
+        self.target = jax.tree.map(np.copy, self.params)
+        self.tx = optax.multi_transform(
+            {"actor": optax.adam(spec.actor_lr),
+             "critic": optax.adam(spec.critic_lr)},
+            {"actor": "actor", "critic": "critic"})
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        n = spec.n_agents
+
+        def actor_one(ap, o):
+            return jnp.tanh(mlp_apply(ap, o, final_linear=True))
+
+        #: (stacked actors, (B, n, obs)) → (B, n, act)
+        actors = jax.vmap(actor_one, in_axes=(0, 1), out_axes=1)
+
+        def critic_one(cp, x):
+            return mlp_apply(cp, x, final_linear=True)[..., 0]
+
+        @jax.jit
+        def act(params, obs, key, noise_scale):
+            """(n, obs_dim) → (n, act_dim) with exploration noise."""
+            a = actors(params["actor"], obs[None])[0]
+            a = a + noise_scale * jax.random.normal(key, a.shape)
+            return jnp.clip(a, -1.0, 1.0)
+
+        def loss_fn(params, target, mini):
+            obs = mini[sb.OBS]                       # (B, n, obs)
+            acts = mini[sb.ACTIONS]                  # (B, n, act)
+            rew = mini[sb.REWARDS]                   # (B, n)
+            done = mini[sb.DONES].astype(jnp.float32)  # (B,)
+            nxt = mini[sb.NEXT_OBS]
+            B = obs.shape[0]
+            # --- critics: TD against all-target-actor joint action
+            a_next = actors(target["actor"], nxt)    # (B, n, act)
+            x_next = jnp.concatenate(
+                [nxt.reshape(B, -1), a_next.reshape(B, -1)], axis=-1)
+            q_next = jax.vmap(critic_one, in_axes=(0, None),
+                              out_axes=1)(target["critic"], x_next)
+            y = jax.lax.stop_gradient(
+                rew + spec.gamma * (1.0 - done)[:, None] * q_next)
+            x_data = jnp.concatenate(
+                [obs.reshape(B, -1), acts.reshape(B, -1)], axis=-1)
+            q = jax.vmap(critic_one, in_axes=(0, None),
+                         out_axes=1)(params["critic"], x_data)
+            critic_loss = jnp.mean(jnp.square(q - y))
+            # --- actors: ascend own critic; others' actions stay at
+            # the data (reference MADDPG actor update)
+            a_pi = actors(params["actor"], obs)      # (B, n, act)
+            eye = jnp.eye(n)[None, :, :, None]       # (1, i, j, 1)
+            joint = (acts[:, None, :, :] * (1.0 - eye)
+                     + a_pi[:, :, None, :] * eye)    # (B, i, j, act)
+            x_pi = jnp.concatenate(
+                [jnp.broadcast_to(obs.reshape(B, 1, -1),
+                                  (B, n, n * spec.obs_dim)),
+                 joint.reshape(B, n, -1)], axis=-1)  # (B, i, feat)
+            frozen = jax.lax.stop_gradient(params["critic"])
+            q_pi = jax.vmap(critic_one, in_axes=(0, 1),
+                            out_axes=1)(frozen, x_pi)  # (B, n)
+            actor_loss = -jnp.mean(q_pi)
+            return critic_loss + actor_loss, (critic_loss, actor_loss)
+
+        @jax.jit
+        def update(params, opt_state, target, stacked):
+            import optax
+
+            def step(carry, mini):
+                params, opt_state, target = carry
+                (_, (cl, al)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, target, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                target = jax.tree.map(
+                    lambda t, p: t * (1 - spec.tau) + p * spec.tau,
+                    target, params)
+                return (params, opt_state, target), (cl, al)
+
+            (params, opt_state, target), (cls, als) = jax.lax.scan(
+                step, (params, opt_state, target), stacked)
+            return (params, opt_state, target, jnp.mean(cls),
+                    jnp.mean(als))
+
+        self._act = act
+        self._update = update
+
+    def compute_actions(self, obs: np.ndarray, noise: float = 0.0
+                        ) -> np.ndarray:
+        import jax
+
+        self._rng = getattr(self, "_rng", jax.random.PRNGKey(0))
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(self._act(self.params, obs, key, noise))
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]
+                             ) -> Tuple[float, float]:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([np.asarray(m[k]) for m in minis])
+                   for k in minis[0].keys()}
+        (self.params, self.opt_state, self.target, cl,
+         al) = self._update(self.params, self.opt_state, self.target,
+                            stacked)
+        return float(cl), float(al)
+
+
+class MADDPGWorker:
+    """Steps a synchronized continuous MultiAgentEnv with the stacked
+    actors + Gaussian exploration noise."""
+
+    def __init__(self, *, env_creator, env_config: Optional[Dict],
+                 spec: MADDPGSpec, agent_ids: List[str],
+                 steps_per_sample: int = 200, noise: float = 0.1,
+                 seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env: MultiAgentEnv = env_creator(env_config or {})
+        self.spec = spec
+        self.agent_ids = list(agent_ids)
+        self.policy = MADDPGPolicy(spec, seed=seed)
+        self.steps = steps_per_sample
+        self.noise = noise
+        self._rng = np.random.RandomState(seed)
+        import jax
+
+        self._key = jax.random.PRNGKey(seed + 13)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def _stack(self, obs_dict) -> np.ndarray:
+        return np.stack([np.asarray(obs_dict[a], np.float32).ravel()
+                         for a in self.agent_ids])
+
+    def sample(self) -> SampleBatch:
+        import jax
+
+        rows: Dict[str, list] = {k: [] for k in
+                                 (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                  sb.DONES, sb.NEXT_OBS)}
+        for _ in range(self.steps):
+            obs_mat = self._stack(self._obs)
+            self._key, k = jax.random.split(self._key)
+            acts = np.asarray(self.policy._act(
+                self.policy.params, obs_mat, k, self.noise))
+            action_dict = {a: acts[i]
+                           for i, a in enumerate(self.agent_ids)}
+            obs2, rew, term, trunc, _ = self.env.step(action_dict)
+            rvec = np.asarray([float(rew[a]) for a in self.agent_ids],
+                              np.float32)
+            self._ep_ret += float(rvec.sum())
+            done = bool(term.get("__all__", False)) or \
+                bool(trunc.get("__all__", False))
+            next_mat = self._stack(obs2) if not done else obs_mat
+            rows[sb.OBS].append(obs_mat)
+            rows[sb.ACTIONS].append(acts.astype(np.float32))
+            rows[sb.REWARDS].append(rvec)
+            rows[sb.DONES].append(done)
+            rows[sb.NEXT_OBS].append(next_mat)
+            if done:
+                self._returns.append(self._ep_ret)
+                self._ep_ret = 0.0
+                self._obs, _ = self.env.reset(
+                    seed=int(self._rng.randint(0, 2**31 - 1)))
+            else:
+                self._obs = obs2
+        return SampleBatch({k: np.stack(v) for k, v in rows.items()})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class MADDPGConfig(AlgorithmConfig):
+    agent_ids: Tuple[str, ...] = ()
+    hidden: Tuple[int, ...] = (64, 64)
+    actor_lr: float = 1e-3
+    critic_lr: float = 1e-3
+    tau: float = 0.01
+    buffer_size: int = 50_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    train_intensity: int = 4
+    exploration_noise: float = 0.1
+    steps_per_sample: int = 200
+    obs_dim: Optional[int] = None
+    act_dim: Optional[int] = None
+
+
+class MADDPG(Algorithm):
+    _config_cls = MADDPGConfig
+
+    def setup(self, config: MADDPGConfig) -> None:
+        if (not config.agent_ids or config.obs_dim is None
+                or config.act_dim is None):
+            env = config.env(config.env_config or {})
+            obs, _ = env.reset(seed=0)
+            if not config.agent_ids:
+                config.agent_ids = tuple(sorted(obs.keys()))
+            first = config.agent_ids[0]
+            if config.obs_dim is None:
+                config.obs_dim = int(np.prod(
+                    np.asarray(obs[first]).shape))
+            if config.act_dim is None:
+                spaces = getattr(env, "action_spaces", None)
+                space = (spaces[first] if spaces
+                         else env.action_space)
+                config.act_dim = int(np.prod(space.shape))
+        spec = MADDPGSpec(
+            obs_dim=config.obs_dim, act_dim=config.act_dim,
+            n_agents=len(config.agent_ids),
+            hidden=tuple(config.hidden), actor_lr=config.actor_lr,
+            critic_lr=config.critic_lr, gamma=config.gamma,
+            tau=config.tau)
+        self.policy = MADDPGPolicy(spec, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(MADDPGWorker)
+        self.workers = [
+            remote_cls.remote(env_creator=config.env,
+                              env_config=config.env_config, spec=spec,
+                              agent_ids=list(config.agent_ids),
+                              steps_per_sample=config.steps_per_sample,
+                              noise=config.exploration_noise,
+                              seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        parts = ray_tpu.get([w.sample.remote() for w in self.workers],
+                            timeout=300.0)
+        for p in parts:
+            self.buffer.add(p)
+        stats: Dict[str, Any] = {
+            "buffer_size": len(self.buffer),
+            "timesteps_this_iter": sum(p.count for p in parts)}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            cl, al = self.policy.learn_on_minibatches(minis)
+            stats["critic_loss"] = cl
+            stats["actor_loss"] = al
+            ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -494,6 +494,8 @@ class JaxPolicy:
         self._act_att_greedy = act_att_greedy
         self._update = update
         self._loss = jax.jit(loss_fn)
+        self._grad = jax.jit(lambda params, mini: jax.value_and_grad(
+            loss_fn, has_aux=True)(params, mini))
         self._value_ff = value_ff
         self._value_rec = value_rec
         self._value_att = value_att
@@ -597,6 +599,27 @@ class JaxPolicy:
         return np.asarray(self._value_ff(self.params, obs))
 
     # -- learning ---------------------------------------------------------
+    def compute_gradients(self, batch: SampleBatch):
+        """Gradients of the policy loss on `batch` WITHOUT applying
+        them (reference: Policy.compute_gradients) — numpy pytree +
+        stats, so gradients can cross the object store (DDPPO's
+        allreduce-style data parallelism)."""
+        import jax
+
+        (_, stats), grads = self._grad(self.params, batch.to_device())
+        return (jax.tree.map(np.asarray, grads),
+                {k: float(v) for k, v in stats.items()})
+
+    def apply_gradients(self, grads) -> None:
+        """Apply externally computed (e.g. worker-averaged) gradients
+        through this policy's optimizer (reference:
+        Policy.apply_gradients)."""
+        import optax
+
+        updates, self.opt_state = self.tx.update(grads, self.opt_state,
+                                                 self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
     def learn_on_batch(self, batch: SampleBatch) -> Dict[str, float]:
         if self.mesh is not None:
             import jax

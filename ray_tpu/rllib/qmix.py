@@ -1,0 +1,363 @@
+"""QMIX — cooperative multi-agent Q-learning with a monotonic mixer.
+
+Reference analog: rllib/algorithms/qmix (Rashid et al. 2018): each
+agent runs an individual Q-network (weights shared across agents, an
+agent-id one-hot distinguishing them), and a MIXING network combines
+the chosen per-agent Q-values into a joint Q_tot conditioned on the
+global state.  Monotonicity (∂Q_tot/∂Q_i ≥ 0, enforced by abs() on the
+hypernetwork-produced mixing weights) makes the decentralized per-agent
+argmax consistent with the centralized argmax — train centralized,
+act decentralized.
+
+Env contract: the synchronized-step subset of MultiAgentEnv (every
+agent observes and acts every step — the SMAC-style setting QMIX
+targets); the team reward is the sum of per-agent rewards and the
+global state is the concatenation of agent observations (the standard
+default when the env exposes no privileged state).
+
+TPU-first shape: one transition row carries ALL agents' obs/actions
+stacked, so the per-agent Q evaluation is a single batched matmul over
+(batch, n_agents) and the whole minibatch round — agent nets, both
+mixers, TD loss, Adam — is one jitted scan, like DQN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+from ray_tpu.rllib.multi_agent import MultiAgentEnv
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+STATE = "state"
+NEXT_STATE = "next_state"
+
+
+@dataclasses.dataclass
+class QMIXSpec:
+    obs_dim: int                 # per-agent obs (incl. agent one-hot)
+    n_actions: int
+    n_agents: int
+    state_dim: int
+    hidden: Tuple[int, ...] = (64,)
+    mixing_embed: int = 32
+    lr: float = 5e-4
+    gamma: float = 0.99
+
+
+class QMIXPolicy:
+    def __init__(self, spec: QMIXSpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        key = jax.random.PRNGKey(seed)
+        kq, k1, k2, k3, k4 = jax.random.split(key, 5)
+        e = spec.mixing_embed
+        n = spec.n_agents
+        self.params = {
+            # shared per-agent Q net
+            "q": mlp_init(kq, (spec.obs_dim, *spec.hidden,
+                               spec.n_actions)),
+            # hypernetworks: state → mixing weights/biases
+            "hyper_w1": mlp_init(k1, (spec.state_dim, n * e)),
+            "hyper_b1": mlp_init(k2, (spec.state_dim, e)),
+            "hyper_w2": mlp_init(k3, (spec.state_dim, e)),
+            # state-value bias V(s) on the mixed output
+            "hyper_v": mlp_init(k4, (spec.state_dim, 1)),
+        }
+        self.target = jax.tree.map(np.copy, self.params)
+        self.tx = optax.adam(spec.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def sync_target(self) -> None:
+        import jax
+
+        self.target = jax.tree.map(np.copy, self.get_weights())
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        n, e = spec.n_agents, spec.mixing_embed
+
+        def agent_q(params, obs):
+            """(..., n_agents, obs_dim) → (..., n_agents, n_actions)."""
+            return mlp_apply(params["q"], obs, final_linear=True)
+
+        def mix(params, q_chosen, state):
+            """Monotonic mixer: (B, n) chosen Qs + (B, state) → (B,).
+            abs() on the hypernet outputs enforces ∂Q_tot/∂Q_i ≥ 0."""
+            w1 = jnp.abs(mlp_apply(params["hyper_w1"], state,
+                                   final_linear=True)).reshape(
+                                       state.shape[0], n, e)
+            b1 = mlp_apply(params["hyper_b1"], state, final_linear=True)
+            hidden = jax.nn.elu(
+                jnp.einsum("bn,bne->be", q_chosen, w1) + b1)
+            w2 = jnp.abs(mlp_apply(params["hyper_w2"], state,
+                                   final_linear=True))
+            v = mlp_apply(params["hyper_v"], state,
+                          final_linear=True)[..., 0]
+            return jnp.sum(hidden * w2, axis=-1) + v
+
+        @jax.jit
+        def act(params, obs, key, epsilon):
+            """(n_agents, obs_dim) → (n_agents,) epsilon-greedy."""
+            q = agent_q(params, obs)
+            greedy = jnp.argmax(q, axis=-1)
+            ku, kr = jax.random.split(key)
+            rand = jax.random.randint(kr, greedy.shape, 0,
+                                      spec.n_actions)
+            coin = jax.random.uniform(ku, greedy.shape) < epsilon
+            return jnp.where(coin, rand, greedy)
+
+        def loss_fn(params, target, mini):
+            obs = mini[sb.OBS]                  # (B, n, obs)
+            acts = mini[sb.ACTIONS]             # (B, n)
+            q_all = agent_q(params, obs)
+            q_chosen = jnp.take_along_axis(
+                q_all, acts[..., None], axis=-1)[..., 0]    # (B, n)
+            q_tot = mix(params, q_chosen, mini[STATE])
+            # decentralized target max, then target mixer
+            q_next = agent_q(target, mini[sb.NEXT_OBS])
+            q_next_max = jnp.max(q_next, axis=-1)           # (B, n)
+            tq_tot = mix(target, q_next_max, mini[NEXT_STATE])
+            nonterminal = 1.0 - mini[sb.DONES].astype(jnp.float32)
+            y = jax.lax.stop_gradient(
+                mini[sb.REWARDS] + spec.gamma * nonterminal * tq_tot)
+            return jnp.mean(jnp.square(q_tot - y))
+
+        @jax.jit
+        def update(params, opt_state, target, stacked):
+            import optax
+
+            def step(carry, mini):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, target, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, jnp.mean(losses)
+
+        self._act = act
+        self._update = update
+
+    def compute_actions(self, obs: np.ndarray, epsilon: float = 0.0
+                        ) -> np.ndarray:
+        import jax
+
+        self._rng = getattr(self, "_rng", jax.random.PRNGKey(0))
+        self._rng, key = jax.random.split(self._rng)
+        return np.asarray(self._act(self.params, obs, key, epsilon))
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]) -> float:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([np.asarray(m[k]) for m in minis])
+                   for k in minis[0].keys()}
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, self.target, stacked)
+        return float(loss)
+
+
+class QMIXWorker:
+    """Steps a synchronized MultiAgentEnv with the shared epsilon-greedy
+    agent Q net; emits stacked team transitions."""
+
+    def __init__(self, *, env_creator, env_config: Optional[Dict],
+                 spec: QMIXSpec, agent_ids: List[str],
+                 steps_per_sample: int = 200, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env: MultiAgentEnv = env_creator(env_config or {})
+        self.spec = spec
+        self.agent_ids = list(agent_ids)
+        self.policy = QMIXPolicy(spec, seed=seed)
+        self.steps = steps_per_sample
+        self._rng = np.random.RandomState(seed)
+        import jax
+
+        self._key = jax.random.PRNGKey(seed + 31)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def _stack(self, obs_dict) -> np.ndarray:
+        eye = np.eye(len(self.agent_ids), dtype=np.float32)
+        return np.stack([
+            np.concatenate([np.asarray(obs_dict[a], np.float32).ravel(),
+                            eye[i]])
+            for i, a in enumerate(self.agent_ids)])
+
+    def sample(self, epsilon: float) -> SampleBatch:
+        import jax
+
+        rows: Dict[str, list] = {k: [] for k in
+                                 (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                  sb.DONES, sb.NEXT_OBS, STATE,
+                                  NEXT_STATE)}
+        for _ in range(self.steps):
+            obs_mat = self._stack(self._obs)
+            self._key, k = jax.random.split(self._key)
+            acts = np.asarray(self.policy._act(
+                self.policy.params, obs_mat, k, epsilon))
+            action_dict = {a: int(acts[i])
+                           for i, a in enumerate(self.agent_ids)}
+            obs2, rew, term, trunc, _ = self.env.step(action_dict)
+            team_r = float(sum(rew.values()))
+            self._ep_ret += team_r
+            done = bool(term.get("__all__", False)) or \
+                bool(trunc.get("__all__", False))
+            next_mat = self._stack(obs2) if not done else obs_mat
+            rows[sb.OBS].append(obs_mat)
+            rows[sb.ACTIONS].append(acts.astype(np.int32))
+            rows[sb.REWARDS].append(team_r)
+            rows[sb.DONES].append(done)
+            rows[sb.NEXT_OBS].append(next_mat)
+            rows[STATE].append(obs_mat.ravel())
+            rows[NEXT_STATE].append(next_mat.ravel())
+            if done:
+                self._returns.append(self._ep_ret)
+                self._ep_ret = 0.0
+                self._obs, _ = self.env.reset(
+                    seed=int(self._rng.randint(0, 2**31 - 1)))
+            else:
+                self._obs = obs2
+        return SampleBatch({k: np.stack(v) if k != sb.REWARDS
+                            else np.asarray(v, np.float32)
+                            for k, v in rows.items()})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class QMIXConfig(AlgorithmConfig):
+    agent_ids: Tuple[str, ...] = ()
+    hidden: Tuple[int, ...] = (64,)
+    mixing_embed: int = 32
+    lr: float = 5e-4
+    buffer_size: int = 20_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    train_intensity: int = 4
+    target_update_freq: int = 500
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 8000
+    steps_per_sample: int = 200
+    obs_dim: Optional[int] = None      # per-agent, WITHOUT the one-hot
+    n_actions: Optional[int] = None
+
+
+class QMIX(Algorithm):
+    _config_cls = QMIXConfig
+
+    def setup(self, config: QMIXConfig) -> None:
+        if (not config.agent_ids or config.obs_dim is None
+                or config.n_actions is None):
+            env = config.env(config.env_config or {})
+            obs, _ = env.reset(seed=0)
+            if not config.agent_ids:
+                config.agent_ids = tuple(sorted(obs.keys()))
+            if config.obs_dim is None:
+                config.obs_dim = int(np.prod(np.asarray(
+                    obs[config.agent_ids[0]]).shape))
+            if config.n_actions is None:
+                config.n_actions = int(
+                    env.action_spaces[config.agent_ids[0]].n
+                    if hasattr(env, "action_spaces")
+                    else env.action_space.n)
+        n = len(config.agent_ids)
+        spec = QMIXSpec(
+            obs_dim=config.obs_dim + n,       # + agent one-hot
+            n_actions=config.n_actions, n_agents=n,
+            state_dim=(config.obs_dim + n) * n,
+            hidden=tuple(config.hidden),
+            mixing_embed=config.mixing_embed, lr=config.lr,
+            gamma=config.gamma)
+        self.policy = QMIXPolicy(spec, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(QMIXWorker)
+        self.workers = [
+            remote_cls.remote(env_creator=config.env,
+                              env_config=config.env_config, spec=spec,
+                              agent_ids=list(config.agent_ids),
+                              steps_per_sample=config.steps_per_sample,
+                              seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        from ray_tpu.rllib.dqn import linear_epsilon
+
+        return linear_epsilon(self._env_steps, self.config)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        eps = self._epsilon()
+        parts = ray_tpu.get([w.sample.remote(eps) for w in self.workers],
+                            timeout=300.0)
+        for p in parts:
+            self.buffer.add(p)
+            self._env_steps += p.count
+        stats: Dict[str, Any] = {
+            "epsilon": eps, "buffer_size": len(self.buffer),
+            "timesteps_this_iter": sum(p.count for p in parts)}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            stats["loss"] = self.policy.learn_on_minibatches(minis)
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_update_freq):
+                self.policy.sync_target()
+                self._last_target_sync = self._env_steps
+            ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

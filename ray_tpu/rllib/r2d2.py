@@ -1,0 +1,378 @@
+"""R2D2 — Recurrent Replay Distributed DQN.
+
+Reference analog: rllib/algorithms/r2d2 (Kapturowski et al. 2019):
+Q-learning with an LSTM state over fixed-length stored SEQUENCES — each
+replay row carries the recurrent state observed at its start, the
+learner re-runs ("burns in") the first `burn_in` steps without gradient
+to warm the state, then applies double-Q TD on the remainder.  (The
+reference's prioritized-sequence eta-mix is not carried over — replay
+here is uniform, noted divergence.)
+
+TPU-first shape: the whole minibatch update — burn-in scan, unrolled
+Q scan over time, masked TD loss, Adam step — is ONE jitted call; time
+is a `lax.scan` axis, batch rows vectorize, and the same compiled
+update serves every iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import lstm_init, lstm_step, mlp_apply, mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+SEQ_H0 = "state_h0"
+SEQ_C0 = "state_c0"
+SEQ_MASK = "seq_mask"
+
+
+@dataclasses.dataclass
+class R2D2Spec:
+    obs_dim: int
+    n_actions: int
+    hidden: Tuple[int, ...] = (64,)
+    cell: int = 64
+    seq_len: int = 16           # stored steps per replay row
+    burn_in: int = 4            # gradient-free warmup prefix
+    lr: float = 1e-3
+    gamma: float = 0.99
+    double_q: bool = True
+
+
+class R2D2Policy:
+    """LSTM Q-network: obs → MLP encoder → LSTM → linear Q head."""
+
+    def __init__(self, spec: R2D2Spec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        key = jax.random.PRNGKey(seed)
+        ke, kl, kq = jax.random.split(key, 3)
+        feat = spec.hidden[-1] if spec.hidden else spec.obs_dim
+        self.params = {
+            "enc": mlp_init(ke, (spec.obs_dim, *spec.hidden)),
+            "lstm": lstm_init(kl, feat, spec.cell),
+            "q": mlp_init(kq, (spec.cell, spec.n_actions)),
+        }
+        self.target = jax.tree.map(np.copy, self.params)
+        self.tx = optax.adam(spec.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def sync_target(self) -> None:
+        import jax
+
+        self.target = jax.tree.map(np.copy, self.get_weights())
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        burn = spec.burn_in
+
+        def encode(params, obs):
+            return (mlp_apply(params["enc"], obs, final_linear=False)
+                    if spec.hidden else obs)
+
+        def q_seq(params, obs_seq, h0, c0):
+            """(B, L, obs) + state → (B, L, n_actions), scanning time."""
+            feats = encode(params, obs_seq)
+
+            def step(carry, x_t):
+                carry = lstm_step(params["lstm"], carry, x_t)
+                return carry, carry[0]
+
+            carry, hs = jax.lax.scan(
+                step, (h0, c0), jnp.moveaxis(feats, 1, 0))
+            q = mlp_apply(params["q"], hs, final_linear=True)
+            return jnp.moveaxis(q, 1, 0), carry     # (B, L, n)
+
+        @jax.jit
+        def act(params, obs, h, c, eps_key, epsilon):
+            """One env step for a row of envs: (N, obs) → actions,
+            new state.  Epsilon-greedy over the recurrent Q."""
+            feats = encode(params, obs)
+            h, c = lstm_step(params["lstm"], (h, c), feats)
+            q = mlp_apply(params["q"], h, final_linear=True)
+            greedy = jnp.argmax(q, axis=-1)
+            ku, kr = jax.random.split(eps_key)
+            rand = jax.random.randint(kr, greedy.shape, 0,
+                                      spec.n_actions)
+            coin = jax.random.uniform(ku, greedy.shape) < epsilon
+            return jnp.where(coin, rand, greedy), h, c
+
+        def loss_fn(params, target, batch):
+            obs = batch[sb.OBS]                     # (B, L+1, obs)
+            h0, c0 = batch[SEQ_H0], batch[SEQ_C0]   # (B, cell)
+            # burn-in: warm the state gradient-free on the prefix
+            if burn > 0:
+                _, carry = q_seq(jax.lax.stop_gradient(params),
+                                 obs[:, :burn], h0, c0)
+                h0, c0 = jax.lax.stop_gradient(carry)
+                _, tcarry = q_seq(target, obs[:, :burn],
+                                  batch[SEQ_H0], batch[SEQ_C0])
+                th0, tc0 = tcarry
+            else:
+                th0, tc0 = h0, c0
+            obs_t = obs[:, burn:]                   # (B, T+1, obs)
+            q_on, _ = q_seq(params, obs_t, h0, c0)
+            q_tg, _ = q_seq(target, obs_t, th0, tc0)
+            act_t = batch[sb.ACTIONS][:, burn:]     # (B, T)
+            rew_t = batch[sb.REWARDS][:, burn:]
+            done_t = batch[sb.DONES][:, burn:].astype(jnp.float32)
+            mask_t = batch[SEQ_MASK][:, burn:]
+            q_sa = jnp.take_along_axis(
+                q_on[:, :-1], act_t[..., None], axis=-1)[..., 0]
+            if spec.double_q:
+                best = jnp.argmax(q_on[:, 1:], axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_tg[:, 1:], best[..., None], axis=-1)[..., 0]
+            else:
+                q_next = jnp.max(q_tg[:, 1:], axis=-1)
+            backup = jax.lax.stop_gradient(
+                rew_t + spec.gamma * (1.0 - done_t) * q_next)
+            td = (q_sa - backup) * mask_t
+            return jnp.sum(jnp.square(td)) / jnp.maximum(
+                jnp.sum(mask_t), 1.0)
+
+        @jax.jit
+        def update(params, opt_state, target, stacked):
+            import optax
+
+            def step(carry, mini):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(
+                    params, target, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, jnp.mean(losses)
+
+        self._act = act
+        self._update = update
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]) -> float:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([np.asarray(m[k]) for m in minis])
+                   for k in minis[0].keys()}
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, self.target, stacked)
+        return float(loss)
+
+
+class SequenceWorker:
+    """CPU rollout actor producing fixed-length sequence rows: each row
+    is (obs[L+1], actions[L], rewards[L], dones[L], mask[L]) plus the
+    LSTM state at the row's first step.  Episodes reset the state;
+    short tails are zero-padded with mask=0."""
+
+    def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
+                 spec: R2D2Spec, seed: int = 0,
+                 rows_per_sample: int = 8):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ray_tpu.rllib.rollout_worker import _make_env
+
+        self.env = _make_env(env, env_config)
+        self.spec = spec
+        self.policy = R2D2Policy(spec, seed=seed)
+        self.rows = rows_per_sample
+        self._rng = np.random.RandomState(seed)
+        import jax
+
+        self._key = jax.random.PRNGKey(seed + 17)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._h = np.zeros((1, spec.cell), np.float32)
+        self._c = np.zeros((1, spec.cell), np.float32)
+        self._returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self, epsilon: float) -> SampleBatch:
+        import jax
+
+        L = self.spec.seq_len
+        d = self.spec.obs_dim
+        rows: Dict[str, list] = {k: [] for k in
+                                 (sb.OBS, sb.ACTIONS, sb.REWARDS,
+                                  sb.DONES, SEQ_MASK, SEQ_H0, SEQ_C0)}
+        for _ in range(self.rows):
+            h0, c0 = self._h[0].copy(), self._c[0].copy()
+            obs_l = [np.asarray(self._obs, np.float32).ravel()]
+            act_l, rew_l, done_l, mask_l = [], [], [], []
+            reset_obs = None
+            for _ in range(L):
+                self._key, k = jax.random.split(self._key)
+                a, h, c = self.policy._act(
+                    self.policy.params, obs_l[-1][None], self._h,
+                    self._c, k, epsilon)
+                self._h = np.asarray(h)
+                self._c = np.asarray(c)
+                a = int(np.asarray(a)[0])
+                obs2, r, term, trunc, _ = self.env.step(a)
+                self._ep_ret += float(r)
+                act_l.append(a)
+                rew_l.append(float(r))
+                done_l.append(bool(term))
+                mask_l.append(1.0)
+                # the TRUE successor stays in obs_l: on truncation
+                # (done=False) the TD target must bootstrap from it,
+                # not from the next episode's reset observation
+                obs_l.append(np.asarray(obs2, np.float32).ravel())
+                if term or trunc:
+                    self._returns.append(self._ep_ret)
+                    self._ep_ret = 0.0
+                    o, _ = self.env.reset(
+                        seed=int(self._rng.randint(0, 2**31 - 1)))
+                    self._h = np.zeros_like(self._h)
+                    self._c = np.zeros_like(self._c)
+                    reset_obs = np.asarray(o, np.float32).ravel()
+                    break
+            self._obs = reset_obs if reset_obs is not None else obs_l[-1]
+            pad = L - len(act_l)
+            if pad:
+                obs_l.extend([np.zeros(d, np.float32)] * pad)
+                act_l.extend([0] * pad)
+                rew_l.extend([0.0] * pad)
+                done_l.extend([True] * pad)
+                mask_l.extend([0.0] * pad)
+            rows[sb.OBS].append(np.stack(obs_l))
+            rows[sb.ACTIONS].append(np.asarray(act_l, np.int32))
+            rows[sb.REWARDS].append(np.asarray(rew_l, np.float32))
+            rows[sb.DONES].append(np.asarray(done_l, bool))
+            rows[SEQ_MASK].append(np.asarray(mask_l, np.float32))
+            rows[SEQ_H0].append(h0)
+            rows[SEQ_C0].append(c0)
+        return SampleBatch({k: np.stack(v) for k, v in rows.items()})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class R2D2Config(AlgorithmConfig):
+    hidden: Tuple[int, ...] = (64,)
+    lstm_cell_size: int = 64
+    seq_len: int = 16
+    burn_in: int = 4
+    lr: float = 1e-3
+    buffer_size: int = 2000      # sequence rows, not steps
+    learning_starts: int = 64    # rows
+    train_batch_size: int = 16   # sequence rows per SGD step
+    train_intensity: int = 4
+    target_update_freq: int = 1000   # env steps
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 8000
+    double_q: bool = True
+    rows_per_sample: int = 8
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+    def r2d2_spec(self) -> R2D2Spec:
+        return R2D2Spec(obs_dim=self.obs_dim,
+                        n_actions=self.n_actions,
+                        hidden=tuple(self.hidden),
+                        cell=self.lstm_cell_size,
+                        seq_len=self.seq_len, burn_in=self.burn_in,
+                        lr=self.lr, gamma=self.gamma,
+                        double_q=self.double_q)
+
+
+class R2D2(Algorithm):
+    _config_cls = R2D2Config
+
+    def setup(self, config: R2D2Config) -> None:
+        from ray_tpu.rllib.ppo import _introspect_spaces
+
+        _introspect_spaces(config)
+        if config.burn_in >= config.seq_len:
+            raise ValueError(
+                f"burn_in={config.burn_in} must be < "
+                f"seq_len={config.seq_len}")
+        spec = config.r2d2_spec()
+        self.policy = R2D2Policy(spec, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(SequenceWorker)
+        self.workers = [
+            remote_cls.remote(env=config.env,
+                              env_config=config.env_config, spec=spec,
+                              rows_per_sample=config.rows_per_sample,
+                              seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        from ray_tpu.rllib.dqn import linear_epsilon
+
+        return linear_epsilon(self._env_steps, self.config)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        eps = self._epsilon()
+        parts = ray_tpu.get([w.sample.remote(eps) for w in self.workers],
+                            timeout=300.0)
+        steps = 0
+        for p in parts:
+            self.buffer.add(p)
+            steps += int(p[SEQ_MASK].sum())
+        self._env_steps += steps
+        stats: Dict[str, Any] = {"epsilon": eps,
+                                 "buffer_rows": len(self.buffer),
+                                 "timesteps_this_iter": steps}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            stats["loss"] = self.policy.learn_on_minibatches(minis)
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_update_freq):
+                self.policy.sync_target()
+                self._last_target_sync = self._env_steps
+            ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

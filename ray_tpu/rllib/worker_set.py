@@ -19,7 +19,8 @@ class WorkerSet:
                  rollout_fragment_length: int = 200,
                  gamma: float = 0.99, lam: float = 0.95,
                  num_cpus_per_worker: float = 1.0, seed: int = 0,
-                 observation_filter: str = "NoFilter"):
+                 observation_filter: str = "NoFilter",
+                 worker_cls: Optional[type] = None):
         self.num_workers = num_workers
         kwargs = dict(env=env, env_config=env_config,
                       policy_spec=policy_spec,
@@ -27,7 +28,7 @@ class WorkerSet:
                       rollout_fragment_length=rollout_fragment_length,
                       observation_filter=observation_filter)
         remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
-            RolloutWorker)
+            worker_cls or RolloutWorker)
         self.workers = [remote_cls.remote(seed=seed + 1000 * (i + 1),
                                           **kwargs)
                         for i in range(num_workers)]

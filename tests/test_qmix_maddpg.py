@@ -1,0 +1,186 @@
+"""QMIX (monotonic value factorization) and MADDPG (centralized-critic
+multi-agent DDPG).
+
+Reference analogs: rllib/algorithms/qmix and rllib/algorithms/maddpg —
+learning checks follow the check_learning_achieved pattern scaled to CI
+(rllib/utils/test_utils.py:480).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (MADDPG, MADDPGConfig, QMIX, QMIXConfig)
+
+
+class _Space:
+    def __init__(self, shape=None, n=None):
+        self.shape = shape
+        self.n = n
+
+
+class _TeamMatchEnv:
+    """Two agents, 8-step episodes.  Each agent privately observes a
+    bit; the TEAM reward per step is 1.0 only if BOTH agents act on
+    their own bit (else 0) — per-agent rewards are identical (team),
+    so credit assignment has to flow through the mixer."""
+
+    LEN = 8
+
+    def __init__(self, seed=0):
+        self._rng = np.random.RandomState(seed)
+        self.action_spaces = {"a0": _Space(n=2), "a1": _Space(n=2)}
+
+    def _obs(self):
+        self._bits = self._rng.randint(2, size=2)
+        return {"a0": np.asarray([self._bits[0]], np.float32),
+                "a1": np.asarray([self._bits[1]], np.float32)}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        both = (int(action_dict["a0"]) == self._bits[0]
+                and int(action_dict["a1"]) == self._bits[1])
+        r = 0.5 if both else 0.0        # 0.5 each → team total 1.0
+        self._t += 1
+        done = self._t >= self.LEN
+        obs = self._obs()
+        rew = {"a0": r, "a1": r}
+        return obs, rew, {"__all__": done}, {"__all__": False}, {}
+
+
+def test_qmix_learns_team_match(ray_start_shared):
+    # gamma=0: steps are iid context draws, so the mixed TD target is
+    # the immediate team reward — isolates the factorization learning
+    cfg = QMIXConfig(env=lambda _: _TeamMatchEnv(), num_workers=1,
+                     hidden=(32,), mixing_embed=16, lr=5e-3,
+                     buffer_size=10_000, learning_starts=200,
+                     train_batch_size=64, train_intensity=16,
+                     target_update_freq=400, epsilon_decay_steps=2000,
+                     steps_per_sample=200, gamma=0.0, seed=0)
+    algo = QMIX(cfg)
+    best = -np.inf
+    try:
+        for _ in range(40):
+            result = algo.train()
+            best = max(best, result.get("episode_reward_mean", -np.inf))
+            if best >= 7.0:
+                break
+    finally:
+        algo.stop()
+    # random play scores 8 * 0.25 = 2.0; solved play scores 8.0
+    assert best >= 5.5, best
+
+
+def test_qmix_mixer_is_monotonic():
+    from ray_tpu.rllib.qmix import QMIXPolicy, QMIXSpec
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.models import mlp_apply
+
+    spec = QMIXSpec(obs_dim=3, n_actions=2, n_agents=2, state_dim=6,
+                    hidden=(8,), mixing_embed=8)
+    pol = QMIXPolicy(spec, seed=0)
+
+    # rebuild the mixer closure exactly as the loss uses it
+    def mix(q_chosen, state):
+        p = pol.params
+        w1 = jnp.abs(mlp_apply(p["hyper_w1"], state,
+                               final_linear=True)).reshape(
+                                   state.shape[0], 2, 8)
+        b1 = mlp_apply(p["hyper_b1"], state, final_linear=True)
+        hidden = jax.nn.elu(jnp.einsum("bn,bne->be", q_chosen, w1) + b1)
+        w2 = jnp.abs(mlp_apply(p["hyper_w2"], state, final_linear=True))
+        v = mlp_apply(p["hyper_v"], state, final_linear=True)[..., 0]
+        return jnp.sum(hidden * w2, axis=-1) + v
+
+    rng = np.random.RandomState(0)
+    state = jnp.asarray(rng.randn(16, 6).astype(np.float32))
+    q = jnp.asarray(rng.randn(16, 2).astype(np.float32))
+    grads = jax.vmap(jax.grad(lambda qq, ss: mix(qq[None], ss[None])[0]
+                              ))(q, state)
+    # ∂Q_tot/∂Q_i ≥ 0 everywhere — the QMIX monotonicity guarantee
+    assert float(jnp.min(grads)) >= 0.0
+
+
+class _SharedPointEnv:
+    """Two agents jointly push a 2-D point toward the origin; each
+    controls one axis.  Identical rewards -|x|^2 — cooperative
+    continuous control."""
+
+    LEN = 25
+
+    def __init__(self, seed=0):
+        self._rng = np.random.RandomState(seed)
+        self.action_spaces = {"a0": _Space(shape=(1,)),
+                              "a1": _Space(shape=(1,))}
+
+    def _obs(self):
+        return {"a0": self._x.copy(), "a1": self._x.copy()}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._x = self._rng.uniform(-2, 2, size=2).astype(np.float32)
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        self._x[0] = np.clip(
+            self._x[0] + 0.5 * float(np.asarray(
+                action_dict["a0"]).ravel()[0]), -3, 3)
+        self._x[1] = np.clip(
+            self._x[1] + 0.5 * float(np.asarray(
+                action_dict["a1"]).ravel()[0]), -3, 3)
+        self._t += 1
+        r = float(-np.sum(self._x ** 2))
+        done = self._t >= self.LEN
+        return self._obs(), {"a0": r, "a1": r}, \
+            {"__all__": done}, {"__all__": False}, {}
+
+
+def test_maddpg_learns_shared_point(ray_start_shared):
+    cfg = MADDPGConfig(env=lambda _: _SharedPointEnv(), num_workers=1,
+                       hidden=(32, 32), actor_lr=3e-3, critic_lr=3e-3,
+                       buffer_size=20_000, learning_starts=300,
+                       train_batch_size=64, train_intensity=16,
+                       exploration_noise=0.3, steps_per_sample=250,
+                       gamma=0.8, tau=0.02, seed=0)
+    algo = MADDPG(cfg)
+    first = None
+    best = -np.inf
+    try:
+        for i in range(40):
+            result = algo.train()
+            mean = result.get("episode_reward_mean", -np.inf)
+            if i == 0:
+                first = mean
+            best = max(best, mean)
+            if best >= -30.0:
+                break
+    finally:
+        algo.stop()
+    # random policy hovers around -150/episode-pair on this env;
+    # trained actors keep the point near the origin
+    assert best > first, (first, best)
+    assert best >= -60.0, (first, best)
+
+
+def test_maddpg_actions_decentralized():
+    # actor i must depend only on obs_i: perturbing agent 1's obs
+    # cannot change agent 0's action
+    from ray_tpu.rllib.maddpg import MADDPGPolicy, MADDPGSpec
+
+    spec = MADDPGSpec(obs_dim=2, act_dim=1, n_agents=2, hidden=(8,))
+    pol = MADDPGPolicy(spec, seed=0)
+    obs = np.zeros((2, 2), np.float32)
+    a1 = pol.compute_actions(obs)
+    obs2 = obs.copy()
+    obs2[1] = 5.0
+    a2 = pol.compute_actions(obs2)  # noise=0 → rng state irrelevant
+    np.testing.assert_allclose(a1[0], a2[0], atol=1e-6)
+    assert not np.allclose(a1[1], a2[1])

@@ -1,0 +1,199 @@
+"""R2D2 (recurrent replay DQN) and CRR (offline advantage-weighted
+regression).
+
+Reference analogs: rllib/algorithms/r2d2 and rllib/algorithms/crr —
+learning checks follow the check_learning_achieved pattern scaled to CI
+(rllib/utils/test_utils.py:480).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (CRR, CRRConfig, JsonWriter, R2D2, R2D2Config,
+                           SampleBatch)
+from ray_tpu.rllib import sample_batch as sb
+
+
+# ---------------------------------------------------------------------------
+# R2D2
+# ---------------------------------------------------------------------------
+
+class _MemoryEnv:
+    """A cue appears only at t=0 (obs = [±1, phase...]); acting on the
+    final step is rewarded iff the action matches the initial cue's
+    sign.  Feedforward Q is chance (reward 0.5/episode expected);
+    only a recurrent policy can carry the cue to the decision step."""
+
+    LEN = 5
+
+    class _Space:
+        def __init__(self, shape=None, n=None):
+            self.shape = shape
+            self.n = n
+
+    def __init__(self, seed=0):
+        self.observation_space = self._Space(shape=(2,))
+        self.action_space = self._Space(n=2)
+        self._rng = np.random.RandomState(seed)
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._cue = int(self._rng.randint(2))
+        self._t = 0
+        return np.asarray([1.0 if self._cue else -1.0, 0.0],
+                          np.float32), {}
+
+    def step(self, action):
+        self._t += 1
+        done = self._t >= self.LEN
+        r = 0.0
+        if done and int(action) == self._cue:
+            r = 1.0
+        # post-cue observations carry only the phase, never the cue
+        obs = np.asarray([0.0, self._t / self.LEN], np.float32)
+        return obs, r, done, False, {}
+
+
+def test_r2d2_validates_burn_in():
+    with pytest.raises(ValueError, match="burn_in"):
+        R2D2(R2D2Config(obs_dim=2, n_actions=2, seq_len=4, burn_in=4))
+
+
+def test_r2d2_learns_memory_env(ray_start_shared):
+    cfg = R2D2Config(env=lambda _: _MemoryEnv(), num_workers=1,
+                     hidden=(32,), lstm_cell_size=32, seq_len=6,
+                     burn_in=0, buffer_size=2000, learning_starts=32,
+                     train_batch_size=32, train_intensity=8,
+                     target_update_freq=400, epsilon_decay_steps=3000,
+                     rows_per_sample=16, lr=2e-3, gamma=0.9, seed=0)
+    algo = R2D2(cfg)
+    best = -np.inf
+    try:
+        for _ in range(30):
+            result = algo.train()
+            best = max(best, result.get("episode_reward_mean", -np.inf))
+            if best >= 0.9:
+                break
+    finally:
+        algo.stop()
+    # memoryless play scores ~0.5; recurrent Q should approach 1.0
+    assert best >= 0.8, best
+
+
+def test_r2d2_burn_in_changes_only_warmup():
+    # with burn_in=2 the first two steps contribute no TD loss terms:
+    # constructing identical sequences with garbage in the burn-in
+    # prefix must produce the same loss as clean ones
+    from ray_tpu.rllib.r2d2 import (R2D2Policy, R2D2Spec, SEQ_C0,
+                                    SEQ_H0, SEQ_MASK)
+    import jax.numpy as jnp
+
+    spec = R2D2Spec(obs_dim=2, n_actions=2, hidden=(8,), cell=8,
+                    seq_len=4, burn_in=2, gamma=0.9)
+    pol = R2D2Policy(spec, seed=0)
+    rng = np.random.RandomState(0)
+    base = {
+        sb.OBS: rng.randn(1, 3, 5, 2).astype(np.float32),
+        sb.ACTIONS: rng.randint(0, 2, (1, 3, 4)).astype(np.int32),
+        sb.REWARDS: rng.randn(1, 3, 4).astype(np.float32),
+        sb.DONES: np.zeros((1, 3, 4), bool),
+        SEQ_MASK: np.ones((1, 3, 4), np.float32),
+        SEQ_H0: np.zeros((1, 3, 8), np.float32),
+        SEQ_C0: np.zeros((1, 3, 8), np.float32),
+    }
+    # rewards/actions inside the burn-in window are ignored by the loss
+    messy = {k: np.copy(v) for k, v in base.items()}
+    messy[sb.REWARDS][:, :, :2] = 99.0
+    p0, o0 = pol.params, pol.opt_state
+    pol.params, pol.opt_state = p0, o0
+    _, _, l_base = pol._update(p0, o0, pol.target,
+                               {k: jnp.asarray(v) for k, v in
+                                base.items()})
+    _, _, l_messy = pol._update(p0, o0, pol.target,
+                                {k: jnp.asarray(v) for k, v in
+                                 messy.items()})
+    np.testing.assert_allclose(float(l_base), float(l_messy), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# CRR
+# ---------------------------------------------------------------------------
+
+class _PointEnv:
+    """1-D point control: state x, action pushes it; reward -(x^2)."""
+
+    class _Space:
+        def __init__(self, shape=None, n=None):
+            self.shape = shape
+            self.n = n
+
+    def __init__(self, seed=0):
+        self.observation_space = self._Space(shape=(1,))
+        self.action_space = self._Space(shape=(1,))
+        self._rng = np.random.RandomState(seed)
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._x = self._rng.uniform(-2, 2, size=1).astype(np.float32)
+        self._t = 0
+        return self._x.copy(), {}
+
+    def step(self, a):
+        self._x = np.clip(self._x + 0.5 * np.asarray(a).ravel(), -3, 3)
+        self._t += 1
+        r = float(-(self._x[0] ** 2))
+        return self._x.copy().astype(np.float32), r, self._t >= 30, \
+            False, {}
+
+
+def _log_point(path, n=1500, seed=2):
+    rng = np.random.RandomState(seed)
+    env = _PointEnv(seed=seed)
+    obs_l, act_l, rew_l, done_l, next_l = [], [], [], [], []
+    o, _ = env.reset(seed=seed)
+    for _ in range(n):
+        a = np.clip(-0.7 * o + 0.3 * rng.randn(1), -1, 1)
+        o2, r, term, trunc, _ = env.step(a)
+        obs_l.append(o)
+        act_l.append(a.astype(np.float32))
+        rew_l.append(r)
+        done_l.append(term)
+        next_l.append(o2)
+        o = o2
+        if term or trunc:
+            o, _ = env.reset()
+    with JsonWriter(str(path)) as w:
+        w.write(SampleBatch({
+            sb.OBS: np.asarray(obs_l, np.float32),
+            sb.ACTIONS: np.asarray(act_l, np.float32),
+            sb.REWARDS: np.asarray(rew_l, np.float32),
+            sb.DONES: np.asarray(done_l, bool),
+            sb.NEXT_OBS: np.asarray(next_l, np.float32)}))
+
+
+@pytest.mark.parametrize("mode", ["bin", "exp"])
+def test_crr_trains_offline(ray_start_shared, tmp_path, mode):
+    log = tmp_path / "cont.json"
+    _log_point(log)
+    algo = CRR(CRRConfig(input_path=str(log), hidden=(32, 32),
+                         sgd_steps_per_iter=100, lr=1e-3,
+                         weight_mode=mode, seed=0))
+    stats = None
+    for _ in range(10):
+        stats = algo.train()
+    assert np.isfinite(stats["critic_loss"])
+    assert 0.0 <= stats["mean_weight"], stats
+    # the learned policy pushes the point toward 0
+    obs = np.asarray([[1.5], [-1.5]], np.float32)
+    acts = algo.compute_actions(obs)
+    assert acts[0, 0] < 0 and acts[1, 0] > 0, acts
+
+
+def test_crr_rejects_bad_mode(tmp_path):
+    log = tmp_path / "x.json"
+    _log_point(log, n=50)
+    with pytest.raises(ValueError, match="weight_mode"):
+        CRR(CRRConfig(input_path=str(log), weight_mode="nope"))
