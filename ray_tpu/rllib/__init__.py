@@ -24,6 +24,7 @@ from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig, SimpleQ,
                                         SimpleQConfig)
 from ray_tpu.rllib.crr import CRR, CRRConfig
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, QMIXPolicy
 from ray_tpu.rllib.pg import (A2C, A2CConfig, A3C, A3CConfig, PG,
@@ -32,7 +33,8 @@ from ray_tpu.rllib.r2d2 import R2D2, R2D2Config, R2D2Policy
 from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
                                          ReplayBuffer)
 from ray_tpu.rllib.sample_batch import SampleBatch
-from ray_tpu.rllib.rollout_worker import RolloutWorker, TrajectoryWorker
+from ray_tpu.rllib.rollout_worker import (AsyncSampler, RolloutWorker,
+                                          TrajectoryWorker)
 from ray_tpu.rllib.worker_set import WorkerSet
 
 __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
@@ -50,4 +52,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "LinUCB", "LinUCBConfig", "LinTS", "LinTSConfig",
            "CRR", "CRRConfig", "R2D2", "R2D2Config", "R2D2Policy",
            "QMIX", "QMIXConfig", "QMIXPolicy", "MADDPG",
-           "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig"]
+           "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig",
+           "AsyncSampler", "DT", "DTConfig"]

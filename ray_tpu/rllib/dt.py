@@ -1,0 +1,250 @@
+"""DT — Decision Transformer (offline RL as sequence modeling).
+
+Reference analog: rllib/algorithms/dt (Chen et al. 2021): logged
+episodes become token sequences (return-to-go, observation, action)
+fed through a causal transformer that is trained to predict each action
+given the history and the remaining return — at evaluation time the
+policy is CONDITIONED on a target return and plays the actions the
+model believes achieve it.
+
+TPU-first shape: the attention trunk is the GTrXL block already in the
+model catalog (models.attention_init/apply); training samples
+fixed-length windows so every update is one static-shape jitted
+scan of minibatch steps over the device-resident dataset, like
+BC/MARWIL/CQL/CRR here.  Discrete actions (CE loss).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import attention_apply, attention_init, mlp_init
+from ray_tpu.rllib.offline import JsonReader
+
+
+@dataclasses.dataclass
+class DTConfig(AlgorithmConfig):
+    input_path: str = ""
+    #: timesteps of context (the token sequence is 3x this: R, s, a)
+    context_len: int = 8
+    embed_dim: int = 64
+    n_heads: int = 4
+    n_layers: int = 2
+    train_batch_size: int = 64
+    sgd_steps_per_iter: int = 50
+    #: return-to-go the eval policy is conditioned on; None = the best
+    #: episode return seen in the dataset (reference: target_return)
+    target_return: Optional[float] = None
+    #: rtg normalization scale (reference dt: rtg / scale)
+    rtg_scale: float = 1.0
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+def _episode_windows(data, K: int):
+    """Cut logged transitions into per-episode (rtg, obs, act) windows
+    of length K (pre-padded with zeros + a validity mask)."""
+    obs = np.asarray(data[sb.OBS], np.float32)
+    acts = np.asarray(data[sb.ACTIONS]).astype(np.int32)
+    rews = np.asarray(data[sb.REWARDS], np.float32)
+    dones = np.asarray(data[sb.DONES], bool)
+    ends = np.flatnonzero(dones)
+    starts = np.concatenate([[0], ends[:-1] + 1])
+    if not dones[-1]:
+        starts = np.append(starts, ends[-1] + 1 if len(ends) else 0)
+        ends = np.append(ends, len(rews) - 1)
+    R, O, A, M, ep_returns = [], [], [], [], []
+    d = obs.shape[1]
+    for s, e in zip(starts, ends):
+        ep_r = rews[s:e + 1]
+        ep_returns.append(float(ep_r.sum()))
+        rtg = np.cumsum(ep_r[::-1])[::-1]          # return-to-go
+        for t in range(s, e + 1):
+            lo = max(s, t - K + 1)
+            n = t - lo + 1
+            r_w = np.zeros(K, np.float32)
+            o_w = np.zeros((K, d), np.float32)
+            a_w = np.zeros(K, np.int32)
+            m_w = np.zeros(K, np.float32)
+            r_w[K - n:] = rtg[lo - s:t - s + 1]
+            o_w[K - n:] = obs[lo:t + 1]
+            a_w[K - n:] = acts[lo:t + 1]
+            m_w[K - n:] = 1.0
+            R.append(r_w)
+            O.append(o_w)
+            A.append(a_w)
+            M.append(m_w)
+    return (np.stack(R), np.stack(O), np.stack(A), np.stack(M),
+            ep_returns)
+
+
+class DT(Algorithm):
+    _config_cls = DTConfig
+
+    def setup(self, config: DTConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        data = JsonReader(config.input_path).read_all()
+        for key in (sb.OBS, sb.ACTIONS, sb.REWARDS, sb.DONES):
+            if key not in data:
+                raise ValueError(f"DT offline data needs {key!r}")
+        if config.obs_dim is None:
+            config.obs_dim = int(np.prod(
+                np.asarray(data[sb.OBS]).shape[1:]))
+        if config.n_actions is None:
+            config.n_actions = int(np.asarray(
+                data[sb.ACTIONS]).max()) + 1
+        K = config.context_len
+        D = config.embed_dim
+        R, O, A, M, ep_returns = _episode_windows(data, K)
+        if config.target_return is None:
+            config.target_return = float(max(ep_returns))
+        self._data = {"rtg": jnp.asarray(R / config.rtg_scale),
+                      "obs": jnp.asarray(O), "act": jnp.asarray(A),
+                      "mask": jnp.asarray(M)}
+        self._n = len(R)
+
+        key = jax.random.PRNGKey(config.seed)
+        ks = jax.random.split(key, 5 + config.n_layers)
+        self.params = {
+            "embed_r": mlp_init(ks[0], (1, D)),
+            "embed_o": mlp_init(ks[1], (config.obs_dim, D)),
+            "embed_a": mlp_init(ks[2], (config.n_actions, D)),
+            "pos": (np.random.RandomState(config.seed)
+                    .randn(3 * K, D).astype(np.float32)
+                    * np.sqrt(1.0 / D)),
+            "head": mlp_init(ks[3], (D, config.n_actions)),
+            "blocks": [attention_init(ks[5 + i], D, config.n_heads)
+                       for i in range(config.n_layers)],
+        }
+        self.tx = optax.adam(config.lr)
+        self.opt_state = self.tx.init(self.params)
+        n_heads = config.n_heads
+        n_act = config.n_actions
+        mb = min(config.train_batch_size, self._n)
+        steps = config.sgd_steps_per_iter
+
+        def trunk(params, rtg, obs, act_onehot):
+            """(B,K),(B,K,obs),(B,K,n_act) → logits at state tokens."""
+            from ray_tpu.rllib.models import mlp_apply
+
+            B = rtg.shape[0]
+            er = mlp_apply(params["embed_r"], rtg[..., None],
+                           final_linear=True)
+            eo = mlp_apply(params["embed_o"], obs, final_linear=True)
+            ea = mlp_apply(params["embed_a"], act_onehot,
+                           final_linear=True)
+            # interleave (R_t, s_t, a_t) along time: (B, 3K, D)
+            toks = jnp.stack([er, eo, ea], axis=2).reshape(B, 3 * K, -1)
+            toks = toks + params["pos"][None]
+            x = toks
+            for blk in params["blocks"]:
+                x = attention_apply(blk, x, n_heads)
+            # action is predicted from the STATE token (position 3t+1)
+            state_tok = x[:, 1::3]                  # (B, K, D)
+            return mlp_apply(params["head"], state_tok,
+                             final_linear=True)
+
+        def loss_fn(params, mini):
+            onehot = jax.nn.one_hot(mini["act"], n_act)
+            logits = trunk(params, mini["rtg"], mini["obs"], onehot)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            pick = jnp.take_along_axis(
+                logp, mini["act"][..., None], axis=-1)[..., 0]
+            return -jnp.sum(pick * mini["mask"]) / jnp.maximum(
+                jnp.sum(mini["mask"]), 1.0)
+
+        @jax.jit
+        def update(params, opt_state, stacked):
+            def step(carry, mini):
+                params, opt_state = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, jnp.mean(losses)
+
+        @jax.jit
+        def act_fn(params, rtg, obs, act_onehot):
+            return jnp.argmax(
+                trunk(params, rtg, obs, act_onehot)[:, -1], axis=-1)
+
+        self._update = update
+        self._act_fn = act_fn
+        self._mb = mb
+        self._steps = steps
+        self._idx_rng = np.random.RandomState(config.seed + 5)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        idx = self._idx_rng.randint(0, self._n,
+                                    size=(self._steps, self._mb))
+        stacked = {k: v[jnp.asarray(idx)] for k, v in self._data.items()}
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, stacked)
+        return {"loss": float(loss),
+                "timesteps_this_iter": self._steps * self._mb}
+
+    def run_episode(self, env, target_return: Optional[float] = None,
+                    max_steps: int = 1000, seed: int = 0) -> float:
+        """Play one episode conditioned on `target_return` (reference
+        dt: rtg decreases by each observed reward)."""
+        import jax.nn
+
+        c = self.config
+        K = c.context_len
+        tr = (target_return if target_return is not None
+              else c.target_return)
+        obs, _ = env.reset(seed=seed)
+        rtg_hist: List[float] = [tr / c.rtg_scale]
+        obs_hist: List[np.ndarray] = [
+            np.asarray(obs, np.float32).ravel()]
+        act_hist: List[int] = [0]      # placeholder for the final slot
+        total = 0.0
+        for _ in range(max_steps):
+            n = min(len(obs_hist), K)
+            r_w = np.zeros((1, K), np.float32)
+            o_w = np.zeros((1, K, c.obs_dim), np.float32)
+            a_w = np.zeros((1, K), np.int32)
+            r_w[0, K - n:] = rtg_hist[-n:]
+            o_w[0, K - n:] = np.stack(obs_hist[-n:])
+            a_w[0, K - n:] = act_hist[-n:]
+            onehot = np.eye(c.n_actions, dtype=np.float32)[a_w]
+            a = int(np.asarray(self._act_fn(
+                self.params, r_w, o_w, onehot))[0])
+            act_hist[-1] = a
+            obs, r, term, trunc, _ = env.step(a)
+            total += float(r)
+            if term or trunc:
+                break
+            rtg_hist.append(rtg_hist[-1] - float(r) / c.rtg_scale)
+            obs_hist.append(np.asarray(obs, np.float32).ravel())
+            act_hist.append(0)
+        return total
+
+    def compute_actions(self, obs: np.ndarray) -> int:
+        """Single-step conditioning at the configured target return."""
+        c = self.config
+        r_w = np.zeros((1, c.context_len), np.float32)
+        o_w = np.zeros((1, c.context_len, c.obs_dim), np.float32)
+        a_w = np.zeros((1, c.context_len), np.int32)
+        r_w[0, -1] = c.target_return / c.rtg_scale
+        o_w[0, -1] = np.asarray(obs, np.float32).ravel()
+        onehot = np.eye(c.n_actions, dtype=np.float32)[a_w]
+        return int(np.asarray(self._act_fn(
+            self.params, r_w, o_w, onehot))[0])
+
+    def cleanup(self) -> None:
+        pass

@@ -234,13 +234,15 @@ class MADDPGWorker:
             rvec = np.asarray([float(rew[a]) for a in self.agent_ids],
                               np.float32)
             self._ep_ret += float(rvec.sum())
-            done = bool(term.get("__all__", False)) or \
-                bool(trunc.get("__all__", False))
-            next_mat = self._stack(obs2) if not done else obs_mat
+            terminated = bool(term.get("__all__", False))
+            done = terminated or bool(trunc.get("__all__", False))
+            next_mat = self._stack(obs2)
             rows[sb.OBS].append(obs_mat)
             rows[sb.ACTIONS].append(acts.astype(np.float32))
             rows[sb.REWARDS].append(rvec)
-            rows[sb.DONES].append(done)
+            # only TERMINATION zeroes the critic bootstrap; truncation
+            # still bootstraps from the successor state
+            rows[sb.DONES].append(terminated)
             rows[sb.NEXT_OBS].append(next_mat)
             if done:
                 self._returns.append(self._ep_ret)

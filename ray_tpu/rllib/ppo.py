@@ -61,6 +61,10 @@ class PPOConfig(AlgorithmConfig):
     #: "MeanStdFilter" = running obs normalization in rollout workers,
     #: synced+merged across workers every training_step
     observation_filter: str = "NoFilter"
+    #: True: workers collect fragments on a background AsyncSampler
+    #: thread (env stepping overlaps the learner round-trip; fragments
+    #: may be one weight-sync stale — reference AsyncSampler semantics)
+    sample_async: bool = False
 
     def policy_spec(self) -> PolicySpec:
         if self.obs_dim is None or self.n_actions is None:
@@ -134,7 +138,8 @@ class PPO(Algorithm):
             gamma=config.gamma, lam=config.lam,
             num_cpus_per_worker=config.num_cpus_per_worker,
             seed=config.seed,
-            observation_filter=config.observation_filter)
+            observation_filter=config.observation_filter,
+            async_sampling=config.sample_async)
         self.workers.sync_weights(self.learner_policy.get_weights())
 
     def _prepare_batch(self, batch: SampleBatch) -> None:

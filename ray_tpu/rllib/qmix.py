@@ -234,13 +234,15 @@ class QMIXWorker:
             obs2, rew, term, trunc, _ = self.env.step(action_dict)
             team_r = float(sum(rew.values()))
             self._ep_ret += team_r
-            done = bool(term.get("__all__", False)) or \
-                bool(trunc.get("__all__", False))
-            next_mat = self._stack(obs2) if not done else obs_mat
+            terminated = bool(term.get("__all__", False))
+            done = terminated or bool(trunc.get("__all__", False))
+            next_mat = self._stack(obs2)
             rows[sb.OBS].append(obs_mat)
             rows[sb.ACTIONS].append(acts.astype(np.int32))
             rows[sb.REWARDS].append(team_r)
-            rows[sb.DONES].append(done)
+            # only TERMINATION zeroes the TD bootstrap; a time-limit
+            # truncation still bootstraps from the successor state
+            rows[sb.DONES].append(terminated)
             rows[sb.NEXT_OBS].append(next_mat)
             rows[STATE].append(obs_mat.ravel())
             rows[NEXT_STATE].append(next_mat.ravel())

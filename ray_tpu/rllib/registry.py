@@ -1,0 +1,61 @@
+"""Algorithm registry (reference analog: rllib/algorithms/registry.py
+get_algorithm_class) — string name → (Algorithm, Config) for CLI/Tune
+style launch-by-name."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+#: registry name → (Algorithm attr, Config attr) on ray_tpu.rllib.
+#: Single source of truth: registered_algorithms() derives from it.
+_TABLE = {
+    "PPO": ("PPO", "PPOConfig"),
+    "APPO": ("APPO", "APPOConfig"),
+    "DDPPO": ("DDPPO", "DDPPOConfig"),
+    "IMPALA": ("IMPALA", "IMPALAConfig"),
+    "PG": ("PG", "PGConfig"),
+    "A2C": ("A2C", "A2CConfig"),
+    "A3C": ("A3C", "A3CConfig"),
+    "DQN": ("DQN", "DQNConfig"),
+    "SimpleQ": ("SimpleQ", "SimpleQConfig"),
+    "ApexDQN": ("ApexDQN", "ApexDQNConfig"),
+    "APEX": ("ApexDQN", "ApexDQNConfig"),
+    "R2D2": ("R2D2", "R2D2Config"),
+    "SAC": ("SAC", "SACConfig"),
+    "TD3": ("TD3", "TD3Config"),
+    "DDPG": ("DDPG", "DDPGConfig"),
+    "ES": ("ES", "ESConfig"),
+    "ARS": ("ARS", "ARSConfig"),
+    "BC": ("BC", "BCConfig"),
+    "MARWIL": ("MARWIL", "MARWILConfig"),
+    "CQL": ("CQL", "CQLConfig"),
+    "CRR": ("CRR", "CRRConfig"),
+    "DT": ("DT", "DTConfig"),
+    "QMIX": ("QMIX", "QMIXConfig"),
+    "MADDPG": ("MADDPG", "MADDPGConfig"),
+    "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),
+    "BanditLinUCB": ("LinUCB", "LinUCBConfig"),
+    "BanditLinTS": ("LinTS", "LinTSConfig"),
+}
+
+
+def get_algorithm_class(name: str, return_config: bool = False):
+    """Resolve an algorithm by its registry name.  Imports lazily so
+    `from ray_tpu.rllib.registry import get_algorithm_class` stays
+    cheap."""
+    if name not in _TABLE:
+        raise ValueError(
+            f"unknown algorithm {name!r}; registered: "
+            f"{sorted(_TABLE)}")
+    import ray_tpu.rllib as rllib
+
+    cls_name, cfg_name = _TABLE[name]
+    cls = getattr(rllib, cls_name)
+    if return_config:
+        return cls, getattr(rllib, cfg_name)
+    return cls
+
+
+def registered_algorithms() -> Tuple[str, ...]:
+    """All registry names (for docs/CLI tab-completion)."""
+    return tuple(_TABLE)

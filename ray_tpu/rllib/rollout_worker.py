@@ -34,12 +34,53 @@ def _make_env(env_name_or_creator, env_config):
     return gym.make(env_name_or_creator)
 
 
+class AsyncSampler:
+    """Background-thread fragment collector (reference analog:
+    rllib/evaluation/sampler.py:317 AsyncSampler): env stepping runs in
+    a daemon thread that keeps a small bounded queue of completed
+    fragments, so the worker's sample() RPC hands back a READY fragment
+    instead of stepping envs inline — the env walltime overlaps the
+    learner round-trip.  Weight updates swap the policy's param pytree
+    between forward calls (an atomic reference assignment), so a popped
+    fragment can lag the latest set_weights by up to queue_size+1 weight
+    syncs — the off-policyness the reference's async sampler accepts."""
+
+    def __init__(self, sample_fn, queue_size: int = 2):
+        import queue as _queue
+        import threading
+
+        self._q: Any = _queue.Queue(maxsize=queue_size)
+        self._stop = threading.Event()
+        self._sample_fn = sample_fn
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import queue as _queue
+
+        while not self._stop.is_set():
+            batch = self._sample_fn()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(batch, timeout=0.5)
+                    break
+                except _queue.Full:
+                    continue
+
+    def get_batch(self, timeout: float = 300.0) -> SampleBatch:
+        return self._q.get(timeout=timeout)
+
+    def stop(self):
+        self._stop.set()
+
+
 class RolloutWorker:
     def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
                  policy_spec: PolicySpec, num_envs: int = 1,
                  gamma: float = 0.99, lam: float = 0.95,
                  rollout_fragment_length: int = 200, seed: int = 0,
-                 observation_filter: str = "NoFilter"):
+                 observation_filter: str = "NoFilter",
+                 async_sampling: bool = False):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -70,11 +111,31 @@ class RolloutWorker:
             preserve_shape=len(policy_obs_shape) == 3)
         self.action_pipeline = default_action_pipeline(
             self.venv.action_space, continuous)
+        if async_sampling and observation_filter not in (
+                None, "", "NoFilter"):
+            # the sampler thread and filter-sync RPCs would mutate the
+            # running statistics concurrently (torn deltas)
+            raise ValueError(
+                "async_sampling does not compose with observation "
+                "filters; normalize in the env wrapper instead")
+        self._async_wanted = async_sampling
+        self._async_sampler: Optional[AsyncSampler] = None
 
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
 
     def sample(self) -> SampleBatch:
+        """Next fragment: from the background AsyncSampler thread when
+        async_sampling is on, else collected inline.  The thread starts
+        LAZILY on the first sample() so the initial sync_weights lands
+        before any fragment is collected."""
+        if self._async_wanted:
+            if self._async_sampler is None:
+                self._async_sampler = AsyncSampler(self._collect)
+            return self._async_sampler.get_batch()
+        return self._collect()
+
+    def _collect(self) -> SampleBatch:
         """One fragment per env copy, GAE-postprocessed + concatenated.
         Every step is batched: connector → one policy forward →
         one vector_step."""

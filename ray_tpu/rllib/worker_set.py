@@ -20,13 +20,15 @@ class WorkerSet:
                  gamma: float = 0.99, lam: float = 0.95,
                  num_cpus_per_worker: float = 1.0, seed: int = 0,
                  observation_filter: str = "NoFilter",
-                 worker_cls: Optional[type] = None):
+                 worker_cls: Optional[type] = None,
+                 async_sampling: bool = False):
         self.num_workers = num_workers
         kwargs = dict(env=env, env_config=env_config,
                       policy_spec=policy_spec,
                       num_envs=num_envs_per_worker, gamma=gamma, lam=lam,
                       rollout_fragment_length=rollout_fragment_length,
-                      observation_filter=observation_filter)
+                      observation_filter=observation_filter,
+                      async_sampling=async_sampling)
         remote_cls = ray_tpu.remote(num_cpus=num_cpus_per_worker)(
             worker_cls or RolloutWorker)
         self.workers = [remote_cls.remote(seed=seed + 1000 * (i + 1),
