@@ -14,8 +14,8 @@ from ray_tpu.rllib.offline import (BC, BCConfig, JsonReader, JsonWriter,
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
-from ray_tpu.rllib.td3 import (DDPG, DDPGConfig, TD3, TD3Config,
-                              TD3Policy)
+from ray_tpu.rllib.td3 import (ApexDDPG, ApexDDPGConfig, DDPG,
+                               DDPGConfig, TD3, TD3Config, TD3Policy)
 from ray_tpu.rllib.cql_es import CQL, CQLConfig, ES, ESConfig
 from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.bandit import (LinTS, LinTSConfig, LinUCB,
@@ -27,6 +27,7 @@ from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, QMIXPolicy
+from ray_tpu.rllib.slateq import SlateQ, SlateQConfig, SlateQPolicy
 from ray_tpu.rllib.pg import (A2C, A2CConfig, A3C, A3CConfig, PG,
                               PGConfig)
 from ray_tpu.rllib.r2d2 import R2D2, R2D2Config, R2D2Policy
@@ -53,4 +54,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "CRR", "CRRConfig", "R2D2", "R2D2Config", "R2D2Policy",
            "QMIX", "QMIXConfig", "QMIXPolicy", "MADDPG",
            "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig",
-           "AsyncSampler", "DT", "DTConfig"]
+           "AsyncSampler", "DT", "DTConfig", "ApexDDPG",
+           "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy"]

@@ -50,6 +50,7 @@ def _episode_windows(data, K: int):
     """Cut logged transitions into per-episode (rtg, obs, act) windows
     of length K (pre-padded with zeros + a validity mask)."""
     obs = np.asarray(data[sb.OBS], np.float32)
+    obs = obs.reshape(len(obs), -1)     # windows are flat-obs rows
     acts = np.asarray(data[sb.ACTIONS]).astype(np.int32)
     rews = np.asarray(data[sb.REWARDS], np.float32)
     dones = np.asarray(data[sb.DONES], bool)

@@ -20,6 +20,7 @@ _TABLE = {
     "SimpleQ": ("SimpleQ", "SimpleQConfig"),
     "ApexDQN": ("ApexDQN", "ApexDQNConfig"),
     "APEX": ("ApexDQN", "ApexDQNConfig"),
+    "ApexDDPG": ("ApexDDPG", "ApexDDPGConfig"),
     "R2D2": ("R2D2", "R2D2Config"),
     "SAC": ("SAC", "SACConfig"),
     "TD3": ("TD3", "TD3Config"),
@@ -31,6 +32,7 @@ _TABLE = {
     "CQL": ("CQL", "CQLConfig"),
     "CRR": ("CRR", "CRRConfig"),
     "DT": ("DT", "DTConfig"),
+    "SlateQ": ("SlateQ", "SlateQConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

@@ -59,7 +59,11 @@ class AsyncSampler:
         import queue as _queue
 
         while not self._stop.is_set():
-            batch = self._sample_fn()
+            try:
+                batch = self._sample_fn()
+            except BaseException as e:  # noqa: BLE001 — surface to caller
+                self._q.put(e)
+                return
             while not self._stop.is_set():
                 try:
                     self._q.put(batch, timeout=0.5)
@@ -68,7 +72,12 @@ class AsyncSampler:
                     continue
 
     def get_batch(self, timeout: float = 300.0) -> SampleBatch:
-        return self._q.get(timeout=timeout)
+        out = self._q.get(timeout=timeout)
+        if isinstance(out, BaseException):
+            # the sampler thread died — re-raise its error promptly
+            # instead of timing out on an empty queue forever
+            raise out
+        return out
 
     def stop(self):
         self._stop.set()

@@ -374,12 +374,18 @@ class ContinuousOffPolicy(Algorithm):
                 ContinuousTransitionWorker)
         self.workers = [
             remote_cls.remote(
-                env=config.env, env_config=config.env_config, spec=spec,
+                env=config.env, env_config=config.env_config,
+                spec=self._worker_spec(config, i),
                 num_envs=config.num_envs_per_worker,
                 rollout_fragment_length=config.rollout_fragment_length,
                 seed=config.seed + 1000 * (i + 1),
                 policy_cls=self._policy_cls)
             for i in range(config.num_workers)]
+
+    def _worker_spec(self, config, i: int):
+        """Spec for worker i — hook for per-worker exploration
+        (ApexDDPG's sigma ladder)."""
+        return self._make_spec(config)
 
     def training_step(self) -> Dict[str, Any]:
         c = self.config
@@ -392,9 +398,7 @@ class ContinuousOffPolicy(Algorithm):
             "timesteps_this_iter": sum(p.count for p in parts)}
         if len(self.buffer) >= max(c.learning_starts,
                                    c.train_batch_size):
-            minis = [self.buffer.sample(c.train_batch_size)
-                     for _ in range(c.train_intensity)]
-            stats.update(self.policy.learn_on_minibatches(minis))
+            stats.update(self._replay_update())
             weights = self.policy.get_weights()
             ref = ray_tpu.put(weights)
             ray_tpu.get([w.set_weights.remote(ref)
@@ -404,6 +408,15 @@ class ContinuousOffPolicy(Algorithm):
             timeout=60.0)
         self._episode_returns.extend(r for p in returns for r in p)
         return stats
+
+    def _replay_update(self) -> Dict[str, Any]:
+        """One learner burst off the replay buffer (train_intensity
+        jitted SGD steps) — shared by the sync driver and the async
+        Ape-X variant."""
+        c = self.config
+        minis = [self.buffer.sample(c.train_batch_size)
+                 for _ in range(c.train_intensity)]
+        return self.policy.learn_on_minibatches(minis)
 
     def cleanup(self) -> None:
         for w in self.workers:

@@ -1,0 +1,368 @@
+"""SlateQ — Q-learning for slate recommendation.
+
+Reference analog: rllib/algorithms/slateq (Ie et al. 2019): the action
+is a SLATE of k documents; SlateQ makes the combinatorial action space
+tractable by decomposing the slate value through a user-choice model:
+
+    Q(s, A) = Σ_{i∈A} P(click=i | s, A) · Q̄(s, i)
+
+with a conditional-logit choice model
+``P(i|s,A) = v(s,i) / (v_null + Σ_{j∈A} v(s,j))`` and an ITEM-level
+Q̄(s, i) learned by TD on the observed click.  Slate construction is
+the standard top-k-by-``v·Q̄`` greedy (the LP-optimal ordering for
+conditional logit).
+
+Env contract (recsim-style): obs is ``{"user": (u,), "docs": (n, f)}``;
+``step(slate_indices)`` returns reward for the clicked doc and
+``info["click"]`` = position-free doc index or -1 for no-click.
+
+TPU-first shape: choice model and item-Q are two small MLP towers;
+both the per-step slate scoring and the minibatch TD/CE update are
+single jitted calls, with the replay row carrying the whole candidate
+doc matrix so the learner never touches the env.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass
+class SlateQSpec:
+    user_dim: int
+    doc_dim: int
+    n_docs: int
+    slate_size: int
+    hidden: Tuple[int, ...] = (64,)
+    embed: int = 32
+    lr: float = 1e-3
+    gamma: float = 0.9
+    #: no-click attractiveness (conditional-logit null weight)
+    v_null: float = 1.0
+
+
+class SlateQPolicy:
+    def __init__(self, spec: SlateQSpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        ku, kd, kq = jax.random.split(jax.random.PRNGKey(seed), 3)
+        e = spec.embed
+        self.params = {
+            # choice model: v(s,d) = exp(user_tower(s)·doc_tower(d))
+            "u_tower": mlp_init(ku, (spec.user_dim, *spec.hidden, e)),
+            "d_tower": mlp_init(kd, (spec.doc_dim, *spec.hidden, e)),
+            # item-level Q̄(s, d)
+            "q": mlp_init(kq, (spec.user_dim + spec.doc_dim,
+                               *spec.hidden, 1)),
+        }
+        self.target = jax.tree.map(np.copy, self.params)
+        self.tx = optax.adam(spec.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def sync_target(self) -> None:
+        import jax
+
+        self.target = jax.tree.map(np.copy, self.get_weights())
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        k = spec.slate_size
+
+        def scores(params, user, docs):
+            """user (..., u), docs (..., n, f) → (v, qbar) each (..., n)."""
+            eu = mlp_apply(params["u_tower"], user, final_linear=True)
+            ed = mlp_apply(params["d_tower"], docs, final_linear=True)
+            v = jnp.exp(jnp.clip(
+                jnp.einsum("...e,...ne->...n", eu, ed), -10.0, 10.0))
+            both = jnp.concatenate(
+                [jnp.broadcast_to(user[..., None, :],
+                                  docs.shape[:-1] + user.shape[-1:]),
+                 docs], axis=-1)
+            qbar = mlp_apply(params["q"], both, final_linear=True)[..., 0]
+            return v, qbar
+
+        def slate_value(params, user, docs, slate):
+            """Q(s, A) under the choice decomposition; slate (..., k)."""
+            v, qbar = scores(params, user, docs)
+            v_s = jnp.take_along_axis(v, slate, axis=-1)
+            q_s = jnp.take_along_axis(qbar, slate, axis=-1)
+            denom = spec.v_null + jnp.sum(v_s, axis=-1, keepdims=True)
+            return jnp.sum(v_s * q_s / denom, axis=-1)
+
+        @jax.jit
+        def greedy_slate(params, user, docs):
+            v, qbar = scores(params, user, docs)
+            _, idx = jax.lax.top_k(v * qbar, k)
+            return idx
+
+        @jax.jit
+        def act(params, user, docs, key, epsilon):
+            greedy = greedy_slate(params, user, docs)
+            ku_, kr = jax.random.split(key)
+            rand = jax.random.choice(kr, spec.n_docs, (k,),
+                                     replace=False)
+            coin = jax.random.uniform(ku_) < epsilon
+            return jnp.where(coin, rand, greedy)
+
+        def loss_fn(params, target, mini):
+            user = mini["user"]                  # (B, u)
+            docs = mini["docs"]                  # (B, n, f)
+            slate = mini["slate"]                # (B, k) int
+            click = mini["click"]                # (B,) int; -1 = none
+            rew = mini["rewards"]                # (B,)
+            done = mini["dones"].astype(jnp.float32)
+            v, qbar = scores(params, user, docs)
+            v_s = jnp.take_along_axis(v, slate, axis=-1)   # (B, k)
+            denom = spec.v_null + jnp.sum(v_s, axis=-1)
+            # --- choice-model CE on the observed (non)click:
+            # P(pos) = v_pos/denom, P(null) = v_null/denom
+            clicked = click >= 0
+            pos = jnp.argmax(
+                slate == jnp.maximum(click, 0)[..., None], axis=-1)
+            p_click = jnp.take_along_axis(
+                v_s, pos[..., None], axis=-1)[..., 0] / denom
+            p_null = spec.v_null / denom
+            choice_nll = -jnp.mean(jnp.where(
+                clicked, jnp.log(p_click + 1e-8),
+                jnp.log(p_null + 1e-8)))
+            # --- item-level TD on the clicked doc (SARSA-style, next
+            # value = decomposed value of the TARGET net's greedy slate)
+            nv, nq = scores(target, mini["next_user"],
+                            mini["next_docs"])
+            _, nidx = jax.lax.top_k(nv * nq, k)
+            nvs = jnp.take_along_axis(nv, nidx, axis=-1)
+            nqs = jnp.take_along_axis(nq, nidx, axis=-1)
+            next_val = jnp.sum(
+                nvs * nqs / (spec.v_null
+                             + jnp.sum(nvs, axis=-1, keepdims=True)),
+                axis=-1)
+            backup = jax.lax.stop_gradient(
+                rew + spec.gamma * (1.0 - done) * next_val)
+            q_clicked = jnp.take_along_axis(
+                qbar, jnp.maximum(click, 0)[..., None],
+                axis=-1)[..., 0]
+            td = jnp.where(clicked, q_clicked - backup, 0.0)
+            td_loss = jnp.sum(jnp.square(td)) / jnp.maximum(
+                jnp.sum(clicked.astype(jnp.float32)), 1.0)
+            return td_loss + choice_nll, (td_loss, choice_nll)
+
+        @jax.jit
+        def update(params, opt_state, target, stacked):
+            import optax
+
+            def step(carry, mini):
+                params, opt_state = carry
+                (_, (td, ce)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, target, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (td, ce)
+
+            (params, opt_state), (tds, ces) = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, jnp.mean(tds), jnp.mean(ces)
+
+        self._act = act
+        self._greedy = greedy_slate
+        self._slate_value = jax.jit(slate_value)
+        self._update = update
+
+    def compute_slate(self, user: np.ndarray, docs: np.ndarray
+                      ) -> np.ndarray:
+        return np.asarray(self._greedy(self.params, user, docs))
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]
+                             ) -> Tuple[float, float]:
+        import jax.numpy as jnp
+
+        stacked = {key: jnp.stack([np.asarray(m[key]) for m in minis])
+                   for key in minis[0].keys()}
+        self.params, self.opt_state, td, ce = self._update(
+            self.params, self.opt_state, self.target, stacked)
+        return float(td), float(ce)
+
+
+class SlateWorker:
+    """Steps a recsim-style env with the epsilon-greedy slate policy."""
+
+    def __init__(self, *, env_creator, env_config: Optional[Dict],
+                 spec: SlateQSpec, steps_per_sample: int = 200,
+                 seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env = env_creator(env_config or {})
+        self.spec = spec
+        self.policy = SlateQPolicy(spec, seed=seed)
+        self.steps = steps_per_sample
+        self._rng = np.random.RandomState(seed)
+        import jax
+
+        self._key = jax.random.PRNGKey(seed + 71)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self, epsilon: float) -> SampleBatch:
+        import jax
+
+        rows: Dict[str, list] = {key: [] for key in
+                                 ("user", "docs", "slate", "click",
+                                  "rewards", "dones", "next_user",
+                                  "next_docs")}
+        for _ in range(self.steps):
+            user = np.asarray(self._obs["user"], np.float32)
+            docs = np.asarray(self._obs["docs"], np.float32)
+            self._key, k = jax.random.split(self._key)
+            slate = np.asarray(self.policy._act(
+                self.policy.params, user, docs, k, epsilon))
+            obs2, r, term, trunc, info = self.env.step(slate)
+            self._ep_ret += float(r)
+            rows["user"].append(user)
+            rows["docs"].append(docs)
+            rows["slate"].append(slate.astype(np.int32))
+            rows["click"].append(np.int32(info.get("click", -1)))
+            rows["rewards"].append(np.float32(r))
+            rows["dones"].append(bool(term))
+            rows["next_user"].append(
+                np.asarray(obs2["user"], np.float32))
+            rows["next_docs"].append(
+                np.asarray(obs2["docs"], np.float32))
+            if term or trunc:
+                self._returns.append(self._ep_ret)
+                self._ep_ret = 0.0
+                self._obs, _ = self.env.reset(
+                    seed=int(self._rng.randint(0, 2**31 - 1)))
+            else:
+                self._obs = obs2
+        return SampleBatch({key: np.stack(v)
+                            for key, v in rows.items()})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class SlateQConfig(AlgorithmConfig):
+    slate_size: int = 2
+    hidden: Tuple[int, ...] = (64,)
+    embed: int = 32
+    v_null: float = 1.0
+    lr: float = 1e-3
+    buffer_size: int = 20_000
+    learning_starts: int = 500
+    train_batch_size: int = 64
+    train_intensity: int = 4
+    target_update_freq: int = 500
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_steps: int = 6000
+    steps_per_sample: int = 200
+    user_dim: Optional[int] = None
+    doc_dim: Optional[int] = None
+    n_docs: Optional[int] = None
+
+
+class SlateQ(Algorithm):
+    _config_cls = SlateQConfig
+
+    def setup(self, config: SlateQConfig) -> None:
+        if (config.user_dim is None or config.doc_dim is None
+                or config.n_docs is None):
+            env = config.env(config.env_config or {})
+            obs, _ = env.reset(seed=0)
+            config.user_dim = int(np.asarray(obs["user"]).shape[-1])
+            config.n_docs, config.doc_dim = \
+                np.asarray(obs["docs"]).shape
+        spec = SlateQSpec(
+            user_dim=config.user_dim, doc_dim=config.doc_dim,
+            n_docs=config.n_docs, slate_size=config.slate_size,
+            hidden=tuple(config.hidden), embed=config.embed,
+            lr=config.lr, gamma=config.gamma, v_null=config.v_null)
+        self.policy = SlateQPolicy(spec, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(SlateWorker)
+        self.workers = [
+            remote_cls.remote(env_creator=config.env,
+                              env_config=config.env_config, spec=spec,
+                              steps_per_sample=config.steps_per_sample,
+                              seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+        self._env_steps = 0
+        self._last_target_sync = 0
+
+    def _epsilon(self) -> float:
+        from ray_tpu.rllib.dqn import linear_epsilon
+
+        return linear_epsilon(self._env_steps, self.config)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        eps = self._epsilon()
+        parts = ray_tpu.get([w.sample.remote(eps) for w in self.workers],
+                            timeout=300.0)
+        for p in parts:
+            self.buffer.add(p)
+            self._env_steps += p.count
+        stats: Dict[str, Any] = {
+            "epsilon": eps, "buffer_size": len(self.buffer),
+            "timesteps_this_iter": sum(p.count for p in parts)}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            td, ce = self.policy.learn_on_minibatches(minis)
+            stats["td_loss"] = td
+            stats["choice_nll"] = ce
+            if (self._env_steps - self._last_target_sync
+                    >= c.target_update_freq):
+                self.policy.sync_target()
+                self._last_target_sync = self._env_steps
+            ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -240,3 +240,70 @@ class DDPGConfig(TD3Config):
 
 class DDPG(TD3):
     _config_cls = DDPGConfig
+
+
+@dataclasses.dataclass
+class ApexDDPGConfig(DDPGConfig):
+    """Reference rllib/algorithms/apex_ddpg/apex_ddpg.py: DDPG under
+    the Ape-X pattern — many exploration actors on a per-worker noise
+    ladder feed replay while the learner updates continuously."""
+    num_workers: int = 2
+    #: worker i explores with sigma = expl_sigma * ladder_base **
+    #: (i/(N-1)) — a fixed spread of exploration scales, the continuous
+    #: counterpart of Ape-X's epsilon ladder
+    ladder_base: float = 4.0
+    #: learner rounds per training_step (each consumes whichever
+    #: worker fragment lands first)
+    updates_per_iter: int = 4
+
+
+class ApexDDPG(DDPG):
+    """Async DDPG: every worker always has a sample task in flight
+    (`ray_tpu.wait`), fragments feed the shared buffer as they land,
+    and fresh weights go back only to the worker just consumed — the
+    Ape-X dataflow over the ContinuousOffPolicy learner."""
+
+    _config_cls = ApexDDPGConfig
+
+    def _worker_spec(self, config: ApexDDPGConfig, i: int):
+        n = max(1, config.num_workers)
+        sigma = float(config.expl_sigma
+                      * config.ladder_base ** (i / max(1, n - 1)))
+        self._worker_sigmas.append(sigma)
+        return dataclasses.replace(self._make_spec(config),
+                                   expl_sigma=sigma)
+
+    def setup(self, config: ApexDDPGConfig) -> None:
+        self._worker_sigmas: List[float] = []
+        super().setup(config)   # workers get ladder sigmas via the hook
+        self._inflight = {w.sample.remote(): w for w in self.workers}
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        c = self.config
+        stats: Dict[str, Any] = {"buffer_size": len(self.buffer),
+                                 "sigmas": list(self._worker_sigmas)}
+        steps = 0
+        for _ in range(c.updates_per_iter):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=300.0)
+            if not ready:
+                raise TimeoutError("no rollout arrived within 300s")
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            part = ray_tpu.get(ref)
+            self.buffer.add(part)
+            steps += part.count
+            if len(self.buffer) >= max(c.learning_starts,
+                                       c.train_batch_size):
+                stats.update(self._replay_update())
+                worker.set_weights.remote(
+                    ray_tpu.put(self.policy.get_weights()))
+            self._inflight[worker.sample.remote()] = worker
+        stats["timesteps_this_iter"] = steps
+        returns = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in returns for r in p)
+        return stats

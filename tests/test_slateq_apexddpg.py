@@ -1,0 +1,171 @@
+"""SlateQ (slate recommendation) and ApexDDPG (async continuous
+off-policy).
+
+Reference analogs: rllib/algorithms/slateq and
+rllib/algorithms/apex_ddpg — learning checks follow the
+check_learning_achieved pattern scaled to CI
+(rllib/utils/test_utils.py:480).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (ApexDDPG, ApexDDPGConfig, SlateQ,
+                           SlateQConfig)
+
+
+class _RecEnv:
+    """Recsim-style slate env: hidden user taste w; v*(d) = exp(w·d)
+    drives a conditional-logit click among the slate + null; reward =
+    the clicked doc's quality (last feature).  Learning the choice
+    model AND ranking by v·q beats random slates by a wide margin."""
+
+    LEN = 10
+    N_DOCS = 12
+    DOC_DIM = 4
+
+    def __init__(self, seed=0):
+        self._rng = np.random.RandomState(seed)
+        self._w = np.asarray([1.5, -1.0, 0.5])
+
+    def _draw(self):
+        docs = self._rng.randn(self.N_DOCS,
+                               self.DOC_DIM).astype(np.float32)
+        return {"user": np.asarray([1.0], np.float32),
+                "docs": docs}
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._t = 0
+        self._obs = self._draw()
+        return self._obs, {}
+
+    def step(self, slate):
+        docs = self._obs["docs"]
+        slate = np.asarray(slate, int)
+        v = np.exp(docs[slate, :3] @ self._w)
+        null = 1.0
+        p = np.concatenate([v, [null]])
+        p = p / p.sum()
+        pick = self._rng.choice(len(p), p=p)
+        if pick < len(slate):
+            click = int(slate[pick])
+            r = float(docs[click, 3])           # quality feature
+        else:
+            click, r = -1, 0.0
+        self._t += 1
+        done = self._t >= self.LEN
+        self._obs = self._draw()
+        return self._obs, r, done, False, {"click": click}
+
+
+def test_slateq_learns_to_rank(ray_start_shared):
+    cfg = SlateQConfig(env=lambda _: _RecEnv(), num_workers=1,
+                       slate_size=2, hidden=(32,), embed=16, lr=3e-3,
+                       buffer_size=10_000, learning_starts=300,
+                       train_batch_size=64, train_intensity=8,
+                       target_update_freq=400, epsilon_decay_steps=2500,
+                       steps_per_sample=250, gamma=0.0, seed=0)
+    algo = SlateQ(cfg)
+    first = None
+    best = -np.inf
+    try:
+        for i in range(25):
+            result = algo.train()
+            mean = result.get("episode_reward_mean", -np.inf)
+            if i == 0:
+                first = mean
+            best = max(best, mean)
+            if best >= 6.0:
+                break
+    finally:
+        algo.stop()
+    # random slates average ~1.5/episode on this env; ranking by
+    # v·quality roughly triples it
+    assert best > first, (first, best)
+    assert best >= 3.5, (first, best)
+
+
+def test_slateq_greedy_slate_ranks_by_v_times_q():
+    from ray_tpu.rllib.slateq import SlateQPolicy, SlateQSpec
+    import jax.numpy as jnp
+    from ray_tpu.rllib.models import mlp_apply
+
+    spec = SlateQSpec(user_dim=2, doc_dim=3, n_docs=6, slate_size=2,
+                      hidden=(8,), embed=4)
+    pol = SlateQPolicy(spec, seed=0)
+    rng = np.random.RandomState(0)
+    user = rng.randn(2).astype(np.float32)
+    docs = rng.randn(6, 3).astype(np.float32)
+    slate = np.asarray(pol._greedy(pol.params, user, docs))
+    # recompute the ranking from the towers directly
+    eu = np.asarray(mlp_apply(pol.params["u_tower"], jnp.asarray(user),
+                              final_linear=True))
+    ed = np.asarray(mlp_apply(pol.params["d_tower"], jnp.asarray(docs),
+                              final_linear=True))
+    v = np.exp(np.clip(ed @ eu, -10, 10))
+    both = np.concatenate(
+        [np.tile(user, (6, 1)), docs], axis=-1)
+    q = np.asarray(mlp_apply(pol.params["q"], jnp.asarray(both),
+                             final_linear=True))[..., 0]
+    want = np.argsort(-(v * q))[:2]
+    np.testing.assert_array_equal(np.sort(slate), np.sort(want))
+
+
+class _PointEnv:
+    def __init__(self, seed=0):
+        import gymnasium as gym
+
+        self.observation_space = gym.spaces.Box(-2.0, 2.0, (1,),
+                                                np.float32)
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (1,), np.float32)
+        self._rng = np.random.RandomState(seed)
+        self._x = 0.0
+        self._t = 0
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._x = float(self._rng.uniform(-2, 2))
+        self._t = 0
+        return np.asarray([self._x], np.float32), {}
+
+    def step(self, a):
+        self._x = float(np.clip(
+            self._x + float(np.asarray(a).ravel()[0]), -2, 2))
+        self._t += 1
+        return (np.asarray([self._x], np.float32), -abs(self._x),
+                False, self._t >= 30, {})
+
+    def close(self):
+        pass
+
+
+def test_apex_ddpg_sigma_ladder():
+    cfg = ApexDDPGConfig(obs_dim=1, action_dim=1, num_workers=3,
+                         expl_sigma=0.1, ladder_base=4.0)
+    # ladder spans expl_sigma .. expl_sigma*base, increasing
+    n = cfg.num_workers
+    sigmas = [cfg.expl_sigma * cfg.ladder_base ** (i / (n - 1))
+              for i in range(n)]
+    assert sigmas[0] == pytest.approx(0.1)
+    assert sigmas[-1] == pytest.approx(0.4)
+    assert sigmas == sorted(sigmas)
+
+
+@pytest.mark.slow
+def test_apex_ddpg_learns_point_control(ray_start_shared):
+    cfg = ApexDDPGConfig(env=lambda _cfg: _PointEnv(), num_workers=2,
+                         rollout_fragment_length=60,
+                         train_batch_size=128, train_intensity=24,
+                         learning_starts=300, updates_per_iter=2,
+                         hidden=(64, 64), lr=1e-3, seed=3)
+    algo = ApexDDPG(cfg)
+    reward = -1e9
+    for _ in range(30):
+        r = algo.train()
+        reward = max(reward, r.get("episode_reward_mean", -1e9))
+    algo.cleanup()
+    assert reward > -12.0, reward
