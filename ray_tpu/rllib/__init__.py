@@ -17,6 +17,8 @@ from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.td3 import (ApexDDPG, ApexDDPGConfig, DDPG,
                                DDPGConfig, TD3, TD3Config, TD3Policy)
 from ray_tpu.rllib.cql_es import CQL, CQLConfig, ES, ESConfig
+from ray_tpu.rllib.alpha_zero import (AlphaZero, AlphaZeroConfig,
+                                      AZNet, MCTS)
 from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.bandit import (LinTS, LinTSConfig, LinUCB,
                                   LinUCBConfig)
@@ -55,4 +57,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "QMIX", "QMIXConfig", "QMIXPolicy", "MADDPG",
            "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig",
            "AsyncSampler", "DT", "DTConfig", "ApexDDPG",
-           "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy"]
+           "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy",
+           "AlphaZero", "AlphaZeroConfig", "AZNet", "MCTS"]

@@ -1,0 +1,373 @@
+"""AlphaZero — MCTS self-play with a learned policy/value network.
+
+Reference analog: rllib/algorithms/alpha_zero (Silver et al. 2017):
+self-play actors run PUCT tree search guided by the current network,
+emit (observation, visit-count policy target, final outcome) triples,
+and the learner minimizes ``CE(policy, π_MCTS) + MSE(value, z)``.
+
+Game protocol (two-player, zero-sum, perfect information — the
+reference wraps envs with get_state/set_state; here the game exposes
+pure state transitions, which is also what lets search run without
+env copies):
+
+    initial_state() -> state
+    legal_actions(state) -> [int]
+    next_state(state, action) -> state
+    terminal_value(state) -> None | float   # None = non-terminal,
+        else the outcome for the player ABOUT TO MOVE (-1 lost, 0
+        draw, +1 won — usually -1 or 0, the mover faces the result)
+    to_obs(state) -> np.ndarray             # canonical: current
+        player's perspective
+    n_actions -> int
+
+TPU-first shape: tree search is host-side python on the self-play
+actors (inherently sequential, tiny matmuls); the LEARNER is one jitted
+scan of minibatch steps, and net inference inside search batches all
+legal-children priors in a single call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass
+class AZSpec:
+    obs_dim: int
+    n_actions: int
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 1e-3
+
+
+class AZNet:
+    """Shared trunk → (policy logits, tanh value)."""
+
+    def __init__(self, spec: AZSpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        kt, kp, kv = jax.random.split(jax.random.PRNGKey(seed), 3)
+        feat = spec.hidden[-1]
+        self.params = {
+            "trunk": mlp_init(kt, (spec.obs_dim, *spec.hidden)),
+            "pi": mlp_init(kp, (feat, spec.n_actions)),
+            "v": mlp_init(kv, (feat, 1)),
+        }
+        self.tx = optax.adam(spec.lr)
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        def forward(params, obs):
+            h = mlp_apply(params["trunk"], obs, final_linear=False)
+            logits = mlp_apply(params["pi"], h, final_linear=True)
+            value = jnp.tanh(
+                mlp_apply(params["v"], h, final_linear=True))[..., 0]
+            return logits, value
+
+        def loss_fn(params, mini):
+            logits, value = forward(params, mini["obs"])
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            pi_loss = -jnp.mean(jnp.sum(mini["pi"] * logp, axis=-1))
+            v_loss = jnp.mean(jnp.square(value - mini["z"]))
+            return pi_loss + v_loss, (pi_loss, v_loss)
+
+        @jax.jit
+        def update(params, opt_state, stacked):
+            import optax
+
+            def step(carry, mini):
+                params, opt_state = carry
+                (_, (pl, vl)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mini)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), (pl, vl)
+
+            (params, opt_state), (pls, vls) = jax.lax.scan(
+                step, (params, opt_state), stacked)
+            return params, opt_state, jnp.mean(pls), jnp.mean(vls)
+
+        self._forward = jax.jit(forward)
+        self._update = update
+
+    def infer(self, obs: np.ndarray) -> Tuple[np.ndarray, float]:
+        logits, value = self._forward(self.params, obs[None])
+        return np.asarray(logits)[0], float(np.asarray(value)[0])
+
+    def learn_on_minibatches(self, minis: List[SampleBatch]
+                             ) -> Tuple[float, float]:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([np.asarray(m[k]) for m in minis])
+                   for k in minis[0].keys()}
+        self.params, self.opt_state, pl, vl = self._update(
+            self.params, self.opt_state, stacked)
+        return float(pl), float(vl)
+
+
+class MCTS:
+    """PUCT search.  Values are stored from the perspective of the
+    player to move at each node; child values negate on backup
+    (zero-sum two-player)."""
+
+    def __init__(self, game, net: AZNet, *, c_puct: float = 1.5,
+                 n_sims: int = 50, dirichlet_alpha: float = 0.6,
+                 root_noise: float = 0.25,
+                 rng: Optional[np.random.RandomState] = None):
+        self.game = game
+        self.net = net
+        self.c = c_puct
+        self.n_sims = n_sims
+        self.alpha = dirichlet_alpha
+        self.noise = root_noise
+        self.rng = rng or np.random.RandomState(0)
+
+    def policy(self, state, temperature: float = 1.0) -> np.ndarray:
+        """Visit-count distribution over ALL actions after n_sims."""
+        root = _Node(prior=1.0)
+        self._expand(root, state, add_noise=True)
+        for _ in range(self.n_sims):
+            self._simulate(root, state)
+        counts = np.zeros(self.game.n_actions, np.float64)
+        for a, child in root.children.items():
+            counts[a] = child.N
+        if temperature <= 1e-3:
+            # low temperatures make counts**(1/T) overflow; below this
+            # the distribution is numerically one-hot anyway
+            out = np.zeros_like(counts, np.float32)
+            out[int(np.argmax(counts))] = 1.0
+            return out
+        # log-space tempering: exp((log N - max log N)/T) never
+        # overflows regardless of T
+        with np.errstate(divide="ignore"):
+            logc = np.where(counts > 0, np.log(counts), -np.inf)
+        w = np.exp((logc - logc.max()) / temperature)
+        return (w / max(w.sum(), 1e-8)).astype(np.float32)
+
+    def _expand(self, node: "_Node", state, add_noise: bool = False):
+        legal = self.game.legal_actions(state)
+        logits, value = self.net.infer(self.game.to_obs(state))
+        exp = np.exp(logits - logits.max())
+        priors = exp / max(exp.sum(), 1e-8)
+        if add_noise and self.noise > 0 and len(legal) > 1:
+            noise = self.rng.dirichlet([self.alpha] * len(legal))
+            for i, a in enumerate(legal):
+                priors[a] = ((1 - self.noise) * priors[a]
+                             + self.noise * noise[i])
+        total = sum(priors[a] for a in legal)
+        for a in legal:
+            node.children[a] = _Node(prior=priors[a]
+                                     / max(total, 1e-8))
+        return value
+
+    def _simulate(self, node: "_Node", state) -> float:
+        """Returns the value FOR THE PLAYER TO MOVE at `state`."""
+        term = self.game.terminal_value(state)
+        if term is not None:
+            return float(term)
+        if not node.children:
+            value = self._expand(node, state)
+            return value
+        # PUCT selection
+        sqrt_n = math.sqrt(max(1, node.N))
+        best, best_score = None, -1e18
+        for a, child in node.children.items():
+            u = self.c * child.prior * sqrt_n / (1 + child.N)
+            q = child.W / child.N if child.N else 0.0
+            # child.W is from the CHILD mover's view → negate
+            score = -q + u
+            if score > best_score:
+                best, best_score = a, score
+        child = node.children[best]
+        next_state = self.game.next_state(state, best)
+        v_child = self._simulate(child, next_state)
+        child.N += 1
+        child.W += v_child
+        node.N += 1
+        return -v_child
+
+
+class _Node:
+    __slots__ = ("prior", "N", "W", "children")
+
+    def __init__(self, prior: float):
+        self.prior = prior
+        self.N = 0
+        self.W = 0.0
+        self.children: Dict[int, "_Node"] = {}
+
+
+class SelfPlayWorker:
+    """Plays complete self-play games with MCTS and returns
+    (obs, π, z) training rows."""
+
+    def __init__(self, *, game_creator, game_config: Optional[Dict],
+                 spec: AZSpec, n_sims: int = 50,
+                 games_per_sample: int = 4, temperature: float = 1.0,
+                 temp_cutoff: int = 6, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.game = game_creator(game_config or {})
+        self.net = AZNet(spec, seed=seed)
+        self.n_sims = n_sims
+        self.games = games_per_sample
+        self.temperature = temperature
+        self.temp_cutoff = temp_cutoff
+        self.rng = np.random.RandomState(seed)
+        self._returns: List[float] = []
+
+    def set_weights(self, weights) -> None:
+        self.net.set_weights(weights)
+
+    def sample(self) -> SampleBatch:
+        obs_l, pi_l, z_l = [], [], []
+        for _ in range(self.games):
+            mcts = MCTS(self.game, self.net, n_sims=self.n_sims,
+                        rng=self.rng)
+            state = self.game.initial_state()
+            traj: List[Tuple[np.ndarray, np.ndarray]] = []
+            ply = 0
+            while True:
+                term = self.game.terminal_value(state)
+                if term is not None:
+                    # term is for the player to move at `state`; walk
+                    # back alternating signs
+                    z = float(term)
+                    for obs, pi in reversed(traj):
+                        z = -z
+                        obs_l.append(obs)
+                        pi_l.append(pi)
+                        z_l.append(z)
+                    self._returns.append(float(term) * (
+                        -1.0 if ply % 2 else 1.0))
+                    break
+                temp = (self.temperature if ply < self.temp_cutoff
+                        else 1e-7)
+                pi = mcts.policy(state, temperature=temp)
+                traj.append((self.game.to_obs(state), pi))
+                a = int(self.rng.choice(len(pi), p=pi))
+                state = self.game.next_state(state, a)
+                ply += 1
+        return SampleBatch({
+            "obs": np.asarray(obs_l, np.float32),
+            "pi": np.asarray(pi_l, np.float32),
+            "z": np.asarray(z_l, np.float32)})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class AlphaZeroConfig(AlgorithmConfig):
+    hidden: Tuple[int, ...] = (64, 64)
+    lr: float = 1e-3
+    n_sims: int = 50
+    games_per_sample: int = 4
+    temperature: float = 1.0
+    temp_cutoff: int = 6
+    buffer_size: int = 20_000
+    learning_starts: int = 128
+    train_batch_size: int = 64
+    train_intensity: int = 8
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class AlphaZero(Algorithm):
+    _config_cls = AlphaZeroConfig
+
+    def setup(self, config: AlphaZeroConfig) -> None:
+        game = config.env(config.env_config or {})
+        if config.obs_dim is None:
+            config.obs_dim = int(np.asarray(
+                game.to_obs(game.initial_state())).size)
+        if config.n_actions is None:
+            config.n_actions = int(game.n_actions)
+        spec = AZSpec(obs_dim=config.obs_dim,
+                      n_actions=config.n_actions,
+                      hidden=tuple(config.hidden), lr=config.lr)
+        self.net = AZNet(spec, seed=config.seed)
+        self.game = game
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(SelfPlayWorker)
+        self.workers = [
+            remote_cls.remote(
+                game_creator=config.env, game_config=config.env_config,
+                spec=spec, n_sims=config.n_sims,
+                games_per_sample=config.games_per_sample,
+                temperature=config.temperature,
+                temp_cutoff=config.temp_cutoff,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(config.num_workers)]
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        parts = ray_tpu.get([w.sample.remote() for w in self.workers],
+                            timeout=600.0)
+        for p in parts:
+            self.buffer.add(p)
+        stats: Dict[str, Any] = {
+            "buffer_size": len(self.buffer),
+            "timesteps_this_iter": sum(p.count for p in parts)}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            pl, vl = self.net.learn_on_minibatches(minis)
+            stats["pi_loss"] = pl
+            stats["v_loss"] = vl
+            ref = ray_tpu.put(self.net.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def compute_action(self, state, n_sims: Optional[int] = None) -> int:
+        """Greedy MCTS move from `state` with the trained net."""
+        mcts = MCTS(self.game, self.net,
+                    n_sims=n_sims or self.config.n_sims,
+                    root_noise=0.0,
+                    rng=np.random.RandomState(self.config.seed))
+        pi = mcts.policy(state, temperature=1e-7)
+        return int(np.argmax(pi))
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

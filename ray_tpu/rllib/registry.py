@@ -33,6 +33,7 @@ _TABLE = {
     "CRR": ("CRR", "CRRConfig"),
     "DT": ("DT", "DTConfig"),
     "SlateQ": ("SlateQ", "SlateQConfig"),
+    "AlphaZero": ("AlphaZero", "AlphaZeroConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

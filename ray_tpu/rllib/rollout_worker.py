@@ -56,20 +56,25 @@ class AsyncSampler:
         self._thread.start()
 
     def _run(self):
-        import queue as _queue
-
         while not self._stop.is_set():
             try:
                 batch = self._sample_fn()
             except BaseException as e:  # noqa: BLE001 — surface to caller
-                self._q.put(e)
+                self._put_until_stopped(e)
                 return
-            while not self._stop.is_set():
-                try:
-                    self._q.put(batch, timeout=0.5)
-                    break
-                except _queue.Full:
-                    continue
+            if not self._put_until_stopped(batch):
+                return
+
+    def _put_until_stopped(self, item) -> bool:
+        import queue as _queue
+
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.5)
+                return True
+            except _queue.Full:
+                continue
+        return False
 
     def get_batch(self, timeout: float = 300.0) -> SampleBatch:
         out = self._q.get(timeout=timeout)

@@ -155,12 +155,8 @@ class SlateQPolicy:
             nv, nq = scores(target, mini["next_user"],
                             mini["next_docs"])
             _, nidx = jax.lax.top_k(nv * nq, k)
-            nvs = jnp.take_along_axis(nv, nidx, axis=-1)
-            nqs = jnp.take_along_axis(nq, nidx, axis=-1)
-            next_val = jnp.sum(
-                nvs * nqs / (spec.v_null
-                             + jnp.sum(nvs, axis=-1, keepdims=True)),
-                axis=-1)
+            next_val = slate_value(target, mini["next_user"],
+                                   mini["next_docs"], nidx)
             backup = jax.lax.stop_gradient(
                 rew + spec.gamma * (1.0 - done) * next_val)
             q_clicked = jnp.take_along_axis(
@@ -300,10 +296,14 @@ class SlateQ(Algorithm):
         if (config.user_dim is None or config.doc_dim is None
                 or config.n_docs is None):
             env = config.env(config.env_config or {})
-            obs, _ = env.reset(seed=0)
-            config.user_dim = int(np.asarray(obs["user"]).shape[-1])
-            config.n_docs, config.doc_dim = \
-                np.asarray(obs["docs"]).shape
+            try:
+                obs, _ = env.reset(seed=0)
+                config.user_dim = int(
+                    np.asarray(obs["user"]).shape[-1])
+                config.n_docs, config.doc_dim = \
+                    np.asarray(obs["docs"]).shape
+            finally:
+                env.close() if hasattr(env, "close") else None
         spec = SlateQSpec(
             user_dim=config.user_dim, doc_dim=config.doc_dim,
             n_docs=config.n_docs, slate_size=config.slate_size,

@@ -285,6 +285,7 @@ class ApexDDPG(DDPG):
         stats: Dict[str, Any] = {"buffer_size": len(self.buffer),
                                  "sigmas": list(self._worker_sigmas)}
         steps = 0
+        ret_refs = []
         for _ in range(c.updates_per_iter):
             ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                     timeout=300.0)
@@ -300,10 +301,13 @@ class ApexDDPG(DDPG):
                 stats.update(self._replay_update())
                 worker.set_weights.remote(
                     ray_tpu.put(self.policy.get_weights()))
+            # queue the returns pop BEFORE the next fragment: actor
+            # tasks run FIFO, so it completes immediately instead of
+            # waiting behind a (up to 300s) rollout — no global
+            # all-workers barrier in the async path
+            ret_refs.append(worker.pop_episode_returns.remote())
             self._inflight[worker.sample.remote()] = worker
         stats["timesteps_this_iter"] = steps
-        returns = ray_tpu.get(
-            [w.pop_episode_returns.remote() for w in self.workers],
-            timeout=60.0)
+        returns = ray_tpu.get(ret_refs, timeout=60.0)
         self._episode_returns.extend(r for p in returns for r in p)
         return stats
