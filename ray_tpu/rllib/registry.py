@@ -34,6 +34,7 @@ _TABLE = {
     "DT": ("DT", "DTConfig"),
     "SlateQ": ("SlateQ", "SlateQConfig"),
     "AlphaZero": ("AlphaZero", "AlphaZeroConfig"),
+    "MAML": ("MAML", "MAMLConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

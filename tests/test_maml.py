@@ -1,0 +1,109 @@
+"""MAML meta-RL: fast adaptation on a two-armed-bandit task family.
+
+Reference analog: rllib/algorithms/maml — the meta-learned init cannot
+beat chance BEFORE adaptation (the rewarded arm varies per task) but
+one inner step on the task's own rollouts should lift reward well above
+chance; meta-training should grow that adaptation gain.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MAML, MAMLConfig
+
+
+class _BanditTask:
+    """Two arms; the rewarded arm is the task.  Constant obs, so the
+    ONLY way to do well is to adapt to the task's own rollouts."""
+
+    class _Space:
+        def __init__(self, shape=None, n=None):
+            self.shape = shape
+            self.n = n
+
+    def __init__(self, cfg):
+        self.arm = int(cfg.get("arm", 0))
+        self.observation_space = self._Space(shape=(1,))
+        self.action_space = self._Space(n=2)
+        self._t = 0
+
+    def reset(self, seed=None, options=None):
+        self._t = 0
+        return np.asarray([1.0], np.float32), {}
+
+    def step(self, a):
+        r = 1.0 if int(a) == self.arm else 0.0
+        self._t += 1
+        return (np.asarray([1.0], np.float32), r, self._t >= 5,
+                False, {})
+
+    def close(self):
+        pass
+
+
+def _sampler(rng):
+    return {"arm": int(rng.randint(2))}
+
+
+def test_maml_adapts_to_bandit_tasks(ray_start_shared):
+    cfg = MAMLConfig(env=lambda c: _BanditTask(c),
+                     task_sampler=_sampler, num_workers=2,
+                     meta_batch_size=8, episodes_per_task=10,
+                     horizon=5, inner_lr=0.5, lr=5e-3, hidden=(16,),
+                     gamma=0.9, seed=0)
+    algo = MAML(cfg)
+    try:
+        gains = []
+        for _ in range(12):
+            r = algo.train()
+            gains.append(r["adaptation_gain"])
+        # pre-adaptation reward is pinned at chance (~2.5/5 episode
+        # steps); post-adaptation must be clearly above it
+        assert r["pre_adapt_reward"] < 3.5, r
+        late = float(np.mean(gains[-4:]))
+        assert late > 0.5, (gains, r)
+        # the meta-objective also shows on a fresh held-out task
+        adapted, out = algo.adapt_to({"arm": 1})
+        assert out["post"]["mean_reward"] > \
+            out["pre"]["mean_reward"] + 0.5, out["post"]["mean_reward"]
+    finally:
+        algo.stop()
+
+
+def test_maml_requires_task_sampler():
+    with pytest.raises(ValueError, match="task_sampler"):
+        MAML(MAMLConfig(env=lambda c: _BanditTask(c), obs_dim=1,
+                        n_actions=2))
+
+
+def test_maml_inner_step_is_differentiable():
+    # the meta-gradient must flow THROUGH the inner update: for a
+    # quadratic-free sanity check, perturbing θ changes θ'(θ) and the
+    # outer grad is nonzero where a first-order-only grad would vanish
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.maml import _adapt, _policy_loss
+    from ray_tpu.rllib.models import mlp_init
+
+    params = mlp_init(jax.random.PRNGKey(0), (1, 2))
+    obs = jnp.ones((8, 1))
+    acts = jnp.asarray([0, 1] * 4)
+    # asymmetric returns: perfectly balanced ±1 returns make the
+    # curvature term cancel at this init, hiding the 2nd-order signal
+    rets = jnp.asarray([1.0, -0.5, 1.0, 0.3, -1.0, 0.7, 0.2, -0.1])
+
+    def outer(params):
+        adapted = _adapt(params, 0.5, obs, acts, rets)
+        return _policy_loss(adapted, obs, acts, rets)
+
+    g = jax.grad(outer)(params)
+    flat = jnp.concatenate([x.ravel() for x in jax.tree.leaves(g)])
+    assert float(jnp.max(jnp.abs(flat))) > 0.0
+    # and differs from the gradient AT the adapted point (i.e. the
+    # second-order term is present)
+    adapted = _adapt(params, 0.5, obs, acts, rets)
+    g1 = jax.grad(_policy_loss)(adapted, obs, acts, rets)
+    flat1 = jnp.concatenate([x.ravel() for x in jax.tree.leaves(g1)])
+    assert not np.allclose(np.asarray(flat), np.asarray(flat1),
+                           atol=1e-6)
