@@ -29,6 +29,7 @@ from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
 from ray_tpu.rllib.maml import MAML, MAMLConfig
+from ray_tpu.rllib.mbmpo import MBMPO, MBMPOConfig
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, QMIXPolicy
 from ray_tpu.rllib.slateq import SlateQ, SlateQConfig, SlateQPolicy
 from ray_tpu.rllib.pg import (A2C, A2CConfig, A3C, A3CConfig, PG,
@@ -60,4 +61,4 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "AsyncSampler", "DT", "DTConfig", "ApexDDPG",
            "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy",
            "AlphaZero", "AlphaZeroConfig", "AZNet", "MCTS", "MAML",
-           "MAMLConfig"]
+           "MAMLConfig", "MBMPO", "MBMPOConfig"]

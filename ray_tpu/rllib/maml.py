@@ -81,10 +81,18 @@ class MAMLWorker:
         self.horizon = horizon
         self._rng = np.random.RandomState(seed)
 
-    def _rollouts(self, env, params) -> Dict[str, np.ndarray]:
-        import jax
+    def _sample_action(self, params, x: np.ndarray) -> int:
+        """Softmax-sample one action for flat obs x — the single
+        rollout action path shared with subclasses (MBMPO)."""
         import jax.numpy as jnp
 
+        logits = np.asarray(mlp_apply(
+            params, jnp.asarray(x[None]), final_linear=True))[0]
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return int(self._rng.choice(self.spec.n_actions, p=p))
+
+    def _rollouts(self, env, params) -> Dict[str, np.ndarray]:
         spec = self.spec
         E, H = self.episodes, self.horizon
         obs_buf = np.zeros((E, H, spec.obs_dim), np.float32)
@@ -96,11 +104,7 @@ class MAMLWorker:
                 seed=int(self._rng.randint(0, 2**31 - 1)))
             for t in range(H):
                 x = np.asarray(obs, np.float32).ravel()
-                logits = np.asarray(mlp_apply(
-                    params, jnp.asarray(x[None]), final_linear=True))[0]
-                p = np.exp(logits - logits.max())
-                p /= p.sum()
-                a = int(self._rng.choice(spec.n_actions, p=p))
+                a = self._sample_action(params, x)
                 obs2, r, term, trunc, _ = env.step(a)
                 obs_buf[e, t] = x
                 act_buf[e, t] = a

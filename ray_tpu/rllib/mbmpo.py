@@ -1,0 +1,290 @@
+"""MBMPO — Model-Based Meta-Policy Optimization.
+
+Reference analog: rllib/algorithms/mbmpo (Clavera et al. 2018): learn
+an ENSEMBLE of dynamics models from real transitions, then treat each
+ensemble member as a TASK for MAML — the policy meta-learns to adapt
+quickly to any plausible dynamics, which absorbs model error instead of
+exploiting it.  Loop: collect real data → fit ensemble → meta-update on
+imagined rollouts → repeat.
+
+TPU-first shape: this is the most compiler-friendly algorithm in the
+library — after real data lands on device, EVERYTHING is one jitted
+program: imagination is a `lax.scan` through the model, the ensemble
+axis is a `vmap`, the inner adaptation is `jax.grad` composed inside
+the outer `jax.grad` (MAML), and the ensemble fit is a scanned SGD.
+The reference's torch version interleaves python worker loops for all
+of this; here only the REAL-env stepping is host-side.
+
+Discrete actions (categorical policy, one-hot model input).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.maml import MAMLSpec, MAMLWorker, _adapt, _policy_loss
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+
+
+@dataclasses.dataclass
+class MBMPOConfig(AlgorithmConfig):
+    ensemble_size: int = 4
+    hidden: Tuple[int, ...] = (32,)
+    model_hidden: Tuple[int, ...] = (64, 64)
+    #: real-env episodes collected per training_step per worker
+    real_episodes: int = 8
+    horizon: int = 10
+    #: imagined rollouts per ensemble member per meta-step
+    imagined_rollouts: int = 16
+    model_sgd_steps: int = 100
+    model_batch_size: int = 64
+    model_lr: float = 1e-3
+    inner_lr: float = 0.1
+    lr: float = 1e-2
+    meta_steps_per_iter: int = 2
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class _RealWorker(MAMLWorker):
+    """Collects real transitions with the softmax policy — reuses the
+    MAML worker's rollout machinery, returning raw (s, a, r, s')."""
+
+    def collect(self, weights) -> Dict[str, np.ndarray]:
+        """Returns FIXED-CAPACITY (E*H) padded arrays + n_valid so the
+        learner's jitted programs never retrace on episode length."""
+        import jax
+
+        env = self._creator({})
+        try:
+            params = jax.tree.map(np.asarray, weights)
+            spec = self.spec
+            E, H = self.episodes, self.horizon
+            cap = E * H
+            s = np.zeros((cap, spec.obs_dim), np.float32)
+            a = np.zeros(cap, np.int32)
+            r = np.zeros(cap, np.float32)
+            s2 = np.zeros((cap, spec.obs_dim), np.float32)
+            n = 0
+            total = 0.0
+            for _ in range(E):
+                obs, _ = env.reset(
+                    seed=int(self._rng.randint(0, 2**31 - 1)))
+                for _t in range(H):
+                    x = np.asarray(obs, np.float32).ravel()
+                    act = self._sample_action(params, x)
+                    obs2, rew, term, trunc, _ = env.step(act)
+                    s[n] = x
+                    a[n] = act
+                    r[n] = float(rew)
+                    s2[n] = np.asarray(obs2, np.float32).ravel()
+                    n += 1
+                    total += float(rew)
+                    obs = obs2
+                    if term or trunc:
+                        break
+            return {"s": s, "a": a, "r": r, "s2": s2, "n_valid": n,
+                    "mean_reward": total / E}
+        finally:
+            env.close() if hasattr(env, "close") else None
+
+
+class MBMPO(Algorithm):
+    _config_cls = MBMPOConfig
+
+    def setup(self, config: MBMPOConfig) -> None:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        if config.obs_dim is None or config.n_actions is None:
+            env = config.env(config.env_config or {})
+            try:
+                config.obs_dim = int(
+                    np.prod(env.observation_space.shape))
+                config.n_actions = int(env.action_space.n)
+            finally:
+                env.close() if hasattr(env, "close") else None
+        d, n_act = config.obs_dim, config.n_actions
+        K = config.ensemble_size
+        key = jax.random.PRNGKey(config.seed)
+        kp, km = jax.random.split(key)
+        self.params = mlp_init(kp, (d, *config.hidden, n_act))
+        # ensemble: (s, onehot a) → (Δs, reward); stacked leading axis
+        model_dims = (d + n_act, *config.model_hidden, d + 1)
+        inits = [mlp_init(k, model_dims)
+                 for k in jax.random.split(km, K)]
+        self.model_params = jax.tree.map(
+            lambda *xs: jnp.stack(xs), *inits)
+        self.policy_tx = optax.adam(config.lr)
+        self.policy_opt = self.policy_tx.init(self.params)
+        self.model_tx = optax.adam(config.model_lr)
+        self.model_opt = self.model_tx.init(self.model_params)
+        self._rng_key = jax.random.PRNGKey(config.seed + 9)
+        self._np_rng = np.random.RandomState(config.seed + 4)
+
+        H = config.horizon
+        R = config.imagined_rollouts
+        alpha = config.inner_lr
+        gamma = config.gamma
+        mb = config.model_batch_size
+        msteps = config.model_sgd_steps
+
+        def model_pred(mp, s, a_onehot):
+            out = mlp_apply(mp, jnp.concatenate([s, a_onehot], -1),
+                            final_linear=True)
+            return s + out[..., :d], out[..., d]
+
+        def model_loss(mp_all, idx_all, s, a_onehot, s2, r):
+            # each ensemble member trains on its OWN bootstrapped
+            # minibatch (idx_all (K, mb)) so members disagree where
+            # data is thin — the ensemble-diversity mechanism MBMPO's
+            # model-error absorption rests on
+            def one(mp, idx):
+                ps2, pr = model_pred(mp, s[idx], a_onehot[idx])
+                return jnp.mean(jnp.square(ps2 - s2[idx])) \
+                    + jnp.mean(jnp.square(pr - r[idx]))
+            return jnp.mean(jax.vmap(one)(mp_all, idx_all))
+
+        @jax.jit
+        def fit_models(mp_all, opt, s, a_onehot, s2, r, n_valid, key):
+            def step(carry, k):
+                mp_all, opt = carry
+                idx = jax.random.randint(k, (K, mb), 0, n_valid)
+                loss, grads = jax.value_and_grad(model_loss)(
+                    mp_all, idx, s, a_onehot, s2, r)
+                updates, opt = self.model_tx.update(grads, opt, mp_all)
+                mp_all = optax.apply_updates(mp_all, updates)
+                return (mp_all, opt), loss
+
+            (mp_all, opt), losses = jax.lax.scan(
+                step, (mp_all, opt), jax.random.split(key, msteps))
+            return mp_all, opt, jnp.mean(losses)
+
+        def imagine(policy, mp, starts, key):
+            """Roll R rollouts of H steps through ONE model; returns
+            flat (obs, acts, standardized returns)."""
+
+            def step(carry, k):
+                s = carry
+                logits = mlp_apply(policy, s, final_linear=True)
+                a = jax.random.categorical(k, logits)     # (R,)
+                onehot = jax.nn.one_hot(a, n_act)
+                s2, r = model_pred(mp, s, onehot)
+                return s2, (s, a, r)
+
+            _, (ss, aa, rr) = jax.lax.scan(
+                step, starts, jax.random.split(key, H))
+            # returns-to-go along the scan (time-major) axis
+            def disc(carry, r):
+                g = r + gamma * carry
+                return g, g
+
+            _, rets = jax.lax.scan(disc, jnp.zeros(R), rr,
+                                   reverse=True)
+            rets = (rets - rets.mean()) / jnp.maximum(rets.std(),
+                                                      1e-6)
+            return (ss.reshape(H * R, d), aa.reshape(H * R),
+                    rets.reshape(H * R))
+
+        def meta_loss(policy, mp_all, starts, keys):
+            def per_model(mp, key):
+                k1, k2 = jax.random.split(key)
+                obs, acts, rets = imagine(policy, mp, starts, k1)
+                adapted = _adapt(policy, alpha, obs, acts, rets)
+                o2, a2, g2 = imagine(adapted, mp, starts, k2)
+                return _policy_loss(adapted, o2, a2, g2)
+
+            return jnp.mean(jax.vmap(per_model)(mp_all, keys))
+
+        @jax.jit
+        def meta_update(policy, opt, mp_all, starts, key):
+            keys = jax.random.split(key, K)
+            loss, grads = jax.value_and_grad(meta_loss)(
+                policy, mp_all, starts, keys)
+            updates, opt = self.policy_tx.update(grads, opt, policy)
+            policy = optax.apply_updates(policy, updates)
+            return policy, opt, loss
+
+        self._fit_models = fit_models
+        self._meta_update = meta_update
+        spec = MAMLSpec(obs_dim=d, n_actions=n_act,
+                        hidden=tuple(config.hidden),
+                        inner_lr=config.inner_lr, gamma=config.gamma)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(_RealWorker)
+        self.workers = [
+            remote_cls.remote(
+                env_creator=lambda _cfg, _e=config.env,
+                _ec=config.env_config: _e(_ec or {}),
+                spec=spec, episodes_per_task=config.real_episodes,
+                horizon=config.horizon,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(max(1, config.num_workers))]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        c = self.config
+        w_ref = ray_tpu.put(jax.tree.map(np.asarray, self.params))
+        parts = ray_tpu.get(
+            [w.collect.remote(w_ref) for w in self.workers],
+            timeout=600.0)
+        # pack valid rows front-first into ONE fixed-capacity buffer
+        # (workers * E * H) — jitted programs see one static shape and
+        # a traced n_valid, so episode-length variation never retraces
+        cap = len(parts) * c.real_episodes * c.horizon
+        d = c.obs_dim
+        s_np = np.zeros((cap, d), np.float32)
+        a_np = np.zeros(cap, np.int32)
+        r_np = np.zeros(cap, np.float32)
+        s2_np = np.zeros((cap, d), np.float32)
+        n_valid = 0
+        for p in parts:
+            n = int(p["n_valid"])
+            s_np[n_valid:n_valid + n] = p["s"][:n]
+            a_np[n_valid:n_valid + n] = p["a"][:n]
+            r_np[n_valid:n_valid + n] = p["r"][:n]
+            s2_np[n_valid:n_valid + n] = p["s2"][:n]
+            n_valid += n
+        s = jnp.asarray(s_np)
+        onehot = jnp.asarray(np.eye(c.n_actions,
+                                    dtype=np.float32)[a_np])
+        s2 = jnp.asarray(s2_np)
+        r = jnp.asarray(r_np)
+
+        self._rng_key, k1 = jax.random.split(self._rng_key)
+        (self.model_params, self.model_opt,
+         model_loss) = self._fit_models(self.model_params,
+                                        self.model_opt, s, onehot,
+                                        s2, r, n_valid, k1)
+        meta_losses = []
+        for _ in range(c.meta_steps_per_iter):
+            idx = self._np_rng.randint(0, n_valid,
+                                       size=c.imagined_rollouts)
+            starts = s[jnp.asarray(idx)]
+            self._rng_key, k2 = jax.random.split(self._rng_key)
+            self.params, self.policy_opt, ml = self._meta_update(
+                self.params, self.policy_opt, self.model_params,
+                starts, k2)
+            meta_losses.append(float(ml))
+        real_r = float(np.mean([p["mean_reward"] for p in parts]))
+        self._episode_returns.append(real_r)
+        return {"model_loss": float(model_loss),
+                "meta_loss": float(np.mean(meta_losses)),
+                "real_mean_reward": real_r,
+                "timesteps_this_iter": int(n_valid)}
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

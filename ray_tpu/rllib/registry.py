@@ -35,6 +35,7 @@ _TABLE = {
     "SlateQ": ("SlateQ", "SlateQConfig"),
     "AlphaZero": ("AlphaZero", "AlphaZeroConfig"),
     "MAML": ("MAML", "MAMLConfig"),
+    "MBMPO": ("MBMPO", "MBMPOConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

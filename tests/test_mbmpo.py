@@ -1,0 +1,102 @@
+"""MBMPO: dynamics-ensemble + MAML meta-policy on a learnable env.
+
+Reference analog: rllib/algorithms/mbmpo.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import MBMPO, MBMPOConfig
+
+
+class _ContextEnv:
+    """Deterministic dynamics: obs is a 2-dim context; acting on the
+    context's argmax yields +1 and flips the context; the dynamics and
+    reward are exactly representable by the model class."""
+
+    class _Space:
+        def __init__(self, shape=None, n=None):
+            self.shape = shape
+            self.n = n
+
+    def __init__(self, seed=0):
+        self.observation_space = self._Space(shape=(2,))
+        self.action_space = self._Space(n=2)
+        self._rng = np.random.RandomState(seed)
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._side = self._rng.randint(2)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        o = np.zeros(2, np.float32)
+        o[self._side] = 1.0
+        return o
+
+    def step(self, a):
+        r = 1.0 if int(a) == self._side else 0.0
+        self._side = 1 - self._side
+        self._t += 1
+        return self._obs(), r, self._t >= 10, False, {}
+
+    def close(self):
+        pass
+
+
+def test_mbmpo_improves_real_reward(ray_start_shared):
+    cfg = MBMPOConfig(env=lambda _: _ContextEnv(), num_workers=2,
+                      ensemble_size=3, hidden=(16,),
+                      model_hidden=(32,), real_episodes=8, horizon=10,
+                      imagined_rollouts=16, model_sgd_steps=80,
+                      inner_lr=0.3, lr=1e-2, meta_steps_per_iter=2,
+                      gamma=0.9, seed=0)
+    algo = MBMPO(cfg)
+    try:
+        first = algo.train()
+        best = -np.inf
+        last = first
+        for _ in range(12):
+            last = algo.train()
+            best = max(best, last["real_mean_reward"])
+        # random play averages ~5/10 steps rewarded; the model is
+        # exactly learnable so the meta-policy should push well above
+        assert last["model_loss"] < first["model_loss"], (
+            first["model_loss"], last["model_loss"])
+        assert best >= 7.0, (first["real_mean_reward"], best)
+    finally:
+        algo.stop()
+
+
+def test_mbmpo_model_learns_dynamics():
+    # the ensemble fit must drive model loss toward zero on the
+    # deterministic env's transitions
+    import jax
+    import jax.numpy as jnp
+
+    cfg = MBMPOConfig(env=lambda _: _ContextEnv(), num_workers=1,
+                      ensemble_size=2, model_hidden=(32,),
+                      model_sgd_steps=200, obs_dim=2, n_actions=2,
+                      seed=0)
+    algo = MBMPO.__new__(MBMPO)
+    algo._episode_returns = []
+    algo.config = cfg
+    MBMPO.setup(algo, cfg)
+    # synthesize exact transitions: s one-hot; correct action flips it
+    s = np.asarray([[1, 0], [0, 1]] * 32, np.float32)
+    a = np.asarray([0, 1] * 32)
+    onehot = jnp.asarray(np.eye(2, dtype=np.float32)[a])
+    s2 = np.asarray([[0, 1], [1, 0]] * 32, np.float32)
+    r = np.ones(64, np.float32)
+    mp, opt, loss1 = algo._fit_models(
+        algo.model_params, algo.model_opt, jnp.asarray(s), onehot,
+        jnp.asarray(s2), jnp.asarray(r), 64, jax.random.PRNGKey(0))
+    _, _, loss2 = algo._fit_models(
+        mp, opt, jnp.asarray(s), onehot, jnp.asarray(s2),
+        jnp.asarray(r), 64, jax.random.PRNGKey(1))
+    assert float(loss2) < float(loss1)
+    assert float(loss2) < 0.05, float(loss2)
+    algo.cleanup()
