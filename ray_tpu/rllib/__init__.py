@@ -26,6 +26,7 @@ from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig, SimpleQ,
                                         SimpleQConfig)
 from ray_tpu.rllib.crr import CRR, CRRConfig
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
+from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
 from ray_tpu.rllib.maml import MAML, MAMLConfig
@@ -61,4 +62,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "AsyncSampler", "DT", "DTConfig", "ApexDDPG",
            "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy",
            "AlphaZero", "AlphaZeroConfig", "AZNet", "MCTS", "MAML",
-           "MAMLConfig", "MBMPO", "MBMPOConfig"]
+           "MAMLConfig", "MBMPO", "MBMPOConfig", "Dreamer",
+           "DreamerConfig"]

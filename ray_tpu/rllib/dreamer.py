@@ -1,0 +1,494 @@
+"""Dreamer — world-model RL trained by latent imagination.
+
+Reference analog: rllib/algorithms/dreamer (Hafner et al. 2020,
+DreamerV1): learn a recurrent state-space model (RSSM) of the
+environment from replayed sequences, then train actor and value
+entirely INSIDE the model by imagining latent rollouts and
+backpropagating λ-returns — real steps are only used to fit the model.
+
+Model (vector-obs variant of the reference's conv RSSM):
+    deterministic:  h_t = GRU(h_{t-1}, [z_{t-1}, a_{t-1}])
+    prior:          z_t ~ N(μ_p(h_t), σ_p(h_t))
+    posterior:      z_t ~ N(μ_q(h_t, enc(o_t)), σ_q)
+    heads:          o_t ≈ dec(h_t, z_t),  r_t ≈ rew(h_t, z_t)
+    loss:           recon MSE + reward MSE + β·KL(q ‖ p)
+
+Behavior: from every posterior state of the training batch, imagine
+``imagine_horizon`` steps with the prior + actor (discrete actions,
+straight-through sampling), compute λ-returns from the reward head and
+the value head, regress value to the λ-return and push the actor by
+REINFORCE on it (+ entropy).
+
+TPU-first shape: model learning (scan over sequence time), imagination
+(scan over horizon, vmapped over every start state), and both behavior
+losses compile into ONE jitted update per minibatch round; rollout
+actors run the same RSSM filter step-by-step on CPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import mlp_apply, mlp_init
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@dataclasses.dataclass
+class DreamerSpec:
+    obs_dim: int
+    n_actions: int
+    deter: int = 64                 # GRU units
+    stoch: int = 16                 # latent dims
+    hidden: Tuple[int, ...] = (64,)
+    seq_len: int = 8
+    imagine_horizon: int = 5
+    model_lr: float = 3e-4
+    actor_lr: float = 1e-3
+    value_lr: float = 1e-3
+    gamma: float = 0.95
+    lam: float = 0.95
+    kl_beta: float = 1.0
+    entropy_coeff: float = 3e-3
+    free_nats: float = 1.0
+
+
+def _gru_init(key, in_dim: int, units: int):
+    import jax
+
+    k1, k2 = jax.random.split(key)
+    scale = np.sqrt(1.0 / (in_dim + units))
+    return {"wz": jax.random.normal(k1, (in_dim + units, 2 * units))
+            * scale,
+            "bz": np.zeros(2 * units, np.float32),
+            "wh": jax.random.normal(k2, (in_dim + units, units))
+            * scale,
+            "bh": np.zeros(units, np.float32)}
+
+
+def _gru_step(p, h, x):
+    import jax
+    import jax.numpy as jnp
+
+    hx = jnp.concatenate([x, h], -1)
+    zr = jax.nn.sigmoid(hx @ p["wz"] + p["bz"])
+    z, r = jnp.split(zr, 2, axis=-1)
+    cand = jnp.tanh(jnp.concatenate([x, r * h], -1) @ p["wh"]
+                    + p["bh"])
+    return (1 - z) * h + z * cand
+
+
+class DreamerPolicy:
+    def __init__(self, spec: DreamerSpec, seed: int = 0):
+        import jax
+        import optax
+
+        self.spec = spec
+        ks = jax.random.split(jax.random.PRNGKey(seed), 9)
+        D, S, A = spec.deter, spec.stoch, spec.n_actions
+        self.params = {
+            "enc": mlp_init(ks[0], (spec.obs_dim, *spec.hidden)),
+            "gru": _gru_init(ks[1], S + A, D),
+            "prior": mlp_init(ks[2], (D, *spec.hidden, 2 * S)),
+            "post": mlp_init(ks[3], (D + spec.hidden[-1],
+                                     *spec.hidden, 2 * S)),
+            "dec": mlp_init(ks[4], (D + S, *spec.hidden,
+                                    spec.obs_dim)),
+            "rew": mlp_init(ks[5], (D + S, *spec.hidden, 1)),
+            "actor": mlp_init(ks[6], (D + S, *spec.hidden, A)),
+            "value": mlp_init(ks[7], (D + S, *spec.hidden, 1)),
+        }
+        self.tx = optax.multi_transform(
+            {"model": optax.adam(spec.model_lr),
+             "actor": optax.adam(spec.actor_lr),
+             "value": optax.adam(spec.value_lr)},
+            {"enc": "model", "gru": "model", "prior": "model",
+             "post": "model", "dec": "model", "rew": "model",
+             "actor": "actor", "value": "value"})
+        self.opt_state = self.tx.init(self.params)
+        self._build_fns()
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+
+        self.params = jax.tree.map(np.asarray, weights)
+
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        S, A = spec.stoch, spec.n_actions
+
+        def split_stats(out):
+            mean, std = out[..., :S], out[..., S:]
+            return mean, jax.nn.softplus(std) + 0.1
+
+        def feat(h, z):
+            return jnp.concatenate([h, z], -1)
+
+        def obs_step(params, h, z, a_onehot, obs, key):
+            """One filtering step: advance determ state, fuse obs."""
+            h = _gru_step(params["gru"], h,
+                          jnp.concatenate([z, a_onehot], -1))
+            e = mlp_apply(params["enc"], obs, final_linear=False)
+            qm, qs = split_stats(mlp_apply(
+                params["post"], jnp.concatenate([h, e], -1),
+                final_linear=True))
+            pm, ps = split_stats(mlp_apply(params["prior"], h,
+                                           final_linear=True))
+            z = qm + qs * jax.random.normal(key, qm.shape)
+            return h, z, (qm, qs, pm, ps)
+
+        def model_loss(params, obs_seq, act_seq, rew_seq, done_seq,
+                       key):
+            """obs (B, L, d), act onehot (B, L, A), rew/done (B, L).
+            done_t marks episode end AFTER step t: the recurrent carry
+            resets across it and the reward alignment masks it, so
+            sequences may span episode boundaries without training the
+            model on spurious reset transitions."""
+            B, L, _ = obs_seq.shape
+            h0 = jnp.zeros((B, spec.deter))
+            z0 = jnp.zeros((B, S))
+            a0 = jnp.zeros((B, A))
+            acts = jnp.concatenate([a0[:, None], act_seq[:, :-1]],
+                                   axis=1)
+            prev_done = jnp.concatenate(
+                [jnp.zeros((B, 1)), done_seq[:, :-1]], axis=1)
+
+            def step(carry, xs):
+                h, z = carry
+                obs_t, a_t, pd_t, k = xs
+                keep = (1.0 - pd_t)[:, None]
+                h, z, stats = obs_step(params, h * keep, z * keep,
+                                       a_t * keep, obs_t, k)
+                return (h, z), (h, z, stats)
+
+            keys = jax.random.split(key, L)
+            (_, _), (hs, zs, stats) = jax.lax.scan(
+                step, (h0, z0),
+                (jnp.moveaxis(obs_seq, 1, 0),
+                 jnp.moveaxis(acts, 1, 0),
+                 jnp.moveaxis(prev_done, 1, 0), keys))
+            hs = jnp.moveaxis(hs, 1, 0)          # (B, L, D)
+            zs = jnp.moveaxis(zs, 1, 0)
+            qm, qs, pm, ps = (jnp.moveaxis(s, 1, 0) for s in stats)
+            f = feat(hs, zs)
+            recon = mlp_apply(params["dec"], f, final_linear=True)
+            pr = mlp_apply(params["rew"], f, final_linear=True)[..., 0]
+            recon_l = jnp.mean(jnp.square(recon - obs_seq))
+            # alignment: h_{t+1} is the first state that has seen a_t,
+            # and r_t is a_t's reward — predict r_t from feat_{t+1},
+            # masked where t ended an episode (feat_{t+1} is then a
+            # fresh episode, unrelated to r_t)
+            m = 1.0 - done_seq[:, :-1]
+            rew_l = jnp.sum(
+                jnp.square(pr[:, 1:] - rew_seq[:, :-1]) * m) \
+                / jnp.maximum(jnp.sum(m), 1.0)
+            kl = (jnp.log(ps / qs)
+                  + (jnp.square(qs) + jnp.square(qm - pm))
+                  / (2 * jnp.square(ps)) - 0.5)
+            kl = jnp.maximum(jnp.mean(jnp.sum(kl, -1)),
+                             spec.free_nats)
+            return (recon_l + rew_l + spec.kl_beta * kl,
+                    (hs, zs, recon_l, rew_l, kl))
+
+        def imagine(params, h, z, key):
+            """From flat start states (N, ...), imagine H steps with
+            the actor; returns features, rewards, action logp+entropy.
+            Model params are stop-gradiented — only the actor shapes
+            the trajectory."""
+            frozen = jax.lax.stop_gradient(
+                {k: params[k] for k in ("gru", "prior", "rew")})
+
+            def step(carry, k):
+                h, z = carry
+                f = feat(h, z)
+                logits = mlp_apply(params["actor"], f,
+                                   final_linear=True)
+                ka, kz = jax.random.split(k)
+                a = jax.random.categorical(ka, logits)
+                logp_all = jax.nn.log_softmax(logits)
+                logp = jnp.take_along_axis(
+                    logp_all, a[..., None], -1)[..., 0]
+                ent = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+                onehot = jax.nn.one_hot(a, A)
+                h = _gru_step(frozen["gru"], h,
+                              jnp.concatenate([z, onehot], -1))
+                pm, ps = split_stats(mlp_apply(
+                    frozen["prior"], h, final_linear=True))
+                z = pm + ps * jax.random.normal(kz, pm.shape)
+                r = mlp_apply(frozen["rew"], feat(h, z),
+                              final_linear=True)[..., 0]
+                return (h, z), (feat(h, z), r, logp, ent)
+
+            keys = jax.random.split(key, spec.imagine_horizon)
+            _, (fs, rs, logps, ents) = jax.lax.scan(
+                step, (h, z), keys)
+            # prepend the start-state feature so values index states
+            # 0..H and every action i has its own baseline V(state_i)
+            fs = jnp.concatenate([feat(h, z)[None], fs], axis=0)
+            return fs, rs, logps, ents    # fs (H+1, N, F), rest (H, N)
+
+        def behavior_loss(params, hs, zs, key):
+            """Actor/value loss on imagined rollouts from every
+            posterior state (sequence x batch flattened)."""
+            h = jax.lax.stop_gradient(
+                hs.reshape(-1, hs.shape[-1]))
+            z = jax.lax.stop_gradient(
+                zs.reshape(-1, zs.shape[-1]))
+            fs, rs, logps, ents = imagine(params, h, z, key)
+            values = mlp_apply(params["value"], fs,
+                               final_linear=True)[..., 0]  # (H+1, N)
+            # λ-returns: G_i = r_i + γ((1-λ)V_{i+1} + λ G_{i+1}),
+            # bootstrapped at G_H = V_H (no terminals in imagination)
+            def lam_step(carry, xs):
+                r, v_next = xs
+                g = r + spec.gamma * ((1 - spec.lam) * v_next
+                                      + spec.lam * carry)
+                return g, g
+
+            boot = values[-1]
+            _, returns = jax.lax.scan(
+                lam_step, boot,
+                (rs, values[1:]), reverse=True)        # (H, N)
+            adv = jax.lax.stop_gradient(returns - values[:-1])
+            actor_l = -jnp.mean(logps * adv) \
+                - spec.entropy_coeff * jnp.mean(ents)
+            value_l = jnp.mean(jnp.square(
+                values[:-1] - jax.lax.stop_gradient(returns)))
+            return actor_l + value_l, (actor_l, value_l)
+
+        def total_loss(params, obs_seq, act_seq, rew_seq, done_seq,
+                       key):
+            k1, k2 = jax.random.split(key)
+            m_l, (hs, zs, recon_l, rew_l, kl) = model_loss(
+                params, obs_seq, act_seq, rew_seq, done_seq, k1)
+            b_l, (actor_l, value_l) = behavior_loss(params, hs, zs, k2)
+            return m_l + b_l, {"recon": recon_l, "reward": rew_l,
+                               "kl": kl, "actor": actor_l,
+                               "value": value_l}
+
+        @jax.jit
+        def update(params, opt_state, stacked, key):
+            import optax
+
+            def step(carry, xs):
+                params, opt_state, key = carry
+                key, k = jax.random.split(key)
+                (_, stats), grads = jax.value_and_grad(
+                    total_loss, has_aux=True)(
+                        params, xs["obs"], xs["acts"], xs["rews"],
+                        xs["dones"], k)
+                updates, opt_state = self.tx.update(grads, opt_state,
+                                                    params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state, key), stats
+
+            (params, opt_state, _), stats = jax.lax.scan(
+                step, (params, opt_state, key), stacked)
+            return params, opt_state, jax.tree.map(
+                lambda s: s[-1], stats)
+
+        @jax.jit
+        def act(params, h, z, a_onehot, obs, key, greedy):
+            ko, ka = jax.random.split(key)
+            h, z, _ = obs_step(params, h, z, a_onehot, obs, ko)
+            logits = mlp_apply(params["actor"], feat(h, z),
+                               final_linear=True)
+            a_s = jax.random.categorical(ka, logits)
+            a_g = jnp.argmax(logits, -1)
+            return jnp.where(greedy, a_g, a_s), h, z
+
+        self._update = update
+        self._act = act
+
+    def learn_on_minibatches(self, minis: List[Dict], rng_key
+                             ) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        stacked = {k: jnp.stack([np.asarray(m[k]) for m in minis])
+                   for k in minis[0].keys()}
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, stacked, rng_key)
+        return {k: float(v) for k, v in stats.items()}
+
+
+class DreamerWorker:
+    """Collects fixed-length (obs, act, rew) sequences, filtering the
+    RSSM state online with the current model."""
+
+    def __init__(self, *, env_creator, env_config: Optional[Dict],
+                 spec: DreamerSpec, seqs_per_sample: int = 8,
+                 seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ray_tpu.rllib.rollout_worker import _make_env
+
+        self.env = _make_env(env_creator, env_config)
+        self.spec = spec
+        self.policy = DreamerPolicy(spec, seed=seed)
+        self.seqs = seqs_per_sample
+        self._rng = np.random.RandomState(seed)
+        import jax
+
+        self._key = jax.random.PRNGKey(seed + 23)
+        self._reset_live()
+        self._returns: List[float] = []
+        self._ep_ret = 0.0
+
+    def _reset_live(self):
+        spec = self.spec
+        o, _ = self.env.reset(
+            seed=int(self._rng.randint(0, 2**31 - 1)))
+        self._obs = np.asarray(o, np.float32).ravel()
+        self._h = np.zeros((1, spec.deter), np.float32)
+        self._z = np.zeros((1, spec.stoch), np.float32)
+        self._last_a = np.zeros((1, spec.n_actions), np.float32)
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self) -> SampleBatch:
+        import jax
+
+        spec = self.spec
+        L = spec.seq_len
+        rows = {"obs": [], "acts": [], "rews": [], "dones": []}
+        for _ in range(self.seqs):
+            o_seq = np.zeros((L, spec.obs_dim), np.float32)
+            a_seq = np.zeros((L, spec.n_actions), np.float32)
+            r_seq = np.zeros(L, np.float32)
+            d_seq = np.zeros(L, np.float32)
+            for t in range(L):
+                self._key, k = jax.random.split(self._key)
+                a, h, z = self.policy._act(
+                    self.policy.params, self._h, self._z,
+                    self._last_a, self._obs[None], k, False)
+                self._h, self._z = np.asarray(h), np.asarray(z)
+                a = int(np.asarray(a)[0])
+                onehot = np.zeros(spec.n_actions, np.float32)
+                onehot[a] = 1.0
+                obs2, r, term, trunc, _ = self.env.step(a)
+                o_seq[t] = self._obs
+                a_seq[t] = onehot
+                r_seq[t] = float(r)
+                self._ep_ret += float(r)
+                self._obs = np.asarray(obs2, np.float32).ravel()
+                self._last_a = onehot[None]
+                if term or trunc:
+                    d_seq[t] = 1.0
+                    self._returns.append(self._ep_ret)
+                    self._ep_ret = 0.0
+                    self._reset_live()
+            rows["obs"].append(o_seq)
+            rows["acts"].append(a_seq)
+            rows["rews"].append(r_seq)
+            rows["dones"].append(d_seq)
+        return SampleBatch({k: np.stack(v) for k, v in rows.items()})
+
+    def pop_episode_returns(self) -> List[float]:
+        out, self._returns = self._returns, []
+        return out
+
+
+@dataclasses.dataclass
+class DreamerConfig(AlgorithmConfig):
+    deter: int = 64
+    stoch: int = 16
+    hidden: Tuple[int, ...] = (64,)
+    seq_len: int = 8
+    imagine_horizon: int = 5
+    model_lr: float = 3e-4
+    actor_lr: float = 1e-3
+    value_lr: float = 1e-3
+    lam: float = 0.95
+    kl_beta: float = 1.0
+    entropy_coeff: float = 3e-3
+    free_nats: float = 1.0
+    seqs_per_sample: int = 8
+    buffer_size: int = 4000         # sequence rows
+    learning_starts: int = 32
+    train_batch_size: int = 16      # sequences per SGD step
+    train_intensity: int = 4
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class Dreamer(Algorithm):
+    _config_cls = DreamerConfig
+
+    def setup(self, config: DreamerConfig) -> None:
+        import jax
+
+        from ray_tpu.rllib.ppo import _introspect_spaces
+
+        _introspect_spaces(config)
+        spec = DreamerSpec(
+            obs_dim=config.obs_dim, n_actions=config.n_actions,
+            deter=config.deter, stoch=config.stoch,
+            hidden=tuple(config.hidden), seq_len=config.seq_len,
+            imagine_horizon=config.imagine_horizon,
+            model_lr=config.model_lr, actor_lr=config.actor_lr,
+            value_lr=config.value_lr, gamma=config.gamma,
+            lam=config.lam, kl_beta=config.kl_beta,
+            entropy_coeff=config.entropy_coeff,
+            free_nats=config.free_nats)
+        self.policy = DreamerPolicy(spec, seed=config.seed)
+        self.buffer = ReplayBuffer(config.buffer_size,
+                                   seed=config.seed)
+        self._rng_key = jax.random.PRNGKey(config.seed + 11)
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(DreamerWorker)
+        self.workers = [
+            remote_cls.remote(env_creator=config.env,
+                              env_config=config.env_config, spec=spec,
+                              seqs_per_sample=config.seqs_per_sample,
+                              seed=config.seed + 1000 * (i + 1))
+            for i in range(max(1, config.num_workers))]
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        c = self.config
+        parts = ray_tpu.get([w.sample.remote() for w in self.workers],
+                            timeout=600.0)
+        for p in parts:
+            self.buffer.add(p)
+        stats: Dict[str, Any] = {
+            "buffer_rows": len(self.buffer),
+            "timesteps_this_iter":
+                sum(p.count for p in parts) * c.seq_len}
+        if len(self.buffer) >= max(c.learning_starts,
+                                   c.train_batch_size):
+            minis = [self.buffer.sample(c.train_batch_size)
+                     for _ in range(c.train_intensity)]
+            self._rng_key, k = jax.random.split(self._rng_key)
+            stats.update(self.policy.learn_on_minibatches(minis, k))
+            ref = ray_tpu.put(self.policy.get_weights())
+            ray_tpu.get([w.set_weights.remote(ref)
+                         for w in self.workers], timeout=60.0)
+        rets = ray_tpu.get(
+            [w.pop_episode_returns.remote() for w in self.workers],
+            timeout=60.0)
+        self._episode_returns.extend(r for p in rets for r in p)
+        return stats
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -102,14 +102,9 @@ class MBMPO(Algorithm):
         import jax.numpy as jnp
         import optax
 
-        if config.obs_dim is None or config.n_actions is None:
-            env = config.env(config.env_config or {})
-            try:
-                config.obs_dim = int(
-                    np.prod(env.observation_space.shape))
-                config.n_actions = int(env.action_space.n)
-            finally:
-                env.close() if hasattr(env, "close") else None
+        from ray_tpu.rllib.ppo import _introspect_spaces
+
+        _introspect_spaces(config)
         d, n_act = config.obs_dim, config.n_actions
         K = config.ensemble_size
         key = jax.random.PRNGKey(config.seed)

@@ -36,6 +36,7 @@ _TABLE = {
     "AlphaZero": ("AlphaZero", "AlphaZeroConfig"),
     "MAML": ("MAML", "MAMLConfig"),
     "MBMPO": ("MBMPO", "MBMPOConfig"),
+    "Dreamer": ("Dreamer", "DreamerConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

@@ -1,0 +1,43 @@
+"""Shared deterministic toy envs for algorithm learning tests."""
+
+import numpy as np
+
+
+class _Space:
+    def __init__(self, shape=None, n=None):
+        self.shape = shape
+        self.n = n
+
+
+class ContextFlipEnv:
+    """Deterministic: obs is a one-hot side bit; acting on the side
+    yields +1 and flips it.  Dynamics and reward are exactly
+    representable by small models — used by the model-based learning
+    gates (MBMPO, Dreamer)."""
+
+    def __init__(self, seed=0, horizon=10):
+        self.observation_space = _Space(shape=(2,))
+        self.action_space = _Space(n=2)
+        self.horizon = horizon
+        self._rng = np.random.RandomState(seed)
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._side = self._rng.randint(2)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        o = np.zeros(2, np.float32)
+        o[self._side] = 1.0
+        return o
+
+    def step(self, a):
+        r = 1.0 if int(a) == self._side else 0.0
+        self._side = 1 - self._side
+        self._t += 1
+        return self._obs(), r, self._t >= self.horizon, False, {}
+
+    def close(self):
+        pass

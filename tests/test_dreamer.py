@@ -1,0 +1,68 @@
+"""Dreamer: world-model RL by latent imagination.
+
+Reference analog: rllib/algorithms/dreamer — the gate checks the world
+model fits a deterministic env and the imagination-trained actor beats
+chance on it.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import Dreamer, DreamerConfig
+from tests._toy_envs import ContextFlipEnv
+
+
+def test_dreamer_learns_context_env(ray_start_shared):
+    cfg = DreamerConfig(env=lambda _: ContextFlipEnv(horizon=16), num_workers=1,
+                        deter=32, stoch=8, hidden=(32,), seq_len=8,
+                        imagine_horizon=4, model_lr=3e-3,
+                        actor_lr=3e-3, value_lr=3e-3, gamma=0.8,
+                        seqs_per_sample=16, learning_starts=32,
+                        train_batch_size=16, train_intensity=8,
+                        entropy_coeff=1e-3, seed=0)
+    algo = Dreamer(cfg)
+    try:
+        first_stats = None
+        best = -np.inf
+        for i in range(30):
+            r = algo.train()
+            if first_stats is None and "recon" in r:
+                first_stats = r
+            best = max(best, r.get("episode_reward_mean", -np.inf))
+            if best >= 13.0:
+                break
+        # world model must fit the deterministic dynamics...
+        assert r["recon"] < first_stats["recon"], (first_stats, r)
+        assert r["reward"] < 0.1, r
+        # ...and the imagination-trained actor must beat chance
+        # (random play scores ~8/16; solved play 16)
+        assert best >= 11.0, (first_stats, best)
+    finally:
+        algo.stop()
+
+
+def test_dreamer_imagination_shapes():
+    # imagination scan must produce (H, N) rewards/logps from flat
+    # start states without touching an env
+    import jax
+    import jax.numpy as jnp
+    from ray_tpu.rllib.dreamer import DreamerPolicy, DreamerSpec
+
+    spec = DreamerSpec(obs_dim=2, n_actions=2, deter=16, stoch=4,
+                       hidden=(16,), imagine_horizon=6)
+    pol = DreamerPolicy(spec, seed=0)
+    # run one update on synthetic sequences to exercise every path
+    rng = np.random.RandomState(0)
+    minis = [{
+        "obs": rng.randn(4, 8, 2).astype(np.float32),
+        "acts": np.eye(2, dtype=np.float32)[
+            rng.randint(0, 2, (4, 8))],
+        "rews": rng.randn(4, 8).astype(np.float32),
+        # a mid-sequence episode boundary exercises the carry reset
+        "dones": np.tile(np.asarray(
+            [0, 0, 0, 1, 0, 0, 0, 0], np.float32), (4, 1)),
+    } for _ in range(2)]
+    stats = pol.learn_on_minibatches(minis, jax.random.PRNGKey(0))
+    for k in ("recon", "reward", "kl", "actor", "value"):
+        assert np.isfinite(stats[k]), stats

@@ -8,43 +8,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu.rllib import MBMPO, MBMPOConfig
-
-
-class _ContextEnv:
-    """Deterministic dynamics: obs is a 2-dim context; acting on the
-    context's argmax yields +1 and flips the context; the dynamics and
-    reward are exactly representable by the model class."""
-
-    class _Space:
-        def __init__(self, shape=None, n=None):
-            self.shape = shape
-            self.n = n
-
-    def __init__(self, seed=0):
-        self.observation_space = self._Space(shape=(2,))
-        self.action_space = self._Space(n=2)
-        self._rng = np.random.RandomState(seed)
-
-    def reset(self, seed=None, options=None):
-        if seed is not None:
-            self._rng = np.random.RandomState(seed)
-        self._side = self._rng.randint(2)
-        self._t = 0
-        return self._obs(), {}
-
-    def _obs(self):
-        o = np.zeros(2, np.float32)
-        o[self._side] = 1.0
-        return o
-
-    def step(self, a):
-        r = 1.0 if int(a) == self._side else 0.0
-        self._side = 1 - self._side
-        self._t += 1
-        return self._obs(), r, self._t >= 10, False, {}
-
-    def close(self):
-        pass
+from tests._toy_envs import ContextFlipEnv as _ContextEnv
 
 
 def test_mbmpo_improves_real_reward(ray_start_shared):
@@ -71,7 +35,7 @@ def test_mbmpo_improves_real_reward(ray_start_shared):
         algo.stop()
 
 
-def test_mbmpo_model_learns_dynamics():
+def test_mbmpo_model_learns_dynamics(ray_start_shared):
     # the ensemble fit must drive model loss toward zero on the
     # deterministic env's transitions
     import jax
