@@ -29,6 +29,8 @@ from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig
 from ray_tpu.rllib.dt import DT, DTConfig
 from ray_tpu.rllib.maddpg import MADDPG, MADDPGConfig, MADDPGPolicy
+from ray_tpu.rllib.league import (LeagueConfig, LeagueTrainer,
+                                  pfsp_weights)
 from ray_tpu.rllib.maml import MAML, MAMLConfig
 from ray_tpu.rllib.mbmpo import MBMPO, MBMPOConfig
 from ray_tpu.rllib.qmix import QMIX, QMIXConfig, QMIXPolicy
@@ -63,4 +65,5 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy",
            "AlphaZero", "AlphaZeroConfig", "AZNet", "MCTS", "MAML",
            "MAMLConfig", "MBMPO", "MBMPOConfig", "Dreamer",
-           "DreamerConfig"]
+           "DreamerConfig", "LeagueTrainer", "LeagueConfig",
+           "pfsp_weights"]

@@ -99,7 +99,10 @@ class DreamerPolicy:
                                      *spec.hidden, 2 * S)),
             "dec": mlp_init(ks[4], (D + S, *spec.hidden,
                                     spec.obs_dim)),
-            "rew": mlp_init(ks[5], (D + S, *spec.hidden, 1)),
+            # reward is a function of (state, ACTION): r_t = rew(s_t,
+            # a_t) — covers terminal rewards (which have no successor
+            # state inside the episode) and needs no sequence shift
+            "rew": mlp_init(ks[5], (D + S + A, *spec.hidden, 1)),
             "actor": mlp_init(ks[6], (D + S, *spec.hidden, A)),
             "value": mlp_init(ks[7], (D + S, *spec.hidden, 1)),
         }
@@ -185,16 +188,14 @@ class DreamerPolicy:
             qm, qs, pm, ps = (jnp.moveaxis(s, 1, 0) for s in stats)
             f = feat(hs, zs)
             recon = mlp_apply(params["dec"], f, final_linear=True)
-            pr = mlp_apply(params["rew"], f, final_linear=True)[..., 0]
+            # r_t = rew(state_t, a_t): state_t and a_t are always
+            # same-episode (the carry resets on the NEXT step), so
+            # every reward — terminal ones included — trains the head
+            pr = mlp_apply(params["rew"],
+                           jnp.concatenate([f, act_seq], -1),
+                           final_linear=True)[..., 0]
             recon_l = jnp.mean(jnp.square(recon - obs_seq))
-            # alignment: h_{t+1} is the first state that has seen a_t,
-            # and r_t is a_t's reward — predict r_t from feat_{t+1},
-            # masked where t ended an episode (feat_{t+1} is then a
-            # fresh episode, unrelated to r_t)
-            m = 1.0 - done_seq[:, :-1]
-            rew_l = jnp.sum(
-                jnp.square(pr[:, 1:] - rew_seq[:, :-1]) * m) \
-                / jnp.maximum(jnp.sum(m), 1.0)
+            rew_l = jnp.mean(jnp.square(pr - rew_seq))
             kl = (jnp.log(ps / qs)
                   + (jnp.square(qs) + jnp.square(qm - pm))
                   / (2 * jnp.square(ps)) - 0.5)
@@ -223,13 +224,15 @@ class DreamerPolicy:
                     logp_all, a[..., None], -1)[..., 0]
                 ent = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
                 onehot = jax.nn.one_hot(a, A)
+                # reward of THIS action from the pre-step state
+                r = mlp_apply(frozen["rew"],
+                              jnp.concatenate([f, onehot], -1),
+                              final_linear=True)[..., 0]
                 h = _gru_step(frozen["gru"], h,
                               jnp.concatenate([z, onehot], -1))
                 pm, ps = split_stats(mlp_apply(
                     frozen["prior"], h, final_linear=True))
                 z = pm + ps * jax.random.normal(kz, pm.shape)
-                r = mlp_apply(frozen["rew"], feat(h, z),
-                              final_linear=True)[..., 0]
                 return (h, z), (feat(h, z), r, logp, ent)
 
             keys = jax.random.split(key, spec.imagine_horizon)

@@ -1,0 +1,293 @@
+"""AlphaStar-style league self-play training.
+
+Reference analog: rllib/algorithms/alpha_star (Vinyals et al. 2019 —
+the distributed-league part, not the StarCraft model): a LEAGUE of
+policies trains against each other.  The transferable machinery built
+here:
+
+  * frozen SNAPSHOTS of past learners join the league on a cadence,
+  * a running PAYOFF MATRIX (EMA win-rates) between live learners and
+    every league member,
+  * PRIORITIZED FICTITIOUS SELF-PLAY (PFSP) opponent sampling — the
+    main agent prefers opponents it struggles with (weight
+    ``(1-p)·p`` over its win-rate p, the reference's f_hard shape),
+  * a MAIN EXPLOITER that trains ONLY against the current main agent
+    (probing it for weaknesses instead of the whole league).
+
+Env contract: the synchronized two-player subset of MultiAgentEnv with
+agent ids "a" and "b" and zero-sum rewards.  Policies are the standard
+JaxPolicy PPO learner, so the league update is the same jitted scan as
+single-agent PPO — the league adds pure task-layer orchestration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.policy import JaxPolicy, PolicySpec
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def pfsp_weights(win_rates: np.ndarray, mode: str = "hard"
+                 ) -> np.ndarray:
+    """Prioritized fictitious self-play opponent weights from the
+    agent's win-rate p against each candidate (reference alpha_star:
+    f_hard(p) = (1-p)p favors even matches; f_var(p) = (1-p)^2 favors
+    opponents that beat us)."""
+    if mode not in ("hard", "var"):
+        raise ValueError(f"pfsp mode must be 'hard' or 'var', "
+                         f"got {mode!r}")
+    p = np.clip(np.asarray(win_rates, np.float64), 0.0, 1.0)
+    w = (1.0 - p) * p if mode == "hard" else (1.0 - p) ** 2
+    w = w + 1e-3                     # never fully starve an opponent
+    return w / w.sum()
+
+
+class LeagueWorker:
+    """Plays matches between two weight sets on a two-player env and
+    returns the FIRST player's PPO-ready batch plus the match score."""
+
+    def __init__(self, *, env_creator, env_config: Optional[Dict],
+                 spec: PolicySpec, episodes_per_match: int = 8,
+                 horizon: int = 16, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        self.env = env_creator(env_config or {})
+        self.spec = spec
+        self.me = JaxPolicy(spec, seed=seed)
+        self.opp = JaxPolicy(spec, seed=seed + 1)
+        self.episodes = episodes_per_match
+        self.horizon = horizon
+        self._rng = np.random.RandomState(seed)
+
+    def play_match(self, my_weights, opp_weights) -> Dict[str, Any]:
+        self.me.set_weights(my_weights)
+        self.opp.set_weights(opp_weights)
+        obs_l, act_l, logp_l, ret_l = [], [], [], []
+        wins = draws = 0
+        total_r = 0.0
+        for _ in range(self.episodes):
+            obs, _ = self.env.reset(
+                seed=int(self._rng.randint(0, 2**31 - 1)))
+            ep_obs, ep_act, ep_logp, ep_rew = [], [], [], []
+            my_return = 0.0
+            for _t in range(self.horizon):
+                oa = np.asarray(obs["a"], np.float32).ravel()
+                ob = np.asarray(obs["b"], np.float32).ravel()
+                a_act, a_logp, _ = self.me.compute_actions(oa[None])
+                b_act, _, _ = self.opp.compute_actions(ob[None])
+                action_dict = {"a": int(a_act[0]), "b": int(b_act[0])}
+                obs, rew, term, trunc, _ = self.env.step(action_dict)
+                r = float(rew["a"])
+                my_return += r
+                ep_obs.append(oa)
+                ep_act.append(int(a_act[0]))
+                ep_logp.append(float(a_logp[0]))
+                ep_rew.append(r)
+                if term.get("__all__") or trunc.get("__all__"):
+                    break
+            # undiscounted return-to-go as the advantage signal
+            g = 0.0
+            rets = []
+            for r in reversed(ep_rew):
+                g = r + g
+                rets.append(g)
+            rets.reverse()
+            obs_l.extend(ep_obs)
+            act_l.extend(ep_act)
+            logp_l.extend(ep_logp)
+            ret_l.extend(rets)
+            total_r += my_return
+            if my_return > 1e-9:
+                wins += 1
+            elif abs(my_return) <= 1e-9:
+                draws += 1
+        adv = np.asarray(ret_l, np.float32)
+        adv = (adv - adv.mean()) / max(adv.std(), 1e-6)
+        batch = SampleBatch({
+            sb.OBS: np.asarray(obs_l, np.float32),
+            sb.ACTIONS: np.asarray(act_l, np.int64),
+            sb.ACTION_LOGP: np.asarray(logp_l, np.float32),
+            sb.ADVANTAGES: adv,
+            sb.VALUE_TARGETS: np.asarray(ret_l, np.float32),
+        })
+        return {"batch": batch, "wins": wins, "draws": draws,
+                "episodes": self.episodes,
+                "mean_return": total_r / self.episodes}
+
+
+@dataclasses.dataclass
+class LeagueConfig(AlgorithmConfig):
+    episodes_per_match: int = 8
+    horizon: int = 16
+    matches_per_iter: int = 4
+    #: learner snapshots join the league every N training_steps
+    snapshot_every: int = 5
+    max_league_size: int = 12
+    pfsp_mode: str = "hard"
+    #: EMA rate for the payoff matrix
+    payoff_ema: float = 0.1
+    #: train a main-exploiter alongside the main agent
+    train_exploiter: bool = True
+    hidden: Tuple[int, ...] = (32,)
+    num_sgd_iter: int = 2
+    clip_param: float = 0.2
+    entropy_coeff: float = 0.01
+    obs_dim: Optional[int] = None
+    n_actions: Optional[int] = None
+
+
+class LeagueTrainer(Algorithm):
+    """Main agent + optional main-exploiter over a snapshot league."""
+
+    _config_cls = LeagueConfig
+
+    def setup(self, config: LeagueConfig) -> None:
+        if config.obs_dim is None or config.n_actions is None:
+            env = config.env(config.env_config or {})
+            try:
+                obs, _ = env.reset(seed=0)
+                config.obs_dim = int(
+                    np.asarray(obs["a"], np.float32).ravel().shape[0])
+                spaces = getattr(env, "action_spaces", None)
+                config.n_actions = int(
+                    spaces["a"].n if spaces else env.action_space.n)
+            finally:
+                env.close() if hasattr(env, "close") else None
+        spec = PolicySpec(
+            obs_dim=config.obs_dim, n_actions=config.n_actions,
+            hidden=tuple(config.hidden), lr=config.lr,
+            clip_param=config.clip_param,
+            entropy_coeff=config.entropy_coeff,
+            num_sgd_iter=config.num_sgd_iter)
+        self._spec = spec
+        self.main = JaxPolicy(spec, seed=config.seed)
+        self.exploiter = (JaxPolicy(spec, seed=config.seed + 100)
+                          if config.train_exploiter else None)
+        #: league of frozen snapshots; index 0 is the initial main.
+        #: snapshots are immutable → one cached object-store ref each
+        #: serves every match they are sampled for
+        self.league: List[Any] = [self.main.get_weights()]
+        self._league_refs: List[Any] = [ray_tpu.put(self.league[0])]
+        #: main's EMA win-rate against each league member
+        self._payoff: List[float] = [0.5]
+        #: exploiter's EMA win-rate against the live main
+        self._exploiter_payoff = 0.5
+        remote_cls = ray_tpu.remote(
+            num_cpus=config.num_cpus_per_worker)(LeagueWorker)
+        self.workers = [
+            remote_cls.remote(
+                env_creator=config.env, env_config=config.env_config,
+                spec=spec,
+                episodes_per_match=config.episodes_per_match,
+                horizon=config.horizon,
+                seed=config.seed + 1000 * (i + 1))
+            for i in range(max(1, config.num_workers))]
+        self._iter = 0
+
+    def _update_payoff(self, idx: int, result: Dict[str, Any]) -> None:
+        c = self.config
+        rate = result["wins"] / max(1, result["episodes"])
+        self._payoff[idx] = ((1 - c.payoff_ema) * self._payoff[idx]
+                             + c.payoff_ema * rate)
+
+    def training_step(self) -> Dict[str, Any]:
+        c = self.config
+        self._iter += 1
+        # --- main agent: PFSP-sampled league opponents
+        weights = pfsp_weights(np.asarray(self._payoff), c.pfsp_mode)
+        opp_idx = [int(i) for i in np.random.RandomState(
+            c.seed + self._iter).choice(
+                len(self.league), size=c.matches_per_iter, p=weights)]
+        my_ref = ray_tpu.put(self.main.get_weights())
+        refs = [self.workers[i % len(self.workers)].play_match.remote(
+            my_ref, self._league_refs[j])
+            for i, j in enumerate(opp_idx)]
+        # --- exploiter: always vs the CURRENT main
+        if self.exploiter is not None:
+            ex_ref = self.workers[
+                len(refs) % len(self.workers)].play_match.remote(
+                    ray_tpu.put(self.exploiter.get_weights()), my_ref)
+        results = ray_tpu.get(refs, timeout=600.0)
+        steps = 0
+        match_stats: List[Dict[str, float]] = []
+        for j, res in zip(opp_idx, results):
+            self._update_payoff(j, res)
+            match_stats.append(self.main.learn_on_batch(res["batch"]))
+            steps += res["batch"].count
+        # aggregate learner stats across ALL matches (a spike in an
+        # early match must not vanish from train() results)
+        stats: Dict[str, Any] = {
+            k: float(np.mean([m[k] for m in match_stats]))
+            for k in match_stats[0]} if match_stats else {}
+        if self.exploiter is not None:
+            ex_res = ray_tpu.get(ex_ref, timeout=600.0)
+            self._exploiter_payoff = (
+                (1 - c.payoff_ema) * self._exploiter_payoff
+                + c.payoff_ema
+                * ex_res["wins"] / max(1, ex_res["episodes"]))
+            self.exploiter.learn_on_batch(ex_res["batch"])
+            steps += ex_res["batch"].count
+        # --- snapshot cadence: freeze main (and exploiter) into the
+        # league, bounded by max_league_size (drop the oldest
+        # non-initial member)
+        if self._iter % c.snapshot_every == 0:
+            for snap in ([self.main.get_weights()]
+                         + ([self.exploiter.get_weights()]
+                            if self.exploiter is not None else [])):
+                self.league.append(snap)
+                self._league_refs.append(ray_tpu.put(snap))
+                self._payoff.append(0.5)
+            while len(self.league) > c.max_league_size:
+                self.league.pop(1)
+                self._league_refs.pop(1)
+                self._payoff.pop(1)
+        mean_ret = float(np.mean([r["mean_return"] for r in results]))
+        self._episode_returns.append(mean_ret)
+        stats.update({
+            "league_size": len(self.league),
+            "main_mean_return": mean_ret,
+            "main_mean_winrate": float(np.mean(self._payoff)),
+            "exploiter_winrate_vs_main": self._exploiter_payoff,
+            "timesteps_this_iter": steps})
+        return stats
+
+    def policy_probs(self, weights, obs: np.ndarray) -> np.ndarray:
+        """Action distribution of a weight set (exploitability
+        probes)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.models import mlp_apply
+
+        x = jnp.asarray(np.asarray(obs, np.float32).ravel()[None])
+        h = self.main.encoder.apply(weights["pi"]["enc"], x)
+        logits = mlp_apply(weights["pi"]["head"], h)
+        return np.asarray(jax.nn.softmax(logits))[0]
+
+    def main_policy_probs(self, obs: np.ndarray) -> np.ndarray:
+        return self.policy_probs(self.main.params, obs)
+
+    def league_average_probs(self, obs: np.ndarray) -> np.ndarray:
+        """Mean action distribution over league snapshots + the live
+        main — the FICTITIOUS-PLAY average, which is what converges
+        toward the mixed Nash on cyclic games even while the last
+        iterate orbits it."""
+        probs = [self.policy_probs(w, obs) for w in self.league]
+        probs.append(self.main_policy_probs(obs))
+        return np.mean(np.stack(probs), axis=0)
+
+    def cleanup(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+        self.workers = []

@@ -37,6 +37,8 @@ _TABLE = {
     "MAML": ("MAML", "MAMLConfig"),
     "MBMPO": ("MBMPO", "MBMPOConfig"),
     "Dreamer": ("Dreamer", "DreamerConfig"),
+    "AlphaStar": ("LeagueTrainer", "LeagueConfig"),
+    "League": ("LeagueTrainer", "LeagueConfig"),
     "QMIX": ("QMIX", "QMIXConfig"),
     "MADDPG": ("MADDPG", "MADDPGConfig"),
     "MultiAgentPPO": ("MultiAgentPPO", "MultiAgentPPOConfig"),

@@ -1,0 +1,101 @@
+"""AlphaStar-style league self-play on rock-paper-scissors.
+
+Reference analog: rllib/algorithms/alpha_star (the league/PFSP
+machinery).  Pure self-play on RPS chases cycles; league training
+against a growing population should drive the main agent TOWARD the
+mixed Nash (uniform), measured by exploitability.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import LeagueConfig, LeagueTrainer, pfsp_weights
+
+
+from tests._toy_envs import _Space
+
+
+class _RPSEnv:
+    """One-shot rock-paper-scissors, zero-sum, constant obs."""
+
+    #: payoff[a][b] for player a
+    _P = np.asarray([[0, -1, 1], [1, 0, -1], [-1, 1, 0]], np.float32)
+
+    def __init__(self, seed=0):
+        self.action_spaces = {"a": _Space(n=3),
+                              "b": _Space(n=3)}
+
+    def reset(self, seed=None):
+        o = np.asarray([1.0], np.float32)
+        return {"a": o, "b": o}, {}
+
+    def step(self, action_dict):
+        r = float(self._P[int(action_dict["a"]), int(action_dict["b"])])
+        o = np.asarray([1.0], np.float32)
+        return ({"a": o, "b": o}, {"a": r, "b": -r},
+                {"__all__": True}, {"__all__": False}, {})
+
+
+def _exploitability(probs: np.ndarray) -> float:
+    """Best-response value against a fixed RPS strategy (Nash = 0)."""
+    return float(np.max(_RPSEnv._P @ probs))
+
+
+def test_pfsp_weight_shapes():
+    w = pfsp_weights(np.asarray([0.0, 0.5, 1.0]), "hard")
+    # even matches (p=0.5) weigh most; sure wins/losses near zero
+    assert w[1] > w[0] and w[1] > w[2]
+    w2 = pfsp_weights(np.asarray([0.1, 0.9]), "var")
+    # f_var prefers opponents that beat us
+    assert w2[0] > w2[1]
+    np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-9)
+
+
+def test_league_reduces_exploitability(ray_start_shared):
+    cfg = LeagueConfig(env=lambda _: _RPSEnv(), num_workers=2,
+                       episodes_per_match=16, horizon=1,
+                       matches_per_iter=4, snapshot_every=3,
+                       max_league_size=8, lr=5e-2, hidden=(8,),
+                       entropy_coeff=0.02, num_sgd_iter=2, seed=0)
+    algo = LeagueTrainer(cfg)
+    try:
+        obs = np.asarray([1.0], np.float32)
+        for _ in range(20):
+            stats = algo.train()
+        # league growth happened and the payoff matrix is tracked
+        assert stats["league_size"] > 1
+        assert len(algo._payoff) == stats["league_size"]
+        assert 0.0 <= stats["main_mean_winrate"] <= 1.0
+        # the LAST ITERATE orbits the Nash on cyclic games; the
+        # fictitious-play AVERAGE over the league converges toward it
+        # (pure strategy = exploitability 1.0, Nash = 0.0)
+        avg = algo.league_average_probs(obs)
+        assert _exploitability(avg) < 0.5, avg
+        # all three actions stay represented in the average
+        assert avg.min() > 0.03, avg
+    finally:
+        algo.stop()
+
+
+def test_league_snapshot_bound(ray_start_shared):
+    cfg = LeagueConfig(env=lambda _: _RPSEnv(), max_league_size=3,
+                       obs_dim=1, n_actions=3, train_exploiter=True,
+                       num_workers=1)
+    algo = LeagueTrainer.__new__(LeagueTrainer)
+    algo._episode_returns = []
+    algo.config = cfg
+    # setup spawns workers; use the real path then immediately bound-
+    # check snapshot trimming logic without matches
+    LeagueTrainer.setup(algo, cfg)
+    try:
+        for _ in range(5):
+            algo.league.append(algo.main.get_weights())
+            algo._payoff.append(0.5)
+            while len(algo.league) > cfg.max_league_size:
+                algo.league.pop(1)
+                algo._payoff.pop(1)
+        assert len(algo.league) == 3
+        assert len(algo._payoff) == 3
+    finally:
+        algo.cleanup()
