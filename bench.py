@@ -40,9 +40,14 @@ def parse_args(argv=None):
                     help="model preset override (e.g. gpt2-medium for the "
                          "fsdp benchmark); default gpt2 on TPU, tiny on CPU")
     ap.add_argument("--batch", type=int, default=0,
-                    help="global batch override (default 32/chip on TPU)")
+                    help="global batch override (default 24/chip on TPU)")
     ap.add_argument("--steps", type=int, default=0,
                     help="timed steps override")
+    ap.add_argument("--remat", default="",
+                    choices=["", "full", "mlp_only", "dots_nb"],
+                    help="remat policy override; default mlp_only at "
+                         "the default batch (the measured-best b24 "
+                         "config), full remat otherwise")
     return ap.parse_args(argv)
 
 # Backend-init hardening (round-2): round 1 died inside jax.devices()
@@ -223,22 +228,31 @@ def main(args=None):
     on_tpu = jax.default_backend() == "tpu"
     fake_mesh = bool(args.chips) and not on_tpu
     seq = 1024
-    # batch 32/chip measured best on v5e (48 and 64 + chunked loss are
-    # slower; >32 without loss chunking exceeds HBM at f32 logits).
-    batch = args.batch or (32 * max(1, n_chips) if on_tpu else 2)
+    # b24 + mlp_only remat measured best on v5e 2026-07-31 (91,965
+    # tok/s/chip, MFU 0.3486, vs b32/full-remat 90,595/0.3434 —
+    # PERF_NOTES round-5 session-2 sweep); flash fwd bwd recompute is
+    # skipped, attention un-rematted (O(T) flash residuals).  mlp_only
+    # applies only at the DEFAULT batch: user-overridden batches run
+    # full remat unless --remat says otherwise (b32+mlp_only was a
+    # measured compile failure — untested combos must not be implied).
+    batch = args.batch or (24 * max(1, n_chips) if on_tpu else 2)
+    remat_policy = args.remat or ("mlp_only" if not args.batch
+                                  else "full")
     if on_tpu:
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=seq, n_steps=args.steps or 20,
             preset=args.preset or "gpt2", mesh=args.mesh,
-            n_devices=args.chips)
+            n_devices=args.chips, remat_policy=remat_policy)
     elif fake_mesh:  # multi-chip program on emulated devices
         batch = args.batch or max(2 * n_chips, 4)
+        remat_policy = "full"        # smoke paths run the default
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=128, n_steps=args.steps or 2,
             preset=args.preset or "tiny", mesh=args.mesh,
             n_devices=args.chips, use_flash=False)
         seq = 128
     else:  # CPU smoke fallback so bench.py always emits a line
+        remat_policy = "full"
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=128, n_steps=args.steps or 2,
             preset=args.preset or "tiny", use_flash=False)
@@ -259,6 +273,7 @@ def main(args=None):
                             and n_chips % 2 else args.mesh),
                    "mfu": round(mfu, 4),
                    "loss": round(final_loss, 3),
+                   "remat_policy": remat_policy,
                    "backend": jax.default_backend(),
                    "tpu_error": TPU_ERROR},
     }

@@ -514,13 +514,24 @@ def _resident_plan(T: int, causal: bool):
     tiles win, so the classic path keeps it; past T=2048 the whole-T
     score tile no longer compiles (scoped-vmem OOM at (1024, 4096)) and
     resident kv is what makes long single-chip sequences viable at all.
+
+    GATING: the resident BACKWARD kernels are interpret-verified but
+    have not yet compiled on real TPU (the tunnel died mid-session), so
+    auto-dispatch at T<=2048 requires RAYTPU_FLASH_RESIDENT=1 until a
+    chip session confirms them — an unattended bench must never be the
+    first to compile a kernel.  T>2048 stays auto (the classic tile
+    cannot compile there at all, so resident is the only option).
     Returns (bq, bk, chunk) or None."""
+    import os
+
     if not causal:
         return None                 # no skip to win; classic path
-    if T == 2048:
-        return None                 # whole-T tile measured faster
     if T % RESIDENT_CHUNK or T % RESIDENT_BLOCK_Q:
         return None
+    if T <= 2048 and os.environ.get("RAYTPU_FLASH_RESIDENT") != "1":
+        return None
+    if T == 2048:
+        return None                 # whole-T tile measured faster
     return RESIDENT_BLOCK_Q, RESIDENT_BLOCK_Q, RESIDENT_CHUNK
 
 

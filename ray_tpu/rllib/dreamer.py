@@ -157,9 +157,11 @@ class DreamerPolicy:
                        key):
             """obs (B, L, d), act onehot (B, L, A), rew/done (B, L).
             done_t marks episode end AFTER step t: the recurrent carry
-            resets across it and the reward alignment masks it, so
-            sequences may span episode boundaries without training the
-            model on spurious reset transitions."""
+            resets across it, so sequences may span episode boundaries
+            without training the dynamics on spurious reset
+            transitions; the reward head needs no boundary handling
+            because r_t = rew(state_t, a_t) pairs only same-episode
+            quantities."""
             B, L, _ = obs_seq.shape
             h0 = jnp.zeros((B, spec.deter))
             z0 = jnp.zeros((B, S))

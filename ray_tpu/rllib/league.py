@@ -176,6 +176,9 @@ class LeagueTrainer(Algorithm):
         #: serves every match they are sampled for
         self.league: List[Any] = [self.main.get_weights()]
         self._league_refs: List[Any] = [ray_tpu.put(self.league[0])]
+        #: snapshot role per league index ("main" | "exploiter") —
+        #: the fictitious-play average must cover MAIN history only
+        self._roles: List[str] = ["main"]
         #: main's EMA win-rate against each league member
         self._payoff: List[float] = [0.5]
         #: exploiter's EMA win-rate against the live main
@@ -239,16 +242,20 @@ class LeagueTrainer(Algorithm):
         # league, bounded by max_league_size (drop the oldest
         # non-initial member)
         if self._iter % c.snapshot_every == 0:
-            for snap in ([self.main.get_weights()]
-                         + ([self.exploiter.get_weights()]
-                            if self.exploiter is not None else [])):
+            snaps = [("main", self.main.get_weights())]
+            if self.exploiter is not None:
+                snaps.append(("exploiter",
+                              self.exploiter.get_weights()))
+            for role, snap in snaps:
                 self.league.append(snap)
                 self._league_refs.append(ray_tpu.put(snap))
                 self._payoff.append(0.5)
+                self._roles.append(role)
             while len(self.league) > c.max_league_size:
                 self.league.pop(1)
                 self._league_refs.pop(1)
                 self._payoff.pop(1)
+                self._roles.pop(1)
         mean_ret = float(np.mean([r["mean_return"] for r in results]))
         self._episode_returns.append(mean_ret)
         stats.update({
@@ -261,27 +268,34 @@ class LeagueTrainer(Algorithm):
 
     def policy_probs(self, weights, obs: np.ndarray) -> np.ndarray:
         """Action distribution of a weight set (exploitability
-        probes)."""
-        import jax
-        import jax.numpy as jnp
-
-        from ray_tpu.rllib.models import mlp_apply
-
-        x = jnp.asarray(np.asarray(obs, np.float32).ravel()[None])
-        h = self.main.encoder.apply(weights["pi"]["enc"], x)
-        logits = mlp_apply(weights["pi"]["head"], h)
-        return np.asarray(jax.nn.softmax(logits))[0]
+        probes) — through the policy's own forward surface."""
+        return self.main.action_probs(obs, params=weights)[0]
 
     def main_policy_probs(self, obs: np.ndarray) -> np.ndarray:
-        return self.policy_probs(self.main.params, obs)
+        return self.main.action_probs(obs)[0]
 
     def league_average_probs(self, obs: np.ndarray) -> np.ndarray:
-        """Mean action distribution over league snapshots + the live
-        main — the FICTITIOUS-PLAY average, which is what converges
-        toward the mixed Nash on cyclic games even while the last
-        iterate orbits it."""
+        """Mean action distribution over MAIN-role snapshots + the live
+        main — the fictitious-play average of the main agent's own
+        history.  Exploiter snapshots are excluded: they model the
+        main's weaknesses, not its play."""
+        probs = [self.policy_probs(w, obs)
+                 for w, role in zip(self.league, self._roles)
+                 if role == "main"]
+        probs.append(self.main_policy_probs(obs))
+        return np.mean(np.stack(probs), axis=0)
+
+    def population_average_probs(self, obs: np.ndarray) -> np.ndarray:
+        """Mean action distribution over the WHOLE league (all roles)
+        plus the live learners — the population mixture a league
+        deployment samples from.  On cyclic zero-sum games this is the
+        quantity that approaches the mixed Nash: exploiters best-
+        respond to the main and drag the mixture around the cycle's
+        remaining corners (the PSRO/league view of convergence)."""
         probs = [self.policy_probs(w, obs) for w in self.league]
         probs.append(self.main_policy_probs(obs))
+        if self.exploiter is not None:
+            probs.append(self.policy_probs(self.exploiter.params, obs))
         return np.mean(np.stack(probs), axis=0)
 
     def cleanup(self) -> None:

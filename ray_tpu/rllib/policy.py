@@ -241,6 +241,8 @@ class JaxPolicy:
                            enc.apply(params["vf"]["enc"], obs))[..., 0]
             return logits, vf
 
+        self._ff_logits_vf = jax.jit(ff_logits_vf)
+
         def rec_step(params, carry, obs):
             """One recurrent forward: carry x obs -> (carry', logits, vf)."""
             feats = enc.apply(params["enc"], obs)
@@ -526,6 +528,24 @@ class JaxPolicy:
         actions, logp, vf, self._rng = self._act(self.params, obs,
                                                  self._rng)
         return (np.asarray(actions), np.asarray(logp), np.asarray(vf))
+
+    def action_probs(self, obs: np.ndarray,
+                     params=None) -> np.ndarray:
+        """Action distribution at `obs` for feedforward policies —
+        optionally under an EXTERNAL weight pytree with this policy's
+        layout (league snapshot probes)."""
+        import jax
+
+        if self.spec.use_lstm or self.spec.use_attention \
+                or self.spec.continuous:
+            raise NotImplementedError(
+                "action_probs serves feedforward categorical policies")
+        obs = np.asarray(obs, np.float32)
+        if obs.ndim == 1:
+            obs = obs[None]
+        logits, _ = self._ff_logits_vf(
+            self.params if params is None else params, obs)
+        return np.asarray(jax.nn.softmax(logits))
 
     def compute_deterministic_actions(self, obs: np.ndarray) -> np.ndarray:
         """Greedy/mean actions for evaluation (reference:
