@@ -14,6 +14,8 @@ import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import numpy as np
+
 
 @dataclasses.dataclass
 class AlgorithmConfig:
@@ -113,6 +115,119 @@ class Algorithm:
     def stop(self) -> None:
         self.cleanup()
 
+    # -- checkpointing (reference: Algorithm.save / Algorithm.restore) ----
+    _WEIGHT_ATTRS = ("learner_policy", "policy", "net", "main",
+                     "exploiter")
+    _RAW_ATTRS = ("params", "model_params", "theta")
+
+    def _checkpoint_state(self) -> Dict[str, Any]:
+        """Learner state as numpy pytrees — every weight-bearing attr
+        this algorithm exposes (policies with get_weights, raw param
+        trees, ES/ARS theta vectors)."""
+        import jax
+
+        state: Dict[str, Any] = {}
+        for attr in self._WEIGHT_ATTRS:
+            obj = getattr(self, attr, None)
+            if obj is not None and hasattr(obj, "get_weights"):
+                state[attr] = obj.get_weights()
+                for tname in ("target", "target_params"):
+                    tgt = getattr(obj, tname, None)
+                    if tgt is not None:
+                        # target nets are saved EXACTLY (structure
+                        # varies per policy — SAC's is a critic
+                        # subset); a restored off-policy run must not
+                        # bootstrap TD from a random target until the
+                        # next sync
+                        state[f"{attr}::{tname}"] = jax.tree.map(
+                            np.asarray, tgt)
+        for attr in self._RAW_ATTRS:
+            val = getattr(self, attr, None)
+            if val is not None:
+                state[attr] = jax.tree.map(np.asarray, val)
+        if not state:
+            raise NotImplementedError(
+                f"{type(self).__name__} exposes no checkpointable "
+                "state")
+        fs = getattr(self, "_filter_state", None)
+        if fs is not None:
+            # observation-filter statistics are part of the policy:
+            # restored weights without them see unnormalized inputs
+            state["_filter_state"] = fs
+        return state
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
+        for attr, val in state.items():
+            if "::" in attr:
+                continue            # applied with its owner below
+            obj = getattr(self, attr, None)
+            if obj is not None and hasattr(obj, "set_weights"):
+                obj.set_weights(val)
+                for tname in ("target", "target_params"):
+                    tgt = state.get(f"{attr}::{tname}")
+                    if tgt is not None:
+                        setattr(obj, tname, tgt)
+            elif attr in self._WEIGHT_ATTRS:
+                # a policy slot the checkpoint fills but this config
+                # did not construct (e.g. train_exploiter=False
+                # restoring an exploiter-bearing checkpoint): writing
+                # the raw dict would explode later — fail loudly now
+                raise ValueError(
+                    f"checkpoint carries {attr!r} weights but this "
+                    f"{type(self).__name__} config did not construct "
+                    f"that policy")
+            else:
+                setattr(self, attr, val)
+
+    def save(self, checkpoint_dir: str) -> str:
+        """Write a restorable checkpoint; returns its path."""
+        import os
+        import pickle
+
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = os.path.join(checkpoint_dir, "algorithm.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({"state": self._checkpoint_state(),
+                         "iteration": self.iteration,
+                         "timesteps_total": self._timesteps_total,
+                         "algorithm": type(self).__name__}, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        """Load weights + counters saved by ``save`` into this
+        (already-constructed) algorithm."""
+        import os
+        import pickle
+
+        if os.path.isdir(path):
+            path = os.path.join(path, "algorithm.pkl")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        saved = blob.get("algorithm")
+        if saved and saved != type(self).__name__:
+            raise ValueError(
+                f"checkpoint was saved by {saved}, cannot restore "
+                f"into {type(self).__name__}")
+        self._restore_state(blob["state"])
+        self.iteration = blob.get("iteration", 0)
+        self._timesteps_total = blob.get("timesteps_total", 0)
+        # rollout workers must act with the restored weights (and the
+        # restored observation-filter statistics)
+        sync = getattr(self, "workers", None)
+        if sync is not None and hasattr(sync, "sync_weights"):
+            for attr in ("learner_policy", "policy"):
+                obj = getattr(self, attr, None)
+                if obj is not None and hasattr(obj, "get_weights"):
+                    sync.sync_weights(obj.get_weights())
+                    break
+            fs = getattr(self, "_filter_state", None)
+            if fs is not None and hasattr(sync, "workers"):
+                import ray_tpu
+
+                ray_tpu.get(
+                    [w.set_filter_state.remote(fs)
+                     for w in sync.workers], timeout=60.0)
+
     @classmethod
     def as_trainable(cls, base_config: AlgorithmConfig,
                      stop_iters: int = 10) -> Callable:
@@ -122,10 +237,15 @@ class Algorithm:
         def trainable(config: Dict[str, Any]):
             from ray_tpu.air import session
 
-            cfg = base_config.copy().update(**config)
+            overrides = dict(config or {})
+            # per-trial loop bound (tune.run("PPO", config={...,
+            # "training_iterations": N}) routes through here)
+            iters = int(overrides.pop("training_iterations",
+                                      stop_iters))
+            cfg = base_config.copy().update(**overrides)
             algo = cls(cfg)
             try:
-                for _ in range(stop_iters):
+                for _ in range(iters):
                     session.report(algo.train())
             finally:
                 algo.stop()

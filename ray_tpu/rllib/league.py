@@ -150,6 +150,11 @@ class LeagueTrainer(Algorithm):
     _config_cls = LeagueConfig
 
     def setup(self, config: LeagueConfig) -> None:
+        if not callable(config.env):
+            raise ValueError(
+                "LeagueTrainer needs a callable env creator producing "
+                "a two-player MultiAgentEnv (agents 'a'/'b', zero-sum)"
+                " — gymnasium id strings are single-player")
         if config.obs_dim is None or config.n_actions is None:
             env = config.env(config.env_config or {})
             try:
@@ -265,6 +270,20 @@ class LeagueTrainer(Algorithm):
             "exploiter_winrate_vs_main": self._exploiter_payoff,
             "timesteps_this_iter": steps})
         return stats
+
+    def _checkpoint_state(self) -> Dict[str, Any]:
+        state = super()._checkpoint_state()
+        state["league"] = self.league
+        state["_payoff"] = list(self._payoff)
+        state["_roles"] = list(self._roles)
+        state["_iter"] = self._iter
+        state["_exploiter_payoff"] = self._exploiter_payoff
+        return state
+
+    def _restore_state(self, state: Dict[str, Any]) -> None:
+        super()._restore_state(state)
+        # object-store refs are process-local: re-pin every snapshot
+        self._league_refs = [ray_tpu.put(w) for w in self.league]
 
     def policy_probs(self, weights, obs: np.ndarray) -> np.ndarray:
         """Action distribution of a weight set (exploitability

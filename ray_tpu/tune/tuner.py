@@ -66,7 +66,12 @@ class Tuner:
                  resources_per_trial: Optional[Dict[str, float]] = None):
         from ray_tpu.train.base_trainer import BaseTrainer
 
-        if isinstance(trainable, BaseTrainer):
+        if isinstance(trainable, str):
+            # launch-by-name (reference tune.run("PPO", ...)): resolve
+            # through the RLlib algorithm registry
+            self._trainable = _algorithm_trainable(trainable)
+            resources_per_trial = resources_per_trial or {"CPU": 0.5}
+        elif isinstance(trainable, BaseTrainer):
             # Trainer-in-Tuner: each trial runs trainer.training_loop with
             # the trial config merged into its loop config (reference
             # base_trainer.py:353 routes fit() here).
@@ -183,6 +188,19 @@ class Tuner:
         tuner.run_config.name = os.path.basename(path)
         tuner._restored_trials = TrialRunner.load_trials(path)
         return tuner
+
+
+def _algorithm_trainable(name: str) -> Callable:
+    """Function trainable for a registry algorithm — delegates to
+    Algorithm.as_trainable (ONE adapter); trial-config keys are the
+    algorithm's Config fields plus ``training_iterations`` (default
+    10) bounding the loop."""
+    from ray_tpu.rllib.registry import get_algorithm_class
+
+    cls, cfg_cls = get_algorithm_class(name, return_config=True)
+    fn = cls.as_trainable(cfg_cls())
+    fn.__name__ = name
+    return fn
 
 
 def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,

@@ -115,3 +115,26 @@ def test_league_snapshot_bound(ray_start_shared):
         assert len(algo._payoff) == 3
     finally:
         algo.cleanup()
+
+
+def test_league_average_excludes_exploiters(ray_start_shared):
+    # the fictitious-play average covers MAIN history only; the
+    # population mixture includes exploiter snapshots — once an
+    # exploiter snapshot exists the two probes must diverge
+    cfg = LeagueConfig(env=lambda _: _RPSEnv(), num_workers=1,
+                       episodes_per_match=4, horizon=1,
+                       matches_per_iter=1, snapshot_every=1,
+                       hidden=(8,), lr=5e-2, seed=1)
+    algo = LeagueTrainer(cfg)
+    try:
+        for _ in range(3):
+            algo.train()
+        assert "exploiter" in algo._roles
+        obs = np.asarray([1.0], np.float32)
+        avg = algo.league_average_probs(obs)
+        pop = algo.population_average_probs(obs)
+        np.testing.assert_allclose(avg.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(pop.sum(), 1.0, rtol=1e-5)
+        assert not np.allclose(avg, pop)
+    finally:
+        algo.stop()

@@ -1,0 +1,172 @@
+"""RLlib launch surfaces: Algorithm.save/restore, tune launch-by-name,
+and the `rllib train/evaluate/algorithms` CLI.
+
+Reference analogs: Algorithm.save/restore, tune.run("PPO"), and the
+`rllib` CLI (rllib/scripts.py).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import PPO, PPOConfig
+
+
+def test_algorithm_save_restore_roundtrip(ray_start_shared, tmp_path):
+    cfg = PPOConfig(env="CartPole-v1", num_workers=1,
+                    num_envs_per_worker=2, train_batch_size=128,
+                    rollout_fragment_length=64, hidden=(8,), seed=0)
+    algo = PPO(cfg)
+    try:
+        algo.train()
+        path = algo.save(str(tmp_path / "ckpt"))
+        before = algo.learner_policy.get_weights()
+        it = algo.iteration
+
+        algo2 = PPO(cfg)
+        try:
+            algo2.restore(path)
+            after = algo2.learner_policy.get_weights()
+            import jax
+
+            for a, b in zip(jax.tree_util.tree_leaves(before),
+                            jax.tree_util.tree_leaves(after)):
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b))
+            assert algo2.iteration == it
+        finally:
+            algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_dqn_and_es_checkpoint_state(ray_start_shared, tmp_path):
+    # the generic state finder must cover QPolicy algos and raw-theta
+    # algos alike — INCLUDING target networks
+    from ray_tpu.rllib import DQN, DQNConfig, ES, ESConfig
+
+    dqn = DQN(DQNConfig(env="CartPole-v1", num_workers=1, hidden=(8,),
+                        learning_starts=10_000, seed=0))
+    try:
+        state = dqn._checkpoint_state()
+        assert "policy" in state
+        assert "policy::target_params" in state
+    finally:
+        dqn.stop()
+
+    es = ES(ESConfig(env="CartPole-v1", num_workers=1, population=2,
+                     hidden=(4,), seed=0))
+    try:
+        state = es._checkpoint_state()
+        assert "theta" in state
+    finally:
+        es.cleanup()
+
+
+def test_checkpoint_carries_filter_state(ray_start_shared, tmp_path):
+    # MeanStdFilter statistics are part of the policy: they must
+    # round-trip through save/restore (and reject a wrong algorithm)
+    cfg = PPOConfig(env="CartPole-v1", num_workers=1,
+                    num_envs_per_worker=2, train_batch_size=128,
+                    rollout_fragment_length=64, hidden=(8,),
+                    observation_filter="MeanStdFilter", seed=0)
+    algo = PPO(cfg)
+    try:
+        algo.train()
+        assert algo._filter_state is not None
+        path = algo.save(str(tmp_path / "fckpt"))
+    finally:
+        algo.stop()
+    algo2 = PPO(cfg)
+    try:
+        algo2.restore(path)
+        assert algo2._filter_state is not None
+        assert algo2._filter_state["type"] == \
+            algo._filter_state["type"]
+        # the running statistics round-tripped numerically
+        for k, v in algo._filter_state.items():
+            np.testing.assert_array_equal(
+                np.asarray(algo2._filter_state[k]), np.asarray(v))
+    finally:
+        algo2.stop()
+    from ray_tpu.rllib import DQN, DQNConfig
+
+    wrong = DQN(DQNConfig(env="CartPole-v1", num_workers=1,
+                          hidden=(8,), seed=0))
+    try:
+        with pytest.raises(ValueError, match="saved by PPO"):
+            wrong.restore(path)
+    finally:
+        wrong.stop()
+
+
+def test_tune_run_by_name(ray_start_shared):
+    from ray_tpu import tune
+
+    grid = tune.run("PPO", config={
+        "env": "CartPole-v1", "num_workers": 1,
+        "num_envs_per_worker": 2, "train_batch_size": 128,
+        "rollout_fragment_length": 64, "hidden": (8,),
+        "training_iterations": 2, "seed": 0,
+    })
+    t = grid.trials[0]
+    assert t.error is None, t.error
+    assert t.last_result["training_iteration"] == 2
+    assert "episode_reward_mean" in t.last_result
+
+
+def test_tune_rejects_unknown_name():
+    from ray_tpu.tune.tuner import _algorithm_trainable
+
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        _algorithm_trainable("NoSuchAlgo")
+
+
+def test_rllib_cli_train_and_evaluate(tmp_path):
+    # the CLI owns init/shutdown, so drive it in a subprocess
+    import subprocess
+    import sys
+
+    ckpt = tmp_path / "cli_ckpt"
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "rllib", "train",
+         "--run", "PPO", "--env", "CartPole-v1", "--stop-iters", "2",
+         "--config", json.dumps({
+             "num_workers": 1, "num_envs_per_worker": 2,
+             "train_batch_size": 128, "rollout_fragment_length": 64,
+             "hidden": [8], "seed": 0}),
+         "--checkpoint-dir", str(ckpt)],
+        capture_output=True, text=True, timeout=420)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 2, out.stdout
+    assert json.loads(lines[-1])["training_iteration"] == 2
+    assert "checkpoint saved" in out.stdout
+
+    out2 = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "rllib", "evaluate",
+         str(ckpt), "--run", "PPO", "--env", "CartPole-v1",
+         "--episodes", "2",
+         "--config", json.dumps({
+             "num_workers": 1, "num_envs_per_worker": 2,
+             "train_batch_size": 128, "rollout_fragment_length": 64,
+             "hidden": [8], "seed": 0})],
+        capture_output=True, text=True, timeout=420)
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    result = json.loads(
+        [l for l in out2.stdout.splitlines() if l.startswith("{")][-1])
+    assert "episode_reward_mean" in result
+
+
+def test_rllib_cli_algorithms_lists_names():
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "rllib", "algorithms"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    names = out.stdout.split()
+    assert "PPO" in names and "AlphaZero" in names
