@@ -119,6 +119,10 @@ class Algorithm:
     _WEIGHT_ATTRS = ("learner_policy", "policy", "net", "main",
                      "exploiter")
     _RAW_ATTRS = ("params", "model_params", "theta")
+    #: plain scalar counters driving schedules (epsilon decay, target
+    #: sync cadence) — without them a resumed run re-explores from
+    #: scratch and re-gates behind learning_starts
+    _COUNTER_ATTRS = ("_env_steps", "_last_target_sync")
 
     def _checkpoint_state(self) -> Dict[str, Any]:
         """Learner state as numpy pytrees — every weight-bearing attr
@@ -154,6 +158,10 @@ class Algorithm:
             # observation-filter statistics are part of the policy:
             # restored weights without them see unnormalized inputs
             state["_filter_state"] = fs
+        for attr in self._COUNTER_ATTRS:
+            val = getattr(self, attr, None)
+            if val is not None:
+                state[attr] = val
         return state
 
     def _restore_state(self, state: Dict[str, Any]) -> None:
@@ -186,11 +194,15 @@ class Algorithm:
 
         os.makedirs(checkpoint_dir, exist_ok=True)
         path = os.path.join(checkpoint_dir, "algorithm.pkl")
-        with open(path, "wb") as f:
+        # write-then-rename: a crash mid-dump must never truncate the
+        # previous good checkpoint at the same path
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             pickle.dump({"state": self._checkpoint_state(),
                          "iteration": self.iteration,
                          "timesteps_total": self._timesteps_total,
                          "algorithm": type(self).__name__}, f)
+        os.replace(tmp, path)
         return path
 
     def restore(self, path: str) -> None:
@@ -213,20 +225,32 @@ class Algorithm:
         self._timesteps_total = blob.get("timesteps_total", 0)
         # rollout workers must act with the restored weights (and the
         # restored observation-filter statistics)
+        weights = None
+        for attr in ("learner_policy", "policy", "net"):
+            obj = getattr(self, attr, None)
+            if obj is not None and hasattr(obj, "get_weights"):
+                weights = obj.get_weights()
+                break
         sync = getattr(self, "workers", None)
-        if sync is not None and hasattr(sync, "sync_weights"):
-            for attr in ("learner_policy", "policy"):
-                obj = getattr(self, attr, None)
-                if obj is not None and hasattr(obj, "get_weights"):
-                    sync.sync_weights(obj.get_weights())
-                    break
-            fs = getattr(self, "_filter_state", None)
-            if fs is not None and hasattr(sync, "workers"):
-                import ray_tpu
+        if weights is not None and sync is not None:
+            import ray_tpu
 
+            if hasattr(sync, "sync_weights"):      # WorkerSet
+                sync.sync_weights(weights)
+                actors = getattr(sync, "workers", [])
+            else:                                  # raw actor list
+                actors = [w for w in sync
+                          if hasattr(w, "set_weights")]
+                if actors:
+                    ref = ray_tpu.put(weights)
+                    ray_tpu.get([w.set_weights.remote(ref)
+                                 for w in actors], timeout=60.0)
+            fs = getattr(self, "_filter_state", None)
+            if fs is not None and actors:
                 ray_tpu.get(
-                    [w.set_filter_state.remote(fs)
-                     for w in sync.workers], timeout=60.0)
+                    [w.set_filter_state.remote(fs) for w in actors
+                     if hasattr(w, "set_filter_state")],
+                    timeout=60.0)
 
     @classmethod
     def as_trainable(cls, base_config: AlgorithmConfig,

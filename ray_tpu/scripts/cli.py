@@ -253,6 +253,7 @@ def _build_algorithm(args, overrides=None):
     cls, cfg_cls = get_algorithm_class(args.run, return_config=True)
     if overrides is None:
         overrides = json.loads(args.config)
+    overrides.pop("env", None)       # --env wins over a config "env"
     # logical-CPU headroom: rollout workers + a lazy eval worker must
     # co-schedule even on a 1-core box (they are IO/step-bound)
     ray_tpu.init(num_cpus=max(4, (os.cpu_count() or 1) * 2))

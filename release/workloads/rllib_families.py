@@ -1,0 +1,295 @@
+"""Release gate: RLlib algorithm-family breadth.
+
+Runs a short end-to-end train() on one representative of every major
+family group (on-policy, async, off-policy, recurrent, multi-agent,
+model-based, meta, search, offline, bandit, league) and reports how
+many completed with finite results — a regression gate on BREADTH
+(the per-family learning gates live in tests/; reference analog:
+rllib release learning_tests running the whole algorithm matrix).
+
+Emits one JSON line: {"families_ok": N, "families_total": M,
+"failed": [...]}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import ray_tpu  # noqa: E402
+from ray_tpu.rllib.registry import get_algorithm_class  # noqa: E402
+
+
+class _Space:
+    def __init__(self, shape=None, n=None):
+        self.shape = shape
+        self.n = n
+
+
+class _CtxEnv:
+    def __init__(self, seed=0):
+        self.observation_space = _Space(shape=(2,))
+        self.action_space = _Space(n=2)
+        self._rng = np.random.RandomState(seed)
+
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.RandomState(seed)
+        self._side = self._rng.randint(2)
+        self._t = 0
+        return self._obs(), {}
+
+    def _obs(self):
+        o = np.zeros(2, np.float32)
+        o[self._side] = 1.0
+        return o
+
+    def step(self, a):
+        r = 1.0 if int(a) == self._side else 0.0
+        self._side = 1 - self._side
+        self._t += 1
+        return self._obs(), r, self._t >= 10, False, {}
+
+    def close(self):
+        pass
+
+
+class _RPS:
+    _P = np.asarray([[0, -1, 1], [1, 0, -1], [-1, 1, 0]], np.float32)
+
+    def __init__(self, seed=0):
+        self.action_spaces = {"a": _Space(n=3), "b": _Space(n=3)}
+
+    def reset(self, seed=None):
+        o = np.asarray([1.0], np.float32)
+        return {"a": o, "b": o}, {}
+
+    def step(self, ad):
+        r = float(self._P[int(ad["a"]), int(ad["b"])])
+        o = np.asarray([1.0], np.float32)
+        return ({"a": o, "b": o}, {"a": r, "b": -r},
+                {"__all__": True}, {"__all__": False}, {})
+
+
+class _TTT:
+    n_actions = 9
+    _L = [(0, 1, 2), (3, 4, 5), (6, 7, 8), (0, 3, 6), (1, 4, 7),
+          (2, 5, 8), (0, 4, 8), (2, 4, 6)]
+
+    def initial_state(self):
+        return (tuple([0] * 9), 0)
+
+    def legal_actions(self, s):
+        return [i for i in range(9) if s[0][i] == 0]
+
+    def next_state(self, s, a):
+        b = list(s[0])
+        b[a] = 1
+        return (tuple(-x for x in b), s[1] + 1)
+
+    def terminal_value(self, s):
+        for i, j, k in self._L:
+            if s[0][i] == s[0][j] == s[0][k] == -1:
+                return -1.0
+        if all(x for x in s[0]):
+            return 0.0
+        return None
+
+    def to_obs(self, s):
+        return np.asarray(s[0], np.float32)
+
+
+def _offline_log():
+    from ray_tpu.rllib import JsonWriter, SampleBatch
+    from ray_tpu.rllib import sample_batch as sb
+
+    rng = np.random.RandomState(0)
+    path = os.path.join(tempfile.mkdtemp(), "log.json")
+    n = 400
+    obs = rng.randn(n, 2).astype(np.float32)
+    with JsonWriter(path) as w:
+        w.write(SampleBatch({
+            sb.OBS: obs,
+            sb.ACTIONS: (obs[:, 0] > 0).astype(np.int64),
+            sb.REWARDS: np.ones(n, np.float32),
+            sb.DONES: (np.arange(n) % 8 == 7),
+            sb.NEXT_OBS: obs,
+            sb.ACTION_LOGP: np.full(n, -0.69, np.float32),
+        }))
+    return path
+
+
+def main() -> int:
+    small = dict(num_workers=1, hidden=(8,), seed=0)
+    log = _offline_log()
+    cases = {
+        "PPO": dict(env="CartPole-v1", num_envs_per_worker=2,
+                    train_batch_size=128, rollout_fragment_length=64,
+                    **small),
+        "A3C": dict(env="CartPole-v1", num_workers=2,
+                    num_envs_per_worker=2, updates_per_iter=2,
+                    rollout_fragment_length=64, hidden=(8,), seed=0),
+        "IMPALA": dict(env="CartPole-v1", num_workers=1,
+                       num_envs_per_worker=2, train_batch_size=128,
+                       rollout_fragment_length=32, hidden=(8,),
+                       seed=0),
+        "ApexDQN": dict(env=lambda _: _CtxEnv(), num_workers=2,
+                        learning_starts=64, train_batch_size=32,
+                        train_intensity=2, updates_per_iter=2,
+                        rollout_fragment_length=50, hidden=(8,),
+                        seed=0),
+        "R2D2": dict(env=lambda _: _CtxEnv(), seq_len=6, burn_in=0,
+                     rows_per_sample=8, learning_starts=16,
+                     train_batch_size=8, train_intensity=2,
+                     lstm_cell_size=8, **small),
+        "SAC": dict(env="Pendulum-v1", learning_starts=100,
+                    train_batch_size=32, train_intensity=2,
+                    rollout_fragment_length=50, hidden=(8, 8),
+                    num_workers=1, seed=0),
+        "BC": dict(input_path=log, hidden=(8,),
+                   sgd_steps_per_iter=10, seed=0),
+        "DT": dict(input_path=log, context_len=4, embed_dim=16,
+                   n_heads=2, n_layers=1, sgd_steps_per_iter=10,
+                   seed=0),
+        "BanditLinUCB": dict(env=lambda _: _CtxEnvBandit(),
+                             steps_per_iter=32, seed=0),
+        "Dreamer": dict(env=lambda _: _CtxEnv(), deter=8, stoch=4,
+                        seq_len=6, imagine_horizon=3,
+                        seqs_per_sample=4, learning_starts=8,
+                        train_batch_size=4, train_intensity=1,
+                        hidden=(8,), num_workers=1, seed=0),
+        "MAML": dict(env=lambda c: _ArmEnv(c),
+                     task_sampler=lambda rng: {
+                         "arm": int(rng.randint(2))},
+                     num_workers=1, meta_batch_size=2,
+                     episodes_per_task=4, horizon=5, hidden=(8,),
+                     seed=0),
+        "MBMPO": dict(env=lambda _: _CtxEnv(), ensemble_size=2,
+                      model_hidden=(16,), real_episodes=4, horizon=10,
+                      imagined_rollouts=4, model_sgd_steps=10,
+                      meta_steps_per_iter=1, hidden=(8,),
+                      num_workers=1, seed=0),
+        "AlphaZero": dict(env=lambda _: _TTT(), n_sims=8,
+                          games_per_sample=2, learning_starts=16,
+                          train_batch_size=8, train_intensity=1,
+                          hidden=(8,), num_workers=1, seed=0),
+        "AlphaStar": dict(env=lambda _: _RPS(), episodes_per_match=4,
+                          horizon=1, matches_per_iter=1,
+                          snapshot_every=2, hidden=(8,),
+                          num_workers=1, seed=0),
+    }
+
+    class _TeamEnv:
+        def __init__(self, seed=0):
+            self._rng = np.random.RandomState(seed)
+            self.action_spaces = {"a0": _Space(n=2), "a1": _Space(n=2)}
+
+        def _obs(self):
+            self._b = self._rng.randint(2, size=2)
+            return {"a0": np.asarray([self._b[0]], np.float32),
+                    "a1": np.asarray([self._b[1]], np.float32)}
+
+        def reset(self, seed=None):
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, ad):
+            r = 0.5 if (int(ad["a0"]) == self._b[0]
+                        and int(ad["a1"]) == self._b[1]) else 0.0
+            self._t += 1
+            return (self._obs(), {"a0": r, "a1": r},
+                    {"__all__": self._t >= 8}, {"__all__": False}, {})
+
+    class _ContEnv:
+        def __init__(self, seed=0):
+            self._rng = np.random.RandomState(seed)
+            self.action_spaces = {"a0": _Space(shape=(1,)),
+                                  "a1": _Space(shape=(1,))}
+
+        def _obs(self):
+            return {"a0": self._x.copy(), "a1": self._x.copy()}
+
+        def reset(self, seed=None):
+            self._x = self._rng.uniform(-1, 1, 2).astype(np.float32)
+            self._t = 0
+            return self._obs(), {}
+
+        def step(self, ad):
+            self._x[0] += 0.5 * float(np.asarray(ad["a0"]).ravel()[0])
+            self._x[1] += 0.5 * float(np.asarray(ad["a1"]).ravel()[0])
+            self._t += 1
+            r = float(-np.sum(self._x ** 2))
+            return (self._obs(), {"a0": r, "a1": r},
+                    {"__all__": self._t >= 10}, {"__all__": False}, {})
+
+    cases["QMIX"] = dict(env=lambda _: _TeamEnv(), num_workers=1,
+                         hidden=(8,), steps_per_sample=80,
+                         learning_starts=32, train_batch_size=16,
+                         train_intensity=1, seed=0)
+    cases["MADDPG"] = dict(env=lambda _: _ContEnv(), num_workers=1,
+                           hidden=(8,), steps_per_sample=80,
+                           learning_starts=32, train_batch_size=16,
+                           train_intensity=1, seed=0)
+
+    ray_tpu.init(num_cpus=4)
+    ok, failed = 0, []
+    try:
+        for name, cfg_kwargs in cases.items():
+            try:
+                cls, cfg_cls = get_algorithm_class(
+                    name, return_config=True)
+                algo = cls(cfg_cls(**cfg_kwargs))
+                try:
+                    for _ in range(2):
+                        result = algo.train()
+                    assert np.isfinite(
+                        result.get("timesteps_this_iter", 0))
+                    ok += 1
+                finally:
+                    algo.stop()
+            except Exception as exc:  # noqa: BLE001
+                failed.append(f"{name}: {type(exc).__name__}: "
+                              f"{str(exc)[:120]}")
+    finally:
+        ray_tpu.shutdown()
+    print(json.dumps({"families_ok": ok,
+                      "families_total": len(cases),
+                      "failed": failed}))
+    return 0 if not failed else 1
+
+
+class _CtxEnvBandit(_CtxEnv):
+    """one-step variant for the linear bandits."""
+
+    def step(self, a):
+        obs, r, _, _, info = super().step(a)
+        return obs, r, True, False, info
+
+
+class _ArmEnv:
+    def __init__(self, cfg):
+        self.arm = int(cfg.get("arm", 0))
+        self.observation_space = _Space(shape=(1,))
+        self.action_space = _Space(n=2)
+        self._t = 0
+
+    def reset(self, seed=None, options=None):
+        self._t = 0
+        return np.asarray([1.0], np.float32), {}
+
+    def step(self, a):
+        self._t += 1
+        return (np.asarray([1.0], np.float32),
+                1.0 if int(a) == self.arm else 0.0, self._t >= 5,
+                False, {})
+
+    def close(self):
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

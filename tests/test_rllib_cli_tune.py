@@ -50,11 +50,23 @@ def test_dqn_and_es_checkpoint_state(ray_start_shared, tmp_path):
     dqn = DQN(DQNConfig(env="CartPole-v1", num_workers=1, hidden=(8,),
                         learning_starts=10_000, seed=0))
     try:
+        dqn.train()
         state = dqn._checkpoint_state()
         assert "policy" in state
         assert "policy::target_params" in state
+        # schedule counters ride along: a resumed run must not reset
+        # its epsilon decay / target-sync cadence
+        assert state["_env_steps"] == dqn._env_steps > 0
+        path = dqn.save(str(tmp_path / "dqn"))
     finally:
         dqn.stop()
+    dqn2 = DQN(DQNConfig(env="CartPole-v1", num_workers=1,
+                         hidden=(8,), learning_starts=10_000, seed=1))
+    try:
+        dqn2.restore(path)
+        assert dqn2._env_steps == state["_env_steps"]
+    finally:
+        dqn2.stop()
 
     es = ES(ESConfig(env="CartPole-v1", num_workers=1, population=2,
                      hidden=(4,), seed=0))
