@@ -11,6 +11,10 @@ from ray_tpu.models.gpt2 import (GPT2Config, gpt2_config, gpt2_forward,
                                  gpt2_param_count)
 from ray_tpu.models.gpt2_decode import (decode_step, generate,
                                         init_cache)
+from ray_tpu.models.llama import (LlamaConfig, llama_config,
+                                  llama_forward, llama_init,
+                                  llama_logical_axes, llama_loss,
+                                  llama_param_count)
 from ray_tpu.models.moe import (MoEConfig, moe_apply, moe_init,
                                 moe_logical_axes)
 from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
@@ -32,4 +36,6 @@ __all__ = [
     "resnet_loss", "resnet_logical_axes",
     "ViTConfig", "vit_config", "vit_init", "vit_forward", "vit_loss",
     "vit_logical_axes", "vit_param_count",
+    "LlamaConfig", "llama_config", "llama_init", "llama_forward",
+    "llama_loss", "llama_logical_axes", "llama_param_count",
 ]

@@ -237,20 +237,21 @@ class Algorithm:
 
             if hasattr(sync, "sync_weights"):      # WorkerSet
                 sync.sync_weights(weights)
-                actors = getattr(sync, "workers", [])
-            else:                                  # raw actor list
-                actors = [w for w in sync
-                          if hasattr(w, "set_weights")]
-                if actors:
-                    ref = ray_tpu.put(weights)
-                    ray_tpu.get([w.set_weights.remote(ref)
-                                 for w in actors], timeout=60.0)
-            fs = getattr(self, "_filter_state", None)
-            if fs is not None and actors:
-                ray_tpu.get(
-                    [w.set_filter_state.remote(fs) for w in actors
-                     if hasattr(w, "set_filter_state")],
-                    timeout=60.0)
+                # RolloutWorkers (the WorkerSet members) implement
+                # set_filter_state; raw-list worker classes
+                # (TransitionWorker etc.) do not, and actor handles
+                # fabricate methods on attribute access, so the push
+                # is gated on the WorkerSet case rather than hasattr
+                fs = getattr(self, "_filter_state", None)
+                if fs is not None:
+                    ray_tpu.get(
+                        [w.set_filter_state.remote(fs)
+                         for w in getattr(sync, "workers", [])],
+                        timeout=60.0)
+            elif isinstance(sync, (list, tuple)) and sync:
+                ref = ray_tpu.put(weights)
+                ray_tpu.get([w.set_weights.remote(ref)
+                             for w in sync], timeout=60.0)
 
     @classmethod
     def as_trainable(cls, base_config: AlgorithmConfig,
