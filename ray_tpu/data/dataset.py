@@ -386,6 +386,32 @@ class Dataset:
             # one pytree transfer: jax batches the H2D copies per dict
             yield jax.device_put(batch, sharding)
 
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False,
+                           dtypes: Optional[Dict[str, Any]] = None,
+                           device: Optional[str] = None) -> Iterator:
+        """Torch-tensor batches (reference: Dataset.iter_torch_batches,
+        python/ray/data/iterator.py) — the CPU-side twin of
+        iter_jax_batches for torch training loops (TorchTrainer /
+        HuggingFaceTrainer workers).
+
+        dtypes: per-column torch dtypes; device: e.g. "cpu" (TPU work
+        goes through iter_jax_batches — torch here is host-side)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for t in self._iter_tables():
             yield from t.to_pylist()

@@ -459,7 +459,8 @@ def gpt2_forward(params, tokens, cfg: GPT2Config,
     return _tied_logits(x, params["wte"], cfg, rules)
 
 
-def _nll_from_logits(logits, targets, cfg: GPT2Config):
+def nll_from_logits(logits, targets, vocab_size: int,
+                    padded_vocab: int):
     """Per-token negative log likelihood with the padded-vocab tail masked.
 
     Gather-free formulation: ``nll = logsumexp(logits) - logits[target]``
@@ -471,14 +472,20 @@ def _nll_from_logits(logits, targets, cfg: GPT2Config):
     materializes beyond the logits themselves."""
     vocab_iota = lax.broadcasted_iota(jnp.int32, logits.shape,
                                       logits.ndim - 1)
-    if cfg.padded_vocab != cfg.vocab_size:
-        logits = jnp.where(vocab_iota < cfg.vocab_size, logits,
+    if padded_vocab != vocab_size:
+        logits = jnp.where(vocab_iota < vocab_size, logits,
                            jnp.asarray(-1e9, logits.dtype))
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     target_logit = jnp.sum(
         jnp.where(vocab_iota == targets[..., None], logits, 0),
         axis=-1)
     return lse - target_logit
+
+
+def _nll_from_logits(logits, targets, cfg):
+    """Config-taking shim over nll_from_logits (gpt2-internal)."""
+    return nll_from_logits(logits, targets, cfg.vocab_size,
+                           cfg.padded_vocab)
 
 
 def _chunked_ce(hidden, wte, targets, mask, cfg: GPT2Config):

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.gpt2 import _nll_from_logits
+from ray_tpu.models.gpt2 import nll_from_logits
 from ray_tpu.parallel.sharding import (DEFAULT_RULES,
                                        with_logical_constraint)
 
@@ -262,14 +262,6 @@ def llama_forward(params, tokens, cfg: LlamaConfig,
                                    rules)
 
 
-class _LlamaVocabView:
-    """Adapter so gpt2's padded-vocab NLL helper sees llama's config."""
-
-    def __init__(self, cfg: LlamaConfig):
-        self.vocab_size = cfg.vocab_size
-        self.padded_vocab = cfg.padded_vocab
-
-
 def llama_loss(params, batch, cfg: LlamaConfig,
                rules=DEFAULT_RULES) -> jnp.ndarray:
     """Next-token cross-entropy; batch = {"tokens": (B, T+1)} or
@@ -280,7 +272,8 @@ def llama_loss(params, batch, cfg: LlamaConfig,
     else:
         inputs, targets = batch["inputs"], batch["targets"]
     logits = llama_forward(params, inputs, cfg, rules)
-    nll = _nll_from_logits(logits, targets, _LlamaVocabView(cfg))
+    nll = nll_from_logits(logits, targets, cfg.vocab_size,
+                          cfg.padded_vocab)
     mask = batch.get("mask")
     if mask is not None:
         m = mask.astype(jnp.float32)

@@ -18,6 +18,7 @@ from ray_tpu.train.data_parallel_trainer import DataParallelTrainer
 from ray_tpu.train.gbdt_trainer import (GBDTModel, GBDTTrainer,
                                         LightGBMTrainer, XGBoostTrainer)
 from ray_tpu.train.jax_backend import JaxConfig
+from ray_tpu.train.huggingface import HuggingFaceTrainer
 from ray_tpu.train.jax_trainer import JaxTrainer, jax_utils
 from ray_tpu.train.torch_backend import (TorchConfig, TorchTrainer,
                                          prepare_data_loader,
@@ -38,4 +39,5 @@ __all__ = [
     "DataParallelTrainer", "JaxConfig", "JaxTrainer", "jax_utils",
     "TorchConfig", "TorchTrainer", "prepare_model", "prepare_data_loader",
     "GBDTTrainer", "GBDTModel", "XGBoostTrainer", "LightGBMTrainer",
+    "HuggingFaceTrainer",
 ]

@@ -1,0 +1,73 @@
+"""HuggingFaceTrainer — transformers.Trainer on the train worker gang.
+
+Reference analog: python/ray/train/huggingface/huggingface_trainer.py
+(HuggingFaceTrainer): the user supplies ``trainer_init_per_worker``
+building a ``transformers.Trainer``; each ray_tpu train worker runs it
+under the gloo process group TorchTrainer already establishes (so
+transformers' own DDP integration sees a normal distributed env), log
+lines stream back through ``session.report``, and the final model is
+captured as an AIR checkpoint.
+
+This is the CPU/torch side of the stack — TPU training goes through
+JaxTrainer; this trainer exists so transformers users can land on the
+same Trainer/Tuner surface (the reference keeps both for the same
+reason).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.torch_backend import TorchConfig, TorchTrainer
+
+
+class HuggingFaceTrainer(TorchTrainer):
+    """Run a user-built transformers.Trainer per worker.
+
+    trainer_init_per_worker(config) -> transformers.Trainer; its
+    TrainingArguments control epochs/batching/logging.  Rank-0 saves
+    the trained model into the AIR checkpoint directory."""
+
+    def __init__(self, trainer_init_per_worker: Callable, *,
+                 trainer_init_config: Optional[Dict[str, Any]] = None,
+                 torch_config: Optional[TorchConfig] = None,
+                 scaling_config=None, run_config=None,
+                 datasets=None, resume_from_checkpoint=None):
+
+        def loop(config: Dict[str, Any]):
+            import transformers
+
+            from ray_tpu.air import session
+            from ray_tpu.air.checkpoint import Checkpoint
+
+            hf_trainer = trainer_init_per_worker(config)
+            if not isinstance(hf_trainer, transformers.Trainer):
+                raise TypeError(
+                    "trainer_init_per_worker must return a "
+                    f"transformers.Trainer, got {type(hf_trainer)}")
+
+            class _ReportCallback(transformers.TrainerCallback):
+                def on_log(self, args, state, control, logs=None,
+                           **kwargs):
+                    if logs:
+                        session.report({**logs,
+                                        "step": state.global_step})
+
+            hf_trainer.add_callback(_ReportCallback())
+            result = hf_trainer.train()
+            metrics = dict(result.metrics or {})
+            checkpoint = None
+            if session.get_world_rank() == 0:
+                out_dir = os.path.join(
+                    tempfile.mkdtemp(prefix="raytpu_hf_"), "model")
+                hf_trainer.save_model(out_dir)
+                checkpoint = Checkpoint.from_directory(out_dir)
+            session.report(metrics, checkpoint=checkpoint)
+
+        super().__init__(
+            loop, train_loop_config=trainer_init_config or {},
+            torch_config=torch_config, scaling_config=scaling_config,
+            run_config=run_config, datasets=datasets,
+            resume_from_checkpoint=resume_from_checkpoint)

@@ -121,8 +121,15 @@ def run_one(test: dict, fast: bool) -> bool:
             metrics.update({k: v for k, v in d.items()
                             if isinstance(v, (int, float, bool))})
     if proc.returncode != 0:
-        print(f"FAIL  {name}: rc={proc.returncode} "
-              f"({proc.stderr.strip().splitlines()[-1:] or '?'})")
+        # grade anyway when the workload still printed metrics — a
+        # partial-failure workload (e.g. rllib_families) keeps its
+        # meaningful exit code AND its diagnostics surface here
+        detail = proc.stderr.strip().splitlines()[-1:] or ["?"]
+        for line in proc.stdout.splitlines():
+            if line.startswith("{") and "failed" in line:
+                detail = [line]
+                break
+        print(f"FAIL  {name}: rc={proc.returncode} ({detail[0]})")
         return False
     criteria = test.get("pass_criteria", {})
     if fast and test.get("fast_pass_criteria"):
