@@ -15,6 +15,9 @@ from ray_tpu.models.llama import (LlamaConfig, llama_config,
                                   llama_forward, llama_init,
                                   llama_logical_axes, llama_loss,
                                   llama_param_count)
+from ray_tpu.models.llama_decode import (llama_decode_step,
+                                         llama_generate,
+                                         llama_init_cache)
 from ray_tpu.models.moe import (MoEConfig, moe_apply, moe_init,
                                 moe_logical_axes)
 from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
@@ -38,4 +41,5 @@ __all__ = [
     "vit_logical_axes", "vit_param_count",
     "LlamaConfig", "llama_config", "llama_init", "llama_forward",
     "llama_loss", "llama_logical_axes", "llama_param_count",
+    "llama_init_cache", "llama_decode_step", "llama_generate",
 ]

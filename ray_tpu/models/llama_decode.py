@@ -1,0 +1,155 @@
+"""Autoregressive KV-cache decoding for the LLaMA family.
+
+Same TPU-first shape as gpt2_decode (static max_seq cache, one compiled
+per-token step scanned over stacked layers, generation itself a scan),
+adapted to the llama block: RMSNorm, RoPE applied at the live position,
+grouped-query attention (the cache stores the kv heads only — GQA's
+memory win is exactly here: cache bytes scale with n_kv_head, not
+n_head), SwiGLU, untied lm_head.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
+                                  rope_frequencies)
+
+__all__ = ["llama_init_cache", "llama_decode_step", "llama_generate"]
+
+
+def llama_init_cache(cfg: LlamaConfig, batch: int
+                     ) -> Dict[str, jnp.ndarray]:
+    """(L, B, S, n_kv_head, hd) key/value cache + position 0."""
+    shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_kv_head,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _rope_at(x, cos_t, sin_t):
+    """Rotate (B, H, hd) by the tables' row for ONE position."""
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    c = cos_t[None, None, :]
+    s = sin_t[None, None, :]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c],
+                    axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One token per sequence: tokens (B,) int32 at cache['pos'].
+
+    Returns (logits (B, padded_vocab) float32, updated cache)."""
+    B = tokens.shape[0]
+    d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
+                    cfg.head_dim)
+    g = h // kv
+    pos = cache["pos"]
+    x = params["wte"].astype(cfg.dtype)[tokens]          # (B, d)
+    cos, sin = rope_frequencies(cfg.max_seq, hd, cfg.rope_theta)
+    cos_t = lax.dynamic_index_in_dim(cos, pos, keepdims=False)
+    sin_t = lax.dynamic_index_in_dim(sin, pos, keepdims=False)
+    pos_mask = (jnp.arange(cfg.max_seq) <= pos)          # (S,)
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        ck = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)    # (B,S,kv,hd)
+        cv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _rmsnorm(x, p["ln1"]["scale"], cfg.rms_eps)
+        xa = xa.astype(cfg.dtype)
+        q = (xa @ p["attn"]["wq"].astype(cfg.dtype).reshape(d, h * hd)
+             ).reshape(B, h, hd)
+        k_new = (xa @ p["attn"]["wk"].astype(cfg.dtype)
+                 .reshape(d, kv * hd)).reshape(B, kv, hd)
+        v_new = (xa @ p["attn"]["wv"].astype(cfg.dtype)
+                 .reshape(d, kv * hd)).reshape(B, kv, hd)
+        q = _rope_at(q, cos_t, sin_t)
+        k_new = _rope_at(k_new, cos_t, sin_t)
+        ck = lax.dynamic_update_slice_in_dim(
+            ck, k_new[:, None], pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(
+            cv, v_new[:, None], pos, axis=1)
+        # grouped-query attention against the kv-head cache: query
+        # heads reshape to (kv, group) — no head repetition needed
+        qg = q.reshape(B, kv, g, hd)
+        scores = jnp.einsum("bkgd,bskd->bkgs", qg,
+                            ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(pos_mask[None, None, None, :], scores,
+                           -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bkgs,bskd->bkgd", probs, cv)
+        wo = p["attn"]["wo"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, h * hd) @ wo).astype(x.dtype)
+        xm = _rmsnorm(x, p["ln2"]["scale"], cfg.rms_eps)
+        xm = xm.astype(cfg.dtype)
+        gate = xm @ p["mlp"]["w_gate"].astype(cfg.dtype)
+        up = xm @ p["mlp"]["w_up"].astype(cfg.dtype)
+        hmid = jax.nn.silu(gate) * up
+        x = x + (hmid @ p["mlp"]["w_down"].astype(cfg.dtype)
+                 ).astype(x.dtype)
+        return (x, lidx + 1), (ck, cv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    logits = (x.astype(cfg.dtype)
+              @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    return logits, cache
+
+
+def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
+                   max_new_tokens: int, temperature: float = 1.0,
+                   key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """prompt (B, T0) int32 → (B, T0 + max_new_tokens) int32; one
+    jitted program (prefill scan + sampling scan), temperature 0 =
+    greedy."""
+    B, T0 = prompt.shape
+    if T0 + max_new_tokens > cfg.max_seq:
+        raise ValueError(
+            f"prompt length {T0} + max_new_tokens {max_new_tokens} "
+            f"exceeds cfg.max_seq={cfg.max_seq}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = llama_init_cache(cfg, B)
+
+    def prefill_step(cache, tok):
+        logits, cache = llama_decode_step(params, cache, tok, cfg)
+        return cache, logits
+
+    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
+    last_logits = logits_seq[-1]
+
+    def sample(logits, k):
+        if cfg.padded_vocab != cfg.vocab_size:
+            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,),
+                           -1e30, dtype=logits.dtype)
+            logits = logits.at[..., cfg.vocab_size:].set(neg)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / jnp.float32(temperature)).astype(jnp.int32)
+
+    def gen_step(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        new_logits, cache = llama_decode_step(params, cache, tok, cfg)
+        return (cache, new_logits), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
+    return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
+                           axis=1)

@@ -45,9 +45,24 @@ def _setup_torch_process_group(backend: str, init_method: str,
                                timeout_s: float):
     """Reference: train/torch/config.py:70 _setup_torch_process_group."""
     import datetime
+    import os
 
     import torch.distributed as dist
 
+    # torchrun-style env vars: accelerate/transformers detect
+    # distributed mode through LOCAL_RANK/WORLD_SIZE (env-gated, NOT
+    # by probing the process group), so without these a
+    # HuggingFaceTrainer gang would silently train unsynchronized
+    # single-process copies
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    os.environ["LOCAL_RANK"] = str(rank)
+    os.environ["LOCAL_WORLD_SIZE"] = str(world_size)
+    host_port = init_method.removeprefix("tcp://")
+    if ":" in host_port:
+        host, _, port = host_port.rpartition(":")
+        os.environ.setdefault("MASTER_ADDR", host)
+        os.environ.setdefault("MASTER_PORT", port)
     if dist.is_initialized():
         return
     dist.init_process_group(

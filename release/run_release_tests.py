@@ -120,18 +120,19 @@ def run_one(test: dict, fast: bool) -> bool:
         else:
             metrics.update({k: v for k, v in d.items()
                             if isinstance(v, (int, float, bool))})
-    if proc.returncode != 0:
-        # grade anyway when the workload still printed metrics — a
-        # partial-failure workload (e.g. rllib_families) keeps its
-        # meaningful exit code AND its diagnostics surface here
-        detail = proc.stderr.strip().splitlines()[-1:] or ["?"]
-        for line in proc.stdout.splitlines():
-            if line.startswith("{") and "failed" in line:
-                detail = [line]
-                break
-        print(f"FAIL  {name}: rc={proc.returncode} ({detail[0]})")
-        return False
     criteria = test.get("pass_criteria", {})
+    if proc.returncode != 0:
+        # a partial-failure workload (e.g. rllib_families) exits
+        # nonzero for shell semantics but still prints metrics — when
+        # it did AND the yaml states criteria, grade those (a
+        # min-threshold criterion exists precisely to tolerate partial
+        # failure); otherwise the rc is the verdict
+        if not (metrics and criteria):
+            detail = proc.stderr.strip().splitlines()[-1:] or ["?"]
+            print(f"FAIL  {name}: rc={proc.returncode} ({detail[0]})")
+            return False
+        print(f"note  {name}: rc={proc.returncode}, grading printed "
+              f"metrics against criteria")
     if fast and test.get("fast_pass_criteria"):
         criteria = test["fast_pass_criteria"]
     failures = _grade(metrics, criteria)

@@ -1,0 +1,44 @@
+"""LLaMA KV-cache decoding vs the full forward pass."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import (llama_config, llama_decode_step,
+                            llama_forward, llama_generate, llama_init,
+                            llama_init_cache)
+
+
+def test_llama_decode_matches_full_forward():
+    # incremental decode with RoPE-at-position + grouped kv cache must
+    # reproduce the training forward's logits token by token
+    cfg = llama_config("nano", n_kv_head=1)      # exercises GQA cache
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 512, (2, 12)), jnp.int32)
+    full = llama_forward(params, tokens, cfg)    # (B, T, V)
+
+    cache = llama_init_cache(cfg, 2)
+    for t in range(12):
+        step_logits, cache = llama_decode_step(
+            params, cache, tokens[:, t], cfg)
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(full[:, t]),
+            atol=2e-2, rtol=2e-2)
+
+
+def test_llama_generate_greedy_is_argmax_chain():
+    cfg = llama_config("nano")
+    params = llama_init(jax.random.PRNGKey(1), cfg)
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = llama_generate(params, prompt, cfg, max_new_tokens=5,
+                         temperature=0.0)
+    assert out.shape == (1, 8)
+    # replaying the full forward at each step reproduces the chain
+    seq = prompt
+    for _ in range(5):
+        logits = llama_forward(params, seq, cfg)[:, -1,
+                                                 :cfg.vocab_size]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
