@@ -1,0 +1,67 @@
+"""The generation loop shared by every decoder family.
+
+Family modules (gpt2_decode, llama_decode) supply their
+(init_cache_fn, decode_step_fn) pair; this module owns the
+family-neutral prefill + sampling scans so fixes land once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def generate_with(init_cache_fn, decode_step_fn, params,
+                  prompt: jnp.ndarray, cfg, *, max_new_tokens: int,
+                  temperature: float = 1.0,
+                  key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """The generation loop shared by every decoder family (gpt2,
+    llama): prefill scan + sampling scan over the family's
+    (init_cache_fn, decode_step_fn) pair.  prompt (B, T0) int32 →
+    (B, T0 + max_new_tokens) int32; temperature 0 = greedy; the whole
+    program jits (static cfg / max_new_tokens)."""
+    B, T0 = prompt.shape
+    if T0 + max_new_tokens > cfg.max_seq:
+        # Past max_seq JAX clamps dynamic_update_slice/gather indices, so
+        # KV writes would silently pile onto the last cache slot (and
+        # position lookups would saturate) — error loudly instead.
+        raise ValueError(
+            f"prompt length {T0} + max_new_tokens {max_new_tokens} "
+            f"exceeds cfg.max_seq={cfg.max_seq}")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache_fn(cfg, B)
+
+    def prefill_step(cache, tok):
+        logits, cache = decode_step_fn(params, cache, tok, cfg)
+        return cache, logits
+
+    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
+    last_logits = logits_seq[-1]                         # (B, V)
+
+    def sample(logits, k):
+        # mask the padded vocab tail so it can never be sampled
+        if cfg.padded_vocab != cfg.vocab_size:
+            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30,
+                           dtype=logits.dtype)
+            logits = logits.at[..., cfg.vocab_size:].set(neg)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            k, logits / jnp.float32(temperature)).astype(jnp.int32)
+
+    def gen_step(carry, k):
+        cache, logits = carry
+        tok = sample(logits, k)
+        new_logits, cache = decode_step_fn(params, cache, tok, cfg)
+        return (cache, new_logits), tok
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
+    return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
+                           axis=1)
+
+

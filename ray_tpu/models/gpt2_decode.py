@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.models.decode_common import generate_with
 from ray_tpu.models.gpt2 import GPT2Config, _layernorm
 
 __all__ = ["init_cache", "decode_step", "generate"]
@@ -99,48 +100,7 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
 def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
              max_new_tokens: int, temperature: float = 1.0,
              key: Optional[jax.Array] = None) -> jnp.ndarray:
-    """prompt (B, T0) int32 → (B, T0 + max_new_tokens) int32.
-
-    temperature 0 = greedy.  The whole generation (prefill + sampling)
-    is one jitted program; call under jax.jit with static cfg/
-    max_new_tokens for repeated use."""
-    B, T0 = prompt.shape
-    if T0 + max_new_tokens > cfg.max_seq:
-        # Past max_seq JAX clamps dynamic_update_slice/gather indices, so
-        # KV writes would silently pile onto the last cache slot and
-        # wpe[pos] would saturate — error loudly instead.
-        raise ValueError(
-            f"prompt length {T0} + max_new_tokens {max_new_tokens} "
-            f"exceeds cfg.max_seq={cfg.max_seq}")
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    cache = init_cache(cfg, B)
-
-    def prefill_step(cache, tok):
-        logits, cache = decode_step(params, cache, tok, cfg)
-        return cache, logits
-
-    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
-    last_logits = logits_seq[-1]                         # (B, V)
-
-    def sample(logits, k):
-        # mask the padded vocab tail so it can never be sampled
-        neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30,
-                       dtype=logits.dtype)
-        if cfg.padded_vocab != cfg.vocab_size:
-            logits = logits.at[..., cfg.vocab_size:].set(neg)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / jnp.float32(temperature)).astype(jnp.int32)
-
-    def gen_step(carry, k):
-        cache, logits = carry
-        tok = sample(logits, k)
-        new_logits, cache = decode_step(params, cache, tok, cfg)
-        return (cache, new_logits), tok
-
-    keys = jax.random.split(key, max_new_tokens)
-    (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
-    return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
-                           axis=1)
+    """GPT-2 generation (see generate_with)."""
+    return generate_with(init_cache, decode_step, params, prompt, cfg,
+                         max_new_tokens=max_new_tokens,
+                         temperature=temperature, key=key)

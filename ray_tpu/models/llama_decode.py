@@ -114,42 +114,9 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
 def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
                    max_new_tokens: int, temperature: float = 1.0,
                    key: Optional[jax.Array] = None) -> jnp.ndarray:
-    """prompt (B, T0) int32 → (B, T0 + max_new_tokens) int32; one
-    jitted program (prefill scan + sampling scan), temperature 0 =
-    greedy."""
-    B, T0 = prompt.shape
-    if T0 + max_new_tokens > cfg.max_seq:
-        raise ValueError(
-            f"prompt length {T0} + max_new_tokens {max_new_tokens} "
-            f"exceeds cfg.max_seq={cfg.max_seq}")
-    if key is None:
-        key = jax.random.PRNGKey(0)
-    cache = llama_init_cache(cfg, B)
+    """LLaMA generation via the shared loop (decode_common.generate_with)."""
+    from ray_tpu.models.decode_common import generate_with
 
-    def prefill_step(cache, tok):
-        logits, cache = llama_decode_step(params, cache, tok, cfg)
-        return cache, logits
-
-    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
-    last_logits = logits_seq[-1]
-
-    def sample(logits, k):
-        if cfg.padded_vocab != cfg.vocab_size:
-            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,),
-                           -1e30, dtype=logits.dtype)
-            logits = logits.at[..., cfg.vocab_size:].set(neg)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / jnp.float32(temperature)).astype(jnp.int32)
-
-    def gen_step(carry, k):
-        cache, logits = carry
-        tok = sample(logits, k)
-        new_logits, cache = llama_decode_step(params, cache, tok, cfg)
-        return (cache, new_logits), tok
-
-    keys = jax.random.split(key, max_new_tokens)
-    (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
-    return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
-                           axis=1)
+    return generate_with(llama_init_cache, llama_decode_step, params,
+                         prompt, cfg, max_new_tokens=max_new_tokens,
+                         temperature=temperature, key=key)

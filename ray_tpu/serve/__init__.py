@@ -4,6 +4,7 @@ from ray_tpu.serve.api import (Deployment, delete, deployment,
                                get_deployment_handle, run, shutdown,
                                start_http_proxy, status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.llm import build_llm_deployment
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.schema import (DeploymentSchema,
                                   ServeApplicationSchema)
@@ -13,4 +14,4 @@ __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
            "start_http_proxy", "batch", "status",
            "ServeApplicationSchema", "DeploymentSchema",
-           "apply_config"]
+           "apply_config", "build_llm_deployment"]

@@ -30,6 +30,12 @@ class ServeController:
         #: interval.
         self._change = threading.Condition(self._lock)
         self._stop = False
+        #: health checks (reference DeploymentConfig defaults:
+        #: health_check_timeout_s=30, failure threshold 3)
+        self._probe_timeout_s = 30.0
+        self._probe_failure_threshold = 3
+        self._probe_failures: Dict[Any, int] = {}
+        self._last_loads: Dict[Any, float] = {}
         self._reconciler = threading.Thread(target=self._reconcile_loop,
                                             daemon=True,
                                             name="serve_reconcile")
@@ -192,6 +198,15 @@ class ServeController:
             return out
 
     # -- reconciliation ---------------------------------------------------
+    def configure_health_checks(self, *, probe_timeout_s: float = None,
+                                failure_threshold: int = None) -> None:
+        """Tune replica health probing (ops/tests; reference analog:
+        DeploymentConfig health_check_timeout_s / failure threshold)."""
+        if probe_timeout_s is not None:
+            self._probe_timeout_s = float(probe_timeout_s)
+        if failure_threshold is not None:
+            self._probe_failure_threshold = int(failure_threshold)
+
     def _reconcile_loop(self):
         import ray_tpu
 
@@ -202,17 +217,43 @@ class ServeController:
                         for n, d in self.deployments.items()}
             for name, replicas in deps.items():
                 loads: Dict[Any, float] = {}
-                for r in replicas:
+                # Out-of-band probes: liveness + queue depth in one
+                # call, answered on the worker's server loop so a
+                # replica saturated with user requests still reports
+                # (reference: health checks on the control concurrency
+                # group).  All probes go out CONCURRENTLY under one
+                # deadline — a single wedged replica must not stall
+                # health checks for everything else by timeout×N.
+                refs = [(r, r.raytpu_probe.remote()) for r in replicas]
+                deadline = time.monotonic() + self._probe_timeout_s
+                for r, ref in refs:
                     try:
-                        # Out-of-band probe: liveness + queue depth in one
-                        # call, answered on the worker's server loop so a
-                        # replica saturated with user requests still
-                        # reports (reference: health checks on the control
-                        # concurrency group).
-                        info = ray_tpu.get(r.raytpu_probe.remote(),
-                                           timeout=5)
+                        info = ray_tpu.get(
+                            ref, timeout=max(
+                                0.1, deadline - time.monotonic()))
                         loads[r] = float(info.get("pending", 0))
-                    except Exception:  # noqa: BLE001 - replica dead
+                        self._probe_failures.pop(r, None)
+                        self._last_loads[r] = loads[r]
+                    except Exception:  # noqa: BLE001 - maybe dead
+                        # Replacement needs CONSECUTIVE failures
+                        # (reference: health_check_failure_threshold):
+                        # a replica mid-jit-trace can hold the GIL past
+                        # one probe window without being dead — tearing
+                        # it down also throws away its warm compile
+                        # cache and any replica state.  Keyed by the
+                        # handle itself (held reference → stable id),
+                        # pruned below when replicas leave.
+                        n = self._probe_failures.get(r, 0) + 1
+                        self._probe_failures[r] = n
+                        if n < self._probe_failure_threshold:
+                            # still routed + autoscale-visible: carry
+                            # the last-known load (default 1.0) so a
+                            # busy-but-unprobed replica is neither a
+                            # preferred downscale victim (0.0 would
+                            # sort it first) nor an upscale trigger
+                            loads[r] = self._last_loads.get(r, 1.0)
+                            continue
+                        self._probe_failures.pop(r, None)
                         with self._lock:
                             dep = self.deployments.get(name)
                             if dep is None or r not in dep["replicas"]:
@@ -226,6 +267,14 @@ class ServeController:
                                 pass
                             self._bump_locked(name)
                 self._autoscale_one(name, loads)
+            # prune bookkeeping for replicas no longer deployed
+            with self._lock:
+                live = {r for d in self.deployments.values()
+                        for r in d["replicas"]}
+            for table in (self._probe_failures, self._last_loads):
+                for r in list(table):
+                    if r not in live:
+                        table.pop(r, None)
 
     def _autoscale_one(self, name: str,
                        loads: Optional[Dict[Any, float]] = None) -> None:

@@ -1,0 +1,90 @@
+"""LM serving: the model zoo's KV-cache decoders behind a batched
+serve deployment.
+
+No reference analog module (the reference serves user torch models);
+this packages the composition its users hand-roll — model init or
+checkpoint load, ONE jitted generate, @serve.batch micro-batching —
+so `serve.run(build_llm_deployment(...).bind())` is a working LM
+endpoint for either decoder family (gpt2 / llama).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.serve.api import deployment
+
+
+def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
+                         *, max_new_tokens: int = 16,
+                         temperature: float = 0.0,
+                         max_batch_size: int = 8,
+                         batch_wait_timeout_s: float = 0.05,
+                         checkpoint_path: Optional[str] = None,
+                         seed: int = 0, num_replicas: int = 1,
+                         config_overrides: Optional[Dict[str, Any]]
+                         = None):
+    """A serve Deployment generating continuations for equal-length
+    int32 token-prompt arrays.
+
+    family: "gpt2" | "llama"; preset: a model-zoo preset name.
+    checkpoint_path: pickled param pytree (matching the family's init
+    layout); absent → fresh init from `seed` (tests/demos)."""
+    if family not in ("gpt2", "llama"):
+        raise ValueError(f"unknown LM family {family!r}")
+
+    @deployment(name=f"llm_{family}_{preset}",
+                num_replicas=num_replicas)
+    class LLM:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            overrides = dict(config_overrides or {})
+            if family == "gpt2":
+                from ray_tpu.models import gpt2_config, gpt2_init
+                from ray_tpu.models.gpt2_decode import generate
+
+                self.cfg = gpt2_config(preset, **overrides)
+                init_fn, gen_fn = gpt2_init, generate
+            else:
+                from ray_tpu.models import (llama_config,
+                                            llama_generate,
+                                            llama_init)
+
+                self.cfg = llama_config(preset, **overrides)
+                init_fn, gen_fn = llama_init, llama_generate
+            if checkpoint_path:
+                with open(checkpoint_path, "rb") as f:
+                    self.params = jax.tree.map(jnp.asarray,
+                                               pickle.load(f))
+            else:
+                self.params = init_fn(jax.random.PRNGKey(seed),
+                                      self.cfg)
+            # per-call PRNG threading: without it every temperature>0
+            # request would sample under the same default key and
+            # return identical "random" continuations
+            self._rng = jax.random.PRNGKey(seed + 1)
+            self._generate = jax.jit(
+                lambda p, toks, k: gen_fn(
+                    p, toks, self.cfg,
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature, key=k))
+
+        from ray_tpu.serve.batching import batch as _batch
+
+        @_batch(max_batch_size=max_batch_size,
+                batch_wait_timeout_s=batch_wait_timeout_s)
+        async def __call__(self, prompts):
+            import jax
+            import jax.numpy as jnp
+
+            self._rng, k = jax.random.split(self._rng)
+            toks = jnp.asarray(np.stack(prompts), jnp.int32)
+            out = self._generate(self.params, toks, k)
+            return [np.asarray(row) for row in out]
+
+    return LLM

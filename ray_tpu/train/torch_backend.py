@@ -42,7 +42,8 @@ def _get_node_ip() -> str:
 
 def _setup_torch_process_group(backend: str, init_method: str,
                                rank: int, world_size: int,
-                               timeout_s: float):
+                               timeout_s: float, local_rank: int = 0,
+                               local_world_size: int = 1):
     """Reference: train/torch/config.py:70 _setup_torch_process_group."""
     import datetime
     import os
@@ -53,11 +54,13 @@ def _setup_torch_process_group(backend: str, init_method: str,
     # distributed mode through LOCAL_RANK/WORLD_SIZE (env-gated, NOT
     # by probing the process group), so without these a
     # HuggingFaceTrainer gang would silently train unsynchronized
-    # single-process copies
+    # single-process copies.  LOCAL_RANK is the rank WITHIN the node
+    # (device placement / local-process-zero gating on multi-node
+    # gangs), computed by the backend from worker node placement.
     os.environ["RANK"] = str(rank)
     os.environ["WORLD_SIZE"] = str(world_size)
-    os.environ["LOCAL_RANK"] = str(rank)
-    os.environ["LOCAL_WORLD_SIZE"] = str(world_size)
+    os.environ["LOCAL_RANK"] = str(local_rank)
+    os.environ["LOCAL_WORLD_SIZE"] = str(local_world_size)
     host_port = init_method.removeprefix("tcp://")
     if ":" in host_port:
         host, _, port = host_port.rpartition(":")
@@ -88,9 +91,19 @@ class TorchBackend(Backend):
         ip = worker_group.execute_single(0, _get_node_ip)
         port = worker_group.execute_single(0, _pick_port)
         init_method = f"tcp://{ip}:{port}"
+        # node-local ranks: group workers by their node ip
+        ips = ray_tpu.get([w.execute.remote(_get_node_ip)
+                           for w in worker_group.workers],
+                          timeout=backend_config.init_timeout_s)
+        seen: Dict[str, int] = {}
+        local_ranks = []
+        for wip in ips:
+            local_ranks.append(seen.get(wip, 0))
+            seen[wip] = seen.get(wip, 0) + 1
         ray_tpu.get([w.execute.remote(
             _setup_torch_process_group, backend_config.backend,
-            init_method, i, n, backend_config.init_timeout_s)
+            init_method, i, n, backend_config.init_timeout_s,
+            local_ranks[i], seen[ips[i]])
             for i, w in enumerate(worker_group.workers)],
             timeout=backend_config.init_timeout_s + 30)
 

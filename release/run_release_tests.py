@@ -121,6 +121,8 @@ def run_one(test: dict, fast: bool) -> bool:
             metrics.update({k: v for k, v in d.items()
                             if isinstance(v, (int, float, bool))})
     criteria = test.get("pass_criteria", {})
+    if fast and test.get("fast_pass_criteria"):
+        criteria = test["fast_pass_criteria"]
     if proc.returncode != 0:
         # a partial-failure workload (e.g. rllib_families) exits
         # nonzero for shell semantics but still prints metrics — when
@@ -133,8 +135,6 @@ def run_one(test: dict, fast: bool) -> bool:
             return False
         print(f"note  {name}: rc={proc.returncode}, grading printed "
               f"metrics against criteria")
-    if fast and test.get("fast_pass_criteria"):
-        criteria = test["fast_pass_criteria"]
     failures = _grade(metrics, criteria)
     if failures:
         print(f"FAIL  {name} ({dt:.0f}s): " + "; ".join(failures))

@@ -213,3 +213,41 @@ def test_serve_status(ray_start_shared):
         assert st["Echo2"]["autoscaling"] is False
     finally:
         serve.shutdown()
+
+
+def test_busy_replica_survives_probe_window(ray_start_shared):
+    """A replica that blocks its worker loop past the probe timeout
+    (e.g. a long jit trace) must NOT be torn down — replacement needs
+    consecutive failures (reference health_check_failure_threshold);
+    killing it would discard replica state and warm compile caches."""
+    import time as _time
+
+    from ray_tpu import serve
+    from ray_tpu.serve.api import _get_or_create_controller
+
+    @serve.deployment(num_replicas=1)
+    class Slow:
+        def __init__(self):
+            self.calls = 0
+
+        async def __call__(self, block_s):
+            self.calls += 1
+            if block_s:
+                _time.sleep(block_s)   # blocks the loop on purpose
+            return self.calls
+
+    handle = serve.run(Slow.bind())
+    try:
+        controller = _get_or_create_controller()
+        # aggressive probing so one blocking call spans several probes
+        ray_tpu.get(controller.configure_health_checks.remote(
+            probe_timeout_s=0.5, failure_threshold=3), timeout=30)
+        assert ray_tpu.get(handle.remote(0), timeout=60) == 1
+        # block ~2 probe windows (threshold is 3 — a
+        # deterministic margin against round phase)
+        assert ray_tpu.get(handle.remote(4.0), timeout=120) == 2
+        _time.sleep(3.0)               # give reconcile rounds a chance
+        # same replica, state intact: the counter kept increasing
+        assert ray_tpu.get(handle.remote(0), timeout=60) == 3
+    finally:
+        serve.shutdown()

@@ -50,3 +50,33 @@ def test_serve_generates_text_batched(ray_start_shared):
         np.testing.assert_array_equal(again, outs[0])
     finally:
         serve.shutdown()
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_build_llm_deployment_serves_both_families(ray_start_shared,
+                                                   family):
+    import jax.numpy as jnp
+
+    from ray_tpu.serve import build_llm_deployment
+
+    dep = build_llm_deployment(
+        family, "nano", max_new_tokens=3, temperature=0.0,
+        config_overrides={"dtype": jnp.float32, "use_flash": False,
+                          "remat": False})
+    handle = serve.run(dep.options(max_concurrent_queries=8).bind())
+    try:
+        prompts = [np.array([1, 2, 3]), np.array([4, 5, 6])]
+        outs = ray_tpu.get([handle.remote(p) for p in prompts],
+                           timeout=180)
+        for p, o in zip(prompts, outs):
+            assert o.shape == (6,)
+            np.testing.assert_array_equal(o[:3], p)
+    finally:
+        serve.shutdown()
+
+
+def test_build_llm_deployment_rejects_unknown_family():
+    from ray_tpu.serve import build_llm_deployment
+
+    with pytest.raises(ValueError, match="unknown LM family"):
+        build_llm_deployment("bert")
