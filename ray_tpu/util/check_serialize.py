@@ -1,0 +1,73 @@
+"""Serializability inspection (reference analog:
+python/ray/util/check_serialize.py inspect_serializability) — walk an
+object and report WHICH nested component fails to pickle, instead of
+the bare TypeError cloudpickle raises from the middle of a task
+submission."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, List, Set, Tuple
+
+try:
+    import cloudpickle
+except ImportError:                              # pragma: no cover
+    import pickle as cloudpickle
+
+
+def _try(obj: Any) -> bool:
+    try:
+        cloudpickle.dumps(obj)
+        return True
+    except Exception:  # noqa: BLE001 - any failure means unserializable
+        return False
+
+
+def _describe(obj: Any) -> str:
+    name = getattr(obj, "__qualname__", None) or \
+        getattr(obj, "__name__", None) or repr(obj)[:80]
+    return f"{type(obj).__name__} {name}"
+
+
+def inspect_serializability(obj: Any, name: str = "<root>",
+                            _depth: int = 0, _seen: Set[int] = None,
+                            _failures: List[str] = None
+                            ) -> Tuple[bool, List[str]]:
+    """Returns (serializable, failure descriptions).  On failure,
+    recurses into closures, attributes, and containers to pinpoint the
+    leaf objects that cannot pickle (locks, sockets, loggers with
+    handlers, live clients...)."""
+    _seen = _seen if _seen is not None else set()
+    _failures = _failures if _failures is not None else []
+    if id(obj) in _seen or _depth > 4:
+        return not _failures, _failures
+    _seen.add(id(obj))
+    if _try(obj):
+        return not _failures, _failures
+
+    children = []
+    closure = getattr(obj, "__closure__", None)
+    if closure:
+        names = obj.__code__.co_freevars
+        children += [(f"{name}.<closure>.{n}", c.cell_contents)
+                     for n, c in zip(names, closure)
+                     if c.cell_contents is not obj]
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        children += [(f"{name}.{k}", v) for k, v in d.items()]
+    if isinstance(obj, dict):
+        children += [(f"{name}[{k!r}]", v) for k, v in obj.items()]
+    elif isinstance(obj, (list, tuple, set)):
+        children += [(f"{name}[{i}]", v)
+                     for i, v in enumerate(obj)]
+
+    found_child = False
+    for child_name, child in children:
+        if not _try(child):
+            found_child = True
+            inspect_serializability(child, child_name, _depth + 1,
+                                    _seen, _failures)
+    if not found_child:
+        # this object itself is the unserializable leaf
+        _failures.append(f"{name}: {_describe(obj)}")
+    return False, _failures

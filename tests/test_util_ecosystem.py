@@ -74,3 +74,24 @@ def test_multiprocessing_pool(ray_start_shared):
         assert list(p.imap(_double, [1, 2])) == [2, 4]
         r = p.apply_async(_double, (5,))
         assert r.get() == [10]
+
+
+def test_inspect_serializability_pinpoints_leaf():
+    import threading
+
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, fails = inspect_serializability({"a": 1, "b": [2, 3]})
+    assert ok and fails == []
+
+    lock = threading.Lock()
+
+    def closure_over_lock():
+        return lock
+
+    ok, fails = inspect_serializability(
+        {"fn": closure_over_lock, "fine": 42}, name="cfg")
+    assert not ok
+    # the report names the path down to the lock, not just the dict
+    assert any("lock" in f.lower() for f in fails), fails
+    assert any("closure" in f for f in fails), fails
