@@ -194,6 +194,81 @@ class Dataset:
 
         return self._with_stage(stage)
 
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        """Keep only `cols` (reference: Dataset.select_columns)."""
+        cols = list(cols)
+
+        def stage(table, _cols=cols):
+            return table.select(_cols)
+
+        return self._with_stage(stage)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        """Remove `cols` (reference: Dataset.drop_columns)."""
+        drop = set(cols)
+
+        def stage(table, _drop=drop):
+            keep = [c for c in table.column_names if c not in _drop]
+            return table.select(keep)
+
+        return self._with_stage(stage)
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        """Rename columns by dict (reference: Dataset.rename_columns)."""
+        m = dict(mapping)
+
+        def stage(table, _m=m):
+            return table.rename_columns(
+                [_m.get(c, c) for c in table.column_names])
+
+        return self._with_stage(stage)
+
+    def limit(self, n: int) -> "Dataset":
+        """First n rows (reference: Dataset.limit).  Materializes only
+        as many blocks as the limit needs."""
+        out, taken = [], 0
+        if n > 0:
+            for t in self._iter_tables():
+                take = min(n - taken, t.num_rows)
+                out.append(ray_tpu.put(t.slice(0, take)))
+                taken += take
+                if taken >= n:
+                    break       # before pulling (executing) more blocks
+        return Dataset(out)
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column (reference: Dataset.unique)."""
+        seen: Dict[Any, None] = {}
+        for t in self._iter_tables():
+            for v in t.column(column).to_pylist():
+                seen.setdefault(v, None)
+        return list(seen)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise join of two equal-length datasets (reference:
+        Dataset.zip); duplicate names from `other` get a _1 suffix."""
+        a = block_util.concat_tables(self._tables())
+        b = block_util.concat_tables(other._tables())
+        if a.num_rows != b.num_rows:
+            raise ValueError(
+                f"zip needs equal row counts: {a.num_rows} vs "
+                f"{b.num_rows}")
+        cols = {c: a.column(c) for c in a.column_names}
+        for c in b.column_names:
+            name, i = c, 0
+            while name in cols:     # first FREE suffix — never clobber
+                i += 1
+                name = f"{c}_{i}"
+            cols[name] = b.column(c)
+        import pyarrow as pa
+
+        return Dataset([ray_tpu.put(pa.table(cols))])
+
+    def show(self, limit: int = 20) -> None:
+        """Print the first rows (reference: Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
+
     # -- geometry ---------------------------------------------------------
     def repartition(self, num_blocks: int) -> "Dataset":
         tables = self._tables()

@@ -387,3 +387,37 @@ def test_custom_read_task_num_rows_metadata():
 
     tasks = RangeDatasource(10).get_read_tasks(3)
     assert sum(t.num_rows for t in tasks) == 10
+
+
+def test_column_ops_limit_unique_zip_show(ray_start_shared, capsys):
+    ds = rdata.from_items([{"a": i, "b": i * 2, "c": str(i % 3)}
+                          for i in range(10)])
+    sel = ds.select_columns(["a", "c"]).take(2)
+    assert set(sel[0]) == {"a", "c"}
+    drop = ds.drop_columns(["b"]).take(1)
+    assert set(drop[0]) == {"a", "c"}
+    ren = ds.rename_columns({"a": "x"}).take(1)
+    assert set(ren[0]) == {"x", "b", "c"}
+    assert [r["a"] for r in ds.limit(3).take_all()] == [0, 1, 2]
+    assert sorted(ds.unique("c")) == ["0", "1", "2"]
+
+    other = rdata.from_items([{"d": -i} for i in range(10)])
+    z = ds.zip(other)
+    rows = z.take_all()
+    assert rows[4] == {"a": 4, "b": 8, "c": "1", "d": -4}
+    # duplicate column names get suffixed
+    z2 = ds.zip(rdata.from_items([{"a": 100 + i} for i in range(10)]))
+    assert z2.take(1)[0]["a_1"] == 100
+
+    ds.show(2)
+    out = capsys.readouterr().out
+    assert "'a': 0" in out and out.count("\n") == 2
+
+    with pytest.raises(ValueError, match="equal row counts"):
+        ds.zip(rdata.from_items([{"d": 1}]))
+    # suffixing finds a FREE name instead of clobbering
+    both = rdata.from_items([{"a_1": 10 + i, "a": 100 + i}
+                             for i in range(10)])
+    z3 = ds.zip(both)
+    row = z3.take(1)[0]
+    assert row["a"] == 0 and row["a_1"] == 10 and row["a_2"] == 100
