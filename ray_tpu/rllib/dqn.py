@@ -35,6 +35,22 @@ class QPolicySpec:
     gamma: float = 0.99
     grad_clip: float = 10.0
     double_q: bool = True
+    #: dueling streams: Q = V(s) + A(s,a) - mean_a A (Wang et al.;
+    #: the reference DQN's default architecture)
+    dueling: bool = True
+
+
+def _q_apply(spec: "QPolicySpec", params, obs):
+    """Q-values under either architecture: flat MLP, or a shared trunk
+    with value/advantage streams recombined dueling-style."""
+    import jax.numpy as jnp
+
+    if spec.dueling:
+        h = _net_apply(params["trunk"], obs, final_linear=False)
+        v = _net_apply(params["v"], h)
+        a = _net_apply(params["a"], h)
+        return v + a - jnp.mean(a, axis=-1, keepdims=True)
+    return _net_apply(params, obs)
 
 
 class QPolicy:
@@ -47,9 +63,18 @@ class QPolicy:
 
         self.spec = spec
         self.mesh = mesh
-        self.params = _net_init(jax.random.PRNGKey(seed),
-                                (spec.obs_dim, *spec.hidden,
-                                 spec.n_actions))
+        if spec.dueling:
+            kt, kv, ka = jax.random.split(jax.random.PRNGKey(seed), 3)
+            feat = spec.hidden[-1] if spec.hidden else spec.obs_dim
+            self.params = {
+                "trunk": _net_init(kt, (spec.obs_dim, *spec.hidden)),
+                "v": _net_init(kv, (feat, 1)),
+                "a": _net_init(ka, (feat, spec.n_actions)),
+            }
+        else:
+            self.params = _net_init(jax.random.PRNGKey(seed),
+                                    (spec.obs_dim, *spec.hidden,
+                                     spec.n_actions))
         self.target_params = self._copy_tree(self.params)
         self.tx = optax.chain(optax.clip_by_global_norm(spec.grad_clip),
                               optax.adam(spec.lr))
@@ -66,6 +91,18 @@ class QPolicy:
         import jax
         import jax.numpy as jnp
 
+        is_dueling_tree = (isinstance(weights, dict)
+                           and {"trunk", "v", "a"} <= set(weights))
+        if is_dueling_tree != self.spec.dueling:
+            # e.g. restoring a pre-dueling checkpoint into the new
+            # dueling-default policy: fail with the knob to flip
+            # instead of a TypeError deep inside the jitted update
+            raise ValueError(
+                f"weight tree is "
+                f"{'dueling' if is_dueling_tree else 'flat'} but this "
+                f"policy was built with dueling={self.spec.dueling}; "
+                f"set DQNConfig(dueling="
+                f"{str(is_dueling_tree)}) to match the checkpoint")
         self.params = jax.tree.map(jnp.asarray, weights)
 
     @staticmethod
@@ -90,18 +127,20 @@ class QPolicy:
 
         @jax.jit
         def q_values(params, obs):
-            return _net_apply(params, obs)
+            return _q_apply(spec, params, obs)
 
         def td_error(params, target_params, mini):
-            q = _net_apply(params, mini[sb.OBS])
+            q = _q_apply(spec, params, mini[sb.OBS])
             qa = jnp.take_along_axis(
                 q, mini[sb.ACTIONS][:, None].astype(jnp.int32),
                 axis=-1)[:, 0]
-            q_next_tgt = _net_apply(target_params, mini[sb.NEXT_OBS])
+            q_next_tgt = _q_apply(spec, target_params,
+                                  mini[sb.NEXT_OBS])
             if spec.double_q:
                 # action argmax by the ONLINE net, value by the target
                 # net (van Hasselt double-DQN)
-                q_next_online = _net_apply(params, mini[sb.NEXT_OBS])
+                q_next_online = _q_apply(
+                    spec, params, mini[sb.NEXT_OBS])
                 best = jnp.argmax(q_next_online, axis=-1)
             else:
                 best = jnp.argmax(q_next_tgt, axis=-1)
@@ -273,6 +312,7 @@ class DQNConfig(AlgorithmConfig):
     epsilon_final: float = 0.02
     epsilon_decay_steps: int = 10_000
     double_q: bool = True
+    dueling: bool = True
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
@@ -283,7 +323,8 @@ class DQNConfig(AlgorithmConfig):
         return QPolicySpec(obs_dim=self.obs_dim,
                            n_actions=self.n_actions,
                            hidden=tuple(self.hidden), lr=self.lr,
-                           gamma=self.gamma, double_q=self.double_q)
+                           gamma=self.gamma, double_q=self.double_q,
+                           dueling=self.dueling)
 
 
 class DQN(Algorithm):

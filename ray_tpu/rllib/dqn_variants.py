@@ -28,8 +28,9 @@ from ray_tpu.rllib.replay_buffer import PrioritizedReplayBuffer
 @dataclasses.dataclass
 class SimpleQConfig(DQNConfig):
     """Reference rllib/algorithms/simple_q/simple_q.py: vanilla
-    Q-learning — single estimator, uniform replay."""
+    Q-learning — single estimator, no dueling, uniform replay."""
     double_q: bool = False
+    dueling: bool = False
     prioritized_replay: bool = False
 
 

@@ -286,3 +286,33 @@ def test_ddppo_learns_cartpole(ray_start_shared):
                              seed=0))
     best = _train_until(algo, "episode_reward_mean", 120.0, 25)
     assert best >= 80.0, best
+
+
+def test_dueling_architecture_and_simpleq_flat():
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.dqn import QPolicy, QPolicySpec, _q_apply
+    from ray_tpu.rllib.policy import _net_apply
+
+    spec = QPolicySpec(obs_dim=3, n_actions=4, hidden=(8,),
+                       dueling=True)
+    pol = QPolicy(spec, seed=0)
+    assert set(pol.params) == {"trunk", "v", "a"}
+    obs = jnp.asarray(np.random.RandomState(0)
+                      .randn(5, 3).astype(np.float32))
+    q = _q_apply(spec, pol.params, obs)
+    assert q.shape == (5, 4)
+    # the dueling identity: mean_a Q == V (advantages centered)
+    h = _net_apply(pol.params["trunk"], obs, final_linear=False)
+    v = np.asarray(_net_apply(pol.params["v"], h))
+    np.testing.assert_allclose(np.asarray(q).mean(-1), v[:, 0],
+                               atol=1e-5)
+    # SimpleQ keeps the flat estimator
+    assert SimpleQConfig(obs_dim=3, n_actions=4).q_spec().dueling \
+        is False
+    # a mismatched checkpoint tree fails with the knob named, not a
+    # TypeError inside the jitted update
+    flat = QPolicy(QPolicySpec(obs_dim=3, n_actions=4, hidden=(8,),
+                               dueling=False), seed=0)
+    with pytest.raises(ValueError, match="dueling=False"):
+        pol.set_weights(flat.get_weights())
