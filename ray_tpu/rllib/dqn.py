@@ -146,8 +146,15 @@ class QPolicy:
                 best = jnp.argmax(q_next_tgt, axis=-1)
             v_next = jnp.take_along_axis(q_next_tgt, best[:, None],
                                          axis=-1)[:, 0]
-            nonterminal = 1.0 - mini[sb.DONES].astype(jnp.float32)
-            target = mini[sb.REWARDS] + spec.gamma * nonterminal * v_next
+            disc = mini.get("discounts")
+            if disc is None:
+                # 1-step path: γ·(1-done).  n-step workers ship a
+                # per-transition "discounts" column = γ^k·(1-terminal)
+                # (k = actual window length — shorter at episode ends
+                # and fragment tails)
+                disc = spec.gamma * (
+                    1.0 - mini[sb.DONES].astype(jnp.float32))
+            target = mini[sb.REWARDS] + disc * v_next
             return qa - jax.lax.stop_gradient(target)
 
         def loss_fn(params, target_params, mini):
@@ -225,15 +232,49 @@ class QPolicy:
         return float(loss), np.asarray(tds)
 
 
+def _nstep_transitions(rew, done, boundary, next_obs,
+                       gamma: float, n: int):
+    """Fold (T, ...) per-env transitions into n-step ones: reward =
+    Σ γ^j r, next_obs = the window's last successor, discounts =
+    γ^k·(1-terminal); windows cut at episode boundaries (term OR
+    trunc) and at the fragment tail."""
+    T = len(rew)
+    R = np.zeros(T, np.float32)
+    nxt = np.array(next_obs)
+    dn = np.array(done)
+    disc = np.zeros(T, np.float32)
+    for t in range(T):
+        acc, g, k = 0.0, 1.0, 0
+        terminal = False
+        for j in range(n):
+            if t + j >= T:
+                break
+            acc += g * float(rew[t + j])
+            g *= gamma
+            k = j
+            if done[t + j]:
+                terminal = True
+                break
+            if boundary[t + j]:          # truncation: stop, bootstrap
+                break
+        R[t] = acc
+        nxt[t] = next_obs[t + k]
+        dn[t] = bool(done[t + k])
+        disc[t] = 0.0 if terminal else g
+    return R, nxt, dn, disc
+
+
 class TransitionWorker:
     """CPU actor collecting (obs, action, reward, next_obs, done)
     transitions with epsilon-greedy exploration (the off-policy
     counterpart of RolloutWorker; reference: the sampling half of DQN's
-    training_step)."""
+    training_step).  n_step > 1 folds each transition's reward over the
+    next n steps (reference DQNConfig.n_step)."""
 
     def __init__(self, *, env: Any, env_config: Optional[Dict] = None,
                  spec: QPolicySpec, num_envs: int = 1,
-                 rollout_fragment_length: int = 50, seed: int = 0):
+                 rollout_fragment_length: int = 50, seed: int = 0,
+                 n_step: int = 1):
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -241,6 +282,7 @@ class TransitionWorker:
 
         self.envs = [_make_env(env, env_config) for _ in range(num_envs)]
         self.policy = QPolicy(spec, seed=seed)
+        self.n_step = max(1, int(n_step))
         self.fragment = rollout_fragment_length
         self._obs = [e.reset(seed=seed + i)[0]
                      for i, e in enumerate(self.envs)]
@@ -259,6 +301,7 @@ class TransitionWorker:
         act_buf = np.zeros(shape, np.int64)
         rew_buf = np.zeros(shape, np.float32)
         done_buf = np.zeros(shape, np.bool_)
+        bound_buf = np.zeros(shape, np.bool_)
         for t in range(T):
             obs = np.stack(self._obs).astype(np.float32)
             actions = self.policy.compute_actions(obs, epsilon=epsilon)
@@ -270,17 +313,29 @@ class TransitionWorker:
                 self._ep_rewards[i] += r
                 # time-limit truncation is NOT a terminal for bootstrap
                 done_buf[t, i] = term
+                bound_buf[t, i] = term or trunc
                 next_buf[t, i] = np.asarray(o2, np.float32)
                 if term or trunc:
                     self.episode_returns.append(self._ep_rewards[i])
                     self._ep_rewards[i] = 0.0
                     o2 = env.reset()[0]
                 self._obs[i] = o2
+        if self.n_step > 1:
+            g = self.policy.spec.gamma
+            disc_buf = np.zeros(shape, np.float32)
+            for i in range(n_env):
+                (rew_buf[:, i], next_buf[:, i], done_buf[:, i],
+                 disc_buf[:, i]) = _nstep_transitions(
+                    rew_buf[:, i], done_buf[:, i], bound_buf[:, i],
+                    next_buf[:, i], g, self.n_step)
         flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
-        return SampleBatch({
+        out = {
             sb.OBS: flat(obs_buf), sb.ACTIONS: flat(act_buf),
             sb.REWARDS: flat(rew_buf), sb.DONES: flat(done_buf),
-            sb.NEXT_OBS: flat(next_buf)})
+            sb.NEXT_OBS: flat(next_buf)}
+        if self.n_step > 1:
+            out["discounts"] = flat(disc_buf)
+        return SampleBatch(out)
 
     def pop_episode_returns(self) -> List[float]:
         out = self.episode_returns
@@ -313,6 +368,8 @@ class DQNConfig(AlgorithmConfig):
     epsilon_decay_steps: int = 10_000
     double_q: bool = True
     dueling: bool = True
+    #: fold rewards over n steps before TD (reference DQNConfig.n_step)
+    n_step: int = 1
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
@@ -359,7 +416,8 @@ class DQN(Algorithm):
                 env=config.env, env_config=config.env_config, spec=spec,
                 num_envs=config.num_envs_per_worker,
                 rollout_fragment_length=config.rollout_fragment_length,
-                seed=config.seed + 1000 * (i + 1))
+                seed=config.seed + 1000 * (i + 1),
+                n_step=config.n_step)
             for i in range(config.num_workers)]
         self._env_steps = 0
         self._last_target_sync = 0

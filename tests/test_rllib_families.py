@@ -316,3 +316,41 @@ def test_dueling_architecture_and_simpleq_flat():
                                dueling=False), seed=0)
     with pytest.raises(ValueError, match="dueling=False"):
         pol.set_weights(flat.get_weights())
+
+
+def test_nstep_transition_folding():
+    from ray_tpu.rllib.dqn import _nstep_transitions
+
+    gamma = 0.9
+    nxt = np.arange(1, 7, dtype=np.float32).reshape(6, 1)
+    rew = np.asarray([1, 1, 1, 1, 1, 1], np.float32)
+    done = np.asarray([0, 0, 1, 0, 0, 0], bool)       # terminal at t=2
+    bound = np.asarray([0, 0, 1, 0, 1, 0], bool)      # + trunc at t=4
+    R, n2, dn, disc = _nstep_transitions(rew, done, bound, nxt,
+                                         gamma, 3)
+    # t=0: spans 0,1,2 (stops at terminal): 1 + .9 + .81
+    np.testing.assert_allclose(R[0], 1 + 0.9 + 0.81)
+    assert dn[0] and disc[0] == 0.0 and n2[0, 0] == 3.0
+    # t=1: spans 1,2 → terminal, discount 0
+    np.testing.assert_allclose(R[1], 1 + 0.9)
+    assert disc[1] == 0.0
+    # t=3: spans 3,4 → TRUNCATION cuts the window but still bootstraps
+    np.testing.assert_allclose(R[3], 1 + 0.9)
+    assert not dn[3] and np.isclose(disc[3], 0.81)
+    assert n2[3, 0] == 5.0
+    # t=5: fragment tail, single step, bootstraps with gamma^1
+    np.testing.assert_allclose(R[5], 1.0)
+    assert np.isclose(disc[5], 0.9)
+
+
+def test_nstep_dqn_learns(ray_start_shared):
+    from ray_tpu.rllib import DQN, DQNConfig
+
+    cfg = DQNConfig(env=lambda _: _ContextBanditEnv(), num_workers=1,
+                    hidden=(32,), buffer_size=5000, learning_starts=200,
+                    train_batch_size=64, train_intensity=16,
+                    target_update_freq=200, epsilon_decay_steps=1500,
+                    rollout_fragment_length=100, lr=5e-3, gamma=0.5,
+                    n_step=3, seed=0)
+    best = _train_until(DQN(cfg), "episode_reward_mean", 18.0, 25)
+    assert best >= 15.0, best
