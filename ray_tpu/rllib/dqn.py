@@ -38,19 +38,78 @@ class QPolicySpec:
     #: dueling streams: Q = V(s) + A(s,a) - mean_a A (Wang et al.;
     #: the reference DQN's default architecture)
     dueling: bool = True
+    #: > 1: distributional C51 (reference DQNConfig.num_atoms) — the
+    #: net emits a categorical return distribution per action over a
+    #: fixed support [v_min, v_max]; TD projects the target
+    #: distribution and minimizes cross-entropy
+    num_atoms: int = 1
+    v_min: float = -10.0
+    v_max: float = 10.0
+
+    @property
+    def atom_support(self):
+        import jax.numpy as jnp
+
+        return jnp.linspace(self.v_min, self.v_max, self.num_atoms)
 
 
-def _q_apply(spec: "QPolicySpec", params, obs):
-    """Q-values under either architecture: flat MLP, or a shared trunk
-    with value/advantage streams recombined dueling-style."""
+def _q_logits(spec: "QPolicySpec", params, obs):
+    """Per-action outputs: (B, n_actions) Q-values when num_atoms == 1,
+    else (B, n_actions, num_atoms) distribution LOGITS.  Dueling
+    combines streams in output space (Rainbow-style for atoms)."""
     import jax.numpy as jnp
 
+    A = spec.num_atoms
     if spec.dueling:
         h = _net_apply(params["trunk"], obs, final_linear=False)
         v = _net_apply(params["v"], h)
         a = _net_apply(params["a"], h)
+        if A > 1:
+            v = v.reshape(v.shape[0], 1, A)
+            a = a.reshape(a.shape[0], spec.n_actions, A)
+            return v + a - jnp.mean(a, axis=1, keepdims=True)
         return v + a - jnp.mean(a, axis=-1, keepdims=True)
-    return _net_apply(params, obs)
+    out = _net_apply(params, obs)
+    if A > 1:
+        return out.reshape(out.shape[0], spec.n_actions, A)
+    return out
+
+
+def _q_apply(spec: "QPolicySpec", params, obs):
+    """Scalar Q-values under any architecture (atoms collapse to the
+    distribution's expectation)."""
+    import jax
+    import jax.numpy as jnp
+
+    out = _q_logits(spec, params, obs)
+    if spec.num_atoms > 1:
+        probs = jax.nn.softmax(out, axis=-1)
+        return jnp.sum(probs * spec.atom_support, axis=-1)
+    return out
+
+
+def _project_distribution(spec: "QPolicySpec", next_probs, rewards,
+                          discounts):
+    """C51 categorical projection: distribute P(Tz) onto the fixed
+    support, Tz = r + disc·z clipped to [v_min, v_max]."""
+    import jax.numpy as jnp
+
+    z = spec.atom_support                          # (A,)
+    dz = (spec.v_max - spec.v_min) / (spec.num_atoms - 1)
+    tz = jnp.clip(rewards[:, None] + discounts[:, None] * z[None, :],
+                  spec.v_min, spec.v_max)          # (B, A)
+    b = (tz - spec.v_min) / dz
+    lo = jnp.floor(b)
+    hi = jnp.ceil(b)
+    # mass splits between neighbors; lo==hi (on-grid) keeps it all
+    w_lo = jnp.where(hi == lo, 1.0, hi - b)
+    w_hi = b - lo
+    B, A = next_probs.shape
+    proj = jnp.zeros((B, A))
+    rows = jnp.arange(B)[:, None].repeat(A, 1)
+    proj = proj.at[rows, lo.astype(jnp.int32)].add(next_probs * w_lo)
+    proj = proj.at[rows, hi.astype(jnp.int32)].add(next_probs * w_hi)
+    return proj
 
 
 class QPolicy:
@@ -63,18 +122,19 @@ class QPolicy:
 
         self.spec = spec
         self.mesh = mesh
+        A = spec.num_atoms
         if spec.dueling:
             kt, kv, ka = jax.random.split(jax.random.PRNGKey(seed), 3)
             feat = spec.hidden[-1] if spec.hidden else spec.obs_dim
             self.params = {
                 "trunk": _net_init(kt, (spec.obs_dim, *spec.hidden)),
-                "v": _net_init(kv, (feat, 1)),
-                "a": _net_init(ka, (feat, spec.n_actions)),
+                "v": _net_init(kv, (feat, A)),
+                "a": _net_init(ka, (feat, spec.n_actions * A)),
             }
         else:
             self.params = _net_init(jax.random.PRNGKey(seed),
                                     (spec.obs_dim, *spec.hidden,
-                                     spec.n_actions))
+                                     spec.n_actions * A))
         self.target_params = self._copy_tree(self.params)
         self.tx = optax.chain(optax.clip_by_global_norm(spec.grad_clip),
                               optax.adam(spec.lr))
@@ -103,6 +163,19 @@ class QPolicy:
                 f"policy was built with dueling={self.spec.dueling}; "
                 f"set DQNConfig(dueling="
                 f"{str(is_dueling_tree)}) to match the checkpoint")
+        # same defense for the distributional width: a num_atoms
+        # mismatch would otherwise surface as an opaque reshape error
+        # inside the jitted forward
+        head = weights["v"] if is_dueling_tree else weights
+        got_width = int(np.asarray(head[-1]["b"]).shape[-1])
+        want_width = (self.spec.num_atoms if is_dueling_tree
+                      else self.spec.n_actions * self.spec.num_atoms)
+        if got_width != want_width:
+            raise ValueError(
+                f"weight head width {got_width} does not match this "
+                f"policy's (num_atoms={self.spec.num_atoms}, "
+                f"n_actions={self.spec.n_actions}); set "
+                f"DQNConfig(num_atoms=...) to match the checkpoint")
         self.params = jax.tree.map(jnp.asarray, weights)
 
     @staticmethod
@@ -129,23 +202,7 @@ class QPolicy:
         def q_values(params, obs):
             return _q_apply(spec, params, obs)
 
-        def td_error(params, target_params, mini):
-            q = _q_apply(spec, params, mini[sb.OBS])
-            qa = jnp.take_along_axis(
-                q, mini[sb.ACTIONS][:, None].astype(jnp.int32),
-                axis=-1)[:, 0]
-            q_next_tgt = _q_apply(spec, target_params,
-                                  mini[sb.NEXT_OBS])
-            if spec.double_q:
-                # action argmax by the ONLINE net, value by the target
-                # net (van Hasselt double-DQN)
-                q_next_online = _q_apply(
-                    spec, params, mini[sb.NEXT_OBS])
-                best = jnp.argmax(q_next_online, axis=-1)
-            else:
-                best = jnp.argmax(q_next_tgt, axis=-1)
-            v_next = jnp.take_along_axis(q_next_tgt, best[:, None],
-                                         axis=-1)[:, 0]
+        def _discounts(mini):
             disc = mini.get("discounts")
             if disc is None:
                 # 1-step path: γ·(1-done).  n-step workers ship a
@@ -154,12 +211,67 @@ class QPolicy:
                 # and fragment tails)
                 disc = spec.gamma * (
                     1.0 - mini[sb.DONES].astype(jnp.float32))
-            target = mini[sb.REWARDS] + disc * v_next
+            return disc
+
+        def _best_next(params, target_params, mini):
+            q_next_tgt = _q_apply(spec, target_params,
+                                  mini[sb.NEXT_OBS])
+            if spec.double_q:
+                # action argmax by the ONLINE net, value by the target
+                # net (van Hasselt double-DQN)
+                q_next_online = _q_apply(
+                    spec, params, mini[sb.NEXT_OBS])
+                return jnp.argmax(q_next_online, axis=-1), q_next_tgt
+            return jnp.argmax(q_next_tgt, axis=-1), q_next_tgt
+
+        def td_error(params, target_params, mini):
+            q = _q_apply(spec, params, mini[sb.OBS])
+            qa = jnp.take_along_axis(
+                q, mini[sb.ACTIONS][:, None].astype(jnp.int32),
+                axis=-1)[:, 0]
+            best, q_next_tgt = _best_next(params, target_params, mini)
+            v_next = jnp.take_along_axis(q_next_tgt, best[:, None],
+                                         axis=-1)[:, 0]
+            target = mini[sb.REWARDS] + _discounts(mini) * v_next
             return qa - jax.lax.stop_gradient(target)
 
+        def c51_ce(params, target_params, mini):
+            """Per-sample cross-entropy of the chosen action's return
+            distribution against the projected target distribution —
+            the C51 loss AND the priority signal."""
+            logits = _q_logits(spec, params, mini[sb.OBS])  # (B,n,A)
+            acts = mini[sb.ACTIONS].astype(jnp.int32)
+            chosen = jnp.take_along_axis(
+                logits, acts[:, None, None].repeat(
+                    spec.num_atoms, 2), axis=1)[:, 0]       # (B, A)
+            logp = jax.nn.log_softmax(chosen, axis=-1)
+            # ONE target forward: best-action selection reuses these
+            # logits (expectation) instead of a second pass
+            nlog_t = _q_logits(spec, target_params, mini[sb.NEXT_OBS])
+            tgt_probs = jax.nn.softmax(nlog_t, axis=-1)
+            q_next_tgt = jnp.sum(tgt_probs * spec.atom_support,
+                                 axis=-1)                   # (B, n)
+            if spec.double_q:
+                best = jnp.argmax(_q_apply(spec, params,
+                                           mini[sb.NEXT_OBS]), axis=-1)
+            else:
+                best = jnp.argmax(q_next_tgt, axis=-1)
+            next_dist = jnp.take_along_axis(
+                tgt_probs, best[:, None, None].repeat(
+                    spec.num_atoms, 2), axis=1)[:, 0]
+            proj = _project_distribution(
+                spec, next_dist, mini[sb.REWARDS], _discounts(mini))
+            return -jnp.sum(jax.lax.stop_gradient(proj) * logp,
+                            axis=-1)
+
         def loss_fn(params, target_params, mini):
-            td = td_error(params, target_params, mini)
             w = mini.get("is_weights")
+            if spec.num_atoms > 1:
+                ce = c51_ce(params, target_params, mini)
+                loss = jnp.mean(ce * w) if w is not None \
+                    else jnp.mean(ce)
+                return loss, ce
+            td = td_error(params, target_params, mini)
             huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
                               jnp.abs(td) - 0.5)
             if w is not None:
@@ -370,6 +482,10 @@ class DQNConfig(AlgorithmConfig):
     dueling: bool = True
     #: fold rewards over n steps before TD (reference DQNConfig.n_step)
     n_step: int = 1
+    #: > 1: distributional C51 head (reference DQNConfig.num_atoms)
+    num_atoms: int = 1
+    v_min: float = -10.0
+    v_max: float = 10.0
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
@@ -381,7 +497,9 @@ class DQNConfig(AlgorithmConfig):
                            n_actions=self.n_actions,
                            hidden=tuple(self.hidden), lr=self.lr,
                            gamma=self.gamma, double_q=self.double_q,
-                           dueling=self.dueling)
+                           dueling=self.dueling,
+                           num_atoms=self.num_atoms, v_min=self.v_min,
+                           v_max=self.v_max)
 
 
 class DQN(Algorithm):

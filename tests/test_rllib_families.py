@@ -354,3 +354,54 @@ def test_nstep_dqn_learns(ray_start_shared):
                     n_step=3, seed=0)
     best = _train_until(DQN(cfg), "episode_reward_mean", 18.0, 25)
     assert best >= 15.0, best
+
+
+def test_c51_projection_and_heads():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.rllib.dqn import (QPolicy, QPolicySpec,
+                                   _project_distribution, _q_apply,
+                                   _q_logits)
+
+    spec = QPolicySpec(obs_dim=2, n_actions=3, hidden=(8,),
+                       num_atoms=11, v_min=-5.0, v_max=5.0)
+    pol = QPolicy(spec, seed=0)
+    obs = jnp.asarray(np.random.RandomState(0)
+                      .randn(4, 2).astype(np.float32))
+    logits = _q_logits(spec, pol.params, obs)
+    assert logits.shape == (4, 3, 11)
+    q = _q_apply(spec, pol.params, obs)
+    assert q.shape == (4, 3)
+    # expectations live inside the support
+    assert (np.asarray(q) >= -5).all() and (np.asarray(q) <= 5).all()
+
+    # projection: a delta at z=0 with reward 1, discount 1 lands as a
+    # delta at z=1 (on-grid for this support, dz=1)
+    probs = jnp.zeros((1, 11)).at[0, 5].set(1.0)
+    proj = _project_distribution(spec, probs, jnp.asarray([1.0]),
+                                 jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(proj)[0, 6], 1.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(proj).sum(), 1.0, rtol=1e-6)
+    # off-grid reward splits mass between neighbors
+    proj2 = _project_distribution(spec, probs, jnp.asarray([0.5]),
+                                  jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(proj2)[0, 5], 0.5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(proj2)[0, 6], 0.5, atol=1e-6)
+    # terminal (discount 0): everything collapses onto z=reward
+    proj3 = _project_distribution(spec, probs, jnp.asarray([2.0]),
+                                  jnp.asarray([0.0]))
+    np.testing.assert_allclose(np.asarray(proj3)[0, 7], 1.0, atol=1e-6)
+
+
+def test_c51_dqn_learns(ray_start_shared):
+    from ray_tpu.rllib import DQN, DQNConfig
+
+    cfg = DQNConfig(env=lambda _: _ContextBanditEnv(), num_workers=1,
+                    hidden=(32,), buffer_size=5000, learning_starts=200,
+                    train_batch_size=64, train_intensity=16,
+                    target_update_freq=200, epsilon_decay_steps=1500,
+                    rollout_fragment_length=100, lr=5e-3, gamma=0.0,
+                    num_atoms=21, v_min=0.0, v_max=4.0, seed=0)
+    best = _train_until(DQN(cfg), "episode_reward_mean", 18.0, 25)
+    assert best >= 15.0, best
