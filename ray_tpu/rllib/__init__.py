@@ -22,8 +22,9 @@ from ray_tpu.rllib.alpha_zero import (AlphaZero, AlphaZeroConfig,
 from ray_tpu.rllib.ars import ARS, ARSConfig
 from ray_tpu.rllib.bandit import (LinTS, LinTSConfig, LinUCB,
                                   LinUCBConfig)
-from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig, SimpleQ,
-                                        SimpleQConfig)
+from ray_tpu.rllib.dqn_variants import (ApexDQN, ApexDQNConfig,
+                                        Rainbow, RainbowConfig,
+                                        SimpleQ, SimpleQConfig)
 from ray_tpu.rllib.crr import CRR, CRRConfig
 from ray_tpu.rllib.ddppo import DDPPO, DDPPOConfig
 from ray_tpu.rllib.dreamer import Dreamer, DreamerConfig
@@ -62,6 +63,7 @@ __all__ = ["SampleBatch", "JaxPolicy", "RolloutWorker",
            "QMIX", "QMIXConfig", "QMIXPolicy", "MADDPG",
            "MADDPGConfig", "MADDPGPolicy", "DDPPO", "DDPPOConfig",
            "AsyncSampler", "DT", "DTConfig", "ApexDDPG",
+           "Rainbow", "RainbowConfig",
            "ApexDDPGConfig", "SlateQ", "SlateQConfig", "SlateQPolicy",
            "AlphaZero", "AlphaZeroConfig", "AZNet", "MCTS", "MAML",
            "MAMLConfig", "MBMPO", "MBMPOConfig", "Dreamer",

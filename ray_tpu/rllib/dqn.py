@@ -166,16 +166,22 @@ class QPolicy:
         # same defense for the distributional width: a num_atoms
         # mismatch would otherwise surface as an opaque reshape error
         # inside the jitted forward
-        head = weights["v"] if is_dueling_tree else weights
-        got_width = int(np.asarray(head[-1]["b"]).shape[-1])
-        want_width = (self.spec.num_atoms if is_dueling_tree
-                      else self.spec.n_actions * self.spec.num_atoms)
-        if got_width != want_width:
-            raise ValueError(
-                f"weight head width {got_width} does not match this "
-                f"policy's (num_atoms={self.spec.num_atoms}, "
-                f"n_actions={self.spec.n_actions}); set "
-                f"DQNConfig(num_atoms=...) to match the checkpoint")
+        if is_dueling_tree:
+            checks = [("v", weights["v"], self.spec.num_atoms),
+                      ("a", weights["a"],
+                       self.spec.n_actions * self.spec.num_atoms)]
+        else:
+            checks = [("q", weights,
+                       self.spec.n_actions * self.spec.num_atoms)]
+        for name, head, want_width in checks:
+            got_width = int(np.asarray(head[-1]["b"]).shape[-1])
+            if got_width != want_width:
+                raise ValueError(
+                    f"{name}-head width {got_width} does not match "
+                    f"this policy's (num_atoms={self.spec.num_atoms}, "
+                    f"n_actions={self.spec.n_actions}); set "
+                    f"DQNConfig(num_atoms=.../n_actions) to match the "
+                    f"checkpoint")
         self.params = jax.tree.map(jnp.asarray, weights)
 
     @staticmethod

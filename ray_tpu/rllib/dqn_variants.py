@@ -112,3 +112,21 @@ class ApexDQN(DQN):
             timeout=60.0)
         self._episode_returns.extend(r for p in returns for r in p)
         return stats
+
+
+@dataclasses.dataclass
+class RainbowConfig(DQNConfig):
+    """Rainbow-style DQN (Hessel et al. 2018): every component this
+    DQN implements switched on together — double-Q + dueling +
+    distributional C51 + n-step returns + prioritized replay.  (The
+    remaining Rainbow ingredient, noisy-net exploration, is not
+    implemented; epsilon-greedy stands in.)"""
+    double_q: bool = True
+    dueling: bool = True
+    num_atoms: int = 51
+    n_step: int = 3
+    prioritized_replay: bool = True
+
+
+class Rainbow(DQN):
+    _config_cls = RainbowConfig

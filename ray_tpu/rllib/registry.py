@@ -21,6 +21,7 @@ _TABLE = {
     "ApexDQN": ("ApexDQN", "ApexDQNConfig"),
     "APEX": ("ApexDQN", "ApexDQNConfig"),
     "ApexDDPG": ("ApexDDPG", "ApexDDPGConfig"),
+    "Rainbow": ("Rainbow", "RainbowConfig"),
     "R2D2": ("R2D2", "R2D2Config"),
     "SAC": ("SAC", "SACConfig"),
     "TD3": ("TD3", "TD3Config"),

@@ -405,3 +405,19 @@ def test_c51_dqn_learns(ray_start_shared):
                     num_atoms=21, v_min=0.0, v_max=4.0, seed=0)
     best = _train_until(DQN(cfg), "episode_reward_mean", 18.0, 25)
     assert best >= 15.0, best
+
+
+def test_rainbow_learns(ray_start_shared):
+    from ray_tpu.rllib import Rainbow, RainbowConfig
+
+    cfg = RainbowConfig(env=lambda _: _ContextBanditEnv(),
+                        num_workers=1, hidden=(32,), buffer_size=5000,
+                        learning_starts=200, train_batch_size=64,
+                        train_intensity=16, target_update_freq=200,
+                        epsilon_decay_steps=1500,
+                        rollout_fragment_length=100, lr=5e-3,
+                        gamma=0.5, num_atoms=21, v_min=0.0,
+                        v_max=8.0, seed=0)
+    assert cfg.dueling and cfg.n_step == 3 and cfg.prioritized_replay
+    best = _train_until(Rainbow(cfg), "episode_reward_mean", 18.0, 25)
+    assert best >= 15.0, best
