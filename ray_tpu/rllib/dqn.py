@@ -45,6 +45,12 @@ class QPolicySpec:
     num_atoms: int = 1
     v_min: float = -10.0
     v_max: float = 10.0
+    #: NoisyNet exploration (Fortunato et al.; the reference's
+    #: DQNConfig.noisy): the HEAD layers carry learned per-weight noise
+    #: scales — exploration comes from resampling factorized Gaussian
+    #: noise each forward instead of epsilon-greedy
+    noisy: bool = False
+    noisy_sigma0: float = 0.5
 
     @property
     def atom_support(self):
@@ -53,17 +59,66 @@ class QPolicySpec:
         return jnp.linspace(self.v_min, self.v_max, self.num_atoms)
 
 
-def _q_logits(spec: "QPolicySpec", params, obs):
+def _noisy_init(key, in_dim: int, out_dim: int, sigma0: float):
+    """A factorized-noisy linear layer: mean weights + learned noise
+    scales, initialized per Fortunato et al."""
+    import jax
+    import jax.numpy as jnp
+
+    bound = 1.0 / np.sqrt(in_dim)
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.uniform(kw, (in_dim, out_dim), minval=-bound,
+                                maxval=bound),
+        "b": jax.random.uniform(kb, (out_dim,), minval=-bound,
+                                maxval=bound),
+        "w_sigma": jnp.full((in_dim, out_dim),
+                            sigma0 / np.sqrt(in_dim)),
+        "b_sigma": jnp.full((out_dim,), sigma0 / np.sqrt(in_dim)),
+    }
+
+
+def _noisy_apply(layer, x, key):
+    """y = (w + w_sigma·eps_w) x + (b + b_sigma·eps_b) with factorized
+    noise eps_w = f(eps_in) f(eps_out)^T, f(e) = sign(e)·sqrt|e|.
+    key=None → mean weights only (evaluation / greedy play)."""
+    import jax
+    import jax.numpy as jnp
+
+    if key is None:
+        return x @ layer["w"] + layer["b"]
+    k_in, k_out = jax.random.split(key)
+
+    def f(e):
+        return jnp.sign(e) * jnp.sqrt(jnp.abs(e))
+
+    e_in = f(jax.random.normal(k_in, (layer["w"].shape[0],)))
+    e_out = f(jax.random.normal(k_out, (layer["w"].shape[1],)))
+    w = layer["w"] + layer["w_sigma"] * jnp.outer(e_in, e_out)
+    b = layer["b"] + layer["b_sigma"] * e_out
+    return x @ w + b
+
+
+def _q_logits(spec: "QPolicySpec", params, obs, noise_key=None):
     """Per-action outputs: (B, n_actions) Q-values when num_atoms == 1,
     else (B, n_actions, num_atoms) distribution LOGITS.  Dueling
     combines streams in output space (Rainbow-style for atoms)."""
     import jax.numpy as jnp
 
     A = spec.num_atoms
-    if spec.dueling:
+    if spec.dueling or spec.noisy:
         h = _net_apply(params["trunk"], obs, final_linear=False)
-        v = _net_apply(params["v"], h)
-        a = _net_apply(params["a"], h)
+        if spec.noisy:
+            import jax
+
+            kv = ka = None
+            if noise_key is not None:
+                kv, ka = jax.random.split(noise_key)
+            v = _noisy_apply(params["v"], h, kv)
+            a = _noisy_apply(params["a"], h, ka)
+        else:
+            v = _net_apply(params["v"], h)
+            a = _net_apply(params["a"], h)
         if A > 1:
             v = v.reshape(v.shape[0], 1, A)
             a = a.reshape(a.shape[0], spec.n_actions, A)
@@ -75,13 +130,13 @@ def _q_logits(spec: "QPolicySpec", params, obs):
     return out
 
 
-def _q_apply(spec: "QPolicySpec", params, obs):
+def _q_apply(spec: "QPolicySpec", params, obs, noise_key=None):
     """Scalar Q-values under any architecture (atoms collapse to the
     distribution's expectation)."""
     import jax
     import jax.numpy as jnp
 
-    out = _q_logits(spec, params, obs)
+    out = _q_logits(spec, params, obs, noise_key)
     if spec.num_atoms > 1:
         probs = jax.nn.softmax(out, axis=-1)
         return jnp.sum(probs * spec.atom_support, axis=-1)
@@ -123,13 +178,21 @@ class QPolicy:
         self.spec = spec
         self.mesh = mesh
         A = spec.num_atoms
-        if spec.dueling:
+        if spec.noisy and not spec.dueling:
+            raise ValueError("noisy=True uses the trunk + v/a head "
+                             "layout; set dueling=True as well")
+        if spec.dueling or spec.noisy:
             kt, kv, ka = jax.random.split(jax.random.PRNGKey(seed), 3)
             feat = spec.hidden[-1] if spec.hidden else spec.obs_dim
+            if spec.noisy:
+                head = lambda k, w: _noisy_init(  # noqa: E731
+                    k, feat, w, spec.noisy_sigma0)
+            else:
+                head = lambda k, w: _net_init(k, (feat, w))  # noqa: E731
             self.params = {
                 "trunk": _net_init(kt, (spec.obs_dim, *spec.hidden)),
-                "v": _net_init(kv, (feat, A)),
-                "a": _net_init(ka, (feat, spec.n_actions * A)),
+                "v": head(kv, A),
+                "a": head(ka, spec.n_actions * A),
             }
         else:
             self.params = _net_init(jax.random.PRNGKey(seed),
@@ -174,7 +237,19 @@ class QPolicy:
             checks = [("q", weights,
                        self.spec.n_actions * self.spec.num_atoms)]
         for name, head, want_width in checks:
-            got_width = int(np.asarray(head[-1]["b"]).shape[-1])
+            if name in ("v", "a"):
+                is_noisy_head = (isinstance(head, dict)
+                                 and "w_sigma" in head)
+                if is_noisy_head != self.spec.noisy:
+                    raise ValueError(
+                        f"{name}-head is "
+                        f"{'noisy' if is_noisy_head else 'plain'} but "
+                        f"this policy was built with noisy="
+                        f"{self.spec.noisy}; set DQNConfig(noisy="
+                        f"{is_noisy_head}) to match the checkpoint")
+            bias = (head["b"] if isinstance(head, dict)
+                    else head[-1]["b"])
+            got_width = int(np.asarray(bias).shape[-1])
             if got_width != want_width:
                 raise ValueError(
                     f"{name}-head width {got_width} does not match "
@@ -208,6 +283,10 @@ class QPolicy:
         def q_values(params, obs):
             return _q_apply(spec, params, obs)
 
+        @jax.jit
+        def q_values_noisy(params, obs, key):
+            return _q_apply(spec, params, obs, key)
+
         def _discounts(mini):
             disc = mini.get("discounts")
             if disc is None:
@@ -219,33 +298,42 @@ class QPolicy:
                     1.0 - mini[sb.DONES].astype(jnp.float32))
             return disc
 
-        def _best_next(params, target_params, mini):
+        def _keys(key, n):
+            if key is None:
+                return [None] * n
+            return list(jax.random.split(key, n))
+
+        def _best_next(params, target_params, mini, keys):
             q_next_tgt = _q_apply(spec, target_params,
-                                  mini[sb.NEXT_OBS])
+                                  mini[sb.NEXT_OBS], keys[0])
             if spec.double_q:
                 # action argmax by the ONLINE net, value by the target
                 # net (van Hasselt double-DQN)
                 q_next_online = _q_apply(
-                    spec, params, mini[sb.NEXT_OBS])
+                    spec, params, mini[sb.NEXT_OBS], keys[1])
                 return jnp.argmax(q_next_online, axis=-1), q_next_tgt
             return jnp.argmax(q_next_tgt, axis=-1), q_next_tgt
 
-        def td_error(params, target_params, mini):
-            q = _q_apply(spec, params, mini[sb.OBS])
+        def td_error(params, target_params, mini, key=None):
+            ks = _keys(key, 3)
+            q = _q_apply(spec, params, mini[sb.OBS], ks[2])
             qa = jnp.take_along_axis(
                 q, mini[sb.ACTIONS][:, None].astype(jnp.int32),
                 axis=-1)[:, 0]
-            best, q_next_tgt = _best_next(params, target_params, mini)
+            best, q_next_tgt = _best_next(params, target_params,
+                                          mini, ks)
             v_next = jnp.take_along_axis(q_next_tgt, best[:, None],
                                          axis=-1)[:, 0]
             target = mini[sb.REWARDS] + _discounts(mini) * v_next
             return qa - jax.lax.stop_gradient(target)
 
-        def c51_ce(params, target_params, mini):
+        def c51_ce(params, target_params, mini, key=None):
             """Per-sample cross-entropy of the chosen action's return
             distribution against the projected target distribution —
             the C51 loss AND the priority signal."""
-            logits = _q_logits(spec, params, mini[sb.OBS])  # (B,n,A)
+            ks = _keys(key, 3)
+            logits = _q_logits(spec, params, mini[sb.OBS],
+                               ks[2])                       # (B,n,A)
             acts = mini[sb.ACTIONS].astype(jnp.int32)
             chosen = jnp.take_along_axis(
                 logits, acts[:, None, None].repeat(
@@ -253,13 +341,15 @@ class QPolicy:
             logp = jax.nn.log_softmax(chosen, axis=-1)
             # ONE target forward: best-action selection reuses these
             # logits (expectation) instead of a second pass
-            nlog_t = _q_logits(spec, target_params, mini[sb.NEXT_OBS])
+            nlog_t = _q_logits(spec, target_params,
+                               mini[sb.NEXT_OBS], ks[0])
             tgt_probs = jax.nn.softmax(nlog_t, axis=-1)
             q_next_tgt = jnp.sum(tgt_probs * spec.atom_support,
                                  axis=-1)                   # (B, n)
             if spec.double_q:
-                best = jnp.argmax(_q_apply(spec, params,
-                                           mini[sb.NEXT_OBS]), axis=-1)
+                best = jnp.argmax(
+                    _q_apply(spec, params, mini[sb.NEXT_OBS], ks[1]),
+                    axis=-1)
             else:
                 best = jnp.argmax(q_next_tgt, axis=-1)
             next_dist = jnp.take_along_axis(
@@ -270,14 +360,14 @@ class QPolicy:
             return -jnp.sum(jax.lax.stop_gradient(proj) * logp,
                             axis=-1)
 
-        def loss_fn(params, target_params, mini):
+        def loss_fn(params, target_params, mini, key=None):
             w = mini.get("is_weights")
             if spec.num_atoms > 1:
-                ce = c51_ce(params, target_params, mini)
+                ce = c51_ce(params, target_params, mini, key)
                 loss = jnp.mean(ce * w) if w is not None \
                     else jnp.mean(ce)
                 return loss, ce
-            td = td_error(params, target_params, mini)
+            td = td_error(params, target_params, mini, key)
             huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td * td,
                               jnp.abs(td) - 0.5)
             if w is not None:
@@ -285,29 +375,45 @@ class QPolicy:
             return jnp.mean(huber), td
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def update(params, opt_state, target_params, stacked):
+        def update(params, opt_state, target_params, stacked, rng):
             """stacked: pytree of (n_steps, minibatch, ...) arrays."""
             import optax
 
             def step(carry, mini):
-                params, opt_state = carry
+                params, opt_state, rng = carry
+                key = None
+                if spec.noisy:
+                    rng, key = jax.random.split(rng)
                 (loss, td), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, target_params, mini)
+                    loss_fn, has_aux=True)(params, target_params,
+                                           mini, key)
                 updates, opt_state = self.tx.update(grads, opt_state,
                                                     params)
                 params = optax.apply_updates(params, updates)
-                return (params, opt_state), (loss, td)
+                return (params, opt_state, rng), (loss, td)
 
-            (params, opt_state), (losses, tds) = jax.lax.scan(
-                step, (params, opt_state), stacked)
-            return params, opt_state, losses.mean(), tds
+            (params, opt_state, rng), (losses, tds) = jax.lax.scan(
+                step, (params, opt_state, rng), stacked)
+            return params, opt_state, losses.mean(), tds, rng
 
         self._q_values = q_values
+        self._q_values_noisy = q_values_noisy
         self._update = update
+        self._train_rng = jax.random.PRNGKey(
+            int(self._rng.randint(0, 2**31 - 1)))
 
     # -- inference --------------------------------------------------------
     def compute_actions(self, obs: np.ndarray,
                         epsilon: float = 0.0) -> np.ndarray:
+        if self.spec.noisy and epsilon > 0.0:
+            # NoisyNet: exploration comes from resampled weight noise,
+            # not epsilon (epsilon>0 marks "exploring" rollouts;
+            # epsilon==0 keeps greedy mean-weight evaluation)
+            import jax
+
+            self._train_rng, k = jax.random.split(self._train_rng)
+            q = np.asarray(self._q_values_noisy(self.params, obs, k))
+            return q.argmax(axis=-1)
         q = np.asarray(self._q_values(self.params, obs))
         greedy = q.argmax(axis=-1)
         if epsilon <= 0.0:
@@ -339,14 +445,17 @@ class QPolicy:
             self.opt_state = jax.device_put(self.opt_state, repl)
             self.target_params = jax.device_put(self.target_params, repl)
             with jax.set_mesh(self.mesh):
-                self.params, self.opt_state, loss, tds = self._update(
+                (self.params, self.opt_state, loss, tds,
+                 self._train_rng) = self._update(
                     self.params, self.opt_state, self.target_params,
-                    stacked)
+                    stacked, self._train_rng)
             return float(loss), np.asarray(tds)
         stacked = {k: jnp.stack([m[k] for m in minis])
                    for k in minis[0].keys()}
-        self.params, self.opt_state, loss, tds = self._update(
-            self.params, self.opt_state, self.target_params, stacked)
+        (self.params, self.opt_state, loss, tds,
+         self._train_rng) = self._update(
+            self.params, self.opt_state, self.target_params, stacked,
+            self._train_rng)
         return float(loss), np.asarray(tds)
 
 
@@ -492,6 +601,10 @@ class DQNConfig(AlgorithmConfig):
     num_atoms: int = 1
     v_min: float = -10.0
     v_max: float = 10.0
+    #: NoisyNet head exploration (reference DQNConfig.noisy); replaces
+    #: epsilon-greedy when on
+    noisy: bool = False
+    noisy_sigma0: float = 0.5
     rollout_fragment_length: int = 50
     obs_dim: Optional[int] = None
     n_actions: Optional[int] = None
@@ -505,7 +618,8 @@ class DQNConfig(AlgorithmConfig):
                            gamma=self.gamma, double_q=self.double_q,
                            dueling=self.dueling,
                            num_atoms=self.num_atoms, v_min=self.v_min,
-                           v_max=self.v_max)
+                           v_max=self.v_max, noisy=self.noisy,
+                           noisy_sigma0=self.noisy_sigma0)
 
 
 class DQN(Algorithm):

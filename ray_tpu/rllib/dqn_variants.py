@@ -118,14 +118,14 @@ class ApexDQN(DQN):
 class RainbowConfig(DQNConfig):
     """Rainbow-style DQN (Hessel et al. 2018): every component this
     DQN implements switched on together — double-Q + dueling +
-    distributional C51 + n-step returns + prioritized replay.  (The
-    remaining Rainbow ingredient, noisy-net exploration, is not
-    implemented; epsilon-greedy stands in.)"""
+    distributional C51 + n-step returns + prioritized replay +
+    noisy-net exploration."""
     double_q: bool = True
     dueling: bool = True
     num_atoms: int = 51
     n_step: int = 3
     prioritized_replay: bool = True
+    noisy: bool = True
 
 
 class Rainbow(DQN):

@@ -407,6 +407,26 @@ def test_c51_dqn_learns(ray_start_shared):
     assert best >= 15.0, best
 
 
+def test_noisy_net_exploration_and_greedy_eval():
+    import jax
+
+    from ray_tpu.rllib.dqn import QPolicy, QPolicySpec
+
+    spec = QPolicySpec(obs_dim=2, n_actions=4, hidden=(8,),
+                       dueling=True, noisy=True)
+    pol = QPolicy(spec, seed=0)
+    assert "w_sigma" in pol.params["v"]
+    obs = np.zeros((64, 2), np.float32)
+    # exploring path (epsilon>0 marker): resampled noise varies actions
+    acts = [tuple(pol.compute_actions(obs, epsilon=1.0))
+            for _ in range(5)]
+    assert len(set(acts)) > 1, acts
+    # greedy path is deterministic (mean weights, no noise)
+    g1 = pol.compute_actions(obs, epsilon=0.0)
+    g2 = pol.compute_actions(obs, epsilon=0.0)
+    np.testing.assert_array_equal(g1, g2)
+
+
 def test_rainbow_learns(ray_start_shared):
     from ray_tpu.rllib import Rainbow, RainbowConfig
 
