@@ -48,6 +48,16 @@ def parse_args(argv=None):
                     help="remat policy override; default mlp_only at "
                          "the default batch (the measured-best b24 "
                          "config), full remat otherwise")
+    ap.add_argument("--ce-impl", default="",
+                    choices=["", "dense", "streaming_xla", "pallas"],
+                    help="cross-entropy implementation: dense logits, "
+                         "XLA-scan vocab tiles, or the fused pallas "
+                         "lm-head+CE kernel (default: config default)")
+    ap.add_argument("--flash-resident", default="",
+                    choices=["", "auto", "on", "off"],
+                    help="resident-kv flash attention selection for this "
+                         "run (RAYTPU_FLASH_RESIDENT env var still "
+                         "overrides; default: config default)")
     return ap.parse_args(argv)
 
 # Backend-init hardening (round-2): round 1 died inside jax.devices()
@@ -238,24 +248,29 @@ def main(args=None):
     batch = args.batch or (24 * max(1, n_chips) if on_tpu else 2)
     remat_policy = args.remat or ("mlp_only" if not args.batch
                                   else "full")
+    cfg_kw = {}
+    if args.ce_impl:
+        cfg_kw["ce_impl"] = args.ce_impl
+    if args.flash_resident:
+        cfg_kw["flash_resident"] = args.flash_resident
     if on_tpu:
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=seq, n_steps=args.steps or 20,
             preset=args.preset or "gpt2", mesh=args.mesh,
-            n_devices=args.chips, remat_policy=remat_policy)
+            n_devices=args.chips, remat_policy=remat_policy, **cfg_kw)
     elif fake_mesh:  # multi-chip program on emulated devices
         batch = args.batch or max(2 * n_chips, 4)
         remat_policy = "full"        # smoke paths run the default
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=128, n_steps=args.steps or 2,
             preset=args.preset or "tiny", mesh=args.mesh,
-            n_devices=args.chips, use_flash=False)
+            n_devices=args.chips, use_flash=False, **cfg_kw)
         seq = 128
     else:  # CPU smoke fallback so bench.py always emits a line
         remat_policy = "full"
         tok_s_chip, mfu, final_loss, n_chips = time_config(
             batch, seq=128, n_steps=args.steps or 2,
-            preset=args.preset or "tiny", use_flash=False)
+            preset=args.preset or "tiny", use_flash=False, **cfg_kw)
         seq = 128
     result = {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
@@ -274,6 +289,8 @@ def main(args=None):
                    "mfu": round(mfu, 4),
                    "loss": round(final_loss, 3),
                    "remat_policy": remat_policy,
+                   "ce_impl": args.ce_impl or "dense",
+                   "flash_resident": args.flash_resident or "auto",
                    "backend": jax.default_backend(),
                    "tpu_error": TPU_ERROR},
     }

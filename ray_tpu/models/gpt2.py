@@ -32,6 +32,42 @@ from jax import lax
 
 from ray_tpu.parallel.sharding import DEFAULT_RULES, with_logical_constraint
 
+#: The three lm-head + cross-entropy implementations (GPT2Config.ce_impl):
+#: "dense" materializes f32 (B,T,V) logits; "streaming_xla" is the
+#: lax.scan vocab-tile path (ops/vocab_ce.py); "pallas" is the fused
+#: MXU-streamed kernel (ops/fused_ce.py) — no (B,T,V) buffer in either
+#: pass.  See PERF_NOTES round 6 for when each wins.
+CE_IMPLS = ("dense", "streaming_xla", "pallas")
+FLASH_RESIDENT_MODES = ("auto", "on", "off")
+
+
+def ce_config_problems(ce_impl: str, flash_resident: str, *,
+                       loss_chunks: int = 1,
+                       seq_parallel: bool = False) -> list:
+    """Validation shared by GPT2Config/LlamaConfig: returns a list of
+    human-readable problems with the CE/attention knob combination (empty
+    when valid).  Callers join the list into ONE coherent ValueError so
+    an invalid config reports every conflict at once instead of the
+    first scattered check to trip."""
+    problems = []
+    if ce_impl not in CE_IMPLS:
+        problems.append(f"ce_impl must be one of {CE_IMPLS} "
+                        f"(got {ce_impl!r})")
+    else:
+        if ce_impl != "dense" and loss_chunks > 1:
+            problems.append(
+                f"loss_chunks={loss_chunks} requires ce_impl='dense' "
+                f"(both bound the logits footprint; pick one)")
+        if ce_impl != "dense" and seq_parallel:
+            problems.append(
+                f"ce_impl={ce_impl!r} needs an unsharded seq axis (the "
+                f"(B,T)->(B*T) flatten would reshard under seq "
+                f"parallelism)")
+    if flash_resident not in FLASH_RESIDENT_MODES:
+        problems.append(f"flash_resident must be one of "
+                        f"{FLASH_RESIDENT_MODES} (got {flash_resident!r})")
+    return problems
+
 
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
@@ -60,13 +96,26 @@ class GPT2Config:
     #: lax.scan unroll factor for the layer stack: >1 lets XLA overlap one
     #: layer's weight loads with the previous layer's compute.
     scan_unroll: int = 1
-    #: Stream the lm-head + cross-entropy over vocab tiles
-    #: (ops/vocab_ce.py): the float32 (B,T,V) logits never materialize —
-    #: ~6.6 GB of HBM traffic per step at b32/V50k (PERF_NOTES lever 1).
-    #: Leave off when the seq axis is mesh-sharded (the (B,T)->(B*T)
-    #: flatten would reshard); mutually exclusive with loss_chunks>1.
+    #: lm-head + cross-entropy implementation — see CE_IMPLS above.  The
+    #: non-dense impls need an unsharded seq axis (the (B,T)->(B*T)
+    #: flatten would reshard) and are mutually exclusive with
+    #: loss_chunks>1; validated coherently in __post_init__.
+    ce_impl: str = "dense"
+    #: DEPRECATED alias for ce_impl="streaming_xla" (the pre-round-6
+    #: knob); normalized into ce_impl by __post_init__.
     use_streaming_ce: bool = False
     vocab_tile: int = 8192
+    #: pallas fused-CE tile sizes (ce_impl="pallas"): block_n rows of
+    #: flattened (B*T, D) hidden per vocab stream, block_v vocab columns
+    #: per MXU tile.  Defaults sized for GPT-2 D=768 on v5e VMEM
+    #: (ops/fused_ce.py).
+    ce_block_n: int = 256
+    ce_block_v: int = 1024
+    #: resident-kv flash attention dispatch: "auto" = the measured
+    #: policy (ops/flash_attention._resident_plan), "on"/"off" force it.
+    #: RAYTPU_FLASH_RESIDENT=1/0 in the env overrides the config — the
+    #: process-wide A/B workflow keeps working.
+    flash_resident: str = "auto"
     seq_parallel: bool = False  # context parallelism over the "seq" axis
     #: context-parallel algorithm: "ring" (kv blocks rotate by ppermute,
     #: O(T/n) memory) or "ulysses" (head-scatter/seq-gather all-to-all —
@@ -82,6 +131,20 @@ class GPT2Config:
     # pad vocab to a multiple of 128 so the logits matmul tiles the MXU
     # cleanly and the vocab dim shards evenly under tensor parallelism
     vocab_pad_to: int = 128
+
+    def __post_init__(self):
+        if self.use_streaming_ce and self.ce_impl == "dense":
+            object.__setattr__(self, "ce_impl", "streaming_xla")
+        problems = ce_config_problems(
+            self.ce_impl, self.flash_resident,
+            loss_chunks=self.loss_chunks, seq_parallel=self.seq_parallel)
+        if self.use_streaming_ce and self.ce_impl == "pallas":
+            problems.append(
+                "use_streaming_ce is a deprecated alias for "
+                "ce_impl='streaming_xla' and conflicts with "
+                "ce_impl='pallas'")
+        if problems:
+            raise ValueError("invalid GPT2Config: " + "; ".join(problems))
 
     @property
     def head_dim(self) -> int:
@@ -270,7 +333,8 @@ def _attention(x, p, cfg: GPT2Config, rules):
         o = _ring_attention_sharded(q, kk, v, rules, cfg.sp_mode)
     if o is None:
         from ray_tpu.ops.attention import causal_attention
-        o = causal_attention(q, kk, v, use_flash=cfg.use_flash)
+        o = causal_attention(q, kk, v, use_flash=cfg.use_flash,
+                             resident=cfg.flash_resident)
     from jax.ad_checkpoint import checkpoint_name
     o = checkpoint_name(o, "attn_out")
     wo = p["o_w"].astype(cfg.dtype).reshape(h * hd, d)
@@ -517,6 +581,31 @@ def _chunked_ce(hidden, wte, targets, mask, cfg: GPT2Config):
     return total / jnp.maximum(count, 1.0)
 
 
+def lm_head_nll(hidden, w_vocab_major, targets, cfg) -> jnp.ndarray:
+    """Per-token nll via the non-dense CE impls, shared by gpt2 and
+    llama.  hidden (B, T, D); w_vocab_major (V, D) — tied wte, or a
+    transposed lm_head for untied models; targets (B, T) int32.  cfg is
+    any config carrying ce_impl / vocab_size / vocab_tile / ce_block_n /
+    ce_block_v / dtype / padded_vocab.  Returns (B, T) float32."""
+    B, T = targets.shape
+    h2 = hidden.reshape(B * T, -1)
+    t1 = targets.reshape(-1).astype(jnp.int32)
+    if cfg.ce_impl == "pallas":
+        from ray_tpu.ops.fused_ce import fused_lm_ce
+
+        nll = fused_lm_ce(h2, w_vocab_major, t1, cfg.vocab_size,
+                          block_n=cfg.ce_block_n,
+                          block_v=min(cfg.ce_block_v, cfg.padded_vocab),
+                          compute_dtype=cfg.dtype)
+    else:
+        from ray_tpu.ops.vocab_ce import streaming_ce
+
+        nll = streaming_ce(h2, w_vocab_major, t1, cfg.vocab_size,
+                           min(cfg.vocab_tile, cfg.padded_vocab),
+                           cfg.dtype)
+    return nll.reshape(B, T)
+
+
 def gpt2_loss(params, batch, cfg: GPT2Config,
               rules=DEFAULT_RULES) -> jnp.ndarray:
     """Next-token cross-entropy.  batch = {"tokens": (B, T+1) int32} or
@@ -529,23 +618,10 @@ def gpt2_loss(params, batch, cfg: GPT2Config,
     hidden, aux = gpt2_hidden(params, inputs, cfg, rules,
                               return_aux=True)
     aux_term = cfg.moe_aux_weight * aux if cfg.n_experts else 0.0
-    if cfg.use_streaming_ce:
-        from ray_tpu.ops.vocab_ce import streaming_ce
-
-        if cfg.loss_chunks > 1:
-            raise ValueError("use_streaming_ce and loss_chunks>1 are "
-                             "mutually exclusive (both bound the logits "
-                             "footprint; pick one)")
-        if cfg.seq_parallel:
-            raise ValueError("use_streaming_ce needs an unsharded seq "
-                             "axis (the (B,T)->(B*T) flatten would "
-                             "force a reshard under seq parallelism)")
-        B, T = targets.shape
-        nll = streaming_ce(
-            hidden.reshape(B * T, -1), params["wte"],
-            targets.reshape(-1).astype(jnp.int32), cfg.vocab_size,
-            min(cfg.vocab_tile, cfg.padded_vocab),
-            cfg.dtype).reshape(B, T)
+    if cfg.ce_impl != "dense":
+        # valid combinations were enforced at config construction
+        # (__post_init__) — one coherent error, not scattered checks here
+        nll = lm_head_nll(hidden, params["wte"], targets, cfg)
         if mask is not None:
             m = mask.astype(jnp.float32)
             return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m),

@@ -28,7 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.gpt2 import nll_from_logits
+from ray_tpu.models.gpt2 import (ce_config_problems, lm_head_nll,
+                                 nll_from_logits)
 from ray_tpu.parallel.sharding import (DEFAULT_RULES,
                                        with_logical_constraint)
 
@@ -50,6 +51,23 @@ class LlamaConfig:
     scan_unroll: int = 1
     use_flash: Optional[bool] = None    # None = auto (flash on TPU)
     vocab_pad_to: int = 128
+    #: lm-head + CE implementation (gpt2.CE_IMPLS); the non-dense impls
+    #: run against the TRANSPOSED (V, D) view of lm_head so one kernel
+    #: serves tied and untied heads (the transpose+cast fuses into the
+    #: bf16 tile staging — cheap next to the (B,T,V) logits it removes).
+    ce_impl: str = "dense"
+    vocab_tile: int = 8192
+    ce_block_n: int = 256
+    ce_block_v: int = 1024
+    #: resident-kv flash dispatch knob (gpt2.FLASH_RESIDENT_MODES);
+    #: RAYTPU_FLASH_RESIDENT overrides per-process.
+    flash_resident: str = "auto"
+
+    def __post_init__(self):
+        problems = ce_config_problems(self.ce_impl, self.flash_resident)
+        if problems:
+            raise ValueError("invalid LlamaConfig: "
+                             + "; ".join(problems))
 
     @property
     def head_dim(self) -> int:
@@ -204,7 +222,8 @@ def _attention(x, p, cos, sin, cfg: LlamaConfig, rules):
                                     "head_dim"), rules)
     from ray_tpu.ops.attention import causal_attention
 
-    o = causal_attention(q, k, v, use_flash=cfg.use_flash)
+    o = causal_attention(q, k, v, use_flash=cfg.use_flash,
+                         resident=cfg.flash_resident)
     o = o.reshape(B, T, h * hd)
     wo = p["wo"].astype(cfg.dtype).reshape(h * hd, d)
     return (o @ wo).astype(x.dtype)
@@ -271,9 +290,15 @@ def llama_loss(params, batch, cfg: LlamaConfig,
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = llama_forward(params, inputs, cfg, rules)
-    nll = nll_from_logits(logits, targets, cfg.vocab_size,
-                          cfg.padded_vocab)
+    if cfg.ce_impl != "dense":
+        hidden = llama_hidden(params, inputs, cfg, rules)
+        # (D, V) lm_head → the (V, D) vocab-major view the CE kernels
+        # share with gpt2's tied wte
+        nll = lm_head_nll(hidden, params["lm_head"].T, targets, cfg)
+    else:
+        logits = llama_forward(params, inputs, cfg, rules)
+        nll = nll_from_logits(logits, targets, cfg.vocab_size,
+                              cfg.padded_vocab)
     mask = batch.get("mask")
     if mask is not None:
         m = mask.astype(jnp.float32)

@@ -1,6 +1,26 @@
 """TPU kernel library: pallas kernels for the hot ops plus XLA reference
-implementations used on CPU and as numerics oracles in tests."""
+implementations used on CPU and as numerics oracles in tests.
+
+Every pallas kernel exported here must have an interpret-mode test
+module under tests/ (enforced by tests/test_ops_kernel_guard.py) so
+numerics stay CPU-verifiable without the TPU tunnel.
+"""
 
 from ray_tpu.ops.attention import causal_attention, reference_attention
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.fused_ce import fused_lm_ce
+from ray_tpu.ops.pipeline import pipeline_apply, stack_stage_params
+from ray_tpu.ops.ring_attention import ring_attention, ulysses_attention
+from ray_tpu.ops.vocab_ce import streaming_ce
 
-__all__ = ["causal_attention", "reference_attention"]
+__all__ = [
+    "causal_attention",
+    "flash_attention",
+    "fused_lm_ce",
+    "pipeline_apply",
+    "reference_attention",
+    "ring_attention",
+    "stack_stage_params",
+    "streaming_ce",
+    "ulysses_attention",
+]

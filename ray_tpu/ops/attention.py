@@ -55,16 +55,23 @@ def flash_auto_dispatch(T: int, D: int) -> bool:
 
 
 def causal_attention(q, k, v, *, use_flash: Optional[bool] = None,
-                     scale: Optional[float] = None) -> jnp.ndarray:
+                     scale: Optional[float] = None,
+                     resident: str = "auto") -> jnp.ndarray:
     """Causal MHA on (B, T, H, D) tensors.
 
     use_flash: True = pallas kernel, False = XLA reference, None = auto
     (pallas on TPU when T >= _FLASH_MIN_SEQ and block-divisible).
+    resident: "auto" | "on" | "off" — per-config resident-kv selection
+    for the flash kernel (RAYTPU_FLASH_RESIDENT env var still wins as a
+    process-wide override; see flash_attention.resolve_resident_mode).
+    Ignored on the XLA reference path.
     """
     T, D = q.shape[1], q.shape[-1]
     if use_flash is None:
         use_flash = flash_auto_dispatch(T, D)
     if use_flash:
-        from ray_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=True, scale=scale)
+        from ray_tpu.ops.flash_attention import (flash_attention,
+                                                 resolve_resident_mode)
+        return flash_attention(q, k, v, causal=True, scale=scale,
+                               resident_kv=resolve_resident_mode(resident))
     return reference_attention(q, k, v, causal=True, scale=scale)

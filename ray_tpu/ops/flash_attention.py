@@ -506,6 +506,27 @@ RESIDENT_BLOCK_Q = 256
 RESIDENT_CHUNK = 512
 
 
+def resolve_resident_mode(mode: str = "auto"):
+    """Per-config resident-kv knob → the flash_attention ``resident_kv``
+    tri-state (True/False/None=auto).  The RAYTPU_FLASH_RESIDENT env var
+    is kept as a process-wide OVERRIDE ("1" forces on, "0" forces off)
+    so the historical whole-process A/B workflow still works, but the
+    primary switch is now per-config (``GPT2Config.flash_resident``) so
+    sweep_tpu.py can A/B resident kernels per VARIANT."""
+    import os
+
+    env = os.environ.get("RAYTPU_FLASH_RESIDENT")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    return None
+
+
 def _resident_plan(T: int, causal: bool):
     """Pick the resident-kv configuration for seq length T, or None when
     the classic grid kernels should run instead.  Measured v5e policy:
@@ -517,21 +538,19 @@ def _resident_plan(T: int, causal: bool):
 
     GATING: the resident BACKWARD kernels are interpret-verified but
     have not yet compiled on real TPU (the tunnel died mid-session), so
-    auto-dispatch at T<=2048 requires RAYTPU_FLASH_RESIDENT=1 until a
-    chip session confirms them — an unattended bench must never be the
-    first to compile a kernel.  T>2048 stays auto (the classic tile
-    cannot compile there at all, so resident is the only option).
+    AUTO dispatch at T<=2048 stays on the classic kernels until a chip
+    session confirms them — an unattended bench must never be the first
+    to compile a kernel.  Opt in per-config (flash_resident="on") or
+    per-process (RAYTPU_FLASH_RESIDENT=1, resolved by
+    resolve_resident_mode into an explicit resident_kv=True).  T>2048
+    stays auto-resident (the classic tile cannot compile there at all).
     Returns (bq, bk, chunk) or None."""
-    import os
-
     if not causal:
         return None                 # no skip to win; classic path
     if T % RESIDENT_CHUNK or T % RESIDENT_BLOCK_Q:
         return None
-    if T <= 2048 and os.environ.get("RAYTPU_FLASH_RESIDENT") != "1":
-        return None
-    if T == 2048:
-        return None                 # whole-T tile measured faster
+    if T <= 2048:
+        return None                 # resident bwd not chip-verified yet
     return RESIDENT_BLOCK_Q, RESIDENT_BLOCK_Q, RESIDENT_CHUNK
 
 
@@ -608,6 +627,9 @@ def flash_attention(q, k, v, *, causal: bool = True,
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
 
+    if resident_kv is None:
+        # the RAYTPU_FLASH_RESIDENT env var overrides auto dispatch
+        resident_kv = resolve_resident_mode("auto")
     if resident_kv is None:
         # any explicit block tuning (fwd or bwd) pins the classic path
         resident_kv = (block_q is None and block_k is None

@@ -5,14 +5,67 @@ Usage:
 
 Each entry is [batch_per_chip, {overrides}].  "max_seq"/"seq" and
 "preset" overrides are routed to time_config's seq/preset parameters;
-everything else is passed to gpt2_config.  Reuses bench.time_config so
-the methodology (donation, mesh, fence, per-chip batch and MFU
-normalization) stays identical to the official bench.
+everything else is passed to gpt2_config (so per-variant knobs like
+ce_impl / flash_resident / remat_policy A/B straight from the sweep
+spec).  Reuses bench.time_config so the methodology (donation, mesh,
+fence, per-chip batch and MFU normalization) stays identical to the
+official bench.
+
+Output: for every variant one HUMAN line and one machine-readable JSON
+line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
+clean JSONL stream).  Failures get a distinct tag — in particular the
+known compile-helper HTTP 500 tunnel failure is tagged
+"compile_helper_500" — so sweeps that straddle the failure boundary
+remain analyzable after the fact.
 """
 import json
 import sys
 
 from bench import time_config
+
+
+def _failure_tag(e: Exception) -> str:
+    """Classify a variant failure.  The compile helper's flaky HTTP 500
+    (tunnel-side, not a repo bug) gets its own tag so post-hoc analysis
+    can split environment flake from genuine compile/OOM failures."""
+    msg = str(e)
+    if "500" in msg and ("compile" in msg.lower() or "http" in msg.lower()
+                         or "server" in msg.lower()):
+        return "compile_helper_500"
+    if "RESOURCE_EXHAUSTED" in msg or "out of memory" in msg.lower():
+        return "oom"
+    return type(e).__name__
+
+
+def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout):
+    """Run each [batch_per_chip, overrides] variant; returns the list of
+    result records that were also emitted as SWEEPJSON lines."""
+    records = []
+    for batch_per_chip, kw in configs:
+        kw = dict(kw)
+        seq = kw.pop("max_seq", kw.pop("seq", 1024))
+        preset = kw.pop("preset", "gpt2")
+        variant = {"batch_per_chip": batch_per_chip, "seq": seq,
+                   "preset": preset, "overrides": kw}
+        try:
+            tok_s_chip, mfu, _, n = time_config(
+                batch_per_chip * n_chips, seq=seq, n_steps=n_steps,
+                preset=preset, **kw)
+            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: "
+                  f"{tok_s_chip:,.0f} tok/s/chip (x{n} chips)  "
+                  f"MFU={mfu:.4f}", file=out, flush=True)
+            rec = {"sweep": variant, "tok_s_chip": round(tok_s_chip, 1),
+                   "mfu": round(mfu, 4), "chips": n}
+        except Exception as e:
+            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: FAILED "
+                  f"{type(e).__name__}: {str(e)[:160]}", file=out,
+                  flush=True)
+            rec = {"sweep": variant, "failed": _failure_tag(e),
+                   "error": f"{type(e).__name__}: {str(e)[:300]}"}
+        print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+        records.append(rec)
+    return records
+
 
 if __name__ == "__main__":
     import jax
@@ -21,17 +74,4 @@ if __name__ == "__main__":
     configs = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
         [32, {}],
     ]
-    for batch_per_chip, kw in configs:
-        kw = dict(kw)
-        seq = kw.pop("max_seq", kw.pop("seq", 1024))
-        preset = kw.pop("preset", "gpt2")
-        try:
-            tok_s_chip, mfu, _, n = time_config(
-                batch_per_chip * n_chips, seq=seq, n_steps=10,
-                preset=preset, **kw)
-            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: "
-                  f"{tok_s_chip:,.0f} tok/s/chip (x{n} chips)  "
-                  f"MFU={mfu:.4f}", flush=True)
-        except Exception as e:
-            print(f"batch/chip={batch_per_chip} seq={seq} {kw}: FAILED "
-                  f"{type(e).__name__}: {str(e)[:160]}", flush=True)
+    run_sweep(configs, n_chips)
