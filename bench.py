@@ -58,6 +58,16 @@ def parse_args(argv=None):
                     help="resident-kv flash attention selection for this "
                          "run (RAYTPU_FLASH_RESIDENT env var still "
                          "overrides; default: config default)")
+    ap.add_argument("--decode", action="store_true",
+                    help="benchmark the serve path instead of training: "
+                         "one batched prefill dispatch (TTFT) + jitted "
+                         "greedy decode steps (tokens/s); emits "
+                         "gpt2_decode_prefill_ttft_ms and "
+                         "gpt2_decode_tokens_per_sec JSON lines")
+    ap.add_argument("--prompt-len", type=int, default=0,
+                    help="--decode prompt length (default 128 on TPU)")
+    ap.add_argument("--new-tokens", type=int, default=0,
+                    help="--decode generated tokens (default 64 on TPU)")
     return ap.parse_args(argv)
 
 # Backend-init hardening (round-2): round 1 died inside jax.devices()
@@ -213,6 +223,105 @@ def time_config(batch, seq=1024, n_steps=20, preset="gpt2", mesh="data",
     return tok_s_chip, mfu, final_loss, n_chips
 
 
+def time_decode(batch, prompt_len=128, new_tokens=64, preset="gpt2",
+                **overrides):
+    """Compile and time the GPT-2 serve path on the local chip: ONE
+    batched prefill dispatch of a (batch, prompt_len) prompt (TTFT =
+    best-of-3 prefill walltime) followed by `new_tokens` jitted greedy
+    decode steps against the KV cache (steady-state decode tokens/s).
+
+    Returns (ttft_ms, tok_s).  Single-device — the decode path is not
+    mesh-sharded yet; shared by main(--decode) and sweep_tpu.py decode
+    variants so the methodology has one source of truth."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.decode_common import (make_vocab_tail_mask,
+                                              sample_token)
+    from ray_tpu.models.gpt2_decode import decode_step, prefill
+
+    cfg = gpt2_config(preset, **overrides)
+    if prompt_len + new_tokens > cfg.max_seq:
+        raise ValueError(f"prompt_len {prompt_len} + new_tokens "
+                         f"{new_tokens} exceeds max_seq={cfg.max_seq}")
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1),
+                              (batch, prompt_len), 0, cfg.vocab_size)
+    tail = make_vocab_tail_mask(cfg)
+
+    @jax.jit
+    def run_prefill(p, t):
+        logits, cache = prefill(p, t, cfg)
+        return sample_token(logits, None, 0.0, tail), cache
+
+    @jax.jit
+    def run_step(p, cache, t):
+        logits, cache = decode_step(p, cache, t, cfg)
+        return sample_token(logits, None, 0.0, tail), cache
+
+    # warmup / compile both programs
+    tok, cache = run_prefill(params, toks)
+    tok2, _ = run_step(params, cache, tok)
+    jax.block_until_ready(tok2)
+
+    ttfts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        tok, cache = run_prefill(params, toks)
+        jax.block_until_ready(tok)
+        ttfts.append(time.perf_counter() - t0)
+    ttft_ms = min(ttfts) * 1000.0
+
+    t0 = time.perf_counter()
+    for _ in range(new_tokens):
+        tok, cache = run_step(params, cache, tok)
+    jax.block_until_ready(tok)
+    dt = time.perf_counter() - t0
+    tok_s = batch * new_tokens / dt
+    return ttft_ms, tok_s
+
+
+def main_decode(args, on_tpu: bool) -> None:
+    """--decode: inference metrics in the same machine-readable shape
+    as the train metric — one JSON line per metric, each carrying the
+    other value in detail.  No published decode baseline exists, so
+    vs_baseline is null."""
+    import jax
+
+    if on_tpu:
+        batch = args.batch or 8
+        preset = args.preset or "gpt2"
+        prompt_len = args.prompt_len or 128
+        new_tokens = args.new_tokens or 64
+        base = "gpt2_decode"
+    else:  # CPU smoke so the decode bench always emits its lines
+        batch = args.batch or 4
+        preset = args.preset or "tiny"
+        prompt_len = args.prompt_len or 16
+        new_tokens = args.new_tokens or 8
+        base = "gpt2_decode_cpu_smoke"
+    cfg_kw = {}
+    if args.flash_resident:
+        cfg_kw["flash_resident"] = args.flash_resident
+    ttft_ms, tok_s = time_decode(batch, prompt_len=prompt_len,
+                                 new_tokens=new_tokens, preset=preset,
+                                 **cfg_kw)
+    detail = {"chips": 1, "batch": batch, "prompt_len": prompt_len,
+              "new_tokens": new_tokens, "preset": preset,
+              "flash_resident": args.flash_resident or "auto",
+              "backend": jax.default_backend(), "tpu_error": TPU_ERROR}
+    print(json.dumps({
+        "metric": f"{base}_prefill_ttft_ms",
+        "value": round(ttft_ms, 2), "unit": "ms", "vs_baseline": None,
+        "detail": dict(detail, tokens_per_sec=round(tok_s, 1))}))
+    print(json.dumps({
+        "metric": f"{base}_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": dict(detail, prefill_ttft_ms=round(ttft_ms, 2))}))
+
+
 def main(args=None):
     args = args or parse_args()
     if args.chips:
@@ -232,6 +341,8 @@ def main(args=None):
     ensure_backend()
     import jax
 
+    if args.decode:
+        return main_decode(args, jax.default_backend() == "tpu")
     n_chips = len(jax.devices())
     if args.chips:
         n_chips = min(n_chips, args.chips)

@@ -10,14 +10,15 @@ from ray_tpu.models.gpt2 import (GPT2Config, gpt2_config, gpt2_forward,
                                  gpt2_init, gpt2_logical_axes, gpt2_loss,
                                  gpt2_param_count)
 from ray_tpu.models.gpt2_decode import (decode_step, generate,
-                                        init_cache)
+                                        init_cache, prefill)
 from ray_tpu.models.llama import (LlamaConfig, llama_config,
                                   llama_forward, llama_init,
                                   llama_logical_axes, llama_loss,
                                   llama_param_count)
 from ray_tpu.models.llama_decode import (llama_decode_step,
                                          llama_generate,
-                                         llama_init_cache)
+                                         llama_init_cache,
+                                         llama_prefill)
 from ray_tpu.models.moe import (MoEConfig, moe_apply, moe_init,
                                 moe_logical_axes)
 from ray_tpu.models.mlp import (MLPConfig, mlp_forward, mlp_init,
@@ -32,7 +33,7 @@ from ray_tpu.models.vit import (ViTConfig, vit_config, vit_forward,
 __all__ = [
     "GPT2Config", "gpt2_config", "gpt2_init", "gpt2_forward", "gpt2_loss",
     "gpt2_logical_axes", "gpt2_param_count", "init_cache", "decode_step",
-    "generate",
+    "generate", "prefill",
     "MLPConfig", "mlp_init", "mlp_forward", "mlp_loss", "mlp_logical_axes",
     "MoEConfig", "moe_init", "moe_apply", "moe_logical_axes",
     "ResNetConfig", "resnet_config", "resnet_init", "resnet_forward",
@@ -42,4 +43,5 @@ __all__ = [
     "LlamaConfig", "llama_config", "llama_init", "llama_forward",
     "llama_loss", "llama_logical_axes", "llama_param_count",
     "llama_init_cache", "llama_decode_step", "llama_generate",
+    "llama_prefill",
 ]

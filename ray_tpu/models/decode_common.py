@@ -1,8 +1,22 @@
-"""The generation loop shared by every decoder family.
+"""The generation machinery shared by every decoder family.
 
 Family modules (gpt2_decode, llama_decode) supply their
-(init_cache_fn, decode_step_fn) pair; this module owns the
-family-neutral prefill + sampling scans so fixes land once.
+(init_cache_fn, prefill_fn, decode_step_fn) triple; this module owns
+the family-neutral prefill dispatch + sampling scan so fixes land once.
+
+Cache contract (vector positions, round 7 — ragged batches decode
+together):
+
+  k, v  : (L, B, S, ...) preallocated at cfg.max_seq
+  pos   : (B,) int32 — next cache slot each sequence writes
+  start : (B,) int32 — first valid slot (the left-pad offset); the
+          LOGICAL position of the token at slot s is s - start[b], so
+          the next token's wpe/RoPE index is pos[b] - start[b]
+
+The per-slot attention mask is derived, not stored: slot s is
+attendable for row b iff start[b] <= s <= pos[b] (after the current
+token's K/V lands at slot pos[b]).  Equal-length prompts are the
+degenerate case start == 0.
 """
 
 from __future__ import annotations
@@ -14,15 +28,66 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def generate_with(init_cache_fn, decode_step_fn, params,
+def slot_mask(start: jnp.ndarray, end: jnp.ndarray,
+              max_seq: int) -> jnp.ndarray:
+    """(B, S) bool — cache slots holding attendable K/V per row:
+    start[b] <= s < end[b] (end exclusive)."""
+    s = jnp.arange(max_seq)
+    return (s[None, :] >= start[:, None]) & (s[None, :] < end[:, None])
+
+
+def make_vocab_tail_mask(cfg) -> Optional[jnp.ndarray]:
+    """Static (padded_vocab,) bool mask, True on the real vocab — built
+    ONCE per generation (or jitted serve program) so sampling is a
+    single jnp.where instead of rebuilding a fill tensor and scattering
+    it over the tail on every sampled token.  None when nothing is
+    padded."""
+    if cfg.padded_vocab == cfg.vocab_size:
+        return None
+    return jnp.arange(cfg.padded_vocab) < cfg.vocab_size
+
+
+def sample_token(logits, key, temperature: float,
+                 tail_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
+    """(B, padded_vocab) logits → (B,) int32 token; the padded vocab
+    tail can never be sampled.  temperature 0 = greedy (key unused)."""
+    if tail_mask is not None:
+        logits = jnp.where(tail_mask, logits,
+                           jnp.asarray(-1e30, logits.dtype))
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits / jnp.float32(temperature)).astype(jnp.int32)
+
+
+def scan_prefill(init_cache_fn, decode_step_fn, params, prompt, cfg):
+    """Per-token reference prefill: T0 sequential decode_step dispatches
+    (the pre-round-7 path).  Kept as the numerics oracle for the
+    batched prefill parity tests; equal-length prompts only.  Returns
+    (last_logits (B, padded_vocab), cache)."""
+    B = prompt.shape[0]
+    cache = init_cache_fn(cfg, B)
+
+    def prefill_step(cache, tok):
+        logits, cache = decode_step_fn(params, cache, tok, cfg)
+        return cache, logits
+
+    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
+    return logits_seq[-1], cache
+
+
+def generate_with(prefill_fn, decode_step_fn, params,
                   prompt: jnp.ndarray, cfg, *, max_new_tokens: int,
+                  lengths: Optional[jnp.ndarray] = None,
                   temperature: float = 1.0,
                   key: Optional[jax.Array] = None) -> jnp.ndarray:
     """The generation loop shared by every decoder family (gpt2,
-    llama): prefill scan + sampling scan over the family's
-    (init_cache_fn, decode_step_fn) pair.  prompt (B, T0) int32 →
-    (B, T0 + max_new_tokens) int32; temperature 0 = greedy; the whole
-    program jits (static cfg / max_new_tokens)."""
+    llama): ONE batched prefill dispatch + a sampling scan over the
+    family's decode_step.  prompt (B, T0) int32 → (B, T0 +
+    max_new_tokens) int32; `lengths` (B,) marks ragged LEFT-padded
+    prompts (row b's real tokens occupy columns [T0 - lengths[b], T0));
+    temperature 0 = greedy; the whole program jits (static cfg /
+    max_new_tokens)."""
     B, T0 = prompt.shape
     if T0 + max_new_tokens > cfg.max_seq:
         # Past max_seq JAX clamps dynamic_update_slice/gather indices, so
@@ -33,29 +98,13 @@ def generate_with(init_cache_fn, decode_step_fn, params,
             f"exceeds cfg.max_seq={cfg.max_seq}")
     if key is None:
         key = jax.random.PRNGKey(0)
-    cache = init_cache_fn(cfg, B)
-
-    def prefill_step(cache, tok):
-        logits, cache = decode_step_fn(params, cache, tok, cfg)
-        return cache, logits
-
-    cache, logits_seq = lax.scan(prefill_step, cache, prompt.T)
-    last_logits = logits_seq[-1]                         # (B, V)
-
-    def sample(logits, k):
-        # mask the padded vocab tail so it can never be sampled
-        if cfg.padded_vocab != cfg.vocab_size:
-            neg = jnp.full((cfg.padded_vocab - cfg.vocab_size,), -1e30,
-                           dtype=logits.dtype)
-            logits = logits.at[..., cfg.vocab_size:].set(neg)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            k, logits / jnp.float32(temperature)).astype(jnp.int32)
+    tail_mask = make_vocab_tail_mask(cfg)
+    last_logits, cache = prefill_fn(params, prompt, cfg,
+                                    lengths=lengths)
 
     def gen_step(carry, k):
         cache, logits = carry
-        tok = sample(logits, k)
+        tok = sample_token(logits, k, temperature, tail_mask)
         new_logits, cache = decode_step_fn(params, cache, tok, cfg)
         return (cache, new_logits), tok
 
@@ -63,5 +112,3 @@ def generate_with(init_cache_fn, decode_step_fn, params,
     (_, _), new_tokens = lax.scan(gen_step, (cache, last_logits), keys)
     return jnp.concatenate([prompt, new_tokens.T.astype(prompt.dtype)],
                            axis=1)
-
-

@@ -5,13 +5,19 @@ needs incremental decode: O(1) new compute per token against cached
 keys/values.  TPU-first choices:
 
   * static shapes everywhere — the cache is allocated at max_seq and
-    positions beyond `pos` are masked, so ONE compiled step serves the
-    whole generation (no shape-polymorphic recompile);
+    slots outside [start, pos] are masked, so ONE compiled step serves
+    the whole generation (no shape-polymorphic recompile);
+  * prompt ingestion is a SINGLE full-sequence forward (`prefill`) that
+    reuses the training-path attention (flash kernel where enabled),
+    writes K/V for every prompt position with one dynamic_update_slice
+    per cache tensor, and computes logits only at each row's last real
+    token — O(1) dispatches instead of the old O(T0) per-token scan;
+  * positions are per-sequence vectors (decode_common cache contract),
+    so LEFT-padded ragged prompts decode correctly in one batch and a
+    serve slot pool can host rows at different depths;
   * the per-token step is a `lax.scan` over the stacked layer params
     with the cache in the carry (same scan-stacked layout as training —
-    one layer traced once);
-  * generation is itself a `lax.scan` over time: prefill + N sampling
-    steps compile into a single dispatch.
+    one layer traced once).
 
 No reference analog (the reference wraps user torch modules); this is
 the piece that makes ray_tpu.serve a real LM server.
@@ -26,14 +32,16 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.decode_common import generate_with
+from ray_tpu.models.decode_common import (generate_with, scan_prefill,
+                                          slot_mask)
 from ray_tpu.models.gpt2 import GPT2Config, _layernorm
 
-__all__ = ["init_cache", "decode_step", "generate"]
+__all__ = ["init_cache", "prefill", "decode_step", "generate"]
 
 
 def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
-    """Preallocated (L, B, S, H, hd) key/value cache + position 0."""
+    """Preallocated (L, B, S, H, hd) key/value cache + per-sequence
+    position vectors (decode_common cache contract)."""
     if cfg.n_experts:
         raise NotImplementedError(
             "KV-cache decoding currently supports dense GPT-2 configs "
@@ -41,21 +49,91 @@ def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
-            "pos": jnp.zeros((), jnp.int32)}
+            "pos": jnp.zeros((batch,), jnp.int32),
+            "start": jnp.zeros((batch,), jnp.int32)}
+
+
+def prefill(params, tokens: jnp.ndarray, cfg: GPT2Config, *,
+            lengths: Optional[jnp.ndarray] = None
+            ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Single-dispatch prompt ingestion: tokens (B, T0) int32 →
+    (last_logits (B, padded_vocab) float32, primed cache).
+
+    Runs ONE full-sequence forward (training-path attention; flash
+    kernel under the same dispatch rules) and writes K/V for all T0
+    positions with one dynamic_update_slice per cache tensor.  Ragged
+    batches pass `lengths` (B,): rows are LEFT-padded, so row b's real
+    tokens sit at columns [T0 - lengths[b], T0) and the last real token
+    is column T0-1 for every row — logits come from that one column,
+    never the full (B, T0, V) tensor."""
+    from ray_tpu.ops.attention import prefill_attention
+
+    B, T0 = tokens.shape
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    cache = init_cache(cfg, B)
+    if lengths is None:
+        start = jnp.zeros((B,), jnp.int32)
+        pos_ids = jnp.broadcast_to(jnp.arange(T0), (B, T0))
+    else:
+        start = (T0 - jnp.asarray(lengths, jnp.int32)).astype(jnp.int32)
+        # pad columns clip to wpe row 0 — garbage the attention mask
+        # keeps unread
+        pos_ids = jnp.maximum(jnp.arange(T0)[None, :] - start[:, None], 0)
+    x = params["wte"].astype(cfg.dtype)[tokens]          # (B, T0, d)
+    x = x + params["wpe"].astype(cfg.dtype)[pos_ids]
+    attn_start = None if lengths is None else start
+
+    def body(x, layer):
+        p, = layer
+        xa = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        w = p["attn"]["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
+        qkv = (xa @ w).reshape(B, T0, 3, h, hd) \
+            + p["attn"]["qkv_b"].astype(cfg.dtype)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = prefill_attention(q, k, v, start=attn_start,
+                              use_flash=cfg.use_flash,
+                              resident=cfg.flash_resident)
+        wo = p["attn"]["o_w"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, T0, h * hd) @ wo
+                 + p["attn"]["o_b"].astype(cfg.dtype))
+        xm = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        hmid = jax.nn.gelu(xm @ p["mlp"]["fc_w"].astype(cfg.dtype)
+                           + p["mlp"]["fc_b"].astype(cfg.dtype))
+        x = x + (hmid @ p["mlp"]["proj_w"].astype(cfg.dtype)
+                 + p["mlp"]["proj_b"].astype(cfg.dtype))
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"],))
+    cache["k"] = lax.dynamic_update_slice(cache["k"], ks,
+                                          (0, 0, 0, 0, 0))
+    cache["v"] = lax.dynamic_update_slice(cache["v"], vs,
+                                          (0, 0, 0, 0, 0))
+    cache["pos"] = jnp.full((B,), T0, jnp.int32)
+    cache["start"] = start
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    last = x[:, -1]                 # left padding ⇒ last real token
+    logits = (last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    return logits, cache
 
 
 def decode_step(params, cache, tokens, cfg: GPT2Config
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """One token per sequence: tokens (B,) int32 at position cache[pos].
+    """One token per sequence: tokens (B,) int32, row b at cache slot
+    cache["pos"][b] (positions are per-sequence vectors, so rows may
+    sit at different depths — ragged prompts, slot-pool serving).
 
     Returns (logits (B, padded_vocab) float32, updated cache)."""
     B = tokens.shape[0]
     d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
-    pos = cache["pos"]
+    pos = cache["pos"]                                   # (B,)
+    start = cache["start"]                               # (B,)
+    rows = jnp.arange(B)
     x = params["wte"].astype(cfg.dtype)[tokens]          # (B, d)
-    x = x + params["wpe"].astype(cfg.dtype)[pos]
+    x = x + params["wpe"].astype(cfg.dtype)[pos - start]
 
-    pos_mask = (jnp.arange(cfg.max_seq) <= pos)          # (S,)
+    # per-slot mask: start[b] <= s <= pos[b] (current token included)
+    attn_mask = slot_mask(start, pos + 1, cfg.max_seq)   # (B, S)
 
     def body(carry, layer):
         x, lidx = carry
@@ -69,14 +147,12 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
         qkv = (xa @ w).reshape(B, 3, h, hd) \
             + p["attn"]["qkv_b"].astype(cfg.dtype)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B,h,hd)
-        ck = lax.dynamic_update_slice_in_dim(
-            ck, k_new[:, None], pos, axis=1)
-        cv = lax.dynamic_update_slice_in_dim(
-            cv, v_new[:, None], pos, axis=1)
+        ck = ck.at[rows, pos].set(k_new)       # row b writes slot pos[b]
+        cv = cv.at[rows, pos].set(v_new)
         # attention of the single query against the cache
         scores = jnp.einsum("bhd,bshd->bhs", q, ck).astype(jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(pos_mask[None, None, :], scores, -1e30)
+        scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         o = jnp.einsum("bhs,bshd->bhd", probs, cv)       # (B,h,hd)
         wo = p["attn"]["o_w"].astype(cfg.dtype).reshape(h * hd, d)
@@ -93,14 +169,29 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
                                       (params["blocks"],))
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
-    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    cache = {"k": new_k, "v": new_v, "pos": pos + 1, "start": start}
     return logits, cache
+
+
+def _scan_prefill(params, tokens, cfg, *, lengths=None):
+    """prefill-shaped wrapper over the per-token reference scan."""
+    if lengths is not None:
+        raise ValueError("prefill_impl='scan' is the equal-length "
+                         "reference path; ragged prompts need the "
+                         "batched prefill")
+    return scan_prefill(init_cache, decode_step, params, tokens, cfg)
 
 
 def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
              max_new_tokens: int, temperature: float = 1.0,
-             key: Optional[jax.Array] = None) -> jnp.ndarray:
-    """GPT-2 generation (see generate_with)."""
-    return generate_with(init_cache, decode_step, params, prompt, cfg,
+             lengths: Optional[jnp.ndarray] = None,
+             key: Optional[jax.Array] = None,
+             prefill_impl: str = "batched") -> jnp.ndarray:
+    """GPT-2 generation (see decode_common.generate_with).  `lengths`
+    marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
+    per-token reference prefill for parity testing."""
+    prefill_fn = prefill if prefill_impl == "batched" else _scan_prefill
+    return generate_with(prefill_fn, decode_step, params, prompt, cfg,
                          max_new_tokens=max_new_tokens,
-                         temperature=temperature, key=key)
+                         lengths=lengths, temperature=temperature,
+                         key=key)

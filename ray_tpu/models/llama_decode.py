@@ -1,11 +1,12 @@
 """Autoregressive KV-cache decoding for the LLaMA family.
 
-Same TPU-first shape as gpt2_decode (static max_seq cache, one compiled
-per-token step scanned over stacked layers, generation itself a scan),
-adapted to the llama block: RMSNorm, RoPE applied at the live position,
-grouped-query attention (the cache stores the kv heads only — GQA's
-memory win is exactly here: cache bytes scale with n_kv_head, not
-n_head), SwiGLU, untied lm_head.
+Same TPU-first shape as gpt2_decode (static max_seq cache, single
+full-sequence `llama_prefill` dispatch, one compiled per-token step
+scanned over stacked layers, per-sequence position vectors for ragged
+batches), adapted to the llama block: RMSNorm, RoPE applied at each
+row's live position, grouped-query attention (the cache stores the kv
+heads only — GQA's memory win is exactly here: cache bytes scale with
+n_kv_head, not n_head), SwiGLU, untied lm_head.
 """
 
 from __future__ import annotations
@@ -16,48 +17,145 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.models.decode_common import (generate_with, scan_prefill,
+                                          slot_mask)
 from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
                                   rope_frequencies)
 
-__all__ = ["llama_init_cache", "llama_decode_step", "llama_generate"]
+__all__ = ["llama_init_cache", "llama_prefill", "llama_decode_step",
+           "llama_generate"]
 
 
 def llama_init_cache(cfg: LlamaConfig, batch: int
                      ) -> Dict[str, jnp.ndarray]:
-    """(L, B, S, n_kv_head, hd) key/value cache + position 0."""
+    """(L, B, S, n_kv_head, hd) key/value cache + per-sequence position
+    vectors (decode_common cache contract)."""
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_kv_head,
              cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
-            "pos": jnp.zeros((), jnp.int32)}
+            "pos": jnp.zeros((batch,), jnp.int32),
+            "start": jnp.zeros((batch,), jnp.int32)}
 
 
 def _rope_at(x, cos_t, sin_t):
-    """Rotate (B, H, hd) by the tables' row for ONE position."""
+    """Rotate (B, H, hd) by per-row table rows (B, hd/2)."""
     x1 = x[..., 0::2].astype(jnp.float32)
     x2 = x[..., 1::2].astype(jnp.float32)
-    c = cos_t[None, None, :]
-    s = sin_t[None, None, :]
+    c = cos_t[:, None, :]
+    s = sin_t[:, None, :]
     out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c],
                     axis=-1).reshape(x.shape)
     return out.astype(x.dtype)
 
 
+def _rope_bt(x, cos_bt, sin_bt):
+    """Rotate (B, T, H, hd) by per-row, per-column tables (B, T, hd/2)
+    — the ragged-prefill variant of llama.apply_rope, whose (T, hd/2)
+    tables assume every row shares the same position ladder."""
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    c = cos_bt[:, :, None, :]
+    s = sin_bt[:, :, None, :]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c],
+                    axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def llama_prefill(params, tokens: jnp.ndarray, cfg: LlamaConfig, *,
+                  lengths: Optional[jnp.ndarray] = None
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Single-dispatch prompt ingestion: tokens (B, T0) int32 →
+    (last_logits (B, padded_vocab) float32, primed cache).
+
+    One full-sequence forward (training-path attention; flash kernel
+    under the same dispatch rules on the equal-length path), K/V for
+    all T0 positions written with one dynamic_update_slice per cache
+    tensor — the cache keeps kv heads only (pre-repeat, post-RoPE),
+    exactly what llama_decode_step expects.  Ragged rows are
+    LEFT-padded with `lengths` (B,); RoPE angles follow each row's
+    logical positions, so pads never shift a real token's rotation."""
+    from ray_tpu.ops.attention import prefill_attention
+
+    B, T0 = tokens.shape
+    d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
+                    cfg.head_dim)
+    cache = llama_init_cache(cfg, B)
+    if lengths is None:
+        start = jnp.zeros((B,), jnp.int32)
+        pos_ids = jnp.broadcast_to(jnp.arange(T0), (B, T0))
+    else:
+        start = (T0 - jnp.asarray(lengths, jnp.int32)).astype(jnp.int32)
+        pos_ids = jnp.maximum(jnp.arange(T0)[None, :] - start[:, None], 0)
+    x = params["wte"].astype(cfg.dtype)[tokens]          # (B, T0, d)
+    cos, sin = rope_frequencies(cfg.max_seq, hd, cfg.rope_theta)
+    cos_p, sin_p = cos[pos_ids], sin[pos_ids]            # (B, T0, hd/2)
+    attn_start = None if lengths is None else start
+
+    def body(x, layer):
+        p, = layer
+        xa = _rmsnorm(x, p["ln1"]["scale"], cfg.rms_eps)
+        xa = xa.astype(cfg.dtype)
+        q = (xa @ p["attn"]["wq"].astype(cfg.dtype).reshape(d, h * hd)
+             ).reshape(B, T0, h, hd)
+        k = (xa @ p["attn"]["wk"].astype(cfg.dtype).reshape(d, kv * hd)
+             ).reshape(B, T0, kv, hd)
+        v = (xa @ p["attn"]["wv"].astype(cfg.dtype).reshape(d, kv * hd)
+             ).reshape(B, T0, kv, hd)
+        q = _rope_bt(q, cos_p, sin_p)
+        k = _rope_bt(k, cos_p, sin_p)
+        if kv != h:
+            rep = h // kv
+            kr = jnp.repeat(k, rep, axis=2)
+            vr = jnp.repeat(v, rep, axis=2)
+        else:
+            kr, vr = k, v
+        o = prefill_attention(q, kr, vr, start=attn_start,
+                              use_flash=cfg.use_flash,
+                              resident=cfg.flash_resident)
+        wo = p["attn"]["wo"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, T0, h * hd) @ wo).astype(x.dtype)
+        xm = _rmsnorm(x, p["ln2"]["scale"], cfg.rms_eps)
+        xm = xm.astype(cfg.dtype)
+        gate = xm @ p["mlp"]["w_gate"].astype(cfg.dtype)
+        up = xm @ p["mlp"]["w_up"].astype(cfg.dtype)
+        hmid = jax.nn.silu(gate) * up
+        x = x + (hmid @ p["mlp"]["w_down"].astype(cfg.dtype)
+                 ).astype(x.dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"],))
+    cache["k"] = lax.dynamic_update_slice(cache["k"], ks,
+                                          (0, 0, 0, 0, 0))
+    cache["v"] = lax.dynamic_update_slice(cache["v"], vs,
+                                          (0, 0, 0, 0, 0))
+    cache["pos"] = jnp.full((B,), T0, jnp.int32)
+    cache["start"] = start
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    last = x[:, -1]                 # left padding ⇒ last real token
+    logits = (last.astype(cfg.dtype)
+              @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    return logits, cache
+
+
 def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """One token per sequence: tokens (B,) int32 at cache['pos'].
+    """One token per sequence: tokens (B,) int32, row b at cache slot
+    cache["pos"][b]; RoPE at each row's LOGICAL position pos - start.
 
     Returns (logits (B, padded_vocab) float32, updated cache)."""
     B = tokens.shape[0]
     d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
                     cfg.head_dim)
     g = h // kv
-    pos = cache["pos"]
+    pos = cache["pos"]                                   # (B,)
+    start = cache["start"]                               # (B,)
+    rows = jnp.arange(B)
     x = params["wte"].astype(cfg.dtype)[tokens]          # (B, d)
     cos, sin = rope_frequencies(cfg.max_seq, hd, cfg.rope_theta)
-    cos_t = lax.dynamic_index_in_dim(cos, pos, keepdims=False)
-    sin_t = lax.dynamic_index_in_dim(sin, pos, keepdims=False)
-    pos_mask = (jnp.arange(cfg.max_seq) <= pos)          # (S,)
+    cos_t, sin_t = cos[pos - start], sin[pos - start]    # (B, hd/2)
+    attn_mask = slot_mask(start, pos + 1, cfg.max_seq)   # (B, S)
 
     def body(carry, layer):
         x, lidx = carry
@@ -76,17 +174,15 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
                  .reshape(d, kv * hd)).reshape(B, kv, hd)
         q = _rope_at(q, cos_t, sin_t)
         k_new = _rope_at(k_new, cos_t, sin_t)
-        ck = lax.dynamic_update_slice_in_dim(
-            ck, k_new[:, None], pos, axis=1)
-        cv = lax.dynamic_update_slice_in_dim(
-            cv, v_new[:, None], pos, axis=1)
+        ck = ck.at[rows, pos].set(k_new)       # row b writes slot pos[b]
+        cv = cv.at[rows, pos].set(v_new)
         # grouped-query attention against the kv-head cache: query
         # heads reshape to (kv, group) — no head repetition needed
         qg = q.reshape(B, kv, g, hd)
         scores = jnp.einsum("bkgd,bskd->bkgs", qg,
                             ck).astype(jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
-        scores = jnp.where(pos_mask[None, None, None, :], scores,
+        scores = jnp.where(attn_mask[:, None, None, :], scores,
                            -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
         o = jnp.einsum("bkgs,bskd->bkgd", probs, cv)
@@ -107,16 +203,31 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
     logits = (x.astype(cfg.dtype)
               @ params["lm_head"].astype(cfg.dtype)
               ).astype(jnp.float32)
-    cache = {"k": new_k, "v": new_v, "pos": pos + 1}
+    cache = {"k": new_k, "v": new_v, "pos": pos + 1, "start": start}
     return logits, cache
+
+
+def _scan_prefill(params, tokens, cfg, *, lengths=None):
+    """prefill-shaped wrapper over the per-token reference scan."""
+    if lengths is not None:
+        raise ValueError("prefill_impl='scan' is the equal-length "
+                         "reference path; ragged prompts need the "
+                         "batched prefill")
+    return scan_prefill(llama_init_cache, llama_decode_step, params,
+                        tokens, cfg)
 
 
 def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
                    max_new_tokens: int, temperature: float = 1.0,
-                   key: Optional[jax.Array] = None) -> jnp.ndarray:
-    """LLaMA generation via the shared loop (decode_common.generate_with)."""
-    from ray_tpu.models.decode_common import generate_with
-
-    return generate_with(llama_init_cache, llama_decode_step, params,
-                         prompt, cfg, max_new_tokens=max_new_tokens,
-                         temperature=temperature, key=key)
+                   lengths: Optional[jnp.ndarray] = None,
+                   key: Optional[jax.Array] = None,
+                   prefill_impl: str = "batched") -> jnp.ndarray:
+    """LLaMA generation via the shared loop (decode_common).  `lengths`
+    marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
+    per-token reference prefill for parity testing."""
+    prefill_fn = (llama_prefill if prefill_impl == "batched"
+                  else _scan_prefill)
+    return generate_with(prefill_fn, llama_decode_step, params, prompt,
+                         cfg, max_new_tokens=max_new_tokens,
+                         lengths=lengths, temperature=temperature,
+                         key=key)

@@ -54,6 +54,38 @@ def flash_auto_dispatch(T: int, D: int) -> bool:
         and D % 64 == 0
 
 
+def prefill_attention(q, k, v, *, start: Optional[jnp.ndarray] = None,
+                      use_flash: Optional[bool] = None,
+                      scale: Optional[float] = None,
+                      resident: str = "auto") -> jnp.ndarray:
+    """Prompt-phase attention for the decode path: the whole prompt in
+    ONE dispatch instead of a per-token scan.
+
+    start=None is the equal-length fast path — exactly causal_attention,
+    so the pallas flash kernel applies under the same dispatch rules as
+    training.  start (B,) int32 marks each row's left-pad offset for
+    ragged batches: key slots < start[b] are masked out ON TOP of
+    causality so pad K/V never contribute to a real token's output.
+    The ragged path runs the XLA reference (the flash kernel is
+    causal-only); fully-masked pad query rows softmax to uniform —
+    finite garbage that the decode masks keep unread.
+    """
+    if start is None:
+        return causal_attention(q, k, v, use_flash=use_flash,
+                                scale=scale, resident=resident)
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    T = q.shape[1]
+    idx = jnp.arange(T)
+    causal = idx[:, None] >= idx[None, :]                 # (Tq, Tk)
+    valid = idx[None, :] >= start[:, None]                # (B, Tk)
+    mask = causal[None, None, :, :] & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def causal_attention(q, k, v, *, use_flash: Optional[bool] = None,
                      scale: Optional[float] = None,
                      resident: str = "auto") -> jnp.ndarray:

@@ -82,6 +82,31 @@ class _BatchQueue:
                     fut.set_exception(e)
 
 
+class RequestQueue:
+    """FIFO admission queue for slot-based continuous batching
+    (serve/llm.py): callers enqueue one request and await its future;
+    the scheduler pops up to n pending requests whenever cache slots
+    free up.  The complement of @serve.batch — that collects FIXED
+    batches and runs them to completion, this hands out work as
+    capacity appears mid-flight."""
+
+    def __init__(self):
+        self._pending: List = []  # (arg, future)
+
+    def put(self, arg) -> "asyncio.Future":
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((arg, fut))
+        return fut
+
+    def pop(self, n: int) -> List:
+        """Up to n oldest (arg, future) pairs, removed from the queue."""
+        taken, self._pending = self._pending[:n], self._pending[n:]
+        return taken
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01):
     """Decorator turning `async def f(self, item)` call sites into

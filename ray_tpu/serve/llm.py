@@ -1,11 +1,29 @@
-"""LM serving: the model zoo's KV-cache decoders behind a batched
-serve deployment.
+"""LM serving: the model zoo's KV-cache decoders behind a serve
+deployment.
 
 No reference analog module (the reference serves user torch models);
 this packages the composition its users hand-roll — model init or
-checkpoint load, ONE jitted generate, @serve.batch micro-batching —
+checkpoint load, jitted prefill/decode programs, request batching —
 so `serve.run(build_llm_deployment(...).bind())` is a working LM
 endpoint for either decoder family (gpt2 / llama).
+
+Two schedulers:
+
+  * "batch" — @serve.batch micro-batching: concurrent requests are
+    collected into one `generate` call and run TO COMPLETION together.
+    Ragged prompt lists are LEFT-padded before stacking (the decode
+    cache contract) and the pads trimmed from each returned row;
+    equal-length batches keep the pad-free fast path (flash-eligible
+    prefill).
+  * "continuous" — slot-based continuous batching: a fixed pool of
+    `max_slots` KV-cache rows.  Each admitted request gets ONE batched
+    prefill dispatch into a free slot; all active slots then share one
+    jitted decode step per token.  Finished sequences free their slot
+    immediately and queued requests are admitted mid-flight — short
+    requests are never held hostage by long ones, the failure mode of
+    stack-and-pray fixed batching.  Prompt lengths are padded up to
+    `prefill_bucket` multiples so the prefill program compiles once
+    per bucket, not once per length.
 """
 
 from __future__ import annotations
@@ -16,6 +34,28 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.serve.api import deployment
+from ray_tpu.serve.batching import RequestQueue
+from ray_tpu.serve.batching import batch as _batch
+
+
+def _family_fns(family: str):
+    """(config_fn, init_fn, generate_fn, prefill_fn, step_fn,
+    init_cache_fn) for a decoder family."""
+    if family == "gpt2":
+        from ray_tpu.models import gpt2_config, gpt2_init
+        from ray_tpu.models.gpt2_decode import (decode_step, generate,
+                                                init_cache, prefill)
+
+        return (gpt2_config, gpt2_init, generate, prefill, decode_step,
+                init_cache)
+    from ray_tpu.models import llama_config, llama_init
+    from ray_tpu.models.llama_decode import (llama_decode_step,
+                                             llama_generate,
+                                             llama_init_cache,
+                                             llama_prefill)
+
+    return (llama_config, llama_init, llama_generate, llama_prefill,
+            llama_decode_step, llama_init_cache)
 
 
 def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
@@ -25,38 +65,37 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          batch_wait_timeout_s: float = 0.05,
                          checkpoint_path: Optional[str] = None,
                          seed: int = 0, num_replicas: int = 1,
+                         scheduler: str = "batch",
+                         max_slots: int = 4,
+                         prefill_bucket: int = 16,
                          config_overrides: Optional[Dict[str, Any]]
                          = None):
-    """A serve Deployment generating continuations for equal-length
-    int32 token-prompt arrays.
+    """A serve Deployment generating continuations for int32
+    token-prompt arrays (1-D per request; ragged lengths welcome —
+    each caller gets back its own prompt + continuation, pads
+    trimmed).
 
     family: "gpt2" | "llama"; preset: a model-zoo preset name.
+    scheduler: "batch" (@serve.batch fixed micro-batches) or
+    "continuous" (slot pool of `max_slots` KV rows with mid-flight
+    admission; `prefill_bucket` bounds prefill recompiles).
     checkpoint_path: pickled param pytree (matching the family's init
     layout); absent → fresh init from `seed` (tests/demos)."""
     if family not in ("gpt2", "llama"):
         raise ValueError(f"unknown LM family {family!r}")
+    if scheduler not in ("batch", "continuous"):
+        raise ValueError(f"unknown scheduler {scheduler!r} "
+                         f"(expected 'batch' or 'continuous')")
 
-    @deployment(name=f"llm_{family}_{preset}",
-                num_replicas=num_replicas)
     class LLM:
         def __init__(self):
             import jax
             import jax.numpy as jnp
 
             overrides = dict(config_overrides or {})
-            if family == "gpt2":
-                from ray_tpu.models import gpt2_config, gpt2_init
-                from ray_tpu.models.gpt2_decode import generate
-
-                self.cfg = gpt2_config(preset, **overrides)
-                init_fn, gen_fn = gpt2_init, generate
-            else:
-                from ray_tpu.models import (llama_config,
-                                            llama_generate,
-                                            llama_init)
-
-                self.cfg = llama_config(preset, **overrides)
-                init_fn, gen_fn = llama_init, llama_generate
+            (config_fn, init_fn, gen_fn, prefill_fn, step_fn,
+             init_cache_fn) = _family_fns(family)
+            self.cfg = config_fn(preset, **overrides)
             if checkpoint_path:
                 with open(checkpoint_path, "rb") as f:
                     self.params = jax.tree.map(jnp.asarray,
@@ -68,23 +107,197 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # request would sample under the same default key and
             # return identical "random" continuations
             self._rng = jax.random.PRNGKey(seed + 1)
-            self._generate = jax.jit(
-                lambda p, toks, k: gen_fn(
-                    p, toks, self.cfg,
-                    max_new_tokens=max_new_tokens,
-                    temperature=temperature, key=k))
+            if scheduler == "batch":
+                self._generate = jax.jit(
+                    lambda p, toks, k: gen_fn(
+                        p, toks, self.cfg,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, key=k))
+                self._generate_ragged = jax.jit(
+                    lambda p, toks, lens, k: gen_fn(
+                        p, toks, self.cfg, lengths=lens,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, key=k))
+            else:
+                self._init_continuous(prefill_fn, step_fn,
+                                      init_cache_fn)
 
-        from ray_tpu.serve.batching import batch as _batch
+        # ------------------------------------------------------------
+        # "batch" scheduler: @serve.batch over (possibly ragged) lists
+        # ------------------------------------------------------------
 
         @_batch(max_batch_size=max_batch_size,
                 batch_wait_timeout_s=batch_wait_timeout_s)
-        async def __call__(self, prompts):
+        async def _call_batch(self, prompts):
             import jax
             import jax.numpy as jnp
 
             self._rng, k = jax.random.split(self._rng)
-            toks = jnp.asarray(np.stack(prompts), jnp.int32)
-            out = self._generate(self.params, toks, k)
-            return [np.asarray(row) for row in out]
+            arrs = [np.asarray(p, np.int32).reshape(-1)
+                    for p in prompts]
+            lens = [int(a.shape[0]) for a in arrs]
+            t0 = max(lens)
+            if min(lens) == t0:
+                # equal-length fast path: no pads, flash-eligible
+                toks = jnp.asarray(np.stack(arrs), jnp.int32)
+                out = self._generate(self.params, toks, k)
+                return [np.asarray(row) for row in out]
+            padded = np.zeros((len(arrs), t0), np.int32)
+            for i, a in enumerate(arrs):
+                padded[i, t0 - lens[i]:] = a
+            out = self._generate_ragged(
+                self.params, jnp.asarray(padded),
+                jnp.asarray(lens, jnp.int32), k)
+            # trim the left pads: each caller sees prompt+continuation
+            return [np.asarray(row)[t0 - n:]
+                    for row, n in zip(out, lens)]
 
-    return LLM
+        # ------------------------------------------------------------
+        # "continuous" scheduler: slot pool with mid-flight admission
+        # ------------------------------------------------------------
+
+        def _init_continuous(self, prefill_fn, step_fn, init_cache_fn):
+            import jax
+
+            from ray_tpu.models.decode_common import (
+                make_vocab_tail_mask, sample_token)
+
+            cfg = self.cfg
+            tail = make_vocab_tail_mask(cfg)
+            self._cache = init_cache_fn(cfg, max_slots)
+            self._cur = np.zeros((max_slots,), np.int32)
+            self._slots = [None] * max_slots
+            self._queue = RequestQueue()
+            self._wake = None           # asyncio.Event, made on-loop
+            self._engine_task = None
+
+            def prefill_sample(p, toks, lens, k):
+                logits, cache = prefill_fn(p, toks, cfg, lengths=lens)
+                return sample_token(logits, k, temperature,
+                                    tail), cache
+
+            def pool_step(p, cache, toks, k):
+                logits, cache = step_fn(p, cache, toks, cfg)
+                return sample_token(logits, k, temperature,
+                                    tail), cache
+
+            def admit(pool, row, slot):
+                from jax import lax
+
+                out = dict(pool)
+                for name in ("k", "v"):   # (L, B, S, ...): row b=slot
+                    out[name] = lax.dynamic_update_slice_in_dim(
+                        pool[name], row[name], slot, axis=1)
+                for name in ("pos", "start"):
+                    out[name] = lax.dynamic_update_slice_in_dim(
+                        pool[name], row[name], slot, axis=0)
+                return out
+
+            self._prefill = jax.jit(prefill_sample)
+            self._pool_step = jax.jit(pool_step)
+            self._admit = jax.jit(admit)
+
+        def _admit_pending(self) -> None:
+            """Prefill queued requests into free slots (one batched
+            prefill dispatch each; K/V rows land in the pool cache)."""
+            import jax
+            import jax.numpy as jnp
+
+            while len(self._queue):
+                free = [i for i, s in enumerate(self._slots)
+                        if s is None]
+                if not free:
+                    return
+                (arr, fut), = self._queue.pop(1)
+                n = int(arr.shape[0])
+                if n == 0 or n + max_new_tokens > self.cfg.max_seq:
+                    if not fut.done():
+                        fut.set_exception(ValueError(
+                            f"prompt length {n} invalid for "
+                            f"max_seq={self.cfg.max_seq} with "
+                            f"max_new_tokens={max_new_tokens}"))
+                    continue
+                # pad up to the bucket so the prefill program compiles
+                # once per bucket; never past the decode headroom
+                t_pad = -(-n // prefill_bucket) * prefill_bucket
+                t_pad = max(n, min(t_pad,
+                                   self.cfg.max_seq - max_new_tokens))
+                padded = np.zeros((1, t_pad), np.int32)
+                padded[0, t_pad - n:] = arr
+                self._rng, k = jax.random.split(self._rng)
+                tok, row = self._prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray([n], jnp.int32), k)
+                first = int(np.asarray(tok)[0])
+                if max_new_tokens <= 1:
+                    if not fut.done():
+                        fut.set_result(np.concatenate(
+                            [arr, np.asarray([first], np.int32)]))
+                    continue
+                slot = free[0]
+                self._cache = self._admit(self._cache, row, slot)
+                self._cur[slot] = first
+                self._slots[slot] = {"prompt": arr, "out": [first],
+                                     "fut": fut}
+
+        async def _engine(self):
+            """The scheduler loop: admit → one pooled decode step →
+            retire finished slots → yield (so new requests enqueue
+            mid-generation)."""
+            import asyncio
+
+            import jax
+            import jax.numpy as jnp
+
+            while True:
+                try:
+                    self._admit_pending()
+                    if not any(s is not None for s in self._slots):
+                        self._wake.clear()
+                        if not len(self._queue):
+                            await self._wake.wait()
+                        continue
+                    self._rng, k = jax.random.split(self._rng)
+                    toks, self._cache = self._pool_step(
+                        self.params, self._cache,
+                        jnp.asarray(self._cur), k)
+                    toks = np.asarray(toks)
+                    for i, st in enumerate(self._slots):
+                        if st is None:
+                            continue
+                        st["out"].append(int(toks[i]))
+                        self._cur[i] = toks[i]
+                        if len(st["out"]) >= max_new_tokens:
+                            if not st["fut"].done():
+                                st["fut"].set_result(np.concatenate(
+                                    [st["prompt"],
+                                     np.asarray(st["out"], np.int32)]))
+                            self._slots[i] = None   # slot freed NOW
+                except Exception as e:  # noqa: BLE001 - fail loudly
+                    for i, st in enumerate(self._slots):
+                        if st is not None and not st["fut"].done():
+                            st["fut"].set_exception(e)
+                        self._slots[i] = None
+                    for arr, fut in self._queue.pop(len(self._queue)):
+                        if not fut.done():
+                            fut.set_exception(e)
+                # yield the loop so callers can enqueue mid-flight
+                await asyncio.sleep(0)
+
+        async def _call_continuous(self, prompt):
+            import asyncio
+
+            if self._wake is None:
+                self._wake = asyncio.Event()
+            if self._engine_task is None or self._engine_task.done():
+                self._engine_task = asyncio.get_running_loop(
+                ).create_task(self._engine())
+            fut = self._queue.put(
+                np.asarray(prompt, np.int32).reshape(-1))
+            self._wake.set()
+            return await fut
+
+    LLM.__call__ = (LLM._call_continuous if scheduler == "continuous"
+                    else LLM._call_batch)
+    return deployment(name=f"llm_{family}_{preset}",
+                      num_replicas=num_replicas)(LLM)

@@ -11,6 +11,15 @@ spec).  Reuses bench.time_config so the methodology (donation, mesh,
 fence, per-chip batch and MFU normalization) stays identical to the
 official bench.
 
+Decode variants: {"mode": "decode", ...} routes the entry to
+bench.time_decode instead — batch is the TOTAL decode batch (the
+decode path is single-device), "seq"/"prompt_len" sets the prompt
+length, "new_tokens" the generated tokens; the SWEEPJSON record
+carries prefill_ttft_ms + decode_tok_s.  E.g.:
+
+  python sweep_tpu.py '[[8, {"mode": "decode"}],
+                        [16, {"mode": "decode", "flash_resident": "on"}]]'
+
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
 clean JSONL stream).  Failures get a distinct tag — in particular the
@@ -21,7 +30,7 @@ remain analyzable after the fact.
 import json
 import sys
 
-from bench import time_config
+from bench import time_config, time_decode
 
 
 def _failure_tag(e: Exception) -> str:
@@ -43,6 +52,37 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout):
     records = []
     for batch_per_chip, kw in configs:
         kw = dict(kw)
+        mode = kw.pop("mode", "train")
+        if mode == "decode":
+            prompt_len = kw.pop("prompt_len",
+                                kw.pop("max_seq", kw.pop("seq", 128)))
+            new_tokens = kw.pop("new_tokens", 64)
+            preset = kw.pop("preset", "gpt2")
+            variant = {"mode": "decode", "batch": batch_per_chip,
+                       "prompt_len": prompt_len,
+                       "new_tokens": new_tokens, "preset": preset,
+                       "overrides": kw}
+            try:
+                ttft_ms, tok_s = time_decode(
+                    batch_per_chip, prompt_len=prompt_len,
+                    new_tokens=new_tokens, preset=preset, **kw)
+                print(f"decode batch={batch_per_chip} "
+                      f"prompt={prompt_len} new={new_tokens} {kw}: "
+                      f"TTFT={ttft_ms:.2f}ms  {tok_s:,.0f} tok/s",
+                      file=out, flush=True)
+                rec = {"sweep": variant,
+                       "prefill_ttft_ms": round(ttft_ms, 2),
+                       "decode_tok_s": round(tok_s, 1)}
+            except Exception as e:
+                print(f"decode batch={batch_per_chip} "
+                      f"prompt={prompt_len} {kw}: FAILED "
+                      f"{type(e).__name__}: {str(e)[:160]}", file=out,
+                      flush=True)
+                rec = {"sweep": variant, "failed": _failure_tag(e),
+                       "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
         seq = kw.pop("max_seq", kw.pop("seq", 1024))
         preset = kw.pop("preset", "gpt2")
         variant = {"batch_per_chip": batch_per_chip, "seq": seq,

@@ -1,0 +1,70 @@
+"""Structural guards for the batched prefill path.
+
+The perf claim behind batched prefill is that a (B, T0) prompt becomes
+ONE forward dispatch instead of T0 sequential decode steps.  These
+tests pin that property at the jaxpr level: the traced prefill may
+scan over layers (length n_layer) but must contain no scan of length
+T0 anywhere — a regression back to token-at-a-time prefill would
+reintroduce one.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import gpt2_config, gpt2_init, llama_config, llama_init
+from ray_tpu.models.gpt2_decode import prefill
+from ray_tpu.models.llama_decode import llama_prefill
+
+B, T0 = 8, 128   # T0 deliberately != n_layer (2) so lengths can't alias
+
+
+def _scan_lengths(jaxpr, acc=None):
+    """All `length` params of scan primitives anywhere in a jaxpr."""
+    if acc is None:
+        acc = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            acc.append(eqn.params["length"])
+        for v in eqn.params.values():
+            vs = v if isinstance(v, (tuple, list)) else (v,)
+            for u in vs:   # pjit/scan carry one jaxpr, cond a tuple
+                inner = getattr(u, "jaxpr", None)
+                if inner is not None:
+                    _scan_lengths(inner, acc)
+    return acc
+
+
+def _assert_no_length_t0_scan(fn, params, toks):
+    jaxpr = jax.make_jaxpr(fn)(params, toks).jaxpr
+    lengths = _scan_lengths(jaxpr)
+    assert T0 not in lengths, (
+        f"prefill traced a scan of length T0={T0} (scan lengths: "
+        f"{lengths}) — prompt processing regressed to per-token steps")
+
+
+def test_gpt2_prefill_is_single_dispatch():
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((B, T0), jnp.int32)
+    _assert_no_length_t0_scan(
+        lambda p, t: prefill(p, t, cfg), params, toks)
+
+
+def test_llama_prefill_is_single_dispatch():
+    cfg = llama_config("nano")
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((B, T0), jnp.int32)
+    _assert_no_length_t0_scan(
+        lambda p, t: llama_prefill(p, t, cfg), params, toks)
+
+
+def test_gpt2_ragged_prefill_is_single_dispatch():
+    # the ragged (lengths=...) variant must stay one dispatch too
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((B, T0), jnp.int32)
+    lens = jnp.full((B,), T0 // 2, jnp.int32)
+    _assert_no_length_t0_scan(
+        lambda p, t: prefill(p, t, cfg, lengths=lens), params, toks)

@@ -75,8 +75,115 @@ def test_build_llm_deployment_serves_both_families(ray_start_shared,
         serve.shutdown()
 
 
+def _reference_continuations(prompts, max_new_tokens):
+    """Greedy single-request continuations straight off the decoder —
+    what every serve scheduler must reproduce exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import generate
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    return [np.asarray(generate(params,
+                                jnp.asarray(p, jnp.int32)[None], cfg,
+                                max_new_tokens=max_new_tokens,
+                                temperature=0.0))[0]
+            for p in prompts]
+
+
+def test_llm_deployment_ragged_batch(ray_start_shared):
+    # ragged prompts through the @serve.batch scheduler: left-padded
+    # internally, each caller gets its own pad-free row back, and every
+    # row matches single-request generation exactly
+    import jax.numpy as jnp
+
+    from ray_tpu.serve import build_llm_deployment
+
+    dep = build_llm_deployment(
+        "gpt2", "nano", max_new_tokens=4, temperature=0.0,
+        batch_wait_timeout_s=0.2,
+        config_overrides={"dtype": jnp.float32, "use_flash": False,
+                          "remat": False})
+    handle = serve.run(dep.options(max_concurrent_queries=16).bind())
+    try:
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 500, (n,)).astype(np.int32)
+                   for n in (3, 7, 5, 7, 2, 6)]
+        outs = ray_tpu.get([handle.remote(p) for p in prompts],
+                           timeout=180)
+        refs = _reference_continuations(prompts, 4)
+        for p, o, r in zip(prompts, outs, refs):
+            assert o.shape == (len(p) + 4,)
+            np.testing.assert_array_equal(o, r)
+    finally:
+        serve.shutdown()
+
+
+def test_llm_deployment_continuous_two_waves(ray_start_shared):
+    # acceptance: >= 16 ragged requests in two waves through a slot
+    # pool SMALLER than the request count; the second wave is admitted
+    # mid-flight as first-wave slots free; every continuation matches
+    # the single-request reference
+    import jax.numpy as jnp
+
+    from ray_tpu.serve import build_llm_deployment
+
+    new = 6
+    dep = build_llm_deployment(
+        "gpt2", "nano", max_new_tokens=new, temperature=0.0,
+        scheduler="continuous", max_slots=3, prefill_bucket=8,
+        config_overrides={"dtype": jnp.float32, "use_flash": False,
+                          "remat": False})
+    handle = serve.run(dep.options(max_concurrent_queries=32).bind())
+    try:
+        rng = np.random.RandomState(1)
+        lens = [3, 9, 5, 7, 4, 8, 6, 2] * 2          # 16 ragged
+        prompts = [rng.randint(1, 500, (n,)).astype(np.int32)
+                   for n in lens]
+        wave1 = [handle.remote(p) for p in prompts[:8]]
+        # second wave lands while wave 1 is still decoding
+        wave2 = [handle.remote(p) for p in prompts[8:]]
+        outs = ray_tpu.get(wave1 + wave2, timeout=300)
+        refs = _reference_continuations(prompts, new)
+        for p, o, r in zip(prompts, outs, refs):
+            assert o.shape == (len(p) + new,)
+            np.testing.assert_array_equal(o[:len(p)], p)
+            np.testing.assert_array_equal(o, r)
+    finally:
+        serve.shutdown()
+
+
+def test_llm_deployment_rejects_oversized_prompt_continuous(
+        ray_start_shared):
+    import jax.numpy as jnp
+
+    from ray_tpu.serve import build_llm_deployment
+
+    dep = build_llm_deployment(
+        "gpt2", "nano", max_new_tokens=8, temperature=0.0,
+        scheduler="continuous", max_slots=2,
+        config_overrides={"dtype": jnp.float32, "use_flash": False,
+                          "remat": False})
+    handle = serve.run(dep.options(max_concurrent_queries=4).bind())
+    try:
+        too_long = np.arange(1, 126, dtype=np.int32)  # 125+8 > 128
+        with pytest.raises(Exception, match="prompt length"):
+            ray_tpu.get(handle.remote(too_long), timeout=120)
+        # pool must stay healthy for well-sized requests afterwards
+        ok = np.array([1, 2, 3], np.int32)
+        out = ray_tpu.get(handle.remote(ok), timeout=120)
+        assert out.shape == (11,)
+    finally:
+        serve.shutdown()
+
+
 def test_build_llm_deployment_rejects_unknown_family():
     from ray_tpu.serve import build_llm_deployment
 
     with pytest.raises(ValueError, match="unknown LM family"):
         build_llm_deployment("bert")
+    with pytest.raises(ValueError, match="unknown scheduler"):
+        build_llm_deployment("gpt2", scheduler="speculative")
