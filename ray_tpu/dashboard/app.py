@@ -206,6 +206,32 @@ class DashboardActor:
         app.router.add_put("/api/serve/applications", serve_apply)
         app.router.add_get("/api/serve/applications", serve_status)
 
+        # Engine telemetry aggregation (serve/telemetry.py): one
+        # engine_stats() snapshot per deployment whose replicas expose
+        # it (LM engines); others report the reason they were skipped.
+        async def serve_stats(_req):
+            def _collect():
+                from ray_tpu.serve import api as serve_api
+
+                out = {}
+                try:
+                    deployments = serve_api.status()
+                except Exception:  # noqa: BLE001 - serve not running
+                    return out
+                for name in deployments:
+                    try:
+                        out[name] = serve_api.engine_stats(name,
+                                                           timeout=15)
+                    except Exception as e:  # noqa: BLE001 - no stats
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/serve/stats", serve_stats)
+
         # Structured events (reference: dashboard event module consuming
         # RAY_EVENT files, src/ray/util/event.h:41).
         async def events_list(req):

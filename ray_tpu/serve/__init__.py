@@ -1,8 +1,8 @@
 """Model serving on actors (reference analog: python/ray/serve/)."""
 
 from ray_tpu.serve.api import (Deployment, delete, deployment,
-                               get_deployment_handle, run, shutdown,
-                               start_http_proxy, status)
+                               engine_stats, get_deployment_handle,
+                               run, shutdown, start_http_proxy, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.llm import build_llm_deployment
 from ray_tpu.serve.handle import DeploymentHandle
@@ -12,6 +12,6 @@ from ray_tpu.serve.schema import apply as apply_config
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
-           "start_http_proxy", "batch", "status",
+           "start_http_proxy", "batch", "status", "engine_stats",
            "ServeApplicationSchema", "DeploymentSchema",
            "apply_config", "build_llm_deployment"]

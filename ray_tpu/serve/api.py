@@ -203,6 +203,19 @@ def status() -> dict:
     return ray_tpu.get(controller.status.remote(), timeout=30.0)
 
 
+def engine_stats(deployment_name: str, timeout: float = 30.0) -> dict:
+    """Engine telemetry snapshot from one replica of an LM deployment
+    (p50/p95/p99 TTFT + queue wait, throughput, slot utilization —
+    serve/telemetry.py).  Raises for deployments without an
+    ``engine_stats`` method; the dashboard's ``/api/serve/stats``
+    aggregates this across every deployment, skipping those."""
+    import ray_tpu
+
+    handle = get_deployment_handle(deployment_name)
+    return ray_tpu.get(handle.method("engine_stats").remote(),
+                       timeout=timeout)
+
+
 def delete(name: str) -> None:
     import ray_tpu
 

@@ -36,6 +36,7 @@ import numpy as np
 from ray_tpu.serve.api import deployment
 from ray_tpu.serve.batching import RequestQueue
 from ray_tpu.serve.batching import batch as _batch
+from ray_tpu.serve.telemetry import EngineTelemetry
 
 
 def _family_fns(family: str):
@@ -107,6 +108,13 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # request would sample under the same default key and
             # return identical "random" continuations
             self._rng = jax.random.PRNGKey(seed + 1)
+            # host-side lifecycle telemetry (enqueue/admit/first-token/
+            # step/finish records -> metrics + engine_stats + timeline);
+            # never touches the jitted programs
+            self._telemetry = EngineTelemetry(
+                f"llm_{family}_{preset}",
+                max_slots=(max_slots if scheduler == "continuous"
+                           else max_batch_size))
             if scheduler == "batch":
                 self._generate = jax.jit(
                     lambda p, toks, k: gen_fn(
@@ -151,6 +159,19 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # trim the left pads: each caller sees prompt+continuation
             return [np.asarray(row)[t0 - n:]
                     for row, n in zip(out, lens)]
+
+        async def _call_batch_traced(self, prompt):
+            # request-level telemetry wraps the @serve.batch queue so
+            # the recorded latency includes the batch-collection wait
+            rec = self._telemetry.record_enqueue(
+                int(np.asarray(prompt).reshape(-1).shape[0]))
+            try:
+                out = await self._call_batch(prompt)
+            except Exception as e:  # noqa: BLE001 - caller sees it too
+                self._telemetry.record_error(rec, error=repr(e))
+                raise
+            self._telemetry.record_finish(rec, n_tokens=max_new_tokens)
+            return out
 
         # ------------------------------------------------------------
         # "continuous" scheduler: slot pool with mid-flight admission
@@ -208,9 +229,11 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         if s is None]
                 if not free:
                     return
-                (arr, fut), = self._queue.pop(1)
+                ((arr, rec), fut), = self._queue.pop(1)
                 n = int(arr.shape[0])
                 if n == 0 or n + max_new_tokens > self.cfg.max_seq:
+                    self._telemetry.record_reject(
+                        rec, reason=f"prompt length {n}")
                     if not fut.done():
                         fut.set_exception(ValueError(
                             f"prompt length {n} invalid for "
@@ -222,29 +245,35 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 t_pad = -(-n // prefill_bucket) * prefill_bucket
                 t_pad = max(n, min(t_pad,
                                    self.cfg.max_seq - max_new_tokens))
+                slot = free[0]
+                self._telemetry.record_admit(rec, slot, t_pad)
                 padded = np.zeros((1, t_pad), np.int32)
                 padded[0, t_pad - n:] = arr
                 self._rng, k = jax.random.split(self._rng)
                 tok, row = self._prefill(
                     self.params, jnp.asarray(padded),
                     jnp.asarray([n], jnp.int32), k)
+                # int() is the engine's existing host fence for the
+                # prefill result; the timestamp behind it is the TTFT
                 first = int(np.asarray(tok)[0])
+                self._telemetry.record_first_token(rec)
                 if max_new_tokens <= 1:
+                    self._telemetry.record_finish(rec, n_tokens=1)
                     if not fut.done():
                         fut.set_result(np.concatenate(
                             [arr, np.asarray([first], np.int32)]))
                     continue
-                slot = free[0]
                 self._cache = self._admit(self._cache, row, slot)
                 self._cur[slot] = first
                 self._slots[slot] = {"prompt": arr, "out": [first],
-                                     "fut": fut}
+                                     "fut": fut, "rec": rec}
 
         async def _engine(self):
             """The scheduler loop: admit → one pooled decode step →
             retire finished slots → yield (so new requests enqueue
             mid-generation)."""
             import asyncio
+            import time as _time
 
             import jax
             import jax.numpy as jnp
@@ -252,22 +281,31 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             while True:
                 try:
                     self._admit_pending()
-                    if not any(s is not None for s in self._slots):
+                    n_active = sum(s is not None for s in self._slots)
+                    if not n_active:
                         self._wake.clear()
                         if not len(self._queue):
                             await self._wake.wait()
                         continue
+                    # step walltime: dispatch + the np.asarray host
+                    # fence the engine already performs — perf_counter
+                    # pairs only, no extra device sync
+                    t_step = _time.perf_counter()
                     self._rng, k = jax.random.split(self._rng)
                     toks, self._cache = self._pool_step(
                         self.params, self._cache,
                         jnp.asarray(self._cur), k)
                     toks = np.asarray(toks)
+                    self._telemetry.record_step(
+                        n_active, _time.perf_counter() - t_step)
                     for i, st in enumerate(self._slots):
                         if st is None:
                             continue
                         st["out"].append(int(toks[i]))
                         self._cur[i] = toks[i]
                         if len(st["out"]) >= max_new_tokens:
+                            self._telemetry.record_finish(
+                                st["rec"], n_tokens=len(st["out"]))
                             if not st["fut"].done():
                                 st["fut"].set_result(np.concatenate(
                                     [st["prompt"],
@@ -275,10 +313,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                             self._slots[i] = None   # slot freed NOW
                 except Exception as e:  # noqa: BLE001 - fail loudly
                     for i, st in enumerate(self._slots):
-                        if st is not None and not st["fut"].done():
-                            st["fut"].set_exception(e)
+                        if st is not None:
+                            self._telemetry.record_error(
+                                st["rec"], error=repr(e))
+                            if not st["fut"].done():
+                                st["fut"].set_exception(e)
                         self._slots[i] = None
-                    for arr, fut in self._queue.pop(len(self._queue)):
+                    for (arr, rec), fut in self._queue.pop(
+                            len(self._queue)):
+                        self._telemetry.record_error(rec, error=repr(e))
                         if not fut.done():
                             fut.set_exception(e)
                 # yield the loop so callers can enqueue mid-flight
@@ -292,12 +335,36 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             if self._engine_task is None or self._engine_task.done():
                 self._engine_task = asyncio.get_running_loop(
                 ).create_task(self._engine())
-            fut = self._queue.put(
-                np.asarray(prompt, np.int32).reshape(-1))
+            arr = np.asarray(prompt, np.int32).reshape(-1)
+            rec = self._telemetry.record_enqueue(int(arr.shape[0]))
+            fut = self._queue.put((arr, rec))
             self._wake.set()
             return await fut
 
+        # -- telemetry surface (works for both schedulers) -----------
+
+        def engine_stats(self):
+            """p50/p95/p99 TTFT + queue wait, throughput, slot
+            utilization, request counts — `handle.method(
+            "engine_stats").remote()` or GET /api/serve/stats."""
+            return self._telemetry.engine_stats()
+
+        def export_timeline(self, path=None):
+            """Chrome-trace engine timeline (queue lane, per-slot
+            occupancy lanes, engine-step lane); writes `path` when
+            given and returns the event list."""
+            return self._telemetry.export_timeline(path)
+
+        def metrics_snapshot(self):
+            """This replica's serve_* metric dumps (histogram buckets
+            included) straight from the process-local registry."""
+            from ray_tpu.util.metrics import _registry
+
+            return {name: dump for name, dump
+                    in _registry.snapshot().items()
+                    if name.startswith("serve_")}
+
     LLM.__call__ = (LLM._call_continuous if scheduler == "continuous"
-                    else LLM._call_batch)
+                    else LLM._call_batch_traced)
     return deployment(name=f"llm_{family}_{preset}",
                       num_replicas=num_replicas)(LLM)

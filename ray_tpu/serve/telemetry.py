@@ -1,0 +1,366 @@
+"""Engine telemetry for the LM serving hot path.
+
+Every request through ``serve/llm.py`` carries a lifecycle record —
+enqueue → admit → prefill-done (first token) → per-decode-step →
+finish / reject — and the continuous-batching engine reports each
+transition here.  Three sinks hang off those records:
+
+1. **util/metrics.py** Histograms / Counters / Gauges (TTFT, queue
+   wait, inter-token latency, slot occupancy, queue depth,
+   admissions/rejections, tokens, and a recompile counter keyed by
+   prefill bucket) — published to the dashboard ``/metrics`` Prometheus
+   page through the existing GCS-KV snapshot path, no new plumbing.
+2. **engine_stats()** — an on-demand snapshot (p50/p95/p99 TTFT and
+   queue wait, throughput, slot utilization, request counts) exposed as
+   a deployment method and aggregated at ``/api/serve/stats``.
+3. **export_timeline()** — a chrome-trace exporter rendering engine
+   steps, per-slot occupancy lanes, and per-request spans in the same
+   format as ``python -m ray_tpu timeline``, so engine activity and
+   task activity open in one Perfetto view.
+
+Everything is host-side bookkeeping (dict/deque appends plus a
+histogram observe) timed around syncs the engine already performs; the
+jitted prefill/decode programs are untouched and no device syncs are
+added.  When ``util/tracing.py`` is enabled, each request records a
+root span at enqueue and a child span at finish, linking the serve
+request to its engine work.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+from ray_tpu._private import telemetry as _core
+from ray_tpu.util import tracing
+
+#: ms boundaries for request-level latencies (TTFT, queue wait, total)
+_LATENCY_BOUNDS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                      1000.0, 2500.0, 5000.0, 10000.0)
+#: ms boundaries for per-decode-step (inter-token) latency
+_STEP_BOUNDS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                   500.0, 1000.0)
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _engine_metrics() -> Dict[str, Any]:
+    """Process-wide metric singletons (one registration per name no
+    matter how many deployments/telemetry instances this process hosts
+    — the registry warns on duplicate names)."""
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            tags = ("deployment",)
+            _metrics = {
+                "ttft": Histogram(
+                    "serve_ttft_ms",
+                    "time to first token (enqueue -> prefill sample)",
+                    boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+                "queue_wait": Histogram(
+                    "serve_queue_wait_ms",
+                    "request wait in the admission queue",
+                    boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+                "inter_token": Histogram(
+                    "serve_inter_token_ms",
+                    "pooled decode step walltime",
+                    boundaries=_STEP_BOUNDS_MS, tag_keys=tags),
+                "latency": Histogram(
+                    "serve_request_latency_ms",
+                    "request latency (enqueue -> finish)",
+                    boundaries=_LATENCY_BOUNDS_MS, tag_keys=tags),
+                "active_slots": Gauge(
+                    "serve_active_slots",
+                    "KV slots decoding this engine step", tag_keys=tags),
+                "queue_depth": Gauge(
+                    "serve_queue_depth",
+                    "requests waiting for a slot", tag_keys=tags),
+                "slot_utilization": Gauge(
+                    "serve_slot_utilization",
+                    "time-weighted active/max slot fraction",
+                    tag_keys=tags),
+                "tokens_per_sec": Gauge(
+                    "serve_tokens_per_sec",
+                    "decode throughput over the step window",
+                    tag_keys=tags),
+                "admitted": Counter(
+                    "serve_requests_admitted_total",
+                    "requests admitted into a slot", tag_keys=tags),
+                "finished": Counter(
+                    "serve_requests_finished_total",
+                    "requests finished", tag_keys=tags),
+                "rejected": Counter(
+                    "serve_requests_rejected_total",
+                    "requests rejected at admission", tag_keys=tags),
+                "errors": Counter(
+                    "serve_requests_errored_total",
+                    "requests failed by an engine error", tag_keys=tags),
+                "tokens": Counter(
+                    "serve_tokens_generated_total",
+                    "decode tokens sampled", tag_keys=tags),
+                "prefill_compiles": Counter(
+                    "serve_prefill_compiles_total",
+                    "first-seen prefill bucket shapes (one XLA compile "
+                    "each)", tag_keys=("deployment", "bucket")),
+            }
+        return _metrics
+
+
+class EngineTelemetry:
+    """Lifecycle recorder for one engine (deployment replica or bench
+    harness).  All methods take an optional ``now`` (seconds, from
+    ``time.perf_counter()``) so tests can drive deterministic clocks;
+    production callers omit it."""
+
+    def __init__(self, deployment: str, max_slots: int = 0,
+                 history: int = 4096):
+        self.deployment = deployment
+        self.max_slots = int(max_slots)
+        self._m = _engine_metrics()
+        self._tags = {"deployment": deployment}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._t0 = time.perf_counter()
+        #: retired request records (finished / rejected / errored)
+        self._done: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=history)
+        #: (end_ts, dur_s, n_active) per pooled decode step
+        self._steps: Deque[tuple] = collections.deque(maxlen=history)
+        self._active: Dict[int, Dict[str, Any]] = {}
+        self._counts = {"enqueued": 0, "admitted": 0, "finished": 0,
+                        "rejected": 0, "errors": 0}
+        self._queue_depth = 0
+        self._max_active = 0
+        self._n_steps = 0
+        self._tokens = 0
+        self._busy_slot_s = 0.0     # sum(active * dur) over steps
+        self._step_s = 0.0          # sum(dur) over steps
+        self._buckets: Dict[int, int] = {}  # prefill bucket -> admits
+
+    def _now(self, now: Optional[float]) -> float:
+        return time.perf_counter() if now is None else now
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def record_enqueue(self, prompt_len: int,
+                       now: Optional[float] = None) -> Dict[str, Any]:
+        now = self._now(now)
+        rec: Dict[str, Any] = {
+            "id": next(self._ids), "prompt_len": int(prompt_len),
+            "enqueue": now, "admit": None, "first_token": None,
+            "finish": None, "slot": None, "bucket": None, "tokens": 0,
+            "status": "queued", "trace": None,
+        }
+        if tracing.is_enabled():
+            rec["trace"] = tracing.record_span(
+                f"serve {self.deployment}.request")
+        with self._lock:
+            self._counts["enqueued"] += 1
+            self._queue_depth += 1
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+        return rec
+
+    def record_admit(self, rec: Dict[str, Any], slot: int, bucket: int,
+                     now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec["admit"] = now
+        rec["slot"] = int(slot)
+        rec["bucket"] = int(bucket)
+        rec["status"] = "active"
+        with self._lock:
+            self._counts["admitted"] += 1
+            self._queue_depth = max(0, self._queue_depth - 1)
+            self._active[rec["id"]] = rec
+            first_seen = bucket not in self._buckets
+            self._buckets[bucket] = self._buckets.get(bucket, 0) + 1
+        self._m["admitted"].inc(tags=self._tags)
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+        self._m["queue_wait"].observe(
+            (now - rec["enqueue"]) * 1e3, tags=self._tags)
+        if first_seen:
+            # a never-seen padded prompt shape means one fresh XLA
+            # compile of the prefill program for this bucket
+            self._m["prefill_compiles"].inc(
+                tags=dict(self._tags, bucket=str(int(bucket))))
+
+    def record_first_token(self, rec: Dict[str, Any],
+                           now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec["first_token"] = now
+        rec["tokens"] = max(1, rec["tokens"])
+        self._m["ttft"].observe(
+            (now - rec["enqueue"]) * 1e3, tags=self._tags)
+
+    def record_step(self, n_active: int, dur_s: float,
+                    now: Optional[float] = None) -> None:
+        """One pooled decode step: `n_active` slots each sampled one
+        token in `dur_s` seconds of host walltime."""
+        now = self._now(now)
+        with self._lock:
+            self._steps.append((now, float(dur_s), int(n_active)))
+            self._n_steps += 1
+            self._tokens += int(n_active)
+            self._max_active = max(self._max_active, int(n_active))
+            self._busy_slot_s += n_active * dur_s
+            self._step_s += dur_s
+            util = (self._busy_slot_s / (self.max_slots * self._step_s)
+                    if self.max_slots and self._step_s else 0.0)
+        self._m["inter_token"].observe(dur_s * 1e3, tags=self._tags)
+        self._m["active_slots"].set(n_active, tags=self._tags)
+        self._m["tokens"].inc(int(n_active), tags=self._tags)
+        self._m["slot_utilization"].set(round(util, 4), tags=self._tags)
+        if dur_s > 0:
+            self._m["tokens_per_sec"].set(
+                round(n_active / dur_s, 1), tags=self._tags)
+
+    def record_finish(self, rec: Dict[str, Any],
+                      n_tokens: Optional[int] = None,
+                      now: Optional[float] = None) -> None:
+        now = self._now(now)
+        rec["finish"] = now
+        if n_tokens is not None:
+            rec["tokens"] = int(n_tokens)
+        rec["status"] = "ok"
+        self._retire(rec, "finished")
+        self._m["finished"].inc(tags=self._tags)
+        self._m["latency"].observe(
+            (now - rec["enqueue"]) * 1e3, tags=self._tags)
+        if rec["trace"] is not None:
+            trace_id, span_id = rec["trace"]
+            tracing.record_span(f"engine {self.deployment}.generate",
+                                trace_id=trace_id, parent_id=span_id)
+
+    def record_reject(self, rec: Dict[str, Any], reason: str = "",
+                      now: Optional[float] = None) -> None:
+        rec["finish"] = self._now(now)
+        rec["status"] = "rejected"
+        rec["reason"] = reason
+        self._retire(rec, "rejected")
+        self._m["rejected"].inc(tags=self._tags)
+
+    def record_error(self, rec: Dict[str, Any], error: str = "",
+                     now: Optional[float] = None) -> None:
+        rec["finish"] = self._now(now)
+        rec["status"] = "error"
+        rec["reason"] = error
+        self._retire(rec, "errors")
+        self._m["errors"].inc(tags=self._tags)
+
+    def _retire(self, rec: Dict[str, Any], count_key: str) -> None:
+        with self._lock:
+            self._counts[count_key] += 1
+            if rec["admit"] is None:
+                self._queue_depth = max(0, self._queue_depth - 1)
+            self._active.pop(rec["id"], None)
+            self._done.append(rec)
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+
+    # -- sinks -------------------------------------------------------------
+
+    def engine_stats(self) -> Dict[str, Any]:
+        """Snapshot of everything ``bench``/dashboards ask the engine:
+        percentiles over retained records, counters, throughput, and
+        slot occupancy — cheap enough to call per scrape."""
+        with self._lock:
+            recs = list(self._done) + list(self._active.values())
+            n_active = len(self._active)
+            steps = list(self._steps)
+            counts = dict(self._counts)
+            queue_depth = self._queue_depth
+            max_active = self._max_active
+            n_steps = self._n_steps
+            tokens = self._tokens
+            busy, step_s = self._busy_slot_s, self._step_s
+            buckets = dict(self._buckets)
+        ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
+                if r["first_token"] is not None]
+        qwait = [(r["admit"] - r["enqueue"]) * 1e3 for r in recs
+                 if r["admit"] is not None]
+        lat = [(r["finish"] - r["enqueue"]) * 1e3 for r in recs
+               if r["finish"] is not None and r["status"] == "ok"]
+        inter = [d * 1e3 for _, d, _ in steps]
+        if steps:
+            window = (steps[-1][0] - steps[0][0] + steps[0][1])
+            win_tokens = sum(n for _, _, n in steps)
+            throughput = win_tokens / window if window > 0 else 0.0
+        else:
+            throughput = 0.0
+        return {
+            "deployment": self.deployment,
+            "uptime_s": round(time.perf_counter() - self._t0, 3),
+            "requests": dict(counts, active=n_active,
+                             queued=queue_depth),
+            "ttft_ms": _core.summarize(ttft),
+            "queue_wait_ms": _core.summarize(qwait),
+            "request_latency_ms": _core.summarize(lat),
+            "inter_token_ms": _core.summarize(inter),
+            "engine_steps": n_steps,
+            "tokens_generated": tokens,
+            "tokens_per_sec": round(throughput, 1),
+            "slot_utilization": round(
+                busy / (self.max_slots * step_s), 4)
+                if self.max_slots and step_s else 0.0,
+            "max_active_slots": max_active,
+            "max_slots": self.max_slots,
+            "prefill_buckets": {str(k): v
+                                for k, v in sorted(buckets.items())},
+            "prefill_compiles": len(buckets),
+        }
+
+    def export_timeline(self, filename: Optional[str] = None
+                        ) -> List[Dict[str, Any]]:
+        """Chrome-trace events in the ``ray_tpu.timeline()`` shape:
+        lane 0 is the admission queue, lanes 1..max_slots are per-slot
+        occupancy (prefill + decode span per request), and the last
+        lane carries the pooled engine steps.  Timestamps are relative
+        to engine start (chrome-trace origins are arbitrary)."""
+        with self._lock:
+            recs = list(self._done) + list(self._active.values())
+            steps = list(self._steps)
+        pid = 1
+        base = self._t0
+        step_lane = self.max_slots + 1
+        events: List[Dict[str, Any]] = [
+            _core.process_name_event(
+                pid, f"llm-engine {self.deployment}"),
+            _core.thread_name_event(pid, 0, "queue"),
+            _core.thread_name_event(pid, step_lane, "engine steps"),
+        ]
+        for slot in range(self.max_slots):
+            events.append(
+                _core.thread_name_event(pid, slot + 1, f"slot {slot}"))
+        now = time.perf_counter()
+        for r in recs:
+            end = r["finish"] if r["finish"] is not None else now
+            admit = r["admit"] if r["admit"] is not None else end
+            events.append(_core.complete_event(
+                f"queued req{r['id']}", "serve", r["enqueue"] - base,
+                admit - r["enqueue"], pid, 0,
+                {"request_id": r["id"], "status": r["status"],
+                 "prompt_len": r["prompt_len"]}))
+            if r["admit"] is None:
+                continue
+            lane = (r["slot"] + 1) if r["slot"] is not None else 0
+            first = (r["first_token"] if r["first_token"] is not None
+                     else min(admit, end))
+            events.append(_core.complete_event(
+                f"prefill req{r['id']}", "serve", admit - base,
+                first - admit, pid, lane,
+                {"request_id": r["id"], "bucket": r["bucket"],
+                 "prompt_len": r["prompt_len"]}))
+            events.append(_core.complete_event(
+                f"decode req{r['id']}", "serve", first - base,
+                end - first, pid, lane,
+                {"request_id": r["id"], "tokens": r["tokens"],
+                 "status": r["status"]}))
+        for end_ts, dur, n_active in steps:
+            events.append(_core.complete_event(
+                "engine_step", "serve", end_ts - dur - base, dur, pid,
+                step_lane, {"active_slots": n_active}))
+        return _core.write_chrome_trace(events, filename)

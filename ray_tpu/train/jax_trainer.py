@@ -64,9 +64,17 @@ class jax_utils:
 
     @staticmethod
     def build_train_step(loss_fn, tx, mesh=None, logical_axes=None,
-                         rules=None, donate: bool = True):
+                         rules=None, donate: bool = True,
+                         telemetry: bool = True,
+                         telemetry_name: str = "jax_trainer"):
         """jitted (params, opt_state, batch) -> (params, opt_state, loss)
-        with optional sharding constraints from logical_axes."""
+        with optional sharding constraints from logical_axes.
+
+        telemetry=True (default) wraps the step with host-side
+        step-time histograms, examples/sec gauges, and compile-event
+        counters (train/telemetry.py — perf_counter pairs only, no
+        added device syncs); read them back via
+        ``jax_utils.train_stats(telemetry_name)``."""
         import functools
 
         import jax
@@ -90,7 +98,23 @@ class jax_utils:
             kw["in_shardings"] = in_shardings
         if donate:
             kw["donate_argnums"] = (0, 1)
-        return jax.jit(step, **kw)
+        jitted = jax.jit(step, **kw)
+        if not telemetry:
+            return jitted
+        from ray_tpu.train.telemetry import (get_train_telemetry,
+                                             instrument_train_step)
+
+        return instrument_train_step(
+            jitted, telemetry=get_train_telemetry(telemetry_name))
+
+    @staticmethod
+    def train_stats(name: str = "jax_trainer"):
+        """Step-time percentiles / compile counts recorded by
+        ``build_train_step`` steps in THIS process (workers call it
+        inside the loop and ``session.report`` it up)."""
+        from ray_tpu.train.telemetry import train_stats
+
+        return train_stats(name)
 
     @staticmethod
     def allreduce_gradients(grads, op: str = "mean",

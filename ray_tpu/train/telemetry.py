@@ -1,0 +1,177 @@
+"""Step-level telemetry for training loops (the Podracer discipline:
+TPU-utilization work is driven by step-time histograms, nothing else).
+
+``instrument_train_step`` wraps a jitted train step with host-side
+timing — a ``perf_counter`` pair around the call, no device syncs are
+added, so under async dispatch the recorded time is dispatch time until
+the pipeline backpressures and device-step time after (exactly what a
+throughput investigation needs).  Each distinct abstract signature of
+the batch argument (leaf shapes/dtypes) counts one compile event: a
+recompile storm shows up as a climbing ``train_compile_events_total``
+long before anyone reads XLA logs.
+
+Metrics land in ``util/metrics.py`` (published to the dashboard
+``/metrics`` page through the GCS-KV snapshot path) and in an
+in-process ``stats()`` snapshot mirroring the serve engine's
+``engine_stats()`` shape.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private import telemetry as _core
+
+_STEP_BOUNDS_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                   1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+
+_metrics_lock = threading.Lock()
+_metrics: Optional[Dict[str, Any]] = None
+
+
+def _train_metrics() -> Dict[str, Any]:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+            tags = ("trainer",)
+            _metrics = {
+                "step_time": Histogram(
+                    "train_step_time_ms",
+                    "host walltime per train step call",
+                    boundaries=_STEP_BOUNDS_MS, tag_keys=tags),
+                "examples_per_sec": Gauge(
+                    "train_examples_per_sec",
+                    "examples consumed per second (last step)",
+                    tag_keys=tags),
+                "steps": Counter(
+                    "train_steps_total", "train step calls",
+                    tag_keys=tags),
+                "compiles": Counter(
+                    "train_compile_events_total",
+                    "distinct batch signatures seen (one XLA compile "
+                    "each)", tag_keys=tags),
+            }
+        return _metrics
+
+
+class TrainTelemetry:
+    """Per-trainer recorder; cheap enough to call once per step."""
+
+    def __init__(self, name: str = "default", history: int = 4096):
+        self.name = name
+        self._m = _train_metrics()
+        self._tags = {"trainer": name}
+        self._lock = threading.Lock()
+        self._durs: collections.deque = collections.deque(maxlen=history)
+        self._steps = 0
+        self._compiles = 0
+        self._examples = 0
+        self._last_eps = 0.0
+
+    def record_step(self, dur_s: float,
+                    examples: Optional[int] = None) -> None:
+        with self._lock:
+            self._durs.append(float(dur_s))
+            self._steps += 1
+            if examples:
+                self._examples += int(examples)
+                if dur_s > 0:
+                    self._last_eps = examples / dur_s
+        self._m["step_time"].observe(dur_s * 1e3, tags=self._tags)
+        self._m["steps"].inc(tags=self._tags)
+        if examples and dur_s > 0:
+            self._m["examples_per_sec"].set(
+                round(examples / dur_s, 1), tags=self._tags)
+
+    def record_compile(self, signature: str = "") -> None:
+        with self._lock:
+            self._compiles += 1
+        self._m["compiles"].inc(tags=self._tags)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            durs = list(self._durs)
+            out = {"trainer": self.name, "steps": self._steps,
+                   "compiles": self._compiles,
+                   "examples": self._examples,
+                   "examples_per_sec": round(self._last_eps, 1)}
+        out["step_time_ms"] = _core.summarize([d * 1e3 for d in durs])
+        return out
+
+
+_telemetries: Dict[str, TrainTelemetry] = {}
+_telemetries_lock = threading.Lock()
+
+
+def get_train_telemetry(name: str = "default") -> TrainTelemetry:
+    """Process-wide TrainTelemetry singleton per trainer name."""
+    with _telemetries_lock:
+        tel = _telemetries.get(name)
+        if tel is None:
+            tel = _telemetries[name] = TrainTelemetry(name)
+        return tel
+
+
+def train_stats(name: str = "default") -> Dict[str, Any]:
+    """Snapshot for the named trainer (empty-shaped if never stepped)."""
+    return get_train_telemetry(name).stats()
+
+
+def _batch_signature(batch: Any) -> tuple:
+    """Abstract signature of the batch pytree: leaf shapes + dtypes.
+    A fresh signature means the jitted step compiles a new program."""
+    import jax
+
+    return tuple(
+        (tuple(getattr(x, "shape", ())),
+         str(getattr(x, "dtype", type(x).__name__)))
+        for x in jax.tree_util.tree_leaves(batch))
+
+
+def _leading_dim(batch: Any) -> Optional[int]:
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(batch):
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return None
+
+
+def instrument_train_step(step_fn: Callable,
+                          telemetry: Optional[TrainTelemetry] = None,
+                          batch_arg: int = 2) -> Callable:
+    """Wrap a (jitted) train step with step-time / compile / throughput
+    telemetry.  ``batch_arg`` is the positional index of the batch
+    pytree (2 for the canonical ``step(params, opt_state, batch)``);
+    out-of-range indices simply skip the examples/sec gauge."""
+    tel = telemetry or get_train_telemetry()
+    seen: set = set()
+
+    @functools.wraps(step_fn)
+    def wrapped(*args, **kwargs):
+        batch = args[batch_arg] if len(args) > batch_arg else None
+        examples = None
+        if batch is not None:
+            try:
+                sig = _batch_signature(batch)
+                examples = _leading_dim(batch)
+            except Exception:  # noqa: BLE001 - exotic batch types
+                sig = None
+            if sig is not None and sig not in seen:
+                seen.add(sig)
+                tel.record_compile(str(sig))
+        t0 = time.perf_counter()
+        out = step_fn(*args, **kwargs)
+        tel.record_step(time.perf_counter() - t0, examples=examples)
+        return out
+
+    wrapped.__wrapped__ = step_fn
+    wrapped.telemetry = tel
+    return wrapped

@@ -11,12 +11,18 @@ every live snapshot into one Prometheus text page.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _KV_PREFIX = "metrics:"
 _PUBLISH_INTERVAL_S = 5.0
+
+#: Prometheus-safe metric names (the repo-wide guard test holds every
+#: Counter/Gauge/Histogram under ray_tpu/ to the same pattern)
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
 
 class _Registry:
@@ -24,9 +30,22 @@ class _Registry:
         self.metrics: Dict[str, "Metric"] = {}
         self._lock = threading.Lock()
         self._publisher: Optional[threading.Thread] = None
+        self._dup_warned: set = set()
 
     def register(self, metric: "Metric") -> None:
         with self._lock:
+            old = self.metrics.get(metric.name)
+            if (old is not None and old is not metric
+                    and metric.name not in self._dup_warned):
+                # warn ONCE per name instead of silently overwriting:
+                # two live instances under one name means one of them
+                # publishes and the other's observations vanish
+                self._dup_warned.add(metric.name)
+                warnings.warn(
+                    f"metric {metric.name!r} registered more than once "
+                    f"in this process; the newest instance replaces the "
+                    f"previous one in the registry (share one instance "
+                    f"instead)", RuntimeWarning, stacklevel=4)
             self.metrics[metric.name] = metric
         self._ensure_publisher()
 
@@ -72,8 +91,10 @@ class Metric:
 
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
-        if not name.replace("_", "").isalnum():
-            raise ValueError(f"invalid metric name {name!r}")
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"invalid metric name {name!r}: must match "
+                f"^[a-z][a-z0-9_]*$ (Prometheus-exportable)")
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
@@ -149,6 +170,33 @@ class Histogram(Metric):
             c = base + (("_stat", "count"),)
             self._values[s] = self._values.get(s, 0.0) + value
             self._values[c] = self._values.get(c, 0.0) + 1
+
+    def _dump(self) -> dict:
+        # Emit EVERY configured boundary (zero-filled) plus +Inf and
+        # sum/count per tag-set: observe() only touches buckets whose
+        # bound >= value, so a raw dump omits the low zero-count
+        # buckets and Prometheus histogram_quantile then works on an
+        # incomplete cumulative series.  A never-observed histogram
+        # still emits one all-zero series under its default tags so the
+        # full bucket layout is visible from registration time.
+        with self._lock:
+            bases = {tuple(t for t in k
+                           if t[0] not in ("le", "_stat"))
+                     for k in self._values}
+            if not bases:
+                bases = {self._key(None)}
+            values = []
+            for base in sorted(bases):
+                for b in self.boundaries:
+                    k = base + (("le", str(b)),)
+                    values.append((list(k), self._values.get(k, 0.0)))
+                for suffix in (("le", "+Inf"), ("_stat", "sum"),
+                               ("_stat", "count")):
+                    k = base + (suffix,)
+                    values.append((list(k), self._values.get(k, 0.0)))
+            return {"kind": self.kind, "desc": self.description,
+                    "boundaries": list(self.boundaries),
+                    "values": values}
 
 
 def collect_cluster_metrics(kv_get, kv_keys, max_age_s: float = 60.0

@@ -81,6 +81,50 @@ def is_enabled() -> bool:
     return _enabled
 
 
+def reset_tracing() -> None:
+    """Clear all tracing state in this process: the fallback span list,
+    the in-memory OTel exporter, and the enabled flag/mode — so tests
+    sharing one process don't leak spans or the enabled bit into each
+    other (test fixtures call this after every test).
+
+    OTel caveat: the global TracerProvider can't drop an added
+    SpanProcessor, so after a reset a re-enable under the otel backend
+    attaches a fresh in-memory exporter and the stale processor keeps
+    exporting into the cleared (now unreferenced) one — harmless."""
+    global _enabled, _mode, _memory_spans
+    with _fallback_lock:
+        _fallback_spans.clear()
+    if _memory_spans is not None:
+        try:
+            _memory_spans.clear()
+        except Exception:  # noqa: BLE001 - exporter already shut down
+            pass
+    _memory_spans = None
+    _enabled = False
+    _mode = ""
+
+
+def record_span(name: str, trace_id: Optional[str] = None,
+                parent_id: Optional[str] = None):
+    """Record one standalone span event and return its identity as a
+    ``(trace_id, span_id)`` pair (None when tracing is off).  The serve
+    engine telemetry uses this to link a request's root span to the
+    engine-side work span: pass the returned pair back as
+    ``trace_id``/``parent_id`` to record a child."""
+    if not _enabled:
+        return None
+    if _mode == "otel":
+        from opentelemetry import trace
+
+        tracer = trace.get_tracer("ray_tpu")
+        with tracer.start_as_current_span(name) as span:
+            ctx = span.get_span_context()
+        return (format(ctx.trace_id, "032x"),
+                format(ctx.span_id, "016x"))
+    tid = trace_id or uuid.uuid4().hex
+    return (tid, _record(name, tid, parent_id))
+
+
 def recorded_spans() -> List[Any]:
     if _mode == "otel" and _memory_spans is not None:
         return list(_memory_spans.get_finished_spans())

@@ -15,7 +15,8 @@ Decode variants: {"mode": "decode", ...} routes the entry to
 bench.time_decode instead — batch is the TOTAL decode batch (the
 decode path is single-device), "seq"/"prompt_len" sets the prompt
 length, "new_tokens" the generated tokens; the SWEEPJSON record
-carries prefill_ttft_ms + decode_tok_s.  E.g.:
+carries prefill_ttft_ms + decode_tok_s plus an "engine" sub-dict of
+p50/p95 TTFT and inter-token percentiles from engine_stats().  E.g.:
 
   python sweep_tpu.py '[[8, {"mode": "decode"}],
                         [16, {"mode": "decode", "flash_resident": "on"}]]'
@@ -63,16 +64,30 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout):
                        "new_tokens": new_tokens, "preset": preset,
                        "overrides": kw}
             try:
-                ttft_ms, tok_s = time_decode(
+                ttft_ms, tok_s, stats = time_decode(
                     batch_per_chip, prompt_len=prompt_len,
                     new_tokens=new_tokens, preset=preset, **kw)
                 print(f"decode batch={batch_per_chip} "
                       f"prompt={prompt_len} new={new_tokens} {kw}: "
                       f"TTFT={ttft_ms:.2f}ms  {tok_s:,.0f} tok/s",
                       file=out, flush=True)
+
+                def _r(v, nd=2):
+                    return None if v is None else round(v, nd)
+
                 rec = {"sweep": variant,
                        "prefill_ttft_ms": round(ttft_ms, 2),
-                       "decode_tok_s": round(tok_s, 1)}
+                       "decode_tok_s": round(tok_s, 1),
+                       # percentiles from the serve engine_stats() path
+                       "engine": {
+                           "ttft_p50_ms": _r(stats["ttft_ms"]["p50"]),
+                           "ttft_p95_ms": _r(stats["ttft_ms"]["p95"]),
+                           "inter_token_p50_ms":
+                               _r(stats["inter_token_ms"]["p50"], 3),
+                           "inter_token_p95_ms":
+                               _r(stats["inter_token_ms"]["p95"], 3),
+                           "tokens_per_sec":
+                               _r(stats["tokens_per_sec"], 1)}}
             except Exception as e:
                 print(f"decode batch={batch_per_chip} "
                       f"prompt={prompt_len} {kw}: FAILED "

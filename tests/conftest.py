@@ -46,3 +46,15 @@ def ray_start_shared():
     ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024 * 1024)
     yield
     ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _tracing_isolation():
+    """Reset util.tracing after every test: the fallback span list and
+    the enabled flag are process globals, so without this a test that
+    calls enable_tracing() leaks spans (and the enabled bit) into every
+    later test in the same process."""
+    yield
+    from ray_tpu.util import tracing
+
+    tracing.reset_tracing()

@@ -1,0 +1,205 @@
+"""Engine/train telemetry unit tests: deterministic-clock lifecycle
+stats, chrome-trace timeline lanes, train-step instrumentation, and the
+tracing span linkage — all host-side (no cluster, no devices).
+"""
+
+import json
+
+import pytest
+
+from ray_tpu._private import telemetry as core
+from ray_tpu.serve.telemetry import EngineTelemetry
+from ray_tpu.train.telemetry import (TrainTelemetry,
+                                     instrument_train_step)
+
+pytestmark = pytest.mark.fast
+
+
+def _run_two_requests(tel):
+    """Two requests through a 2-slot engine on a fake clock: req a
+    (queued 10ms, prefill 40ms, 3 decode steps) and req b (queued 30ms,
+    prefill 20ms, finishes earlier)."""
+    a = tel.record_enqueue(5, now=0.000)
+    b = tel.record_enqueue(7, now=0.005)
+    tel.record_admit(a, slot=0, bucket=8, now=0.010)
+    tel.record_first_token(a, now=0.050)
+    tel.record_admit(b, slot=1, bucket=8, now=0.035)
+    tel.record_first_token(b, now=0.055)
+    tel.record_step(2, 0.010, now=0.065)
+    tel.record_step(2, 0.010, now=0.075)
+    tel.record_finish(b, n_tokens=3, now=0.075)
+    tel.record_step(1, 0.010, now=0.085)
+    tel.record_finish(a, n_tokens=4, now=0.085)
+    return a, b
+
+
+def test_engine_stats_deterministic_clock():
+    tel = EngineTelemetry("t_unit", max_slots=2)
+    _run_two_requests(tel)
+    # a rejected request retires without ever being admitted
+    r = tel.record_enqueue(999, now=0.090)
+    tel.record_reject(r, reason="prompt length 999", now=0.090)
+
+    s = tel.engine_stats()
+    assert s["deployment"] == "t_unit"
+    assert s["requests"] == {"enqueued": 3, "admitted": 2,
+                             "finished": 2, "rejected": 1, "errors": 0,
+                             "active": 0, "queued": 0}
+    # queue waits: a=10ms, b=30ms (nearest-rank p50 of 2 = lower value)
+    assert s["queue_wait_ms"]["count"] == 2
+    assert s["queue_wait_ms"]["p50"] == pytest.approx(10.0)
+    assert s["queue_wait_ms"]["max"] == pytest.approx(30.0)
+    # TTFT is enqueue->first_token: a=50ms, b=50ms
+    assert s["ttft_ms"]["count"] == 2
+    assert s["ttft_ms"]["p50"] == pytest.approx(50.0)
+    assert s["ttft_ms"]["p50"] <= s["ttft_ms"]["p95"]
+    # latencies: b=70ms, a=85ms
+    assert s["request_latency_ms"]["count"] == 2
+    assert s["request_latency_ms"]["max"] == pytest.approx(85.0)
+    assert s["engine_steps"] == 3
+    assert s["tokens_generated"] == 5          # 2 + 2 + 1 slot-tokens
+    assert s["inter_token_ms"]["p50"] == pytest.approx(10.0)
+    assert s["max_active_slots"] == 2
+    # busy 50ms over 3 steps * 2 slots * 10ms = 60 slot-ms of capacity
+    assert s["slot_utilization"] == pytest.approx(50.0 / 60.0, abs=1e-3)
+    assert s["prefill_buckets"] == {"8": 2}
+    assert s["prefill_compiles"] == 1
+
+
+def test_engine_stats_empty_shape_is_stable():
+    s = EngineTelemetry("t_empty", max_slots=4).engine_stats()
+    assert s["requests"]["enqueued"] == 0
+    for block in ("ttft_ms", "queue_wait_ms", "request_latency_ms",
+                  "inter_token_ms"):
+        assert s[block] == {"count": 0, "mean": None, "p50": None,
+                            "p95": None, "p99": None, "max": None}
+    assert s["tokens_per_sec"] == 0.0
+    assert s["slot_utilization"] == 0.0
+
+
+def test_timeline_export_lanes_and_spans(tmp_path):
+    tel = EngineTelemetry("t_trace", max_slots=2)
+    _run_two_requests(tel)
+    path = tmp_path / "trace.json"
+    events = tel.export_timeline(str(path))
+    assert json.loads(path.read_text()) == events   # valid JSON dump
+
+    names = {e["args"]["name"] for e in events
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert names == {"queue", "slot 0", "slot 1", "engine steps"}
+    procs = [e for e in events if e["name"] == "process_name"]
+    assert procs[0]["args"]["name"] == "llm-engine t_trace"
+
+    spans = {e["name"]: e for e in events if e.get("ph") == "X"}
+    # request a: queued on lane 0 for 10ms, prefill+decode on slot 0
+    assert spans["queued req0"]["tid"] == 0
+    assert spans["queued req0"]["dur"] == pytest.approx(10_000)   # µs
+    assert spans["prefill req0"]["tid"] == 1
+    assert spans["prefill req0"]["dur"] == pytest.approx(40_000)
+    assert spans["decode req0"]["tid"] == 1
+    assert spans["decode req1"]["tid"] == 2
+    # pooled steps land on the dedicated last lane
+    step_events = [e for e in events
+                   if e.get("ph") == "X" and e["name"] == "engine_step"]
+    assert len(step_events) == 3
+    assert {e["tid"] for e in step_events} == {3}
+    assert all(e["dur"] == pytest.approx(10_000) for e in step_events)
+
+
+def test_summarize_and_percentile():
+    assert core.summarize([]) == {"count": 0, "mean": None, "p50": None,
+                                  "p95": None, "p99": None, "max": None}
+    vals = list(range(1, 101))                  # 1..100
+    s = core.summarize(vals)
+    assert s["count"] == 100 and s["max"] == 100.0
+    assert s["p50"] == 50.0 and s["p95"] == 95.0 and s["p99"] == 99.0
+    # nearest-rank never interpolates: a 3-sample series reports an
+    # actual observation
+    assert core.percentile([1.0, 2.0, 3.0], 95) == 3.0
+    with pytest.raises(ValueError):
+        core.percentile([], 50)
+
+
+def test_instrument_train_step_counts_compiles_and_steps():
+    import numpy as np
+
+    calls = []
+
+    def step(params, opt_state, batch):
+        calls.append(batch.shape)
+        return params, opt_state, 0.0
+
+    tel = TrainTelemetry("t_train")
+    wrapped = instrument_train_step(step, telemetry=tel)
+    b8 = np.zeros((8, 4), np.float32)
+    b16 = np.zeros((16, 4), np.float32)
+    for _ in range(3):
+        wrapped(None, None, b8)
+    wrapped(None, None, b16)
+    wrapped(None, None, b16)
+
+    s = tel.stats()
+    assert s["steps"] == 5 and len(calls) == 5
+    # two distinct batch signatures -> exactly two compile events
+    assert s["compiles"] == 2
+    assert s["examples"] == 3 * 8 + 2 * 16
+    assert s["step_time_ms"]["count"] == 5
+    assert s["step_time_ms"]["p50"] is not None
+    assert wrapped.__wrapped__ is step
+    assert wrapped.telemetry is tel
+
+
+def test_record_span_links_and_reset():
+    from ray_tpu.util import tracing
+
+    assert tracing.record_span("off") is None   # disabled -> no-op
+    tracing.enable_tracing()
+    try:
+        root = tracing.record_span("serve d.request")
+        assert root is not None
+        trace_id, span_id = root
+        child = tracing.record_span("engine d.generate",
+                                    trace_id=trace_id,
+                                    parent_id=span_id)
+        assert child is not None
+        spans = tracing.recorded_spans()
+        assert len(spans) >= 2
+        if tracing._mode == "fallback":
+            assert child[0] == trace_id          # same trace
+            by_name = {s.name: s for s in spans}
+            assert by_name["engine d.generate"].parent_id == span_id
+    finally:
+        tracing.reset_tracing()
+    assert not tracing.is_enabled()
+    assert tracing.recorded_spans() == []
+
+
+def test_engine_telemetry_traces_request_lifecycle():
+    from ray_tpu.util import tracing
+
+    tracing.enable_tracing()
+    try:
+        tel = EngineTelemetry("t_traced", max_slots=1)
+        rec = tel.record_enqueue(4, now=0.0)
+        assert rec["trace"] is not None
+        tel.record_admit(rec, slot=0, bucket=4, now=0.001)
+        tel.record_first_token(rec, now=0.002)
+        tel.record_finish(rec, n_tokens=2, now=0.003)
+        names = [getattr(s, "name", "") for s in
+                 tracing.recorded_spans()]
+        assert any("t_traced.request" in n for n in names)
+        assert any("t_traced.generate" in n for n in names)
+    finally:
+        tracing.reset_tracing()
+
+
+def test_metric_singletons_no_duplicate_warning():
+    # constructing many telemetry instances must not re-register
+    # metric names (the registry would warn)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        for i in range(3):
+            EngineTelemetry(f"t_dup{i}", max_slots=1)
+            TrainTelemetry(f"t_dup{i}")

@@ -1,0 +1,112 @@
+"""Golden test for the Prometheus exposition path: registry snapshot →
+msgpack KV blob → collect_cluster_metrics text lines, exactly what the
+dashboard /metrics endpoint serves.  No cluster: the KV store is a dict.
+"""
+
+import re
+import time
+
+import pytest
+
+from ray_tpu.util import metrics
+
+pytestmark = pytest.mark.fast
+
+
+def _exposition(snapshot):
+    """Round-trip a registry snapshot through the same msgpack blob +
+    collector the dashboard uses."""
+    import msgpack
+
+    kv = {"metrics:promgoldworker01": msgpack.packb(
+        {"ts": time.time(), "metrics": snapshot})}
+
+    def kv_get(key):
+        return kv.get(key)
+
+    def kv_keys(prefix):
+        return [k for k in kv if k.startswith(prefix)]
+
+    return metrics.collect_cluster_metrics(kv_get, kv_keys)
+
+
+def test_prometheus_exposition_histogram_golden():
+    bounds = [1.0, 5.0, 25.0, 100.0]
+    h = metrics.Histogram("prom_gold_latency_ms", "golden latency",
+                          boundaries=bounds, tag_keys=("route",))
+    h.observe(3.0, tags={"route": "/a"})     # lands in le=5 and up
+    h.observe(3.0, tags={"route": "/a"})
+    h.observe(60.0, tags={"route": "/a"})    # lands in le=100 only
+    h.observe(500.0, tags={"route": "/a"})   # +Inf only
+    c = metrics.Counter("prom_gold_reqs_total", "golden requests")
+    c.inc(7)
+
+    lines = _exposition(metrics._registry.snapshot())
+    text = "\n".join(lines)
+    full = "raytpu_app_prom_gold_latency_ms"
+
+    # exactly one HELP/TYPE pair per metric, typed correctly
+    assert text.count(f"# HELP {full} ") == 1
+    assert text.count(f"# TYPE {full} histogram") == 1
+    assert text.count("# TYPE raytpu_app_prom_gold_reqs_total "
+                      "counter") == 1
+
+    # every configured boundary appears as a _bucket series — including
+    # le="1.0", which NO observation touched (zero-filled) — plus +Inf
+    def bucket(le):
+        m = re.search(
+            rf'{full}_bucket{{([^}}]*)le="{re.escape(le)}"([^}}]*)}} '
+            rf'([0-9.]+)', text)
+        assert m, f"missing bucket le={le}:\n{text}"
+        return float(m.group(3))
+
+    series = [bucket(str(b)) for b in bounds] + [bucket("+Inf")]
+    assert series == [0.0, 2.0, 2.0, 3.0, 4.0]
+    # cumulative: counts never decrease along the boundary order
+    assert series == sorted(series)
+
+    # _sum / _count series present with the right totals
+    m = re.search(rf"{full}_sum{{[^}}]*}} ([0-9.]+)", text)
+    assert m and float(m.group(1)) == pytest.approx(566.0)
+    m = re.search(rf"{full}_count{{[^}}]*}} ([0-9.]+)", text)
+    assert m and float(m.group(1)) == 4.0
+    # worker + tag labels ride every series
+    count_line = next(line for line in lines
+                      if line.startswith(f"{full}_count{{"))
+    assert 'worker="promgoldwork"' in count_line
+    assert 'route="/a"' in count_line
+
+
+def test_prometheus_exposition_zero_observation_histogram():
+    bounds = [0.5, 2.0]
+    metrics.Histogram("prom_gold_empty_ms", "never observed",
+                      boundaries=bounds)
+    lines = _exposition(metrics._registry.snapshot())
+    text = "\n".join(lines)
+    full = "raytpu_app_prom_gold_empty_ms"
+    # a never-observed histogram still exposes its FULL bucket layout,
+    # all zero, so histogram_quantile works from registration time
+    for le in ("0.5", "2.0", "+Inf"):
+        m = re.search(
+            rf'{full}_bucket{{[^}}]*le="{re.escape(le)}"[^}}]*}} '
+            rf'([0-9.]+)', text)
+        assert m and float(m.group(1)) == 0.0, f"le={le}\n{text}"
+    assert re.search(rf"{full}_sum{{[^}}]*}} 0.0", text)
+    assert re.search(rf"{full}_count{{[^}}]*}} 0.0", text)
+
+
+def test_histogram_dump_emits_all_boundaries_per_tagset():
+    h = metrics.Histogram("prom_gold_multi_ms", "two tag sets",
+                          boundaries=[1.0, 10.0], tag_keys=("k",))
+    h.observe(0.5, tags={"k": "x"})
+    h.observe(100.0, tags={"k": "y"})        # only +Inf for y
+    dump = h._dump()
+    assert dump["boundaries"] == [1.0, 10.0]
+    by_key = {tuple(map(tuple, k)): v for k, v in dump["values"]}
+    # per tag set: every boundary + Inf + sum + count = 5 entries
+    assert len(by_key) == 2 * 5
+    assert by_key[(("k", "x"), ("le", "1.0"))] == 1.0
+    assert by_key[(("k", "y"), ("le", "1.0"))] == 0.0     # zero-filled
+    assert by_key[(("k", "y"), ("le", "10.0"))] == 0.0
+    assert by_key[(("k", "y"), ("le", "+Inf"))] == 1.0
+    assert by_key[(("k", "y"), ("_stat", "count"))] == 1.0

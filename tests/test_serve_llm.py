@@ -122,11 +122,14 @@ def test_llm_deployment_ragged_batch(ray_start_shared):
         serve.shutdown()
 
 
-def test_llm_deployment_continuous_two_waves(ray_start_shared):
+def test_llm_deployment_continuous_two_waves(ray_start_shared,
+                                             tmp_path):
     # acceptance: >= 16 ragged requests in two waves through a slot
     # pool SMALLER than the request count; the second wave is admitted
     # mid-flight as first-wave slots free; every continuation matches
     # the single-request reference
+    import json
+
     import jax.numpy as jnp
 
     from ray_tpu.serve import build_llm_deployment
@@ -152,6 +155,55 @@ def test_llm_deployment_continuous_two_waves(ray_start_shared):
             assert o.shape == (len(p) + new,)
             np.testing.assert_array_equal(o[:len(p)], p)
             np.testing.assert_array_equal(o, r)
+
+        # --- engine telemetry over the same run -------------------
+        stats = ray_tpu.get(handle.method("engine_stats").remote(),
+                            timeout=60)
+        assert stats["requests"]["enqueued"] == 16
+        assert stats["requests"]["admitted"] == 16
+        assert stats["requests"]["finished"] == 16
+        assert stats["requests"]["rejected"] == 0
+        assert stats["requests"]["active"] == 0
+        # 16 requests through 3 slots: the pool MUST have run >1 slot
+        # concurrently for the continuous scheduler to be doing its job
+        assert stats["max_active_slots"] >= 2
+        assert stats["max_slots"] == 3
+        assert stats["ttft_ms"]["count"] == 16
+        assert stats["queue_wait_ms"]["count"] == 16
+        assert stats["ttft_ms"]["p50"] <= stats["ttft_ms"]["p95"]
+        assert stats["request_latency_ms"]["count"] == 16
+        assert stats["engine_steps"] > 0
+        assert stats["tokens_generated"] > 0
+        # every prompt fits one prefill_bucket=8 bucket (max len 9 -> 16)
+        assert sum(stats["prefill_buckets"].values()) == 16
+        assert stats["prefill_compiles"] == len(stats["prefill_buckets"])
+
+        # Prometheus-side histograms populated on the replica
+        snap = ray_tpu.get(handle.method("metrics_snapshot").remote(),
+                           timeout=60)
+        for hist in ("serve_ttft_ms", "serve_queue_wait_ms"):
+            vals = dict((tuple(map(tuple, k)), v)
+                        for k, v in snap[hist]["values"])
+            counts = [v for k, v in vals.items()
+                      if ("_stat", "count") in k]
+            assert counts and sum(counts) >= 16
+
+        # chrome-trace timeline: valid JSON, per-slot lanes with spans
+        trace_path = tmp_path / "engine_trace.json"
+        ray_tpu.get(handle.method("export_timeline").remote(
+            str(trace_path)), timeout=60)
+        events = json.loads(trace_path.read_text())
+        lanes = {e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert {"queue", "slot 0", "slot 1", "slot 2",
+                "engine steps"} <= lanes
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert all(e["dur"] >= 0 and "ts" in e for e in spans)
+        slot_lanes_used = {e["tid"] for e in spans
+                          if e["name"].startswith(("prefill", "decode"))}
+        assert len(slot_lanes_used) >= 2       # >1 slot lane occupied
+        assert any(e["name"] == "engine_step" for e in spans)
+        assert sum(e["name"].startswith("decode") for e in spans) == 16
     finally:
         serve.shutdown()
 
