@@ -2,8 +2,9 @@
 implementations used on CPU and as numerics oracles in tests.
 
 Every pallas kernel exported here must have an interpret-mode test
-module under tests/ (enforced by tests/test_ops_kernel_guard.py) so
-numerics stay CPU-verifiable without the TPU tunnel.
+module under tests/ (enforced by graftcheck's pallas-interpret-test
+and kernel-exports rules — see docs/static-analysis.md) so numerics
+stay CPU-verifiable without the TPU tunnel.
 """
 
 from ray_tpu.ops.attention import causal_attention, reference_attention

@@ -1,0 +1,3 @@
+"""Developer tooling shipped with the package (static analysis,
+auditing).  Nothing here runs on the hot path; tools import lazily so
+``import ray_tpu`` stays cheap."""

@@ -1,0 +1,76 @@
+"""graftcheck: static enforcement of the TPU hot-path invariants.
+
+Two engines, one report:
+
+* the **jaxpr auditor** (:mod:`.jaxpr_audit` + :mod:`.programs`)
+  traces the canonical jitted programs — train step for both model
+  families, ragged prefill, pooled decode, fused-CE fwd/bwd — and
+  proves no host transfer, no f64, no materialized logits buffer, no
+  length-T0 prefill scan, donation actually applied, and a peak-HBM
+  estimate within each program's declared budget;
+* the **repo linter** (:mod:`.lint`) walks ``ray_tpu/`` with stdlib
+  ``ast`` for the host-side habits that erode those invariants
+  (blocking calls on the async serve path, wall-clock telemetry,
+  mutable module state under ``@remote``, invalid metric names,
+  untested pallas kernels).
+
+Run both with ``python -m ray_tpu.tools.graftcheck`` (exit 0 iff
+clean; ``--format json`` for the machine-readable report).  Waive a
+finding with ``# graftcheck: disable=<rule>`` — see
+docs/static-analysis.md for the rule catalog.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict
+
+from ray_tpu.tools.graftcheck.core import (Violation, make_report,
+                                           parse_suppressions,
+                                           render_text,
+                                           split_suppressed)
+from ray_tpu.tools.graftcheck.jaxpr_audit import (ProgramSpec,
+                                                  audit_program,
+                                                  audit_programs,
+                                                  collect_shapes,
+                                                  estimate_peak_bytes,
+                                                  iter_eqns,
+                                                  logits_sized_shapes,
+                                                  scan_lengths)
+from ray_tpu.tools.graftcheck.lint import (lint_repo, lint_source,
+                                           pallas_modules)
+
+__all__ = [
+    "Violation", "ProgramSpec", "run_repo_check", "make_report",
+    "render_text", "parse_suppressions", "split_suppressed",
+    "audit_program", "audit_programs", "iter_eqns", "collect_shapes",
+    "scan_lengths", "logits_sized_shapes", "estimate_peak_bytes",
+    "lint_repo", "lint_source", "pallas_modules",
+]
+
+
+def run_repo_check(root=None, *, skip_jaxpr: bool = False,
+                   skip_lint: bool = False) -> Dict[str, Any]:
+    """Run both engines over the repo at ``root`` (defaults to the
+    checkout containing this package) and return the combined report
+    dict (see :func:`core.make_report`).  ``report["ok"]`` is the CLI
+    exit status; tier-1 asserts it on every run."""
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[3]
+    root = pathlib.Path(root)
+    violations = []
+    suppressed = 0
+    files_scanned = 0
+    infos: Dict[str, Dict[str, Any]] = {}
+    if not skip_lint:
+        lint_violations, stats = lint_repo(root)
+        violations.extend(lint_violations)
+        suppressed += stats["suppressed"]
+        files_scanned = stats["files"]
+    if not skip_jaxpr:
+        from ray_tpu.tools.graftcheck.programs import default_programs
+
+        jaxpr_violations, infos = audit_programs(default_programs())
+        violations.extend(jaxpr_violations)
+    return make_report(violations, suppressed=suppressed,
+                       files_scanned=files_scanned, programs=infos)

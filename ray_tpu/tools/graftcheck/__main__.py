@@ -1,0 +1,49 @@
+"""CLI driver: ``python -m ray_tpu.tools.graftcheck``.
+
+Exit status 0 iff no un-suppressed violation was found, so the
+command drops straight into CI.  ``--format json`` prints the full
+machine-readable report (the same dict ``run_repo_check`` returns);
+``sweep_tpu.py`` embeds its summary in a SWEEPJSON line per sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.graftcheck",
+        description="Audit traced hot-path programs and lint the repo "
+                    "for TPU hot-path invariant violations.")
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root to scan (default: the checkout containing "
+             "the ray_tpu package)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (default: text)")
+    parser.add_argument(
+        "--skip-jaxpr", action="store_true",
+        help="skip the jaxpr auditor (lint only; no jax tracing)")
+    parser.add_argument(
+        "--skip-lint", action="store_true",
+        help="skip the repo linter (jaxpr programs only)")
+    args = parser.parse_args(argv)
+
+    from ray_tpu.tools.graftcheck import render_text, run_repo_check
+
+    report = run_repo_check(args.root, skip_jaxpr=args.skip_jaxpr,
+                            skip_lint=args.skip_lint)
+    if args.format == "json":
+        json.dump(report, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(render_text(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

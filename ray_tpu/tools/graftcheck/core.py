@@ -1,0 +1,132 @@
+"""graftcheck shared machinery: violations, suppressions, reports.
+
+A check (jaxpr rule or lint rule) produces :class:`Violation` records;
+the driver filters them through per-line ``# graftcheck:
+disable=<rule>[,<rule>...]`` suppressions and assembles one report that
+both the text renderer and ``--format json`` consume.  Suppression is
+deliberate and visible: a disable comment on the offending line (or on
+a standalone comment line directly above it) names the rule it waives,
+so every waiver is grep-able and reviewable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Set
+
+#: ``# graftcheck: disable=rule-a,rule-b`` (anywhere in a line)
+_DISABLE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable=([a-z0-9,\-\s]+)", re.IGNORECASE)
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant breach, from either engine."""
+
+    rule: str
+    message: str
+    file: Optional[str] = None     # repo-relative path (lint)
+    line: Optional[int] = None
+    program: Optional[str] = None  # audited program name (jaxpr)
+
+    def location(self) -> str:
+        if self.file is not None:
+            where = self.file
+            if self.line is not None:
+                where += f":{self.line}"
+            return where
+        return f"<jaxpr:{self.program}>" if self.program else "<repo>"
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"rule": self.rule, "message": self.message}
+        if self.file is not None:
+            out["file"] = self.file
+        if self.line is not None:
+            out["line"] = self.line
+        if self.program is not None:
+            out["program"] = self.program
+        return out
+
+    def __str__(self) -> str:
+        return f"{self.location()}: [{self.rule}] {self.message}"
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """1-based line -> set of rule ids disabled on that line.
+
+    A disable comment sharing a line with code covers that line; a
+    standalone comment line covers itself AND the next line, so wrapped
+    statements can carry the waiver above them."""
+    out: Dict[int, Set[str]] = {}
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _DISABLE_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(lineno, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            out.setdefault(lineno + 1, set()).update(rules)
+    return out
+
+
+def is_suppressed(v: Violation,
+                  suppressions: Dict[int, Set[str]]) -> bool:
+    if v.line is None:
+        return False
+    rules = suppressions.get(v.line, ())
+    return v.rule in rules or "all" in rules
+
+
+def split_suppressed(violations: List[Violation],
+                     suppressions: Dict[int, Set[str]]):
+    """(kept, suppressed) partition of one file's violations."""
+    kept, dropped = [], []
+    for v in violations:
+        (dropped if is_suppressed(v, suppressions) else kept).append(v)
+    return kept, dropped
+
+
+def make_report(violations: List[Violation], *,
+                suppressed: int = 0,
+                files_scanned: int = 0,
+                programs: Optional[Dict[str, Dict[str, Any]]] = None
+                ) -> Dict[str, Any]:
+    """The machine-readable report (``--format json`` emits exactly
+    this; ``sweep_tpu.py`` summarizes it into SWEEPJSON lines)."""
+    return {
+        "ok": not violations,
+        "violations": [v.to_dict() for v in violations],
+        "summary": {
+            "n_violations": len(violations),
+            "n_suppressed": suppressed,
+            "files_scanned": files_scanned,
+            "rules_failed": sorted({v.rule for v in violations}),
+        },
+        "programs": programs or {},
+    }
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    for v in report["violations"]:
+        where = v.get("file") or f"<jaxpr:{v.get('program', '?')}>"
+        if v.get("line") is not None:
+            where += f":{v['line']}"
+        lines.append(f"{where}: [{v['rule']}] {v['message']}")
+    s = report["summary"]
+    for name, info in sorted(report["programs"].items()):
+        budget = info.get("hbm_budget_bytes")
+        peak = info.get("peak_hbm_bytes")
+        extra = ""
+        if peak is not None:
+            extra = f"  peak_hbm={peak / 2**20:.2f}MiB"
+            if budget:
+                extra += f" / budget={budget / 2**20:.2f}MiB"
+        lines.append(f"audited {name}: {info.get('eqns', '?')} eqns"
+                     + extra)
+    lines.append(
+        f"graftcheck: {s['n_violations']} violation(s), "
+        f"{s['n_suppressed']} suppressed, {s['files_scanned']} files, "
+        f"{len(report['programs'])} programs audited")
+    return "\n".join(lines)

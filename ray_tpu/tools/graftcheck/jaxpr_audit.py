@@ -1,0 +1,367 @@
+"""The jaxpr auditor: static rules over traced hot-path programs.
+
+``jax.make_jaxpr`` gives the exact program XLA will see — so instead of
+hoping a review catches a host callback, a stray float64, or a
+rematerialized ``(B*T, V)`` logits buffer, we trace each canonical
+program (``programs.py``) and walk its equations.  The rules here grew
+out of real regressions measured on the live chip (PERF_NOTES rounds
+3-7) and out of the one-off jaxpr asserts the test suite carried
+before this module existed (``tests/test_fused_ce.py``,
+``tests/test_decode_prefill.py`` — both now call the shared helpers
+below, so each invariant lives in exactly one place).
+
+Rules (ids as reported / suppressed):
+
+* ``host-transfer`` — no callback / infeed / outfeed primitives inside
+  a jitted program: each one is a device->host fence that stalls the
+  async dispatch pipeline.
+* ``f64`` — no float64/complex128 intermediate anywhere: one doubles
+  HBM and runs the VPU at a fraction of rate (TPUs have no f64 units).
+* ``f32-matmul`` — large matmuls must feed the MXU bf16 operands
+  (f32 accumulation via ``preferred_element_type`` is the sanctioned
+  pattern); an f32xf32 ``dot_general`` above the size threshold runs
+  ~3x slower via multi-pass unless the program whitelists it.
+* ``logits-buffer`` — no buffer of ``(..., padded_vocab)`` covering >=
+  n_tokens rows may appear (fwd or bwd): the fused/streaming CE paths
+  exist precisely to keep the (B*T, V) f32 tensor out of HBM.
+* ``t0-scan`` — prefill must not scan over the prompt length: a
+  length-T0 scan is the one-dispatch-per-token regression.
+* ``donation`` — buffers we claim to donate must actually alias an
+  output in the lowered program (``tf.aliasing_output``); silently
+  dropped donation doubles parameter+optimizer HBM.
+* ``hbm-budget`` — a liveness-based peak-bytes estimate of the traced
+  program checked against the budget the program declares.
+
+The estimator is conservative-but-approximate: it walks the flattened
+equation list with last-use liveness and adds each inner jaxpr's own
+peak on top of the bytes live at its call site.  It exists to catch
+order-of-magnitude blowups (an accidental dense logits buffer is ~100x
+a nano budget), not to referee 10% regressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.tools.graftcheck.core import Violation
+
+#: primitive names that move data or control to the host mid-program
+HOST_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "python_callback",
+    "callback", "host_callback_call", "infeed", "outfeed",
+})
+
+#: f32xf32 dot_generals at or above this many elements (largest
+#: operand) are flagged; below it the MXU penalty is noise
+F32_MATMUL_MIN_ELEMENTS = 1 << 16
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking helpers (shared with the test suite)
+# ---------------------------------------------------------------------------
+
+def _sub_jaxprs(val):
+    """Yield every jaxpr hiding in one eqn param value (ClosedJaxpr,
+    raw Jaxpr, or lists/tuples of either — pjit/scan carry one, cond a
+    tuple)."""
+    if hasattr(val, "jaxpr") and hasattr(getattr(val, "jaxpr"), "eqns"):
+        yield val.jaxpr                      # ClosedJaxpr
+    elif hasattr(val, "eqns"):
+        yield val                            # raw Jaxpr
+    elif isinstance(val, (list, tuple)):
+        for item in val:
+            yield from _sub_jaxprs(item)
+
+
+def iter_eqns(jaxpr):
+    """Depth-first generator over every equation in ``jaxpr`` and every
+    nested jaxpr (pjit bodies, scan bodies, cond branches, custom-vjp
+    calls, pallas kernels...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for inner in _sub_jaxprs(val):
+                yield from iter_eqns(inner)
+
+
+def collect_shapes(jaxpr) -> List[Tuple[tuple, str]]:
+    """(shape, dtype-str) of every in/out aval of every deep equation."""
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            if aval is not None and getattr(aval, "shape", None) is not None:
+                out.append((tuple(aval.shape),
+                            str(getattr(aval, "dtype", ""))))
+    return out
+
+
+def scan_lengths(jaxpr) -> List[int]:
+    """``length`` param of every scan primitive anywhere in the jaxpr
+    (the shared form of tests/test_decode_prefill.py's walker)."""
+    return [eqn.params["length"] for eqn in iter_eqns(jaxpr)
+            if eqn.primitive.name == "scan"]
+
+
+def logits_sized_shapes(fn, args, n_tokens: int,
+                        padded_vocab: int) -> List[tuple]:
+    """Shapes in ``jax.make_jaxpr(fn)(*args)`` whose trailing dim is
+    ``padded_vocab`` and whose leading dims cover >= ``n_tokens`` rows —
+    i.e. (B, T, V)/(B*T, V) logits-class buffers.  The shared form of
+    tests/test_fused_ce.py's detector."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    return [s for s, _dt in collect_shapes(closed.jaxpr)
+            if len(s) >= 2 and s[-1] == padded_vocab
+            and math.prod(s[:-1]) >= n_tokens]
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    itemsize = getattr(dtype, "itemsize", 4)
+    n = 1
+    for d in (shape or ()):
+        n *= int(d)
+    return n * itemsize
+
+
+def estimate_peak_bytes(jaxpr) -> int:
+    """Liveness-based peak-bytes estimate of one jaxpr.
+
+    Linear walk with last-use refcounts over the top-level equations;
+    each inner jaxpr contributes its own recursive peak (minus its
+    inputs, which are already live at the call site).  Scan bodies run
+    per-iteration, so their internal peak — not length x peak — is the
+    right charge."""
+    eqns = list(jaxpr.eqns)
+    last_use: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):          # skip Literals
+                last_use[v] = i
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            last_use[v] = len(eqns)            # outputs live to the end
+    live: Dict[Any, int] = {}
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        live[v] = _aval_bytes(getattr(v, "aval", None))
+    cur = sum(live.values())
+    peak = cur
+    for i, eqn in enumerate(eqns):
+        inner_extra = 0
+        for val in eqn.params.values():
+            for inner in _sub_jaxprs(val):
+                inner_inputs = sum(
+                    _aval_bytes(getattr(v, "aval", None))
+                    for v in list(inner.invars) + list(inner.constvars))
+                inner_extra = max(
+                    inner_extra,
+                    estimate_peak_bytes(inner) - inner_inputs)
+        for v in eqn.outvars:
+            if v not in live:
+                b = _aval_bytes(getattr(v, "aval", None))
+                live[v] = b
+                cur += b
+        peak = max(peak, cur + max(0, inner_extra))
+        for v in eqn.invars:
+            if hasattr(v, "val"):
+                continue
+            if last_use.get(v) == i and v in live:
+                cur -= live.pop(v)
+    return peak
+
+
+# ---------------------------------------------------------------------------
+# program specs + the rules
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One canonical hot-path program and the invariants it declares.
+
+    ``build()`` returns ``(fn, args)`` — kept lazy so importing the
+    auditor never constructs models.  ``forbid_logits`` is the
+    ``(n_tokens, padded_vocab)`` pair of the logits-buffer rule;
+    ``donate_argnums`` asserts those arguments' leaves alias outputs in
+    the lowered program; ``hbm_budget_bytes`` is the declared ceiling
+    for the peak estimate (see docs/static-analysis.md for how to size
+    one)."""
+
+    name: str
+    build: Callable[[], Tuple[Callable, tuple]]
+    forbid_logits: Optional[Tuple[int, int]] = None
+    forbid_scan_lengths: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    hbm_budget_bytes: Optional[int] = None
+    allow_f32_matmul: bool = False
+    skip_rules: Tuple[str, ...] = ()
+
+
+def _check_host_transfer(jaxpr, spec) -> List[Violation]:
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in HOST_PRIMITIVES or "callback" in name:
+            out.append(Violation(
+                "host-transfer",
+                f"primitive '{name}' performs a host round-trip inside "
+                f"the jitted program", program=spec.name))
+    return out
+
+
+def _check_f64(jaxpr, spec) -> List[Violation]:
+    out = []
+    seen = set()
+    for shape, dtype in collect_shapes(jaxpr):
+        if dtype in ("float64", "complex128") and (shape, dtype) not in seen:
+            seen.add((shape, dtype))
+            out.append(Violation(
+                "f64",
+                f"{dtype} buffer of shape {shape} in the traced program "
+                f"(TPUs have no f64 units; dtype policy is bf16 compute "
+                f"/ f32 accumulate)", program=spec.name))
+    return out
+
+
+def _check_f32_matmul(jaxpr, spec) -> List[Violation]:
+    out = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "dot_general":
+            continue
+        avals = [getattr(v, "aval", None) for v in eqn.invars]
+        if any(a is None for a in avals):
+            continue
+        if not all(str(getattr(a, "dtype", "")) == "float32"
+                   for a in avals):
+            continue
+        biggest = max(math.prod(a.shape) if a.shape else 1
+                      for a in avals)
+        if biggest >= F32_MATMUL_MIN_ELEMENTS:
+            shapes = [tuple(a.shape) for a in avals]
+            out.append(Violation(
+                "f32-matmul",
+                f"f32xf32 dot_general over {shapes} (>= "
+                f"{F32_MATMUL_MIN_ELEMENTS} elements) — feed the MXU "
+                f"bf16 operands with preferred_element_type=f32, or "
+                f"whitelist via allow_f32_matmul", program=spec.name))
+    return out
+
+
+def _check_logits_buffer(jaxpr, spec) -> List[Violation]:
+    n_tokens, padded_vocab = spec.forbid_logits
+    hits = [s for s, _dt in collect_shapes(jaxpr)
+            if len(s) >= 2 and s[-1] == padded_vocab
+            and math.prod(s[:-1]) >= n_tokens]
+    if hits:
+        return [Violation(
+            "logits-buffer",
+            f"(>= {n_tokens} tokens, {padded_vocab})-sized buffers "
+            f"materialized: {sorted(set(hits))} — the fused/streaming "
+            f"CE contract forbids a full logits tensor",
+            program=spec.name)]
+    return []
+
+
+def _check_t0_scan(jaxpr, spec) -> List[Violation]:
+    lengths = scan_lengths(jaxpr)
+    out = []
+    for forbidden in spec.forbid_scan_lengths:
+        if forbidden in lengths:
+            out.append(Violation(
+                "t0-scan",
+                f"scan of forbidden length {forbidden} traced (scan "
+                f"lengths: {sorted(set(lengths))}) — prompt processing "
+                f"regressed to per-token dispatches",
+                program=spec.name))
+    return out
+
+
+def _check_donation(fn, args, spec) -> List[Violation]:
+    import jax
+
+    expected = 0
+    for argnum in spec.donate_argnums:
+        expected += len(jax.tree_util.tree_leaves(args[argnum]))
+    lowered = jax.jit(
+        fn, donate_argnums=spec.donate_argnums).lower(*args)
+    aliased = lowered.as_text().count("tf.aliasing_output")
+    if aliased < expected:
+        return [Violation(
+            "donation",
+            f"only {aliased} of {expected} donated buffers alias an "
+            f"output in the lowered program — dropped donation doubles "
+            f"the HBM those arguments occupy", program=spec.name)]
+    return []
+
+
+def audit_program(spec: ProgramSpec
+                  ) -> Tuple[List[Violation], Dict[str, Any]]:
+    """Trace one program and run every rule it doesn't skip.  Returns
+    (violations, info) where info carries the audit telemetry that
+    rides into the JSON report (eqn count, peak-HBM estimate)."""
+    import jax
+
+    fn, args = spec.build()
+    closed = jax.make_jaxpr(fn)(*args)
+    jaxpr = closed.jaxpr
+    checks = {
+        "host-transfer": lambda: _check_host_transfer(jaxpr, spec),
+        "f64": lambda: _check_f64(jaxpr, spec),
+        "f32-matmul": lambda: (
+            [] if spec.allow_f32_matmul
+            else _check_f32_matmul(jaxpr, spec)),
+        "logits-buffer": lambda: (
+            _check_logits_buffer(jaxpr, spec)
+            if spec.forbid_logits else []),
+        "t0-scan": lambda: _check_t0_scan(jaxpr, spec),
+        "donation": lambda: (
+            _check_donation(fn, args, spec)
+            if spec.donate_argnums else []),
+    }
+    violations: List[Violation] = []
+    for rule, run in checks.items():
+        if rule not in spec.skip_rules:
+            violations.extend(run())
+    info: Dict[str, Any] = {
+        "eqns": sum(1 for _ in iter_eqns(jaxpr)),
+    }
+    if "hbm-budget" not in spec.skip_rules:
+        peak = estimate_peak_bytes(jaxpr)
+        info["peak_hbm_bytes"] = int(peak)
+        info["hbm_budget_bytes"] = spec.hbm_budget_bytes
+        if spec.hbm_budget_bytes and peak > spec.hbm_budget_bytes:
+            violations.append(Violation(
+                "hbm-budget",
+                f"estimated peak HBM {peak / 2**20:.2f} MiB exceeds the "
+                f"declared budget "
+                f"{spec.hbm_budget_bytes / 2**20:.2f} MiB",
+                program=spec.name))
+    return violations, info
+
+
+def audit_programs(specs) -> Tuple[List[Violation],
+                                   Dict[str, Dict[str, Any]]]:
+    """Audit every spec; a program whose build/trace itself crashes is
+    reported as an ``audit-error`` violation instead of killing the
+    whole run (the other programs' results still matter)."""
+    violations: List[Violation] = []
+    infos: Dict[str, Dict[str, Any]] = {}
+    for spec in specs:
+        try:
+            vs, info = audit_program(spec)
+        except Exception as e:  # noqa: BLE001 - surfaced as a finding
+            violations.append(Violation(
+                "audit-error",
+                f"tracing failed: {type(e).__name__}: {str(e)[:200]}",
+                program=spec.name))
+            infos[spec.name] = {"error": type(e).__name__}
+            continue
+        violations.extend(vs)
+        infos[spec.name] = info
+    return violations, infos

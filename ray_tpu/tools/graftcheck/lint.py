@@ -1,0 +1,368 @@
+"""The repo linter: stdlib-``ast`` rules over ray_tpu/ source.
+
+Where the jaxpr auditor proves invariants about traced programs, this
+engine catches the host-side habits that erode them: blocking calls on
+the async serve path, wall-clock reads in telemetry code that promised
+an injectable clock, module-level mutable state shared across remote
+invocations, and metric declarations the Prometheus exposition would
+reject.  Two repo-level checks (pallas kernels need interpret-mode
+tests; the kernel entry points stay exported) absorb what
+``tests/test_ops_kernel_guard.py`` used to pin.
+
+Every rule honors ``# graftcheck: disable=<rule>`` on the offending
+line or a standalone comment line directly above it (core.py).
+
+Rule ids:
+
+* ``blocking-call-in-async`` — ``.block_until_ready()``,
+  ``np.asarray(...)``, sync ``ray.get``/``ray_tpu.get``, and
+  ``time.sleep`` inside ``async def`` bodies under ``ray_tpu/serve/``:
+  each blocks the event loop (and usually the decode engine) on a
+  device or cluster round-trip.  Deliberate host fences carry a
+  disable comment naming the reason.
+* ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``
+  or ``util/tracing.py``: telemetry takes an injectable ``now`` (tests
+  drive deterministic clocks) and intervals must use the monotonic
+  ``perf_counter``.
+* ``mutable-global-in-remote`` — a ``@remote`` function or
+  remote-actor method mutating a module-level list/dict/set: each
+  worker process gets its own copy, so the mutation is a silent no-op
+  cross-process and a race within one (heuristic: flags mutating
+  calls/subscript-stores only, not reads).
+* ``metric-name`` — every ``Counter``/``Gauge``/``Histogram`` from
+  ``ray_tpu.util.metrics`` must carry a literal
+  ``^[a-z][a-z0-9_]*$`` name (absorbs tests/test_metrics_guard.py).
+* ``pallas-interpret-test`` — an ``ops/*.py`` building a pallas kernel
+  without an interpret-mode test module keeps numerics
+  CPU-unverifiable.
+* ``kernel-exports`` — the public kernel entry points must stay
+  exported (and resolvable) from ``ray_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from typing import Any, Dict, List, Tuple
+
+from ray_tpu.tools.graftcheck.core import (Violation, parse_suppressions,
+                                           split_suppressed)
+
+_METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_MUTATORS = {"append", "add", "update", "setdefault", "extend",
+             "insert", "remove", "clear", "pop", "popleft",
+             "appendleft"}
+_MUTABLE_FACTORIES = {"list", "dict", "set", "defaultdict", "deque",
+                      "OrderedDict", "Counter"}
+#: entry points that must stay exported from ray_tpu.ops
+KERNEL_EXPORTS = ("causal_attention", "flash_attention", "fused_lm_ce",
+                  "streaming_ce", "ring_attention", "ulysses_attention")
+
+
+def _call_label(func: ast.AST) -> str:
+    try:
+        return ast.unparse(func)
+    except Exception:  # noqa: BLE001 - exotic call targets
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# per-file rules
+# ---------------------------------------------------------------------------
+
+def _blocking_calls_in_async(tree: ast.AST, rel: str) -> List[Violation]:
+    if not rel.replace("\\", "/").startswith("ray_tpu/serve/"):
+        return []
+    out: List[Violation] = []
+
+    def walk_async_body(node):
+        """Yield calls lexically inside one async def, not descending
+        into nested function/class definitions (they run elsewhere)."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for call in walk_async_body(node):
+            label = _call_label(call.func)
+            blocking = (
+                label.endswith(".block_until_ready")
+                or label in ("np.asarray", "numpy.asarray")
+                or label in ("ray.get", "ray_tpu.get")
+                or label in ("time.sleep", "_time.sleep"))
+            if blocking:
+                out.append(Violation(
+                    "blocking-call-in-async",
+                    f"'{label}(...)' blocks the event loop inside "
+                    f"async '{node.name}' on the serve path — await an "
+                    f"executor, or mark a deliberate host fence with a "
+                    f"disable comment", file=rel, line=call.lineno))
+    return out
+
+
+def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
+    rel_posix = rel.replace("\\", "/")
+    if not (rel_posix.endswith("/telemetry.py")
+            or rel_posix.endswith("util/tracing.py")):
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and _call_label(node.func) in ("time.time", "_time.time"):
+            out.append(Violation(
+                "wallclock-in-telemetry",
+                "time.time() in telemetry code — intervals must use "
+                "time.perf_counter() (monotonic) and record_* methods "
+                "take an injectable `now` for deterministic tests",
+                file=rel, line=node.lineno))
+    return out
+
+
+def _module_mutables(tree: ast.Module) -> set:
+    """Module-level names bound to mutable list/dict/set containers."""
+    names = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in _MUTABLE_FACTORIES)
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+def _is_remote_decorated(node) -> bool:
+    for dec in node.decorator_list:
+        root = dec.func if isinstance(dec, ast.Call) else dec
+        label = _call_label(root)
+        if label == "remote" or label.endswith(".remote"):
+            return True
+    return False
+
+
+def _mutable_global_in_remote(tree: ast.Module,
+                              rel: str) -> List[Violation]:
+    mutables = _module_mutables(tree)
+    if not mutables:
+        return []
+    remote_fns: List = []
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and _is_remote_decorated(node):
+            remote_fns.append(node)
+        elif isinstance(node, ast.ClassDef) and _is_remote_decorated(node):
+            remote_fns.extend(
+                n for n in node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    out: List[Violation] = []
+    for fn in remote_fns:
+        for sub in ast.walk(fn):
+            name = None
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATORS \
+                    and isinstance(sub.func.value, ast.Name):
+                name = sub.func.value.id
+            elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Name):
+                        name = t.value.id
+            if name and name in mutables:
+                out.append(Violation(
+                    "mutable-global-in-remote",
+                    f"remote '{fn.name}' mutates module-level "
+                    f"'{name}' — each worker process has its own copy "
+                    f"(cross-process no-op, in-process race); pass "
+                    f"state explicitly or use an actor",
+                    file=rel, line=sub.lineno))
+    return out
+
+
+def _metric_calls(tree: ast.Module):
+    """(lineno, class_label, name_node) for util.metrics constructions
+    — bare aliases from ``from ray_tpu.util.metrics import X`` or
+    attribute calls on a module imported as ``metrics``."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == "ray_tpu.util.metrics":
+            for a in node.names:
+                if a.name in _METRIC_CLASSES:
+                    aliases[a.asname or a.name] = a.name
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        label = None
+        if isinstance(f, ast.Name) and f.id in aliases:
+            label = aliases[f.id]
+        elif (isinstance(f, ast.Attribute) and f.attr in _METRIC_CLASSES
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "metrics"):
+            label = f.attr
+        if label is None:
+            continue
+        name_node = node.args[0] if node.args else None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_node = kw.value
+        out.append((node.lineno, label, name_node))
+    return out
+
+
+def _metric_names(tree: ast.Module, rel: str,
+                  seen: List[str]) -> List[Violation]:
+    out: List[Violation] = []
+    for lineno, label, name_node in _metric_calls(tree):
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            out.append(Violation(
+                "metric-name",
+                f"{label} name is not a string literal (the Prometheus "
+                f"exposition guard can't verify it)",
+                file=rel, line=lineno))
+            continue
+        name = name_node.value
+        seen.append(name)
+        if not _METRIC_NAME_RE.match(name):
+            out.append(Violation(
+                "metric-name",
+                f"{label} name {name!r} violates ^[a-z][a-z0-9_]*$ "
+                f"(Prometheus would reject or mangle it)",
+                file=rel, line=lineno))
+    return out
+
+
+def lint_source(source: str, rel: str,
+                metric_names_seen: List[str] = None
+                ) -> Tuple[List[Violation], int]:
+    """Lint one file's source; returns (kept violations, n suppressed).
+    ``rel`` is the repo-relative posix path — the rules scope on it."""
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as e:
+        return [Violation("parse-error", f"file does not parse: {e}",
+                          file=rel, line=e.lineno)], 0
+    violations: List[Violation] = []
+    violations += _blocking_calls_in_async(tree, rel)
+    violations += _wallclock_in_telemetry(tree, rel)
+    violations += _mutable_global_in_remote(tree, rel)
+    violations += _metric_names(
+        tree, rel,
+        metric_names_seen if metric_names_seen is not None else [])
+    kept, dropped = split_suppressed(violations,
+                                     parse_suppressions(source))
+    return kept, len(dropped)
+
+
+# ---------------------------------------------------------------------------
+# repo-level checks
+# ---------------------------------------------------------------------------
+
+def pallas_modules(root: pathlib.Path) -> List[str]:
+    """ops/*.py stems that build a pallas kernel (pallas_call in
+    source)."""
+    ops_dir = root / "ray_tpu" / "ops"
+    return sorted(
+        p.stem for p in ops_dir.glob("*.py")
+        if p.name != "__init__.py" and "pallas_call" in p.read_text())
+
+
+def _pallas_interpret_tests(root: pathlib.Path) -> List[Violation]:
+    out: List[Violation] = []
+    tests_dir = root / "tests"
+    for stem in pallas_modules(root):
+        rel = f"ray_tpu/ops/{stem}.py"
+        test_file = tests_dir / f"test_{stem}.py"
+        if not test_file.exists():
+            out.append(Violation(
+                "pallas-interpret-test",
+                f"builds a pallas kernel but has no tests/test_{stem}"
+                f".py — add an interpret-mode numerics test (see "
+                f"tests/test_flash_attention.py for the pattern)",
+                file=rel))
+        elif "interpret" not in test_file.read_text():
+            out.append(Violation(
+                "pallas-interpret-test",
+                f"tests/test_{stem}.py never runs the kernel in "
+                f"interpret mode; tier-1 must verify numerics on CPU "
+                f"without the TPU tunnel", file=rel))
+    return out
+
+
+def _kernel_exports() -> List[Violation]:
+    out: List[Violation] = []
+    try:
+        import ray_tpu.ops as ops
+    except Exception as e:  # noqa: BLE001 - import failure IS the finding
+        return [Violation(
+            "kernel-exports",
+            f"ray_tpu.ops failed to import: {type(e).__name__}: {e}",
+            file="ray_tpu/ops/__init__.py")]
+    for name in KERNEL_EXPORTS:
+        if name not in getattr(ops, "__all__", ()):
+            out.append(Violation(
+                "kernel-exports",
+                f"'{name}' missing from ray_tpu.ops.__all__",
+                file="ray_tpu/ops/__init__.py"))
+        elif not callable(getattr(ops, name, None)):
+            out.append(Violation(
+                "kernel-exports",
+                f"ray_tpu.ops.{name} is not callable",
+                file="ray_tpu/ops/__init__.py"))
+    for name in getattr(ops, "__all__", ()):
+        if getattr(ops, name, None) is None:
+            out.append(Violation(
+                "kernel-exports",
+                f"__all__ entry '{name}' does not resolve",
+                file="ray_tpu/ops/__init__.py"))
+    return out
+
+
+def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
+    """Lint every package file under ``root`` plus the repo-level
+    checks.  Returns (violations, stats) where stats carries
+    ``files``, ``suppressed``, and the literal ``metric_names`` seen
+    (so callers can assert the scan isn't vacuous)."""
+    root = pathlib.Path(root)
+    violations: List[Violation] = []
+    metric_names_seen: List[str] = []
+    n_files = 0
+    n_suppressed = 0
+    for path in sorted((root / "ray_tpu").rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(root).as_posix()
+        kept, dropped = lint_source(path.read_text(), rel,
+                                    metric_names_seen)
+        violations.extend(kept)
+        n_suppressed += dropped
+        n_files += 1
+    violations.extend(_pallas_interpret_tests(root))
+    violations.extend(_kernel_exports())
+    stats = {"files": n_files, "suppressed": n_suppressed,
+             "metric_names": metric_names_seen}
+    return violations, stats

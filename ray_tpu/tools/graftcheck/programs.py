@@ -1,0 +1,227 @@
+"""The canonical hot-path programs graftcheck audits.
+
+These are the jitted programs the paper's perf story rides on: the
+train step for both model families, batched (ragged) prefill, the
+pooled ragged decode step, and the fused-CE kernel's forward and
+backward.  Every spec uses the CPU-traceable nano presets — jaxpr
+structure (primitives, scans, buffer shapes, donation) is preset- and
+backend-independent, so invariants proven on nano hold for the real
+configs.
+
+Conventions:
+
+* ``n_tokens`` for the logits-buffer rule is the full token count of
+  the traced batch; it must exceed ``d_model`` so a transposed
+  ``(d_model, padded_vocab)`` weight view can never alias the
+  forbidden shape class.
+* HBM budgets are the measured peak estimate of the healthy program
+  rounded up ~2-3x — generous enough to survive jax-version jitter in
+  the trace, tight enough that an order-of-magnitude blowup (remat
+  accidentally storing every layer's activations, a full-cache copy
+  per decode step) trips the rule.  To declare a budget for a new
+  program: run ``python -m ray_tpu.tools.graftcheck --format json``,
+  read ``programs.<name>.peak_hbm_bytes``, round up 2-3x.  Measured
+  2026-08 (jax 0.4.37, CPU trace): train 2.2-3.0 MiB, prefill/decode
+  1.3-2.1 MiB, fused-CE 0.2-0.3 MiB.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ray_tpu.tools.graftcheck.jaxpr_audit import ProgramSpec
+
+#: nano-family shape constants shared by the builders below
+_B, _T = 2, 64           # train batch: 128 tokens (> d_model=64)
+_PB, _PT0 = 4, 64        # prefill batch: T0 != n_layer so no aliasing
+_CE_N, _CE_D, _CE_V, _CE_VALID = 128, 64, 512, 500
+_NANO_VOCAB = 512        # padded_vocab of the nano presets
+
+_MiB = 2 ** 20
+
+
+def _nano_gpt2_cfg():
+    from ray_tpu.models import gpt2_config
+
+    return gpt2_config("nano", ce_impl="pallas", ce_block_n=16,
+                       ce_block_v=128, remat=False)
+
+
+def _nano_llama_cfg():
+    from ray_tpu.models import llama_config
+
+    return llama_config("nano", ce_impl="pallas", ce_block_n=16,
+                        ce_block_v=128)
+
+
+def _sgd_step(loss_fn):
+    """The minimal donated train step shape (value_and_grad + in-place
+    update) — optimizer choice doesn't change the audited invariants."""
+    import jax
+
+    def step(params, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch))(params)
+        new = jax.tree.map(lambda p, g: p - 0.01 * g.astype(p.dtype),
+                           params, grads)
+        return new, loss
+
+    return step
+
+
+def _build_gpt2_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_init, gpt2_loss
+
+    cfg = _nano_gpt2_cfg()
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jnp.zeros((_B, _T + 1), jnp.int32)}
+    return _sgd_step(lambda p, b: gpt2_loss(p, b, cfg)), (params, batch)
+
+
+def _build_llama_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama_init, llama_loss
+
+    cfg = _nano_llama_cfg()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    batch = {"tokens": jnp.zeros((_B, _T + 1), jnp.int32)}
+    return _sgd_step(lambda p, b: llama_loss(p, b, cfg)), (params, batch)
+
+
+def _build_gpt2_prefill():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import prefill
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((_PB, _PT0), jnp.int32)
+    lens = jnp.full((_PB,), _PT0 // 2, jnp.int32)
+    return (lambda p, t, n: prefill(p, t, cfg, lengths=n),
+            (params, toks, lens))
+
+
+def _build_llama_prefill():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama_config, llama_init
+    from ray_tpu.models.llama_decode import llama_prefill
+
+    cfg = llama_config("nano")
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.zeros((_PB, _PT0), jnp.int32)
+    lens = jnp.full((_PB,), _PT0 // 2, jnp.int32)
+    return (lambda p, t, n: llama_prefill(p, t, cfg, lengths=n),
+            (params, toks, lens))
+
+
+def _build_gpt2_decode_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import decode_step, init_cache
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    cache = init_cache(cfg, _PB)
+    toks = jnp.zeros((_PB,), jnp.int32)
+    return (lambda p, c, t: decode_step(p, c, t, cfg),
+            (params, cache, toks))
+
+
+def _ce_inputs():
+    import jax
+    import jax.numpy as jnp
+
+    k = jax.random.PRNGKey(0)
+    h = jax.random.normal(k, (_CE_N, _CE_D), jnp.float32)
+    w = jax.random.normal(k, (_CE_V, _CE_D), jnp.float32)
+    t = jnp.zeros((_CE_N,), jnp.int32)
+    return h, w, t
+
+
+def _build_fused_ce_fwd():
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.fused_ce import fused_lm_ce
+
+    h, w, t = _ce_inputs()
+    return (lambda a, b, c: fused_lm_ce(
+        a, b, c, _CE_VALID, block_n=16, block_v=128,
+        compute_dtype=jnp.bfloat16), (h, w, t))
+
+
+def _build_fused_ce_bwd():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.fused_ce import fused_lm_ce
+
+    h, w, t = _ce_inputs()
+
+    def loss(a, b):
+        return jnp.sum(fused_lm_ce(a, b, t, _CE_VALID, block_n=16,
+                                   block_v=128,
+                                   compute_dtype=jnp.bfloat16))
+
+    return jax.grad(loss, argnums=(0, 1)), (h, w)
+
+
+def default_programs() -> List[ProgramSpec]:
+    """The registry ``python -m ray_tpu.tools.graftcheck`` audits."""
+    return [
+        ProgramSpec(
+            name="gpt2_train_step",
+            build=_build_gpt2_train_step,
+            forbid_logits=(_B * _T, _NANO_VOCAB),
+            donate_argnums=(0,),
+            hbm_budget_bytes=8 * _MiB),
+        ProgramSpec(
+            name="llama_train_step",
+            build=_build_llama_train_step,
+            forbid_logits=(_B * _T, _NANO_VOCAB),
+            donate_argnums=(0,),
+            hbm_budget_bytes=8 * _MiB),
+        ProgramSpec(
+            name="gpt2_prefill_ragged",
+            build=_build_gpt2_prefill,
+            forbid_logits=(_PB * _PT0, _NANO_VOCAB),
+            forbid_scan_lengths=(_PT0,),
+            # prefill runs the full-precision f32 nano config on CPU;
+            # the dtype policy is audited on the train-step programs
+            allow_f32_matmul=True,
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="llama_prefill_ragged",
+            build=_build_llama_prefill,
+            forbid_logits=(_PB * _PT0, _NANO_VOCAB),
+            forbid_scan_lengths=(_PT0,),
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_decode_step",
+            build=_build_gpt2_decode_step,
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="fused_ce_fwd",
+            build=_build_fused_ce_fwd,
+            forbid_logits=(_CE_N, _CE_V),
+            hbm_budget_bytes=1 * _MiB),
+        ProgramSpec(
+            name="fused_ce_bwd",
+            build=_build_fused_ce_bwd,
+            forbid_logits=(_CE_N, _CE_V),
+            hbm_budget_bytes=1 * _MiB),
+    ]

@@ -23,7 +23,10 @@ p50/p95 TTFT and inter-token percentiles from engine_stats().  E.g.:
 
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
-clean JSONL stream).  Failures get a distinct tag — in particular the
+clean JSONL stream).  The first record is the graftcheck static-audit
+summary for the current tree (docs/static-analysis.md) so sweep
+numbers are traceable to a tree whose hot-path invariants held; pass
+--no-audit to skip it.  Failures get a distinct tag — in particular the
 known compile-helper HTTP 500 tunnel failure is tagged
 "compile_helper_500" — so sweeps that straddle the failure boundary
 remain analyzable after the fact.
@@ -47,10 +50,34 @@ def _failure_tag(e: Exception) -> str:
     return type(e).__name__
 
 
-def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout):
+def _graftcheck_record():
+    """One SWEEPJSON record summarizing the static audit (the same
+    report ``python -m ray_tpu.tools.graftcheck --format json`` emits),
+    so every sweep log carries proof the hot-path invariants held for
+    the exact tree that produced the numbers.  Never raises: an audit
+    crash is recorded, not fatal to the sweep."""
+    try:
+        from ray_tpu.tools.graftcheck import run_repo_check
+
+        report = run_repo_check()
+        return {"graftcheck": report["summary"], "ok": report["ok"]}
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        return {"graftcheck": {"error": f"{type(e).__name__}: "
+                               f"{str(e)[:200]}"}, "ok": False}
+
+
+def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
+              audit=False):
     """Run each [batch_per_chip, overrides] variant; returns the list of
-    result records that were also emitted as SWEEPJSON lines."""
+    result records that were also emitted as SWEEPJSON lines.  With
+    ``audit=True`` the first record is the graftcheck summary for the
+    current tree (``python sweep_tpu.py`` turns this on; pass
+    --no-audit to skip)."""
     records = []
+    if audit:
+        rec = _graftcheck_record()
+        print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+        records.append(rec)
     for batch_per_chip, kw in configs:
         kw = dict(kw)
         mode = kw.pop("mode", "train")
@@ -125,8 +152,9 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout):
 if __name__ == "__main__":
     import jax
 
+    argv = [a for a in sys.argv[1:] if a != "--no-audit"]
     n_chips = len(jax.devices())
-    configs = json.loads(sys.argv[1]) if len(sys.argv) > 1 else [
+    configs = json.loads(argv[0]) if argv else [
         [32, {}],
     ]
-    run_sweep(configs, n_chips)
+    run_sweep(configs, n_chips, audit="--no-audit" not in sys.argv)

@@ -5,7 +5,9 @@ ONE forward dispatch instead of T0 sequential decode steps.  These
 tests pin that property at the jaxpr level: the traced prefill may
 scan over layers (length n_layer) but must contain no scan of length
 T0 anywhere — a regression back to token-at-a-time prefill would
-reintroduce one.
+reintroduce one.  The scan walker is graftcheck's ``scan_lengths`` —
+the same rule the repo-wide audit (``python -m
+ray_tpu.tools.graftcheck``) enforces on the canonical prefill programs.
 """
 
 import jax
@@ -14,29 +16,14 @@ import jax.numpy as jnp
 from ray_tpu.models import gpt2_config, gpt2_init, llama_config, llama_init
 from ray_tpu.models.gpt2_decode import prefill
 from ray_tpu.models.llama_decode import llama_prefill
+from ray_tpu.tools.graftcheck import scan_lengths
 
 B, T0 = 8, 128   # T0 deliberately != n_layer (2) so lengths can't alias
 
 
-def _scan_lengths(jaxpr, acc=None):
-    """All `length` params of scan primitives anywhere in a jaxpr."""
-    if acc is None:
-        acc = []
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "scan":
-            acc.append(eqn.params["length"])
-        for v in eqn.params.values():
-            vs = v if isinstance(v, (tuple, list)) else (v,)
-            for u in vs:   # pjit/scan carry one jaxpr, cond a tuple
-                inner = getattr(u, "jaxpr", None)
-                if inner is not None:
-                    _scan_lengths(inner, acc)
-    return acc
-
-
 def _assert_no_length_t0_scan(fn, params, toks):
     jaxpr = jax.make_jaxpr(fn)(params, toks).jaxpr
-    lengths = _scan_lengths(jaxpr)
+    lengths = scan_lengths(jaxpr)
     assert T0 not in lengths, (
         f"prefill traced a scan of length T0={T0} (scan lengths: "
         f"{lengths}) — prompt processing regressed to per-token steps")

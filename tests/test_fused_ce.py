@@ -1,8 +1,7 @@
 """Fused pallas lm-head + cross-entropy kernel (ops/fused_ce.py):
 interpret-mode numerics and gradients must match the dense logits path,
-and the jitted computation must never materialize a (B, T, V) buffer."""
-
-import math
+and the jitted computation must never materialize a (B, T, V) buffer
+(detector shared with graftcheck's jaxpr auditor)."""
 
 import jax
 import jax.numpy as jnp
@@ -10,6 +9,7 @@ import numpy as np
 import pytest
 
 from ray_tpu.ops.fused_ce import fused_lm_ce
+from ray_tpu.tools.graftcheck import logits_sized_shapes
 
 pytestmark = pytest.mark.fast
 
@@ -168,40 +168,13 @@ def test_gpt2_loss_pallas_masked_targets():
     assert np.all(np.isfinite(np.asarray(g["wte"])))
 
 
-def _collect_shapes(jaxpr, shapes):
-    for eqn in jaxpr.eqns:
-        for var in list(eqn.invars) + list(eqn.outvars):
-            aval = getattr(var, "aval", None)
-            if aval is not None and getattr(aval, "shape", None) is not None:
-                shapes.append(tuple(aval.shape))
-        for val in eqn.params.values():
-            _collect_from(val, shapes)
-
-
-def _collect_from(val, shapes):
-    if hasattr(val, "jaxpr") and hasattr(getattr(val, "jaxpr"), "eqns"):
-        _collect_shapes(val.jaxpr, shapes)    # ClosedJaxpr
-    elif hasattr(val, "eqns"):
-        _collect_shapes(val, shapes)          # raw Jaxpr
-    elif isinstance(val, (list, tuple)):
-        for item in val:
-            _collect_from(item, shapes)
-
-
-def _logits_sized_shapes(fn, args, n_tokens, padded_vocab):
-    closed = jax.make_jaxpr(fn)(*args)
-    shapes = []
-    _collect_shapes(closed.jaxpr, shapes)
-    return [s for s in shapes
-            if len(s) >= 2 and s[-1] == padded_vocab
-            and math.prod(s[:-1]) >= n_tokens]
-
-
 def test_no_btv_buffer_in_pallas_jaxpr():
     """Acceptance: for ce_impl="pallas" no (B, T, V)- or (B*T, V)-shaped
     buffer may appear anywhere in the jitted loss or grad computation
     (the whole point of the fusion).  The dense path is checked to
-    trigger the detector, guarding against a vacuous pass."""
+    trigger the detector, guarding against a vacuous pass.  The
+    detector is graftcheck's — the same rule the repo-wide audit
+    enforces on every canonical program."""
     from ray_tpu.models import gpt2_init, gpt2_loss
 
     cfgs = _nano_cfgs()
@@ -212,11 +185,11 @@ def test_no_btv_buffer_in_pallas_jaxpr():
     batch = {"tokens": toks}
     vp = cfgs["dense"].padded_vocab
 
-    dense_hits = _logits_sized_shapes(
+    dense_hits = logits_sized_shapes(
         lambda p: gpt2_loss(p, batch, cfgs["dense"]), (params,), B * T, vp)
     assert dense_hits, "detector is broken: dense path has a logits buffer"
 
     for fn in (lambda p: gpt2_loss(p, batch, cfgs["pallas"]),
                jax.grad(lambda p: gpt2_loss(p, batch, cfgs["pallas"]))):
-        hits = _logits_sized_shapes(fn, (params,), B * T, vp)
+        hits = logits_sized_shapes(fn, (params,), B * T, vp)
         assert not hits, f"(B*T, V)-sized buffers leaked: {hits}"

@@ -110,3 +110,35 @@ def test_histogram_dump_emits_all_boundaries_per_tagset():
     assert by_key[(("k", "y"), ("le", "10.0"))] == 0.0
     assert by_key[(("k", "y"), ("le", "+Inf"))] == 1.0
     assert by_key[(("k", "y"), ("_stat", "count"))] == 1.0
+
+
+# -- registry hygiene (moved from the retired test_metrics_guard.py;
+# the static metric-name scan now lives in graftcheck's lint engine) --
+
+def test_metric_invalid_names_raise():
+    for name in ("Bad", "1starts_with_digit", "has-dash", "has space",
+                 "", "raytpu_app_UPPER"):
+        with pytest.raises(ValueError, match="invalid metric name"):
+            metrics.Gauge(name, "nope")
+
+
+def test_duplicate_registration_warns_once_newest_wins():
+    import warnings
+
+    g1 = metrics.Gauge("guard_dup_gauge", "first")
+    with pytest.warns(RuntimeWarning, match="registered more than once"):
+        g2 = metrics.Gauge("guard_dup_gauge", "second")
+    # newest instance owns the registry slot
+    assert metrics._registry.metrics["guard_dup_gauge"] is g2
+    g1.set(1.0)
+    g2.set(2.0)
+    snap = metrics._registry.snapshot()
+    assert snap["guard_dup_gauge"]["values"][0][1] == 2.0
+    # the SAME name warns only once per process (no warning storm)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        metrics.Gauge("guard_dup_gauge", "third")
+    # re-registering the SAME instance never warns
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", RuntimeWarning)
+        metrics._registry.register(g2)
