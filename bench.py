@@ -68,6 +68,17 @@ def parse_args(argv=None):
                     help="--decode prompt length (default 128 on TPU)")
     ap.add_argument("--new-tokens", type=int, default=0,
                     help="--decode generated tokens (default 64 on TPU)")
+    ap.add_argument("--traffic", action="store_true",
+                    help="benchmark the continuous serve engine under "
+                         "synthetic shared-prefix Poisson traffic "
+                         "(serve/traffic.py); emits prefix-hit-rate and "
+                         "SLO-attainment JSON lines")
+    ap.add_argument("--requests", type=int, default=0,
+                    help="--traffic request count (default 64 on TPU)")
+    ap.add_argument("--kv-layout", default="paged",
+                    choices=["dense", "paged"],
+                    help="--traffic KV-cache layout (paged enables "
+                         "prefix reuse; dense is the parity oracle)")
     return ap.parse_args(argv)
 
 # Backend-init hardening (round-2): round 1 died inside jax.devices()
@@ -350,6 +361,71 @@ def main_decode(args, on_tpu: bool) -> None:
         "detail": dict(detail, prefill_ttft_ms=round(ttft_ms, 2))}))
 
 
+def main_traffic(args, on_tpu: bool) -> None:
+    """--traffic: the continuous engine under seeded shared-prefix
+    Poisson load (serve/traffic.py run_traffic — the same entry the
+    tier-1 traffic test and sweep_tpu.py traffic variants call).
+    Headline metrics are the paged KV cache's prefix-hit rate and the
+    fraction of requests finishing inside the latency SLO; throughput
+    and shed counts ride in detail.  No published baseline exists, so
+    vs_baseline is null."""
+    import jax
+
+    from ray_tpu.serve.batching import AdmissionPolicy
+    from ray_tpu.serve.traffic import TrafficSpec, run_traffic
+
+    if on_tpu:
+        base, preset = "gpt2_traffic", "gpt2"
+        n = args.requests or 64
+        spec = TrafficSpec(num_requests=n, seed=0, rate_rps=32.0,
+                           num_prefix_groups=4, prefix_len=256,
+                           p_shared=0.75, tail_len_mean=32.0,
+                           tail_len_max=128, vocab=50000)
+        kw = dict(max_slots=8, max_new_tokens=64, prefill_bucket=128,
+                  latency_slo_ms=20000.0, time_scale=1.0)
+    else:  # CPU smoke so the traffic bench always emits its lines
+        base, preset = "gpt2_traffic_cpu_smoke", "nano"
+        import jax.numpy as jnp
+
+        n = args.requests or 16
+        spec = TrafficSpec(num_requests=n, seed=0, rate_rps=100.0,
+                           num_prefix_groups=2, prefix_len=32,
+                           p_shared=0.75, tail_len_mean=6.0,
+                           tail_len_max=16, vocab=500)
+        kw = dict(max_slots=4, max_new_tokens=8, prefill_bucket=16,
+                  latency_slo_ms=60000.0, time_scale=0.0,
+                  config_overrides={"dtype": jnp.float32,
+                                    "use_flash": False})
+    rep = run_traffic(
+        spec, family="gpt2", preset=preset,
+        kv_layout=args.kv_layout,
+        admission_policy=AdmissionPolicy(max_queue_depth=4 * n),
+        **kw)
+    eng = rep["engine"]
+    detail = {"chips": 1, "requests": rep["offered"],
+              "completed": rep["completed"], "shed": rep["shed"],
+              "kv_layout": args.kv_layout, "preset": preset,
+              "backend": jax.default_backend(), "tpu_error": TPU_ERROR,
+              "latency_ms": rep["latency_ms"],
+              "tokens_per_sec": eng["tokens_per_sec"],
+              "ttft_ms": eng["ttft_ms"],
+              "kv_cache": eng.get("kv_cache"),
+              "rejections_by_reason": eng["rejections_by_reason"]}
+    print(json.dumps({
+        "metric": f"{base}_prefix_hit_rate",
+        "value": rep["prefix_hit_rate"], "unit": "fraction",
+        "vs_baseline": None,
+        "detail": dict(detail,
+                       slo_attainment=rep["slo_attainment"])}))
+    print(json.dumps({
+        "metric": f"{base}_slo_attainment",
+        "value": rep["slo_attainment"], "unit": "fraction",
+        "vs_baseline": None,
+        "detail": dict(detail,
+                       latency_slo_ms=rep["latency_slo_ms"],
+                       prefix_hit_rate=rep["prefix_hit_rate"])}))
+
+
 def main(args=None):
     args = args or parse_args()
     if args.chips:
@@ -371,6 +447,8 @@ def main(args=None):
 
     if args.decode:
         return main_decode(args, jax.default_backend() == "tpu")
+    if args.traffic:
+        return main_traffic(args, jax.default_backend() == "tpu")
     n_chips = len(jax.devices())
     if args.chips:
         n_chips = min(n_chips, args.chips)

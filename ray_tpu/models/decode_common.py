@@ -17,6 +17,28 @@ The per-slot attention mask is derived, not stored: slot s is
 attendable for row b iff start[b] <= s <= pos[b] (after the current
 token's K/V lands at slot pos[b]).  Equal-length prompts are the
 degenerate case start == 0.
+
+Paged layout (round 8 — block-paged KV with prefix reuse): instead of
+dense per-row (L, B, S, ...) cache tensors, K/V live in a shared pool
+of fixed-size blocks
+
+  k, v          : (L, num_blocks, block_size, ...) preallocated pool
+  block_tables  : (B, S // block_size) int32 — row b's j-th table entry
+                  names the pool block holding slots
+                  [j*block_size, (j+1)*block_size); block 0 is the
+                  reserved null/trash block (never allocated, absorbs
+                  masked pad writes)
+  pos, start    : unchanged
+
+The jitted decode step reads the cache through a gather by block id
+(one layer at a time inside the layer scan — never the whole dense
+cache at once) and writes the new token with a scatter at
+(block_tables[b, pos//bs], pos % bs).  Because table entries are kept
+in sequence order, the gathered view is value-identical to the dense
+layout, so attention numerics are bit-identical between layouts — the
+dense path stays the parity oracle (same pattern as
+prefill_impl="scan").  Host-side block allocation / refcounting /
+prefix hashing lives in ray_tpu/serve/kv_pager.py.
 """
 
 from __future__ import annotations
@@ -34,6 +56,72 @@ def slot_mask(start: jnp.ndarray, end: jnp.ndarray,
     start[b] <= s < end[b] (end exclusive)."""
     s = jnp.arange(max_seq)
     return (s[None, :] >= start[:, None]) & (s[None, :] < end[:, None])
+
+
+def is_paged(cache) -> bool:
+    """The cache pytree itself is the layout knob: a pool cache carries
+    a block table, a dense cache doesn't.  Static under jit (pytree
+    structure), so the python branch costs nothing."""
+    return "block_tables" in cache
+
+
+def paged_update_and_view(layer, block_tables, pos, new):
+    """One decode-step K (or V) update against a paged pool layer.
+
+    layer (num_blocks, bs, H, hd) is one layer's block pool;
+    block_tables (B, max_blk) int32; pos (B,) int32; new (B, H, hd).
+    Writes new[b] into block block_tables[b, pos[b]//bs] at offset
+    pos[b] % bs (every active row's tail block is private, so the
+    scatter is conflict-free), then gathers each row's blocks into the
+    dense-equivalent (B, max_blk*bs, H, hd) attention view.  Table
+    entries are sequence-ordered, so view[b, s] holds exactly what the
+    dense cache would hold at slot s — unattended slots carry other
+    sequences' bytes, but the slot mask replaces them with the same
+    -1e30 the dense path writes, keeping logits bit-identical."""
+    bs = layer.shape[1]
+    rows = jnp.arange(block_tables.shape[0])
+    blk = block_tables[rows, pos // bs]
+    layer = layer.at[blk, pos % bs].set(new)
+    view = layer[block_tables]            # (B, max_blk, bs, H, hd)
+    b, nb = block_tables.shape
+    return layer, view.reshape(b, nb * bs, *layer.shape[2:])
+
+
+def dense_to_paged(cache, block_size: int):
+    """Re-lay a dense cache into a fresh block pool (row-major block
+    tables, block 0 reserved as the null block).  Pure reshape +
+    concat — the pool holds byte-identical K/V, so paged decode
+    continues a dense prefill exactly.  Used by generate_with's
+    kv_layout="paged" path and the parity tests; the serve engine
+    builds its pool through kv_pager instead."""
+    k = cache["k"]
+    L, B, S, *tail = k.shape
+    if S % block_size:
+        raise ValueError(f"max_seq={S} must be a multiple of "
+                         f"block_size={block_size}")
+    nb = S // block_size
+    out = dict(cache)
+    for name in ("k", "v"):
+        pool = cache[name].reshape(L, B * nb, block_size, *tail)
+        null = jnp.zeros((L, 1, block_size, *tail), pool.dtype)
+        out[name] = jnp.concatenate([null, pool], axis=1)
+    out["block_tables"] = (
+        1 + jnp.arange(B * nb, dtype=jnp.int32).reshape(B, nb))
+    return out
+
+
+def copy_block(cache, src, dst):
+    """Copy-on-write fork: duplicate pool block `src` into `dst` across
+    every layer of both K and V, on device.  src/dst are dynamic int32
+    scalars, so ONE jitted program serves every fork.  The pager calls
+    this before a sequence writes into a block whose refcount > 1."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    out = dict(cache)
+    for name in ("k", "v"):
+        pool = cache[name]                 # (L, num_blocks, bs, ...)
+        out[name] = pool.at[:, dst].set(pool[:, src])
+    return out
 
 
 def make_vocab_tail_mask(cfg) -> Optional[jnp.ndarray]:
@@ -80,15 +168,22 @@ def generate_with(prefill_fn, decode_step_fn, params,
                   prompt: jnp.ndarray, cfg, *, max_new_tokens: int,
                   lengths: Optional[jnp.ndarray] = None,
                   temperature: float = 1.0,
-                  key: Optional[jax.Array] = None) -> jnp.ndarray:
+                  key: Optional[jax.Array] = None,
+                  kv_layout: str = "dense",
+                  kv_block_size: int = 16) -> jnp.ndarray:
     """The generation loop shared by every decoder family (gpt2,
     llama): ONE batched prefill dispatch + a sampling scan over the
     family's decode_step.  prompt (B, T0) int32 → (B, T0 +
     max_new_tokens) int32; `lengths` (B,) marks ragged LEFT-padded
     prompts (row b's real tokens occupy columns [T0 - lengths[b], T0));
     temperature 0 = greedy; the whole program jits (static cfg /
-    max_new_tokens)."""
+    max_new_tokens).  kv_layout="paged" re-lays the prefilled cache
+    into kv_block_size blocks and decodes through the block-table
+    gather/scatter path — the dense layout is its parity oracle."""
     B, T0 = prompt.shape
+    if kv_layout not in ("dense", "paged"):
+        raise ValueError(f"kv_layout must be 'dense' or 'paged', got "
+                         f"{kv_layout!r}")
     if T0 + max_new_tokens > cfg.max_seq:
         # Past max_seq JAX clamps dynamic_update_slice/gather indices, so
         # KV writes would silently pile onto the last cache slot (and
@@ -101,6 +196,8 @@ def generate_with(prefill_fn, decode_step_fn, params,
     tail_mask = make_vocab_tail_mask(cfg)
     last_logits, cache = prefill_fn(params, prompt, cfg,
                                     lengths=lengths)
+    if kv_layout == "paged":
+        cache = dense_to_paged(cache, kv_block_size)
 
     def gen_step(carry, k):
         cache, logits = carry

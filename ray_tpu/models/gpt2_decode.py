@@ -25,6 +25,7 @@ the piece that makes ray_tpu.serve a real LM server.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -32,11 +33,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.decode_common import (generate_with, scan_prefill,
-                                          slot_mask)
+from ray_tpu.models.decode_common import (generate_with, is_paged,
+                                          paged_update_and_view,
+                                          scan_prefill, slot_mask)
 from ray_tpu.models.gpt2 import GPT2Config, _layernorm
 
-__all__ = ["init_cache", "prefill", "decode_step", "generate"]
+__all__ = ["init_cache", "init_paged_cache", "prefill", "paged_prefill",
+           "decode_step", "generate"]
 
 
 def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
@@ -49,6 +52,29 @@ def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((batch,), jnp.int32),
+            "start": jnp.zeros((batch,), jnp.int32)}
+
+
+def init_paged_cache(cfg: GPT2Config, batch: int, *, num_blocks: int,
+                     block_size: int) -> Dict[str, jnp.ndarray]:
+    """Block-pool cache (decode_common paged contract): K/V pools of
+    (L, num_blocks, block_size, H, hd) shared by all rows, per-row
+    block tables initialized to the reserved null block 0 (rows hold no
+    storage until the pager assigns blocks)."""
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "KV-cache decoding currently supports dense GPT-2 configs "
+            "only (n_experts=0); MoE decode needs per-step routing")
+    if cfg.max_seq % block_size:
+        raise ValueError(f"max_seq={cfg.max_seq} must be a multiple of "
+                         f"block_size={block_size}")
+    shape = (cfg.n_layer, num_blocks, block_size, cfg.n_head,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "block_tables": jnp.zeros(
+                (batch, cfg.max_seq // block_size), jnp.int32),
             "pos": jnp.zeros((batch,), jnp.int32),
             "start": jnp.zeros((batch,), jnp.int32)}
 
@@ -117,15 +143,114 @@ def prefill(params, tokens: jnp.ndarray, cfg: GPT2Config, *,
     return logits, cache
 
 
+def paged_prefill(params, cache, tokens: jnp.ndarray, cfg: GPT2Config,
+                  *, row_bt: jnp.ndarray, prefix_len, n_tail, slot
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Prompt-tail ingestion for ONE sequence against the block pool:
+    the prefix-reuse fast path (and, with prefix_len=0, the cold path).
+
+    tokens (1, Tt) int32 is the prompt tail RIGHT-aligned in its bucket
+    (left-padded — same convention as the batched prefill, so the last
+    real token is always column Tt-1); `n_tail` of them are real and
+    land at logical positions [prefix_len, prefix_len + n_tail).
+    row_bt (max_seq // block_size,) int32 is the row's full block
+    table: entries < prefix_len//bs name already-resident prefix blocks
+    whose K/V are read, not recomputed — that is the entire point.
+    Tail K/V are scattered into the pool (pad columns route to the
+    reserved null block 0); attention for the Tt queries runs against
+    the row's gathered pool view with a causal-by-logical-position
+    mask.  prefix_len / n_tail / slot are dynamic scalars — one
+    compiled program per (Tt bucket, pool shape) serves every request.
+
+    Returns (last-token logits (padded_vocab,) float32, cache with
+    pool K/V updated and row `slot`'s table/pos/start set).  Paged
+    rows always use start=0 (slot == logical position — the invariant
+    that makes blocks shareable across sequences)."""
+    _, Tt = tokens.shape
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    bs = cache["k"].shape[2]
+    prefix_len = jnp.asarray(prefix_len, jnp.int32)
+    n_tail = jnp.asarray(n_tail, jnp.int32)
+    pad = Tt - n_tail
+    col = jnp.arange(Tt, dtype=jnp.int32)
+    real = col >= pad                          # (Tt,), False on pads
+    logical = prefix_len + col - pad           # position iff real
+    pos_ids = jnp.maximum(logical, 0)          # pads clip to wpe row 0
+    # scatter targets for tail K/V: pad columns MUST go to the null
+    # block — their logical index can alias a live prefix slot
+    blk = jnp.where(real, row_bt[pos_ids // bs], 0)
+    off = jnp.where(real, logical % bs, 0)
+    # key slot s attendable by query column c iff c is real and
+    # s <= logical[c] (all-masked pad columns softmax to uniform —
+    # finite garbage that never reaches the pool or the logits)
+    mask = real[:, None] & (
+        jnp.arange(cfg.max_seq)[None, :] <= logical[:, None])
+    scale = 1.0 / math.sqrt(hd)
+    x = params["wte"].astype(cfg.dtype)[tokens[0]]       # (Tt, d)
+    x = x + params["wpe"].astype(cfg.dtype)[pos_ids]
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)    # (nb,bs,H,hd)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        w = p["attn"]["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
+        qkv = (xa @ w).reshape(Tt, 3, h, hd) \
+            + p["attn"]["qkv_b"].astype(cfg.dtype)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]        # (Tt,h,hd)
+        lk = lk.at[blk, off].set(k)
+        lv = lv.at[blk, off].set(v)
+        kview = lk[row_bt].reshape(cfg.max_seq, h, hd)
+        vview = lv[row_bt].reshape(cfg.max_seq, h, hd)
+        scores = jnp.einsum("qhd,khd->hqk", q,
+                            kview).astype(jnp.float32) * scale
+        scores = jnp.where(mask[None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("hqk,khd->qhd", probs, vview)
+        wo = p["attn"]["o_w"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(Tt, h * hd) @ wo
+                 + p["attn"]["o_b"].astype(cfg.dtype))
+        xm = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        hmid = jax.nn.gelu(xm @ p["mlp"]["fc_w"].astype(cfg.dtype)
+                           + p["mlp"]["fc_b"].astype(cfg.dtype))
+        x = x + (hmid @ p["mlp"]["proj_w"].astype(cfg.dtype)
+                 + p["mlp"]["proj_b"].astype(cfg.dtype))
+        return (x, lidx + 1), (lk, lv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    last = x[-1]                    # right-aligned ⇒ last real token
+    logits = (last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    out = dict(cache)
+    out["k"], out["v"] = new_k, new_v
+    out["block_tables"] = cache["block_tables"].at[slot].set(row_bt)
+    out["pos"] = cache["pos"].at[slot].set(prefix_len + n_tail)
+    out["start"] = cache["start"].at[slot].set(0)
+    return logits, out
+
+
 def decode_step(params, cache, tokens, cfg: GPT2Config
                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One token per sequence: tokens (B,) int32, row b at cache slot
     cache["pos"][b] (positions are per-sequence vectors, so rows may
     sit at different depths — ragged prompts, slot-pool serving).
 
+    Works on both cache layouts (the pytree structure is the knob —
+    decode_common.is_paged): dense caches index a (B, S, ...) layer and
+    write slot pos[b]; paged caches scatter into the row's pool block
+    and attend over the gathered block-table view, which is
+    value-identical to the dense layer, so everything downstream of the
+    K/V update is shared verbatim between layouts.
+
     Returns (logits (B, padded_vocab) float32, updated cache)."""
     B = tokens.shape[0]
     d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    paged = is_paged(cache)
     pos = cache["pos"]                                   # (B,)
     start = cache["start"]                               # (B,)
     rows = jnp.arange(B)
@@ -138,17 +263,22 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
     def body(carry, layer):
         x, lidx = carry
         p, = layer
-        ck = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
-                                      keepdims=False)    # (B,S,H,hd)
-        cv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
                                       keepdims=False)
         xa = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
         w = p["attn"]["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
         qkv = (xa @ w).reshape(B, 3, h, hd) \
             + p["attn"]["qkv_b"].astype(cfg.dtype)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # (B,h,hd)
-        ck = ck.at[rows, pos].set(k_new)       # row b writes slot pos[b]
-        cv = cv.at[rows, pos].set(v_new)
+        if paged:
+            bt = cache["block_tables"]
+            lk, ck = paged_update_and_view(lk, bt, pos, k_new)
+            lv, cv = paged_update_and_view(lv, bt, pos, v_new)
+        else:
+            lk = ck = lk.at[rows, pos].set(k_new)  # row b → slot pos[b]
+            lv = cv = lv.at[rows, pos].set(v_new)
         # attention of the single query against the cache
         scores = jnp.einsum("bhd,bshd->bhs", q, ck).astype(jnp.float32)
         scores = scores / jnp.sqrt(jnp.float32(hd))
@@ -163,14 +293,15 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
                            + p["mlp"]["fc_b"].astype(cfg.dtype))
         x = x + (hmid @ p["mlp"]["proj_w"].astype(cfg.dtype)
                  + p["mlp"]["proj_b"].astype(cfg.dtype))
-        return (x, lidx + 1), (ck, cv)
+        return (x, lidx + 1), (lk, lv)
 
     (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
                                       (params["blocks"],))
     x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
-    cache = {"k": new_k, "v": new_v, "pos": pos + 1, "start": start}
-    return logits, cache
+    out = dict(cache)
+    out["k"], out["v"], out["pos"] = new_k, new_v, pos + 1
+    return logits, out
 
 
 def _scan_prefill(params, tokens, cfg, *, lengths=None):
@@ -186,12 +317,16 @@ def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
              max_new_tokens: int, temperature: float = 1.0,
              lengths: Optional[jnp.ndarray] = None,
              key: Optional[jax.Array] = None,
-             prefill_impl: str = "batched") -> jnp.ndarray:
+             prefill_impl: str = "batched",
+             kv_layout: str = "dense",
+             kv_block_size: int = 16) -> jnp.ndarray:
     """GPT-2 generation (see decode_common.generate_with).  `lengths`
     marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
-    per-token reference prefill for parity testing."""
+    per-token reference prefill for parity testing; kv_layout="paged"
+    decodes through the block-pool layout (dense is its oracle)."""
     prefill_fn = prefill if prefill_impl == "batched" else _scan_prefill
     return generate_with(prefill_fn, decode_step, params, prompt, cfg,
                          max_new_tokens=max_new_tokens,
                          lengths=lengths, temperature=temperature,
-                         key=key)
+                         key=key, kv_layout=kv_layout,
+                         kv_block_size=kv_block_size)

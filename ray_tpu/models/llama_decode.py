@@ -11,18 +11,21 @@ n_kv_head, not n_head), SwiGLU, untied lm_head.
 
 from __future__ import annotations
 
+import math
 from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ray_tpu.models.decode_common import (generate_with, scan_prefill,
-                                          slot_mask)
+from ray_tpu.models.decode_common import (generate_with, is_paged,
+                                          paged_update_and_view,
+                                          scan_prefill, slot_mask)
 from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
                                   rope_frequencies)
 
-__all__ = ["llama_init_cache", "llama_prefill", "llama_decode_step",
+__all__ = ["llama_init_cache", "llama_init_paged_cache",
+           "llama_prefill", "llama_paged_prefill", "llama_decode_step",
            "llama_generate"]
 
 
@@ -34,6 +37,25 @@ def llama_init_cache(cfg: LlamaConfig, batch: int
              cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
+            "pos": jnp.zeros((batch,), jnp.int32),
+            "start": jnp.zeros((batch,), jnp.int32)}
+
+
+def llama_init_paged_cache(cfg: LlamaConfig, batch: int, *,
+                           num_blocks: int, block_size: int
+                           ) -> Dict[str, jnp.ndarray]:
+    """Block-pool cache (decode_common paged contract): K/V pools of
+    (L, num_blocks, block_size, n_kv_head, hd) shared by all rows,
+    per-row block tables initialized to the reserved null block 0."""
+    if cfg.max_seq % block_size:
+        raise ValueError(f"max_seq={cfg.max_seq} must be a multiple of "
+                         f"block_size={block_size}")
+    shape = (cfg.n_layer, num_blocks, block_size, cfg.n_kv_head,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "block_tables": jnp.zeros(
+                (batch, cfg.max_seq // block_size), jnp.int32),
             "pos": jnp.zeros((batch,), jnp.int32),
             "start": jnp.zeros((batch,), jnp.int32)}
 
@@ -139,16 +161,111 @@ def llama_prefill(params, tokens: jnp.ndarray, cfg: LlamaConfig, *,
     return logits, cache
 
 
+def llama_paged_prefill(params, cache, tokens: jnp.ndarray,
+                        cfg: LlamaConfig, *, row_bt: jnp.ndarray,
+                        prefix_len, n_tail, slot
+                        ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Prompt-tail ingestion for ONE sequence against the block pool
+    (see gpt2_decode.paged_prefill for the full contract): tokens
+    (1, Tt) RIGHT-aligned tail, prefix K/V read from resident pool
+    blocks via row_bt, tail K/V (post-RoPE, kv heads only) scattered in
+    (pads → null block 0).  RoPE follows logical positions, and the
+    kv heads are repeated to n_head for attention exactly as in
+    llama_prefill so the hidden states match the dense path."""
+    _, Tt = tokens.shape
+    d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
+                    cfg.head_dim)
+    bs = cache["k"].shape[2]
+    prefix_len = jnp.asarray(prefix_len, jnp.int32)
+    n_tail = jnp.asarray(n_tail, jnp.int32)
+    pad = Tt - n_tail
+    col = jnp.arange(Tt, dtype=jnp.int32)
+    real = col >= pad                          # (Tt,), False on pads
+    logical = prefix_len + col - pad           # position iff real
+    pos_ids = jnp.maximum(logical, 0)          # pads clip to position 0
+    # pad columns MUST scatter to the null block — their logical index
+    # can alias a live prefix slot
+    blk = jnp.where(real, row_bt[pos_ids // bs], 0)
+    off = jnp.where(real, logical % bs, 0)
+    mask = real[:, None] & (
+        jnp.arange(cfg.max_seq)[None, :] <= logical[:, None])
+    scale = 1.0 / math.sqrt(hd)
+    x = params["wte"].astype(cfg.dtype)[tokens[0]]       # (Tt, d)
+    cos, sin = rope_frequencies(cfg.max_seq, hd, cfg.rope_theta)
+    cos_p, sin_p = cos[pos_ids], sin[pos_ids]            # (Tt, hd/2)
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)    # (nb,bs,kv,hd)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _rmsnorm(x, p["ln1"]["scale"], cfg.rms_eps)
+        xa = xa.astype(cfg.dtype)
+        q = (xa @ p["attn"]["wq"].astype(cfg.dtype).reshape(d, h * hd)
+             ).reshape(Tt, h, hd)
+        k = (xa @ p["attn"]["wk"].astype(cfg.dtype).reshape(d, kv * hd)
+             ).reshape(Tt, kv, hd)
+        v = (xa @ p["attn"]["wv"].astype(cfg.dtype).reshape(d, kv * hd)
+             ).reshape(Tt, kv, hd)
+        q = _rope_at(q, cos_p, sin_p)
+        k = _rope_at(k, cos_p, sin_p)
+        lk = lk.at[blk, off].set(k)
+        lv = lv.at[blk, off].set(v)
+        kview = lk[row_bt].reshape(cfg.max_seq, kv, hd)
+        vview = lv[row_bt].reshape(cfg.max_seq, kv, hd)
+        if kv != h:
+            rep = h // kv
+            kview = jnp.repeat(kview, rep, axis=1)
+            vview = jnp.repeat(vview, rep, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q,
+                            kview).astype(jnp.float32) * scale
+        scores = jnp.where(mask[None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("hqk,khd->qhd", probs, vview)
+        wo = p["attn"]["wo"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(Tt, h * hd) @ wo).astype(x.dtype)
+        xm = _rmsnorm(x, p["ln2"]["scale"], cfg.rms_eps)
+        xm = xm.astype(cfg.dtype)
+        gate = xm @ p["mlp"]["w_gate"].astype(cfg.dtype)
+        up = xm @ p["mlp"]["w_up"].astype(cfg.dtype)
+        hmid = jax.nn.silu(gate) * up
+        x = x + (hmid @ p["mlp"]["w_down"].astype(cfg.dtype)
+                 ).astype(x.dtype)
+        return (x, lidx + 1), (lk, lv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    last = x[-1]                    # right-aligned ⇒ last real token
+    logits = (last.astype(cfg.dtype)
+              @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    out = dict(cache)
+    out["k"], out["v"] = new_k, new_v
+    out["block_tables"] = cache["block_tables"].at[slot].set(row_bt)
+    out["pos"] = cache["pos"].at[slot].set(prefix_len + n_tail)
+    out["start"] = cache["start"].at[slot].set(0)
+    return logits, out
+
+
 def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One token per sequence: tokens (B,) int32, row b at cache slot
     cache["pos"][b]; RoPE at each row's LOGICAL position pos - start.
+
+    Works on both cache layouts (decode_common.is_paged): dense caches
+    write slot pos[b] in a (B, S, ...) layer; paged caches scatter into
+    the row's pool block and attend over the gathered block-table view
+    (value-identical to dense, so the attention math is shared).
 
     Returns (logits (B, padded_vocab) float32, updated cache)."""
     B = tokens.shape[0]
     d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
                     cfg.head_dim)
     g = h // kv
+    paged = is_paged(cache)
     pos = cache["pos"]                                   # (B,)
     start = cache["start"]                               # (B,)
     rows = jnp.arange(B)
@@ -160,9 +277,9 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
     def body(carry, layer):
         x, lidx = carry
         p, = layer
-        ck = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
-                                      keepdims=False)    # (B,S,kv,hd)
-        cv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
                                       keepdims=False)
         xa = _rmsnorm(x, p["ln1"]["scale"], cfg.rms_eps)
         xa = xa.astype(cfg.dtype)
@@ -174,8 +291,13 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
                  .reshape(d, kv * hd)).reshape(B, kv, hd)
         q = _rope_at(q, cos_t, sin_t)
         k_new = _rope_at(k_new, cos_t, sin_t)
-        ck = ck.at[rows, pos].set(k_new)       # row b writes slot pos[b]
-        cv = cv.at[rows, pos].set(v_new)
+        if paged:
+            bt = cache["block_tables"]
+            lk, ck = paged_update_and_view(lk, bt, pos, k_new)
+            lv, cv = paged_update_and_view(lv, bt, pos, v_new)
+        else:
+            lk = ck = lk.at[rows, pos].set(k_new)  # row b → slot pos[b]
+            lv = cv = lv.at[rows, pos].set(v_new)
         # grouped-query attention against the kv-head cache: query
         # heads reshape to (kv, group) — no head repetition needed
         qg = q.reshape(B, kv, g, hd)
@@ -195,7 +317,7 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
         hmid = jax.nn.silu(gate) * up
         x = x + (hmid @ p["mlp"]["w_down"].astype(cfg.dtype)
                  ).astype(x.dtype)
-        return (x, lidx + 1), (ck, cv)
+        return (x, lidx + 1), (lk, lv)
 
     (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
                                       (params["blocks"],))
@@ -203,8 +325,9 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
     logits = (x.astype(cfg.dtype)
               @ params["lm_head"].astype(cfg.dtype)
               ).astype(jnp.float32)
-    cache = {"k": new_k, "v": new_v, "pos": pos + 1, "start": start}
-    return logits, cache
+    out = dict(cache)
+    out["k"], out["v"], out["pos"] = new_k, new_v, pos + 1
+    return logits, out
 
 
 def _scan_prefill(params, tokens, cfg, *, lengths=None):
@@ -221,13 +344,17 @@ def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
                    max_new_tokens: int, temperature: float = 1.0,
                    lengths: Optional[jnp.ndarray] = None,
                    key: Optional[jax.Array] = None,
-                   prefill_impl: str = "batched") -> jnp.ndarray:
+                   prefill_impl: str = "batched",
+                   kv_layout: str = "dense",
+                   kv_block_size: int = 16) -> jnp.ndarray:
     """LLaMA generation via the shared loop (decode_common).  `lengths`
     marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
-    per-token reference prefill for parity testing."""
+    per-token reference prefill for parity testing; kv_layout="paged"
+    decodes through the block-pool layout (dense is its oracle)."""
     prefill_fn = (llama_prefill if prefill_impl == "batched"
                   else _scan_prefill)
     return generate_with(prefill_fn, llama_decode_step, params, prompt,
                          cfg, max_new_tokens=max_new_tokens,
                          lengths=lengths, temperature=temperature,
-                         key=key)
+                         key=key, kv_layout=kv_layout,
+                         kv_block_size=kv_block_size)

@@ -3,15 +3,21 @@
 from ray_tpu.serve.api import (Deployment, delete, deployment,
                                engine_stats, get_deployment_handle,
                                run, shutdown, start_http_proxy, status)
-from ray_tpu.serve.batching import batch
+from ray_tpu.serve.batching import (AdmissionPolicy, OverloadedError,
+                                    batch)
+from ray_tpu.serve.kv_pager import BlockPager
 from ray_tpu.serve.llm import build_llm_deployment
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.schema import (DeploymentSchema,
                                   ServeApplicationSchema)
 from ray_tpu.serve.schema import apply as apply_config
+from ray_tpu.serve.traffic import (TrafficGenerator, TrafficSpec,
+                                   run_traffic)
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
            "start_http_proxy", "batch", "status", "engine_stats",
            "ServeApplicationSchema", "DeploymentSchema",
-           "apply_config", "build_llm_deployment"]
+           "apply_config", "build_llm_deployment", "AdmissionPolicy",
+           "OverloadedError", "BlockPager", "TrafficSpec",
+           "TrafficGenerator", "run_traffic"]

@@ -103,8 +103,71 @@ class RequestQueue:
         taken, self._pending = self._pending[:n], self._pending[n:]
         return taken
 
+    def push_front(self, arg, fut) -> None:
+        """Return a popped (arg, future) pair to the HEAD of the queue
+        — used when admission pops a request but cannot place it yet
+        (e.g. the KV block pool is exhausted until a retirement), so
+        FIFO order survives the retry."""
+        self._pending.insert(0, (arg, fut))
+
     def __len__(self) -> int:
         return len(self._pending)
+
+
+class OverloadedError(Exception):
+    """Raised to a caller whose request was load-shed at admission
+    (AdmissionPolicy said the engine cannot meet its SLOs).  Callers
+    should back off and retry; proxies map this to HTTP 503."""
+
+
+class AdmissionPolicy:
+    """SLO-driven load shedding: the control loop closing serve
+    telemetry back into admission decisions.
+
+    The continuous engine consults ``decide(stats, queue_depth)``
+    before enqueueing each request, passing its own ``engine_stats()``
+    snapshot.  A request is shed (reason string returned) when:
+
+      * ``queue_depth >= max_queue_depth`` — backlog bound; or
+      * observed p95 queue wait exceeds ``queue_wait_slo_ms`` while a
+        backlog exists — admitted requests are already waiting longer
+        than the SLO, so new ones cannot meet it; or
+      * observed p95 TTFT exceeds ``ttft_slo_ms`` while a backlog
+        exists.
+
+    The percentile gates only fire with a backlog (``queue_depth >
+    0``): an idle engine with bad historical percentiles must accept
+    work, or it could shed forever on stale history.  ``None`` for any
+    threshold disables that gate; the default policy (all None except
+    a generous queue bound) never sheds in small test runs."""
+
+    def __init__(self, *, max_queue_depth: Optional[int] = None,
+                 queue_wait_slo_ms: Optional[float] = None,
+                 ttft_slo_ms: Optional[float] = None):
+        self.max_queue_depth = max_queue_depth
+        self.queue_wait_slo_ms = queue_wait_slo_ms
+        self.ttft_slo_ms = ttft_slo_ms
+
+    def decide(self, stats, queue_depth: int) -> Optional[str]:
+        """None = admit; otherwise the shed reason (metric label)."""
+        if self.max_queue_depth is not None \
+                and queue_depth >= self.max_queue_depth:
+            return "queue_full"
+        if queue_depth > 0:
+            qw = (stats.get("queue_wait_ms") or {}).get("p95")
+            if self.queue_wait_slo_ms is not None and qw is not None \
+                    and qw > self.queue_wait_slo_ms:
+                return "queue_wait_slo"
+            ttft = (stats.get("ttft_ms") or {}).get("p95")
+            if self.ttft_slo_ms is not None and ttft is not None \
+                    and ttft > self.ttft_slo_ms:
+                return "ttft_slo"
+        return None
+
+    def describe(self) -> dict:
+        return {"max_queue_depth": self.max_queue_depth,
+                "queue_wait_slo_ms": self.queue_wait_slo_ms,
+                "ttft_slo_ms": self.ttft_slo_ms}
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,

@@ -1,0 +1,234 @@
+"""Host-side block manager for the paged KV cache (the data plane
+under serve/llm.py's continuous scheduler).
+
+The jitted decode programs see only a preallocated block pool and
+per-row block tables (decode_common paged contract); everything that
+DECIDES which block holds what lives here, on the host:
+
+  * **free-list allocation** — blocks 1..num_blocks-1 start free
+    (block 0 is the reserved null block: never allocated, absorbs the
+    masked pad scatter-writes the jitted programs route to it);
+  * **refcounts** — a block referenced by several live sequences is
+    shared; the last release returns it;
+  * **prefix cache** — full prompt-token blocks are content-indexed
+    (exact token-tuple keys, no hash collisions → no silent wrong
+    reuse), so a request whose prompt extends a resident prefix skips
+    re-prefilling those blocks entirely;
+  * **cached LRU pool** — released-but-registered blocks stay resident
+    (refcount 0) until allocation pressure evicts them
+    least-recently-used, so popular prefixes survive across requests;
+  * **copy-on-write** — before a sequence writes into a block it
+    shares (the tail boundary of a prefix hit), `ensure_private`
+    hands it a fresh block and tells the engine to device-copy the
+    original (decode_common.copy_block).
+
+Nothing here touches device memory — the pager returns block ids and
+the engine stitches them into jitted calls.  Analogous data/control
+split to vLLM's PagedAttention block manager, rebuilt TPU-side: the
+pool is a static-shape jit argument, never reallocated.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["BlockPager"]
+
+
+class BlockPager:
+    """Allocator + prefix index over a pool of `num_blocks` KV blocks
+    of `block_size` token slots each.
+
+    Block ids are ints in [1, num_blocks); 0 is the reserved null
+    block.  Every returned block carries a refcount the caller must
+    eventually `release`.  `num_blocks` must cover at least one full
+    sequence (max_seq // block_size) or admission could never succeed.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, max_seq: int):
+        if max_seq % block_size:
+            raise ValueError(f"max_seq={max_seq} must be a multiple of "
+                             f"block_size={block_size}")
+        if num_blocks < 1 + max_seq // block_size:
+            raise ValueError(
+                f"num_blocks={num_blocks} cannot hold one full "
+                f"sequence ({max_seq // block_size} blocks + null)")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.max_seq = int(max_seq)
+        # LIFO free list: recently-freed blocks are re-used first
+        # (warmer HBM pages on real hardware, denser tests)
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        #: exact prompt-token prefix -> resident block id.  Keys are
+        #: token tuples (content-addressed), so a block evicted and
+        #: re-filled with other tokens can never falsely match.
+        self._index: Dict[Tuple[int, ...], int] = {}
+        self._block_key: Dict[int, Tuple[int, ...]] = {}
+        #: refcount-0 registered blocks, insertion order == LRU order
+        self._cached: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self.prefix_hits = 0      # blocks served from the cache
+        self.prefix_misses = 0    # blocks that had to be prefilled
+        self.cow_copies = 0
+        self.evictions = 0
+
+    # -- capacity ------------------------------------------------------
+
+    @property
+    def blocks_free(self) -> int:
+        """Immediately allocatable blocks (untouched free list)."""
+        return len(self._free)
+
+    @property
+    def blocks_cached(self) -> int:
+        """Refcount-0 registered blocks — evictable on demand."""
+        return len(self._cached)
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.num_blocks - 1 - len(self._free) - len(self._cached)
+
+    @property
+    def available(self) -> int:
+        """Blocks an `allocate` call could produce right now."""
+        return len(self._free) + len(self._cached)
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        return -(-(prompt_len + max_new_tokens) // self.block_size)
+
+    # -- allocation ----------------------------------------------------
+
+    def allocate(self, count: int) -> Optional[List[int]]:
+        """`count` private blocks (refcount 1 each), evicting cached
+        prefix blocks LRU-first when the free list runs dry.  Returns
+        None (allocating nothing) when even eviction cannot cover the
+        request — the caller requeues and retries after a retirement.
+        """
+        if count > self.available:
+            return None
+        out: List[int] = []
+        for _ in range(count):
+            if not self._free:
+                blk, _ = self._cached.popitem(last=False)  # LRU
+                self._deregister(blk)
+                self.evictions += 1
+                self._free.append(blk)
+            blk = self._free.pop()
+            self._ref[blk] = 1
+            out.append(blk)
+        return out
+
+    def release(self, block_ids: Sequence[int]) -> None:
+        """Drop one reference on each block.  Zero-ref registered
+        blocks park in the cached pool (prefix stays warm); zero-ref
+        unregistered blocks return to the free list."""
+        for blk in block_ids:
+            ref = self._ref.get(blk, 0) - 1
+            if ref > 0:
+                self._ref[blk] = ref
+                continue
+            if ref < 0:
+                raise ValueError(f"release of unallocated block {blk}")
+            del self._ref[blk]
+            if blk in self._block_key:
+                self._cached[blk] = None       # most-recently used
+                self._cached.move_to_end(blk)
+            else:
+                self._free.append(blk)
+
+    # -- prefix cache --------------------------------------------------
+
+    def match_prefix(self, tokens: Sequence[int]
+                     ) -> Tuple[int, List[int]]:
+        """Longest resident block-aligned prefix of `tokens`.
+
+        Returns (prefix_len, matched_block_ids); each matched block's
+        refcount is raised (cached blocks are revived), so the caller
+        owns them and must `release` on retirement or admission
+        failure.  prefix_len is capped at len(tokens) - 1: the tail
+        prefill must ingest at least one token to produce the first
+        logits — a full-prompt match reuses everything but the last
+        position (whose recompute lands in a COW fork of the boundary
+        block, see `ensure_private`)."""
+        tokens = tuple(int(t) for t in tokens)
+        n = len(tokens)
+        matched: List[int] = []
+        for i in range(1, n // self.block_size + 1):
+            blk = self._index.get(tokens[:i * self.block_size])
+            if blk is None:
+                break
+            matched.append(blk)
+        prefix_len = min(len(matched) * self.block_size, max(n - 1, 0))
+        for blk in matched:
+            if blk in self._cached:            # revive from LRU pool
+                del self._cached[blk]
+                self._ref[blk] = 1
+            else:
+                self._ref[blk] += 1
+        self.prefix_hits += len(matched)
+        self.prefix_misses += self.blocks_needed(n, 0) - len(matched)
+        return prefix_len, matched
+
+    def register_prefix(self, tokens: Sequence[int],
+                        block_ids: Sequence[int]) -> None:
+        """Index every FULL prompt block of `tokens` (block i holds
+        K/V for tokens[i*bs:(i+1)*bs]) so later prompts can match it.
+        First writer wins: keys already indexed keep their canonical
+        block (the duplicate block simply stays unregistered)."""
+        tokens = tuple(int(t) for t in tokens)
+        for i in range(len(tokens) // self.block_size):
+            key = tokens[:(i + 1) * self.block_size]
+            blk = block_ids[i]
+            if key in self._index or blk in self._block_key:
+                continue
+            self._index[key] = blk
+            self._block_key[blk] = key
+
+    def ensure_private(self, block_id: int
+                       ) -> Tuple[int, Optional[int]]:
+        """Copy-on-write gate: called before a sequence writes into
+        `block_id` (the prefix/tail boundary block of a prefix hit).
+
+        A block is writable in place only when this sequence is its
+        sole referent AND it is not indexed (an indexed block's
+        content is a promise to future matchers).  Otherwise the
+        caller's reference moves to a fresh block and (new_id, src_id)
+        is returned — the caller must device-copy src → new before
+        the write.  Returns (block_id, None) when no fork was needed;
+        raises MemoryError when no block can be allocated (caller
+        rolls back + requeues)."""
+        shared = self._ref.get(block_id, 0) > 1 \
+            or block_id in self._block_key
+        if not shared:
+            return block_id, None
+        fresh = self.allocate(1)
+        if fresh is None:
+            raise MemoryError("no free block for copy-on-write fork")
+        self.release([block_id])       # our ref moves to the fork
+        self.cow_copies += 1
+        return fresh[0], block_id
+
+    def _deregister(self, block_id: int) -> None:
+        key = self._block_key.pop(block_id, None)
+        if key is not None:
+            self._index.pop(key, None)
+
+    # -- introspection -------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        total = self.prefix_hits + self.prefix_misses
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_in_use": self.blocks_in_use,
+            "blocks_cached": self.blocks_cached,
+            "blocks_free": self.blocks_free,
+            "prefix_block_hits": self.prefix_hits,
+            "prefix_block_misses": self.prefix_misses,
+            "prefix_hit_rate": round(self.prefix_hits / total, 4)
+            if total else 0.0,
+            "cow_copies": self.cow_copies,
+            "evictions": self.evictions,
+        }

@@ -34,29 +34,104 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 from ray_tpu.serve.api import deployment
-from ray_tpu.serve.batching import RequestQueue
+from ray_tpu.serve.batching import OverloadedError, RequestQueue
 from ray_tpu.serve.batching import batch as _batch
 from ray_tpu.serve.telemetry import EngineTelemetry
 
 
 def _family_fns(family: str):
     """(config_fn, init_fn, generate_fn, prefill_fn, step_fn,
-    init_cache_fn) for a decoder family."""
+    init_cache_fn, init_paged_cache_fn, paged_prefill_fn) for a
+    decoder family."""
     if family == "gpt2":
         from ray_tpu.models import gpt2_config, gpt2_init
         from ray_tpu.models.gpt2_decode import (decode_step, generate,
-                                                init_cache, prefill)
+                                                init_cache,
+                                                init_paged_cache,
+                                                paged_prefill, prefill)
 
         return (gpt2_config, gpt2_init, generate, prefill, decode_step,
-                init_cache)
+                init_cache, init_paged_cache, paged_prefill)
     from ray_tpu.models import llama_config, llama_init
     from ray_tpu.models.llama_decode import (llama_decode_step,
                                              llama_generate,
                                              llama_init_cache,
+                                             llama_init_paged_cache,
+                                             llama_paged_prefill,
                                              llama_prefill)
 
     return (llama_config, llama_init, llama_generate, llama_prefill,
-            llama_decode_step, llama_init_cache)
+            llama_decode_step, llama_init_cache,
+            llama_init_paged_cache, llama_paged_prefill)
+
+
+# jax's compile cache is keyed by the jitted function OBJECT, so a
+# fresh `jax.jit(closure)` per engine instance recompiles every
+# program for every instance — pathological for test suites and
+# notebooks that build many short-lived engines.  The continuous
+# engine's programs depend only on (family fns, config, temperature);
+# configs are frozen dataclasses (hashable, value-equal), so
+# equal-config engines can share ONE set of jitted callables and
+# therefore one compile.
+_JIT_CACHE: Dict[Any, Any] = {}
+
+
+def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
+                       temperature):
+    """(prefill, paged_prefill, pool_step, admit, copy_block,
+    clear_row) jitted programs for one (family, cfg, temperature)."""
+    key = (prefill_fn, step_fn, paged_prefill_fn, cfg, temperature)
+    cached = _JIT_CACHE.get(key)
+    if cached is not None:
+        return cached
+    import jax
+    from jax import lax
+
+    from ray_tpu.models.decode_common import (copy_block,
+                                              make_vocab_tail_mask,
+                                              sample_token)
+
+    tail = make_vocab_tail_mask(cfg)
+
+    def prefill_sample(p, toks, lens, k):
+        logits, cache = prefill_fn(p, toks, cfg, lengths=lens)
+        return sample_token(logits, k, temperature, tail), cache
+
+    def paged_prefill_sample(p, cache, toks, row_bt, prefix_len,
+                             n_tail, slot, k):
+        logits, cache = paged_prefill_fn(
+            p, cache, toks, cfg, row_bt=row_bt,
+            prefix_len=prefix_len, n_tail=n_tail, slot=slot)
+        return sample_token(logits[None], k, temperature, tail), cache
+
+    def pool_step(p, cache, toks, k):
+        logits, cache = step_fn(p, cache, toks, cfg)
+        return sample_token(logits, k, temperature, tail), cache
+
+    def admit(pool, row, slot):
+        out = dict(pool)
+        for name in ("k", "v"):   # (L, B, S, ...): row b=slot
+            out[name] = lax.dynamic_update_slice_in_dim(
+                pool[name], row[name], slot, axis=1)
+        for name in ("pos", "start"):
+            out[name] = lax.dynamic_update_slice_in_dim(
+                pool[name], row[name], slot, axis=0)
+        return out
+
+    def clear_row(cache, slot):
+        # retire a row: its table points at the null block so the
+        # (masked, unread) writes of an idle row can never land in a
+        # block the pager has handed to someone else
+        out = dict(cache)
+        out["block_tables"] = cache["block_tables"].at[slot].set(0)
+        out["pos"] = cache["pos"].at[slot].set(0)
+        return out
+
+    fns = (jax.jit(prefill_sample), jax.jit(paged_prefill_sample),
+           jax.jit(pool_step), jax.jit(admit), jax.jit(copy_block),
+           jax.jit(clear_row))
+    _JIT_CACHE[key] = fns
+    return fns
 
 
 def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
@@ -69,6 +144,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          scheduler: str = "batch",
                          max_slots: int = 4,
                          prefill_bucket: int = 16,
+                         kv_layout: str = "dense",
+                         kv_block_size: int = 16,
+                         kv_num_blocks: Optional[int] = None,
+                         admission_policy=None,
                          config_overrides: Optional[Dict[str, Any]]
                          = None):
     """A serve Deployment generating continuations for int32
@@ -80,6 +159,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     scheduler: "batch" (@serve.batch fixed micro-batches) or
     "continuous" (slot pool of `max_slots` KV rows with mid-flight
     admission; `prefill_bucket` bounds prefill recompiles).
+    kv_layout: "dense" (per-slot rows, the parity oracle) or "paged"
+    (shared block pool + per-row block tables managed by
+    serve/kv_pager.py — prompt prefixes resident from earlier requests
+    are reused instead of re-prefilled, with copy-on-write forks at
+    shared write boundaries).  kv_block_size sets the block token
+    granularity; kv_num_blocks the pool size (default: enough for
+    every slot plus one sequence of prefix-cache headroom).
+    admission_policy: a serve.batching.AdmissionPolicy closing the
+    telemetry loop — requests are load-shed with OverloadedError when
+    its queue-depth / queue-wait / TTFT gates trip.
     checkpoint_path: pickled param pytree (matching the family's init
     layout); absent → fresh init from `seed` (tests/demos)."""
     if family not in ("gpt2", "llama"):
@@ -87,6 +176,13 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     if scheduler not in ("batch", "continuous"):
         raise ValueError(f"unknown scheduler {scheduler!r} "
                          f"(expected 'batch' or 'continuous')")
+    if kv_layout not in ("dense", "paged"):
+        raise ValueError(f"unknown kv_layout {kv_layout!r} "
+                         f"(expected 'dense' or 'paged')")
+    if kv_layout == "paged" and scheduler != "continuous":
+        raise ValueError("kv_layout='paged' requires "
+                         "scheduler='continuous' (the block pager "
+                         "lives in the continuous engine)")
 
     class LLM:
         def __init__(self):
@@ -95,7 +191,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
             overrides = dict(config_overrides or {})
             (config_fn, init_fn, gen_fn, prefill_fn, step_fn,
-             init_cache_fn) = _family_fns(family)
+             init_cache_fn, init_paged_fn,
+             paged_prefill_fn) = _family_fns(family)
             self.cfg = config_fn(preset, **overrides)
             if checkpoint_path:
                 with open(checkpoint_path, "rb") as f:
@@ -128,7 +225,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         temperature=temperature, key=k))
             else:
                 self._init_continuous(prefill_fn, step_fn,
-                                      init_cache_fn)
+                                      init_cache_fn, init_paged_fn,
+                                      paged_prefill_fn)
 
         # ------------------------------------------------------------
         # "batch" scheduler: @serve.batch over (possibly ragged) lists
@@ -175,6 +273,18 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # graftcheck: disable=blocking-call-in-async
             n_prompt = int(np.asarray(prompt).reshape(-1).shape[0])
             rec = self._telemetry.record_enqueue(n_prompt)
+            if n_prompt == 0 or \
+                    n_prompt + max_new_tokens > self.cfg.max_seq:
+                # pre-validate BEFORE batching: an oversized prompt
+                # used to blow up the whole micro-batch from inside
+                # generate (and bypassed the rejection metrics lane)
+                self._telemetry.record_reject(
+                    rec, reason=f"prompt length {n_prompt}",
+                    label="oversized")
+                raise ValueError(
+                    f"prompt length {n_prompt} invalid for "
+                    f"max_seq={self.cfg.max_seq} with "
+                    f"max_new_tokens={max_new_tokens}")
             try:
                 out = await self._call_batch(prompt)
             except Exception as e:  # noqa: BLE001 - caller sees it too
@@ -187,50 +297,44 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
         # "continuous" scheduler: slot pool with mid-flight admission
         # ------------------------------------------------------------
 
-        def _init_continuous(self, prefill_fn, step_fn, init_cache_fn):
-            import jax
-
-            from ray_tpu.models.decode_common import (
-                make_vocab_tail_mask, sample_token)
-
+        def _init_continuous(self, prefill_fn, step_fn, init_cache_fn,
+                             init_paged_fn, paged_prefill_fn):
             cfg = self.cfg
-            tail = make_vocab_tail_mask(cfg)
-            self._cache = init_cache_fn(cfg, max_slots)
+            self._pager = None
+            if kv_layout == "paged":
+                from ray_tpu.serve.kv_pager import BlockPager
+
+                max_blk = cfg.max_seq // kv_block_size
+                # default pool: every slot can hold a full sequence,
+                # plus one sequence of headroom so the prefix cache and
+                # COW forks survive a fully-occupied pool
+                n_blocks = (kv_num_blocks if kv_num_blocks is not None
+                            else 1 + (max_slots + 1) * max_blk)
+                self._pager = BlockPager(n_blocks, kv_block_size,
+                                         cfg.max_seq)
+                self._cache = init_paged_fn(cfg, max_slots,
+                                            num_blocks=n_blocks,
+                                            block_size=kv_block_size)
+            else:
+                self._cache = init_cache_fn(cfg, max_slots)
             self._cur = np.zeros((max_slots,), np.int32)
             self._slots = [None] * max_slots
             self._queue = RequestQueue()
             self._wake = None           # asyncio.Event, made on-loop
             self._engine_task = None
 
-            def prefill_sample(p, toks, lens, k):
-                logits, cache = prefill_fn(p, toks, cfg, lengths=lens)
-                return sample_token(logits, k, temperature,
-                                    tail), cache
-
-            def pool_step(p, cache, toks, k):
-                logits, cache = step_fn(p, cache, toks, cfg)
-                return sample_token(logits, k, temperature,
-                                    tail), cache
-
-            def admit(pool, row, slot):
-                from jax import lax
-
-                out = dict(pool)
-                for name in ("k", "v"):   # (L, B, S, ...): row b=slot
-                    out[name] = lax.dynamic_update_slice_in_dim(
-                        pool[name], row[name], slot, axis=1)
-                for name in ("pos", "start"):
-                    out[name] = lax.dynamic_update_slice_in_dim(
-                        pool[name], row[name], slot, axis=0)
-                return out
-
-            self._prefill = jax.jit(prefill_sample)
-            self._pool_step = jax.jit(pool_step)
-            self._admit = jax.jit(admit)
+            (self._prefill, self._paged_prefill, self._pool_step,
+             self._admit, self._copy_block, self._clear_row) = \
+                _jitted_engine_fns(prefill_fn, step_fn,
+                                   paged_prefill_fn, cfg, temperature)
 
         def _admit_pending(self) -> None:
             """Prefill queued requests into free slots (one batched
-            prefill dispatch each; K/V rows land in the pool cache)."""
+            prefill dispatch each; K/V rows land in the pool cache).
+            Paged layout: blocks are matched/allocated through the
+            pager first — a request the pool cannot hold yet goes back
+            to the queue HEAD and admission pauses until a retirement
+            frees blocks."""
             import jax
             import jax.numpy as jnp
 
@@ -243,19 +347,24 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 n = int(arr.shape[0])
                 if n == 0 or n + max_new_tokens > self.cfg.max_seq:
                     self._telemetry.record_reject(
-                        rec, reason=f"prompt length {n}")
+                        rec, reason=f"prompt length {n}",
+                        label="oversized")
                     if not fut.done():
                         fut.set_exception(ValueError(
                             f"prompt length {n} invalid for "
                             f"max_seq={self.cfg.max_seq} with "
                             f"max_new_tokens={max_new_tokens}"))
                     continue
+                slot = free[0]
+                if self._pager is not None:
+                    if not self._admit_one_paged(arr, rec, fut, slot):
+                        return          # pool exhausted — retry later
+                    continue
                 # pad up to the bucket so the prefill program compiles
                 # once per bucket; never past the decode headroom
                 t_pad = -(-n // prefill_bucket) * prefill_bucket
                 t_pad = max(n, min(t_pad,
                                    self.cfg.max_seq - max_new_tokens))
-                slot = free[0]
                 self._telemetry.record_admit(rec, slot, t_pad)
                 padded = np.zeros((1, t_pad), np.int32)
                 padded[0, t_pad - n:] = arr
@@ -277,6 +386,87 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 self._cur[slot] = first
                 self._slots[slot] = {"prompt": arr, "out": [first],
                                      "fut": fut, "rec": rec}
+
+        def _admit_one_paged(self, arr, rec, fut, slot) -> bool:
+            """Admit one request through the block pager: match the
+            longest resident prompt prefix, allocate the remaining
+            blocks up front (decode never allocates), COW-fork the
+            write-boundary block if it is shared, then prefill only
+            the unmatched tail.  Returns False when the pool cannot
+            hold the request yet (request requeued at the head)."""
+            import jax
+            import jax.numpy as jnp
+
+            pager = self._pager
+            n = int(arr.shape[0])
+            tokens = arr.tolist()
+            need = pager.blocks_needed(n, max_new_tokens)
+            prefix_len, matched = pager.match_prefix(tokens)
+            alloc = pager.allocate(need - len(matched))
+            if alloc is None:
+                pager.release(matched)
+                self._queue.push_front((arr, rec), fut)
+                return False
+            blocks = matched + alloc
+            wb = prefix_len // kv_block_size
+            if wb < len(matched):
+                # the tail's first write lands inside a matched block
+                try:
+                    new_blk, src = pager.ensure_private(blocks[wb])
+                except MemoryError:
+                    pager.release(blocks)
+                    self._queue.push_front((arr, rec), fut)
+                    return False
+                if src is not None:
+                    blocks[wb] = new_blk
+                    self._cache = self._copy_block(
+                        self._cache, np.int32(src), np.int32(new_blk))
+                    self._telemetry.record_cow()
+            self._telemetry.record_prefix_reuse(
+                len(matched), pager.blocks_needed(n, 0) - len(matched))
+            n_tail = n - prefix_len
+            t_pad = -(-n_tail // prefill_bucket) * prefill_bucket
+            t_pad = max(n_tail, min(t_pad, self.cfg.max_seq))
+            self._telemetry.record_admit(rec, slot, t_pad)
+            tail_toks = np.zeros((1, t_pad), np.int32)
+            tail_toks[0, t_pad - n_tail:] = arr[prefix_len:]
+            row_bt = np.zeros((self.cfg.max_seq // kv_block_size,),
+                              np.int32)
+            row_bt[:len(blocks)] = blocks
+            self._rng, k = jax.random.split(self._rng)
+            tok, self._cache = self._paged_prefill(
+                self.params, self._cache, jnp.asarray(tail_toks),
+                jnp.asarray(row_bt), np.int32(prefix_len),
+                np.int32(n_tail), np.int32(slot), k)
+            # int() is the engine's existing host fence for the
+            # prefill result; the timestamp behind it is the TTFT
+            first = int(np.asarray(tok)[0])
+            self._telemetry.record_first_token(rec)
+            # the prompt's full blocks now hold exactly its K/V —
+            # index them so later prompts can skip this work
+            pager.register_prefix(tokens, blocks)
+            if max_new_tokens <= 1:
+                self._telemetry.record_finish(rec, n_tokens=1)
+                if not fut.done():
+                    fut.set_result(np.concatenate(
+                        [arr, np.asarray([first], np.int32)]))
+                self._retire_paged_row(slot, blocks)
+                return True
+            self._cur[slot] = first
+            self._slots[slot] = {"prompt": arr, "out": [first],
+                                 "fut": fut, "rec": rec,
+                                 "blocks": blocks}
+            self._telemetry.record_kv_stats(pager.stats())
+            return True
+
+        def _retire_paged_row(self, slot, blocks) -> None:
+            """Free a finished/errored row's blocks.  The row's table
+            is pointed at the null block FIRST: an idle row's decode
+            step still scatter-writes (masked garbage), which must
+            never land in a block the pager may re-hand out."""
+            self._cache = self._clear_row(self._cache, np.int32(slot))
+            self._pager.release(blocks)
+            self._telemetry.record_kv_stats(self._pager.stats())
 
         async def _engine(self):
             """The scheduler loop: admit → one pooled decode step →
@@ -327,6 +517,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                 st["fut"].set_result(np.concatenate(
                                     [st["prompt"], tail]))
                             self._slots[i] = None   # slot freed NOW
+                            if self._pager is not None:
+                                self._retire_paged_row(i, st["blocks"])
                 except Exception as e:  # noqa: BLE001 - fail loudly
                     for i, st in enumerate(self._slots):
                         if st is not None:
@@ -334,6 +526,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                 st["rec"], error=repr(e))
                             if not st["fut"].done():
                                 st["fut"].set_exception(e)
+                            if self._pager is not None \
+                                    and "blocks" in st:
+                                self._pager.release(st["blocks"])
                         self._slots[i] = None
                     for (arr, rec), fut in self._queue.pop(
                             len(self._queue)):
@@ -354,18 +549,50 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # host-side prompt normalization (python ints, no device
             # fetch) # graftcheck: disable=blocking-call-in-async
             arr = np.asarray(prompt, np.int32).reshape(-1)
+            if admission_policy is not None:
+                # the control loop: telemetry percentiles feed the
+                # shed decision BEFORE the request costs the engine
+                # anything
+                shed = admission_policy.decide(
+                    self._telemetry.engine_stats(), len(self._queue))
+                if shed is not None:
+                    rec = self._telemetry.record_enqueue(
+                        int(arr.shape[0]))
+                    self._telemetry.record_reject(
+                        rec, reason=f"load shed: {shed}",
+                        label=f"shed_{shed}")
+                    raise OverloadedError(
+                        f"request shed ({shed}): engine over SLO "
+                        f"with {len(self._queue)} queued")
             rec = self._telemetry.record_enqueue(int(arr.shape[0]))
             fut = self._queue.put((arr, rec))
             self._wake.set()
             return await fut
 
+        def shutdown_engine(self) -> None:
+            """Stop the background engine task (direct-instance
+            drivers — traffic generator, bench — call this so their
+            event loop can close cleanly; serve replicas die with
+            their actor process and never need it)."""
+            task, self._engine_task = self._engine_task, None
+            if task is not None and not task.done():
+                task.cancel()
+
         # -- telemetry surface (works for both schedulers) -----------
 
         def engine_stats(self):
             """p50/p95/p99 TTFT + queue wait, throughput, slot
-            utilization, request counts — `handle.method(
-            "engine_stats").remote()` or GET /api/serve/stats."""
-            return self._telemetry.engine_stats()
+            utilization, request counts, rejections by reason, and
+            (paged layout) the live kv_cache block/prefix-hit stats —
+            `handle.method("engine_stats").remote()` or GET
+            /api/serve/stats."""
+            pager = getattr(self, "_pager", None)
+            if pager is not None:
+                self._telemetry.record_kv_stats(pager.stats())
+            stats = self._telemetry.engine_stats()
+            if admission_policy is not None:
+                stats["admission_policy"] = admission_policy.describe()
+            return stats
 
         def export_timeline(self, path=None):
             """Chrome-trace engine timeline (queue lane, per-slot

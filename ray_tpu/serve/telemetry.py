@@ -97,7 +97,9 @@ def _engine_metrics() -> Dict[str, Any]:
                     "requests finished", tag_keys=tags),
                 "rejected": Counter(
                     "serve_requests_rejected_total",
-                    "requests rejected at admission", tag_keys=tags),
+                    "requests rejected at admission, labeled by reason "
+                    "(oversized / shed_* / invalid)",
+                    tag_keys=("deployment", "reason")),
                 "errors": Counter(
                     "serve_requests_errored_total",
                     "requests failed by an engine error", tag_keys=tags),
@@ -108,6 +110,22 @@ def _engine_metrics() -> Dict[str, Any]:
                     "serve_prefill_compiles_total",
                     "first-seen prefill bucket shapes (one XLA compile "
                     "each)", tag_keys=("deployment", "bucket")),
+                "prefix_hits": Counter(
+                    "serve_prefix_blocks_hit_total",
+                    "prompt KV blocks served from the prefix cache "
+                    "(prefill skipped)", tag_keys=tags),
+                "prefix_misses": Counter(
+                    "serve_prefix_blocks_miss_total",
+                    "prompt KV blocks that had to be prefilled",
+                    tag_keys=tags),
+                "cow_copies": Counter(
+                    "serve_kv_cow_copies_total",
+                    "copy-on-write forks of shared KV blocks",
+                    tag_keys=tags),
+                "kv_blocks_in_use": Gauge(
+                    "serve_kv_blocks_in_use",
+                    "pool blocks referenced by live sequences",
+                    tag_keys=tags),
             }
         return _metrics
 
@@ -142,6 +160,8 @@ class EngineTelemetry:
         self._busy_slot_s = 0.0     # sum(active * dur) over steps
         self._step_s = 0.0          # sum(dur) over steps
         self._buckets: Dict[int, int] = {}  # prefill bucket -> admits
+        self._rejections_by_reason: Dict[str, int] = {}
+        self._kv_stats: Optional[Dict[str, Any]] = None
 
     def _now(self, now: Optional[float]) -> float:
         return time.perf_counter() if now is None else now
@@ -237,12 +257,42 @@ class EngineTelemetry:
                                 trace_id=trace_id, parent_id=span_id)
 
     def record_reject(self, rec: Dict[str, Any], reason: str = "",
-                      now: Optional[float] = None) -> None:
+                      now: Optional[float] = None,
+                      label: str = "invalid") -> None:
+        """`reason` is the free-form human string kept on the request
+        record; `label` is the LOW-CARDINALITY metric tag ("oversized",
+        "shed_queue_full", ...) — never put request-specific text in a
+        metric label."""
         rec["finish"] = self._now(now)
         rec["status"] = "rejected"
         rec["reason"] = reason
+        with self._lock:
+            self._rejections_by_reason[label] = \
+                self._rejections_by_reason.get(label, 0) + 1
         self._retire(rec, "rejected")
-        self._m["rejected"].inc(tags=self._tags)
+        self._m["rejected"].inc(tags=dict(self._tags, reason=label))
+
+    # -- paged KV cache (serve/kv_pager.py feeds these) --------------------
+
+    def record_prefix_reuse(self, hit_blocks: int,
+                            miss_blocks: int) -> None:
+        """One admission's prefix-cache outcome, in blocks."""
+        if hit_blocks:
+            self._m["prefix_hits"].inc(int(hit_blocks), tags=self._tags)
+        if miss_blocks:
+            self._m["prefix_misses"].inc(int(miss_blocks),
+                                         tags=self._tags)
+
+    def record_cow(self) -> None:
+        self._m["cow_copies"].inc(tags=self._tags)
+
+    def record_kv_stats(self, stats: Dict[str, Any]) -> None:
+        """Latest BlockPager.stats() snapshot — mirrored into
+        engine_stats()["kv_cache"] and the blocks-in-use gauge."""
+        with self._lock:
+            self._kv_stats = dict(stats)
+        self._m["kv_blocks_in_use"].set(
+            int(stats.get("blocks_in_use", 0)), tags=self._tags)
 
     def record_error(self, rec: Dict[str, Any], error: str = "",
                      now: Optional[float] = None) -> None:
@@ -278,6 +328,9 @@ class EngineTelemetry:
             tokens = self._tokens
             busy, step_s = self._busy_slot_s, self._step_s
             buckets = dict(self._buckets)
+            rejections = dict(self._rejections_by_reason)
+            kv_stats = (dict(self._kv_stats)
+                        if self._kv_stats is not None else None)
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
                 if r["first_token"] is not None]
         qwait = [(r["admit"] - r["enqueue"]) * 1e3 for r in recs
@@ -311,6 +364,10 @@ class EngineTelemetry:
             "prefill_buckets": {str(k): v
                                 for k, v in sorted(buckets.items())},
             "prefill_compiles": len(buckets),
+            # round-8: paged-KV + admission-control surfaces (top-level
+            # keys — the "requests" dict shape is a stable contract)
+            "rejections_by_reason": rejections,
+            "kv_cache": kv_stats,
         }
 
     def export_timeline(self, filename: Optional[str] = None

@@ -1,0 +1,206 @@
+"""Synthetic serve traffic: seeded Poisson arrivals over shared-prefix
+prompt mixtures.
+
+Serving benchmarks lie unless the offered load looks like production:
+requests arrive in bursts (Poisson, not back-to-back), prompts cluster
+around a few hot system-prompt prefixes (what the paged KV cache's
+prefix reuse exists for), and lengths are ragged.  This module is the
+single source of that workload for tests, ``bench.py --traffic`` and
+``sweep_tpu.py`` traffic variants — everything is derived from one
+integer seed, so a run is reproducible down to the token.
+
+Pieces:
+
+* :class:`TrafficSpec` — the workload knobs (rate, prefix groups,
+  length distributions), a frozen dataclass so specs can be shared;
+* :class:`TrafficGenerator` — expands a spec into concrete
+  ``TrafficRequest`` records (arrival offset + int32 prompt array);
+* :func:`drive` — fires the requests at an engine instance on their
+  (optionally time-scaled) arrival schedule and measures per-request
+  latency, shed count, and SLO attainment;
+* :func:`run_traffic` — sync wrapper: builds the LLM deployment,
+  drives it, merges ``engine_stats()`` into the report.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private.telemetry import summarize
+from ray_tpu.serve.batching import OverloadedError
+
+__all__ = ["TrafficSpec", "TrafficRequest", "TrafficGenerator",
+           "drive", "run_traffic"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficSpec:
+    """Knobs for one synthetic workload.  All randomness flows from
+    ``seed`` through one ``np.random.RandomState``, so equal specs
+    generate equal traffic on any host."""
+
+    num_requests: int = 32
+    seed: int = 0
+    #: Poisson arrival rate (requests/second of *modeled* time);
+    #: ``drive(time_scale=...)`` compresses it for fast tests.
+    rate_rps: float = 50.0
+    #: distinct shared prefixes ("system prompts") in the mixture
+    num_prefix_groups: int = 4
+    #: tokens per shared prefix (block-aligned values exercise full
+    #: reuse; off-aligned values exercise the COW boundary)
+    prefix_len: int = 32
+    #: probability a request extends one of the shared prefixes
+    #: (otherwise its whole prompt is unique)
+    p_shared: float = 0.75
+    #: request tail (user turn) length ~ 1 + Poisson(mean - 1)
+    tail_len_mean: float = 8.0
+    tail_len_max: int = 24
+    vocab: int = 256
+
+    def __post_init__(self):
+        if self.num_requests < 1:
+            raise ValueError("num_requests must be >= 1")
+        if self.rate_rps <= 0:
+            raise ValueError("rate_rps must be > 0")
+        if not 0.0 <= self.p_shared <= 1.0:
+            raise ValueError("p_shared must be in [0, 1]")
+
+
+@dataclasses.dataclass
+class TrafficRequest:
+    arrival_s: float          # offset from the start of the run
+    prompt: np.ndarray        # int32 (len,)
+    group: int                # shared-prefix group id, -1 = unique
+
+
+class TrafficGenerator:
+    """Expands a :class:`TrafficSpec` into a concrete request list."""
+
+    def __init__(self, spec: TrafficSpec):
+        self.spec = spec
+        self._rng = np.random.RandomState(spec.seed)
+        # tokens drawn from [2, vocab): 0/1 stay reserved so traffic
+        # never collides with pad/bos conventions in the model zoo
+        self.prefixes = [
+            self._rng.randint(2, spec.vocab, size=spec.prefix_len)
+            .astype(np.int32)
+            for _ in range(spec.num_prefix_groups)]
+
+    def requests(self) -> List[TrafficRequest]:
+        spec, rng = self.spec, self._rng
+        inter = rng.exponential(1.0 / spec.rate_rps,
+                                size=spec.num_requests)
+        arrivals = np.cumsum(inter)
+        out: List[TrafficRequest] = []
+        for i in range(spec.num_requests):
+            tail_len = 1 + min(int(rng.poisson(
+                max(spec.tail_len_mean - 1.0, 0.0))),
+                spec.tail_len_max - 1)
+            tail = rng.randint(2, spec.vocab,
+                               size=tail_len).astype(np.int32)
+            if spec.num_prefix_groups > 0 \
+                    and rng.rand() < spec.p_shared:
+                group = int(rng.randint(spec.num_prefix_groups))
+                prompt = np.concatenate([self.prefixes[group], tail])
+            else:
+                group, prompt = -1, tail
+            out.append(TrafficRequest(float(arrivals[i]), prompt,
+                                      group))
+        return out
+
+
+async def drive(instance, requests: List[TrafficRequest], *,
+                time_scale: float = 1.0,
+                latency_slo_ms: Optional[float] = None
+                ) -> Dict[str, Any]:
+    """Fire `requests` at a deployment instance (``async __call__``
+    taking one prompt array) on their arrival schedule.
+
+    time_scale scales modeled arrival offsets to wall time (0.01 turns
+    a 50 rps modeled workload into a burst for tests); 0 fires
+    everything immediately.  Sheds (:class:`OverloadedError`) are
+    counted, not raised.  Returns a report dict with latency
+    percentiles over completed requests and, when ``latency_slo_ms``
+    is set, the fraction that finished inside the SLO."""
+    import asyncio
+
+    t0 = time.perf_counter()
+
+    async def one(req: TrafficRequest) -> Dict[str, Any]:
+        delay = req.arrival_s * time_scale - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        start = time.perf_counter()
+        try:
+            await instance(req.prompt)
+        except OverloadedError:
+            return {"shed": True, "latency_ms": None}
+        return {"shed": False,
+                "latency_ms": (time.perf_counter() - start) * 1e3}
+
+    results = await asyncio.gather(*[one(r) for r in requests])
+    lat = [r["latency_ms"] for r in results if not r["shed"]]
+    shed = sum(1 for r in results if r["shed"])
+    report: Dict[str, Any] = {
+        "offered": len(requests),
+        "completed": len(lat),
+        "shed": shed,
+        "latency_ms": summarize(lat),
+        "wall_s": round(time.perf_counter() - t0, 4),
+    }
+    if latency_slo_ms is not None:
+        report["latency_slo_ms"] = latency_slo_ms
+        report["slo_attainment"] = round(
+            sum(1 for v in lat if v <= latency_slo_ms) / len(lat), 4) \
+            if lat else 0.0
+    return report
+
+
+def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
+                preset: str = "nano", kv_layout: str = "paged",
+                kv_block_size: int = 16, max_slots: int = 4,
+                max_new_tokens: int = 8, prefill_bucket: int = 16,
+                time_scale: float = 0.0,
+                latency_slo_ms: Optional[float] = None,
+                admission_policy=None,
+                config_overrides: Optional[Dict[str, Any]] = None
+                ) -> Dict[str, Any]:
+    """One synthetic-traffic run against a fresh in-process engine
+    (no serve cluster: the deployment class is instantiated directly,
+    same trick the serve tests use).  Returns the :func:`drive` report
+    plus the engine's ``engine_stats()`` snapshot — prefix-hit rate
+    and kv_cache occupancy ride along when ``kv_layout="paged"``."""
+    import asyncio
+
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    dep = build_llm_deployment(
+        family, preset, scheduler="continuous", max_slots=max_slots,
+        max_new_tokens=max_new_tokens, temperature=0.0,
+        prefill_bucket=prefill_bucket, kv_layout=kv_layout,
+        kv_block_size=kv_block_size,
+        admission_policy=admission_policy,
+        config_overrides=config_overrides)
+    requests = TrafficGenerator(spec).requests()
+
+    async def main():
+        inst = dep.func_or_class()
+        try:
+            report = await drive(inst, requests,
+                                 time_scale=time_scale,
+                                 latency_slo_ms=latency_slo_ms)
+            report["engine"] = inst.engine_stats()
+        finally:
+            inst.shutdown_engine()
+        return report
+
+    report = asyncio.run(main())
+    report["spec"] = dataclasses.asdict(spec)
+    report["kv_layout"] = kv_layout
+    kv = report["engine"].get("kv_cache") or {}
+    report["prefix_hit_rate"] = kv.get("prefix_hit_rate", 0.0)
+    return report

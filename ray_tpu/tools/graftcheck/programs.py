@@ -140,6 +140,32 @@ def _build_gpt2_decode_step():
             (params, cache, toks))
 
 
+def _build_gpt2_paged_decode_step():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import decode_step, init_paged_cache
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    # null block + one full sequence of blocks per pooled row — the
+    # serve engine's default sizing (llm.py _init_continuous)
+    bs = 16
+    per_row = cfg.max_seq // bs
+    cache = init_paged_cache(cfg, _PB, num_blocks=1 + _PB * per_row,
+                             block_size=bs)
+    # identity tables so the traced program exercises the real
+    # gather/scatter indirection (all-zero tables would too, but this
+    # mirrors a live engine's layout)
+    cache["block_tables"] = 1 + jnp.arange(
+        _PB * per_row, dtype=jnp.int32).reshape(_PB, per_row)
+    toks = jnp.zeros((_PB,), jnp.int32)
+    return (lambda p, c, t: decode_step(p, c, t, cfg),
+            (params, cache, toks))
+
+
 def _ce_inputs():
     import jax
     import jax.numpy as jnp
@@ -213,6 +239,17 @@ def default_programs() -> List[ProgramSpec]:
             build=_build_gpt2_decode_step,
             forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
             allow_f32_matmul=True,
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_paged_decode_step",
+            build=_build_gpt2_paged_decode_step,
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            # budget covers the block pool (1 + B*max_seq/bs blocks,
+            # == dense cache footprint + one null block) plus the
+            # per-layer gathered (B, max_seq) views inside the scan; a
+            # hidden dense re-materialization of the WHOLE pool per
+            # layer would blow straight through it
             hbm_budget_bytes=6 * _MiB),
         ProgramSpec(
             name="fused_ce_fwd",

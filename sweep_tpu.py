@@ -21,6 +21,16 @@ p50/p95 TTFT and inter-token percentiles from engine_stats().  E.g.:
   python sweep_tpu.py '[[8, {"mode": "decode"}],
                         [16, {"mode": "decode", "flash_resident": "on"}]]'
 
+Traffic variants: {"mode": "traffic", ...} drives the continuous serve
+engine under seeded shared-prefix Poisson load (serve/traffic.py) —
+batch is max_slots, "requests"/"kv_layout"/"prefix_len"/"p_shared"/
+"rate_rps"/"block_size" tune the workload; the SWEEPJSON record
+carries prefix_hit_rate + slo_attainment plus shed counts and latency
+percentiles, so dense-vs-paged A/Bs come straight from the sweep spec:
+
+  python sweep_tpu.py '[[8, {"mode": "traffic", "kv_layout": "dense"}],
+                        [8, {"mode": "traffic", "kv_layout": "paged"}]]'
+
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
 clean JSONL stream).  The first record is the graftcheck static-audit
@@ -64,6 +74,73 @@ def _graftcheck_record():
     except Exception as e:  # noqa: BLE001 - sweep must survive
         return {"graftcheck": {"error": f"{type(e).__name__}: "
                                f"{str(e)[:200]}"}, "ok": False}
+
+
+def _run_traffic_variant(max_slots, kw, out):
+    """One {"mode": "traffic"} sweep entry → SWEEPJSON record with
+    prefix_hit_rate + slo_attainment (the two fields a dense-vs-paged
+    A/B compares) plus shed counts and client latency percentiles."""
+    from ray_tpu.serve.batching import AdmissionPolicy
+    from ray_tpu.serve.traffic import TrafficSpec, run_traffic
+
+    kv_layout = kw.pop("kv_layout", "paged")
+    spec = TrafficSpec(
+        num_requests=kw.pop("requests", 64),
+        seed=kw.pop("seed", 0),
+        rate_rps=kw.pop("rate_rps", 32.0),
+        num_prefix_groups=kw.pop("prefix_groups", 4),
+        prefix_len=kw.pop("prefix_len", 256),
+        p_shared=kw.pop("p_shared", 0.75),
+        tail_len_mean=kw.pop("tail_len_mean", 32.0),
+        tail_len_max=kw.pop("tail_len_max", 128),
+        vocab=kw.pop("vocab", 50000))
+    run_kw = {
+        "preset": kw.pop("preset", "gpt2"),
+        "kv_block_size": kw.pop("block_size", 16),
+        "max_new_tokens": kw.pop("new_tokens", 64),
+        "prefill_bucket": kw.pop("prefill_bucket", 128),
+        "time_scale": kw.pop("time_scale", 1.0),
+        "latency_slo_ms": kw.pop("latency_slo_ms", 20000.0),
+    }
+    policy = AdmissionPolicy(
+        max_queue_depth=kw.pop("max_queue_depth",
+                               4 * spec.num_requests))
+    variant = {"mode": "traffic", "max_slots": max_slots,
+               "kv_layout": kv_layout, "requests": spec.num_requests,
+               "prefix_len": spec.prefix_len,
+               "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
+               "preset": run_kw["preset"], "overrides": kw}
+    try:
+        rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
+                          max_slots=max_slots,
+                          admission_policy=policy,
+                          config_overrides=kw or None, **run_kw)
+        eng = rep["engine"]
+        print(f"traffic slots={max_slots} layout={kv_layout} "
+              f"n={rep['offered']}: hit_rate={rep['prefix_hit_rate']} "
+              f"slo={rep['slo_attainment']} shed={rep['shed']} "
+              f"{eng['tokens_per_sec']:,.0f} tok/s", file=out,
+              flush=True)
+        rec = {"sweep": variant,
+               "prefix_hit_rate": rep["prefix_hit_rate"],
+               "slo_attainment": rep["slo_attainment"],
+               "completed": rep["completed"], "shed": rep["shed"],
+               "latency_p50_ms": rep["latency_ms"]["p50"],
+               "latency_p95_ms": rep["latency_ms"]["p95"],
+               "engine": {
+                   "tokens_per_sec": eng["tokens_per_sec"],
+                   "ttft_p50_ms": (eng["ttft_ms"] or {}).get("p50"),
+                   "ttft_p95_ms": (eng["ttft_ms"] or {}).get("p95"),
+                   "kv_cache": eng.get("kv_cache"),
+                   "rejections_by_reason":
+                       eng["rejections_by_reason"]}}
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        print(f"traffic slots={max_slots} layout={kv_layout} {kw}: "
+              f"FAILED {type(e).__name__}: {str(e)[:160]}", file=out,
+              flush=True)
+        rec = {"sweep": variant, "failed": _failure_tag(e),
+               "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    return rec
 
 
 def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
@@ -122,6 +199,11 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                       flush=True)
                 rec = {"sweep": variant, "failed": _failure_tag(e),
                        "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
+        if mode == "traffic":
+            rec = _run_traffic_variant(batch_per_chip, kw, out)
             print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
             records.append(rec)
             continue

@@ -1,0 +1,181 @@
+"""BlockPager unit tests: the host-side allocator/prefix-index under
+the paged KV cache (serve/kv_pager.py).  Pure host logic — no jax, no
+device arrays — so these pin the subsystem's bookkeeping invariants
+(refcounts, LRU eviction, COW forks, content-addressed matching)
+independently of the decode kernels that consume the block ids."""
+
+import pytest
+
+from ray_tpu.serve.kv_pager import BlockPager
+
+
+def _pager(num_blocks=9, block_size=4, max_seq=16):
+    return BlockPager(num_blocks, block_size, max_seq)
+
+
+def test_constructor_validates_geometry():
+    with pytest.raises(ValueError, match="multiple"):
+        BlockPager(9, block_size=5, max_seq=16)
+    with pytest.raises(ValueError, match="full"):
+        # needs 4 blocks + null = 5 minimum
+        BlockPager(4, block_size=4, max_seq=16)
+
+
+def test_allocate_release_roundtrip_and_refcounts():
+    p = _pager()
+    assert p.blocks_free == 8          # block 0 reserved
+    blocks = p.allocate(3)
+    assert len(blocks) == 3
+    assert 0 not in blocks             # null block never allocated
+    assert p.blocks_in_use == 3 and p.blocks_free == 5
+    p.release(blocks)
+    assert p.blocks_in_use == 0 and p.blocks_free == 8
+    # double release must blow up, not corrupt the free list
+    with pytest.raises(ValueError, match="unallocated"):
+        p.release([blocks[0]])
+
+
+def test_allocate_exhaustion_returns_none_and_allocates_nothing():
+    p = _pager()
+    assert p.allocate(9) is None       # > 8 available
+    assert p.blocks_free == 8          # nothing leaked
+    got = p.allocate(8)
+    assert len(got) == 8
+    assert p.allocate(1) is None
+    p.release(got[:1])
+    assert p.allocate(1) is not None   # recycled after release
+
+
+def test_match_prefix_exact_block_aligned_and_capped():
+    p = _pager()
+    prompt = list(range(10, 22))       # 12 tokens = 3 blocks of 4
+    blocks = p.allocate(3)
+    p.register_prefix(prompt, blocks)
+    p.release(blocks)                  # park in the cached pool
+    assert p.blocks_cached == 3
+
+    # identical prompt: full match but capped at n-1 -> 2 full blocks
+    # of prefix (8 tokens <= 11) plus the boundary block
+    n, matched = p.match_prefix(prompt)
+    assert matched == blocks
+    assert n == 11                     # len(prompt) - 1 cap
+    p.release(matched)
+
+    # longer prompt extending the prefix: all 3 blocks reusable
+    n, matched = p.match_prefix(prompt + [99, 98])
+    assert matched == blocks and n == 12
+    p.release(matched)
+
+    # diverging in the middle of block 2: only block 1 matches
+    div = prompt[:5] + [777] + prompt[6:]
+    n, matched = p.match_prefix(div)
+    assert matched == blocks[:1] and n == 4
+    p.release(matched)
+
+    # content addressing: unrelated tokens match nothing
+    n, matched = p.match_prefix([1, 2, 3, 4, 5])
+    assert matched == [] and n == 0
+
+
+def test_match_revives_cached_blocks_and_shares_refcounts():
+    p = _pager()
+    prompt = list(range(8))            # 2 full blocks
+    blocks = p.allocate(2)
+    p.register_prefix(prompt, blocks)
+    # still live (ref 1) — a second matcher shares via refcount
+    _, m1 = p.match_prefix(prompt + [50, 51, 52, 53])
+    assert m1 == blocks
+    p.release(blocks)                  # original owner retires
+    assert p.blocks_cached == 0        # still referenced by matcher
+    p.release(m1)
+    assert p.blocks_cached == 2        # now parked, not freed
+
+
+def test_lru_eviction_prefers_coldest_prefix():
+    p = _pager(num_blocks=6, block_size=4, max_seq=16)  # 5 usable
+    a, b = p.allocate(1), p.allocate(1)
+    p.register_prefix([1, 2, 3, 4], a)
+    p.register_prefix([5, 6, 7, 8], b)
+    p.release(a)                       # a is LRU (parked first)
+    p.release(b)
+    got = p.allocate(4)                # free list has 3 -> evict 1
+    assert len(got) == 4 and p.evictions == 1
+    assert a[0] in got                 # the colder prefix went
+    # evicted key must not match any more (index deregistered)
+    n, matched = p.match_prefix([1, 2, 3, 4, 9])
+    assert matched == [] and n == 0
+    # b's key survived
+    n, matched = p.match_prefix([5, 6, 7, 8, 9])
+    assert matched == b
+    p.release(matched)
+    p.release(got)
+
+
+def test_ensure_private_cow_semantics():
+    p = _pager()
+    prompt = list(range(4))
+    blocks = p.allocate(1)
+
+    # sole referent + unregistered: write in place, no fork
+    blk, src = p.ensure_private(blocks[0])
+    assert blk == blocks[0] and src is None and p.cow_copies == 0
+
+    # registered block: fork even at refcount 1 (its content is a
+    # promise to future matchers)
+    p.register_prefix(prompt, blocks)
+    blk, src = p.ensure_private(blocks[0])
+    assert blk != blocks[0] and src == blocks[0]
+    assert p.cow_copies == 1
+    # our ref moved to the fork; the original parked in the cache
+    assert p.blocks_cached == 1
+    p.release([blk])
+
+    # shared block (ref 2): second owner's write forks too
+    _, m = p.match_prefix(prompt + [9])
+    assert m == blocks
+    _, m2 = p.match_prefix(prompt + [7])
+    blk2, src2 = p.ensure_private(m2[0])
+    assert blk2 != m2[0] and src2 == m2[0] and p.cow_copies == 2
+    p.release([blk2])
+    p.release(m)
+
+
+def test_ensure_private_raises_when_pool_exhausted():
+    p = _pager(num_blocks=5, block_size=4, max_seq=16)  # 4 usable
+    blocks = p.allocate(4)
+    p.register_prefix([1, 2, 3, 4], blocks[:1])
+    with pytest.raises(MemoryError):
+        p.ensure_private(blocks[0])
+
+
+def test_register_prefix_first_writer_wins():
+    p = _pager()
+    prompt = [1, 2, 3, 4]
+    a = p.allocate(1)
+    b = p.allocate(1)
+    p.register_prefix(prompt, a)
+    p.register_prefix(prompt, b)       # duplicate content: ignored
+    _, matched = p.match_prefix(prompt + [9])
+    assert matched == a
+    p.release(matched)
+    p.release(a)
+    p.release(b)
+    # b was never indexed, so its release frees it outright
+    assert p.blocks_cached == 1
+
+
+def test_stats_shape_and_hit_rate():
+    p = _pager()
+    prompt = list(range(8))
+    blocks = p.allocate(2)
+    p.register_prefix(prompt, blocks)
+    p.release(blocks)
+    p.match_prefix(prompt + [30, 31, 32, 33])   # 2 hits, 1 miss
+    s = p.stats()
+    assert s["prefix_block_hits"] == 2
+    assert s["prefix_block_misses"] == 1
+    assert s["prefix_hit_rate"] == pytest.approx(2 / 3, abs=1e-3)
+    for key in ("num_blocks", "block_size", "blocks_in_use",
+                "blocks_cached", "blocks_free", "cow_copies",
+                "evictions"):
+        assert key in s

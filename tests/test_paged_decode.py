@@ -1,0 +1,194 @@
+"""Paged KV-cache model-layer parity: the block-pool layout must be a
+pure data-movement change.  Every test pins paged output against the
+dense layout (the bit-exactness oracle, same role prefill_impl="scan"
+plays for batched prefill): gathered pool views are value-identical to
+the dense cache, masked lanes are exactly -1e30 in both layouts, so
+softmax zeros land on the same lanes and sums see identical terms."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.models import (gpt2_config, gpt2_init, llama_config,
+                            llama_init)  # noqa: E402
+from ray_tpu.models import decode_common  # noqa: E402
+from ray_tpu.models import gpt2_decode, llama_decode  # noqa: E402
+from ray_tpu.models.decode_common import (dense_to_paged, is_paged,
+                                          make_vocab_tail_mask,
+                                          sample_token)  # noqa: E402
+
+BS = 16  # block size under test (nano max_seq=128 -> 8 blocks/row)
+
+
+def _family(name):
+    """(cfg, params, prefill, paged_prefill, decode_step,
+    init_paged_cache, generate) for one model family."""
+    if name == "gpt2":
+        cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                          remat=False)
+        params = gpt2_init(jax.random.PRNGKey(0), cfg)
+        return (cfg, params, gpt2_decode.prefill,
+                gpt2_decode.paged_prefill, gpt2_decode.decode_step,
+                gpt2_decode.init_paged_cache, gpt2_decode.generate)
+    cfg = llama_config("nano", dtype=jnp.float32, use_flash=False)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    return (cfg, params, llama_decode.llama_prefill,
+            llama_decode.llama_paged_prefill,
+            llama_decode.llama_decode_step,
+            llama_decode.llama_init_paged_cache,
+            llama_decode.llama_generate)
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_generate_paged_matches_dense_bitwise(family):
+    cfg, params, *_, generate = _family(family)
+    prompt = jnp.asarray(
+        np.random.RandomState(1).randint(2, cfg.vocab_size, (2, 9)),
+        jnp.int32)
+    dense = generate(params, prompt, cfg, max_new_tokens=6,
+                     temperature=0.0)
+    paged = generate(params, prompt, cfg, max_new_tokens=6,
+                     temperature=0.0, kv_layout="paged",
+                     kv_block_size=BS)
+    np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+
+def test_dense_to_paged_roundtrip_structure():
+    cfg, params, prefill, *_ = _family("gpt2")
+    toks = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    _, cache = prefill(params, toks, cfg, lengths=jnp.asarray([4]))
+    paged = dense_to_paged(cache, BS)
+    assert is_paged(paged) and not is_paged(cache)
+    nb = cfg.max_seq // BS
+    assert paged["k"].shape == (cfg.n_layer, 1 + nb, BS, cfg.n_head,
+                                cfg.head_dim)
+    # block 0 is the null block; the gathered view reassembles the
+    # dense layout exactly
+    assert not np.asarray(paged["k"][:, 0]).any()
+    view = np.asarray(paged["k"])[:, np.asarray(paged["block_tables"])[0]]
+    np.testing.assert_array_equal(
+        view.reshape(cfg.n_layer, cfg.max_seq, cfg.n_head,
+                     cfg.head_dim),
+        np.asarray(cache["k"])[:, 0])
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_paged_prefill_cold_matches_dense(family):
+    cfg, params, prefill, paged_prefill, _, init_paged, _ = \
+        _family(family)
+    n = 33
+    prompt = np.random.RandomState(2).randint(
+        2, cfg.vocab_size, n).astype(np.int32)
+    want, _ = prefill(params, jnp.asarray(prompt[None]), cfg,
+                      lengths=jnp.asarray([n]))
+
+    nb_row = cfg.max_seq // BS
+    cache = init_paged(cfg, 1, num_blocks=1 + nb_row, block_size=BS)
+    row_bt = jnp.arange(1, 1 + nb_row, dtype=jnp.int32)
+    t_pad = 48  # bucket >= n, right-aligned
+    toks = np.zeros((1, t_pad), np.int32)
+    toks[0, t_pad - n:] = prompt
+    got, cache = paged_prefill(params, cache, jnp.asarray(toks), cfg,
+                               row_bt=row_bt, prefix_len=np.int32(0),
+                               n_tail=np.int32(n), slot=np.int32(0))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(want)[0], atol=1e-5)
+    assert int(cache["pos"][0]) == n and int(cache["start"][0]) == 0
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_paged_prefill_prefix_reuse_matches_dense(family):
+    """The tentpole property: a request whose prompt extends blocks
+    already resident in the pool (written by ANOTHER sequence's
+    prefill) produces the same logits as dense-prefilling its full
+    prompt from scratch — and the shared blocks are untouched."""
+    cfg, params, prefill, paged_prefill, decode_step, init_paged, \
+        generate = _family(family)
+    rng = np.random.RandomState(3)
+    shared = rng.randint(2, cfg.vocab_size, 32).astype(np.int32)
+    a = np.concatenate([shared, rng.randint(2, cfg.vocab_size, 3)
+                        .astype(np.int32)])
+    b = np.concatenate([shared, rng.randint(2, cfg.vocab_size, 2)
+                        .astype(np.int32)])
+
+    nb_row = cfg.max_seq // BS
+    cache = init_paged(cfg, 2, num_blocks=1 + 2 * nb_row,
+                       block_size=BS)
+
+    def right_aligned(tokens, t_pad):
+        out = np.zeros((1, t_pad), np.int32)
+        out[0, t_pad - len(tokens):] = tokens
+        return jnp.asarray(out)
+
+    # sequence A prefills cold into blocks 1..8
+    bt_a = jnp.arange(1, 1 + nb_row, dtype=jnp.int32)
+    _, cache = paged_prefill(params, cache, right_aligned(a, 48), cfg,
+                             row_bt=bt_a, prefix_len=np.int32(0),
+                             n_tail=np.int32(len(a)), slot=np.int32(0))
+    pool_before = np.asarray(cache["k"])
+
+    # sequence B reuses A's first two blocks (tokens 0..31) and owns
+    # fresh blocks for its tail
+    bt_b = np.zeros(nb_row, np.int32)
+    bt_b[0], bt_b[1], bt_b[2] = 1, 2, 1 + nb_row
+    n_tail = len(b) - 32
+    got, cache = paged_prefill(params, cache, right_aligned(b[32:], 16),
+                               cfg, row_bt=jnp.asarray(bt_b),
+                               prefix_len=np.int32(32),
+                               n_tail=np.int32(n_tail),
+                               slot=np.int32(1))
+    want, _ = prefill(params, jnp.asarray(b[None]), cfg,
+                      lengths=jnp.asarray([len(b)]))
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(want)[0], atol=1e-5)
+    # the shared prefix blocks were read, not rewritten
+    pool_after = np.asarray(cache["k"])
+    np.testing.assert_array_equal(pool_before[:, [1, 2]],
+                                  pool_after[:, [1, 2]])
+
+    # greedy decode from the shared pool matches per-sequence dense
+    # generate token-for-token (both rows step together)
+    tail = make_vocab_tail_mask(cfg)
+    streams = [[], []]
+    new = 4
+    oracle = {}
+    for row, tokens in ((0, a), (1, b)):
+        out = generate(params, jnp.asarray(tokens[None]), cfg,
+                       max_new_tokens=new, temperature=0.0)
+        oracle[row] = np.asarray(out)[0, len(tokens):]
+    # row 0 starts from its oracle's first token (its prefill parity
+    # is already covered by the cold-prefill test); row 1's first
+    # token comes from the prefix-reusing paged prefill above
+    tok = jnp.asarray([int(oracle[0][0]),
+                       int(np.argmax(np.asarray(got)))], jnp.int32)
+    for _ in range(new):
+        streams[0].append(int(tok[0]))
+        streams[1].append(int(tok[1]))
+        logits, cache = decode_step(params, cache, tok, cfg)
+        tok = sample_token(logits, None, 0.0, tail)
+    assert streams[0] == oracle[0].tolist()
+    assert streams[1] == oracle[1].tolist()
+
+
+def test_generate_rejects_unknown_kv_layout():
+    cfg, params, *_, generate = _family("gpt2")
+    with pytest.raises(ValueError, match="kv_layout"):
+        generate(params, jnp.asarray([[1, 2, 3]], jnp.int32), cfg,
+                 max_new_tokens=2, temperature=0.0,
+                 kv_layout="ragged")
+
+
+def test_copy_block_copies_all_layers():
+    cfg, params, prefill, paged_prefill, _, init_paged, _ = \
+        _family("gpt2")
+    cache = init_paged(cfg, 1, num_blocks=4, block_size=BS)
+    cache["k"] = cache["k"].at[:, 1].set(1.5)
+    cache["v"] = cache["v"].at[:, 1].set(-2.5)
+    out = decode_common.copy_block(cache, np.int32(1), np.int32(3))
+    assert np.asarray(out["k"][:, 3] == 1.5).all()
+    assert np.asarray(out["v"][:, 3] == -2.5).all()
+    # source and unrelated blocks untouched
+    assert np.asarray(out["k"][:, 1] == 1.5).all()
+    assert not np.asarray(out["k"][:, 2]).any()

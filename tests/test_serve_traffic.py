@@ -1,0 +1,123 @@
+"""Synthetic traffic generator (serve/traffic.py): determinism of the
+seeded workload, and the continuous paged engine driven under it —
+the same entry points bench.py --traffic and sweep_tpu.py's
+{"mode": "traffic"} variants use, so the tier-1 run here is the
+canary for the whole traffic tooling path."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.serve.traffic import TrafficGenerator, TrafficSpec
+
+def _overrides():
+    import jax.numpy as jnp
+
+    return {"dtype": jnp.float32, "use_flash": False, "remat": False}
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="num_requests"):
+        TrafficSpec(num_requests=0)
+    with pytest.raises(ValueError, match="rate_rps"):
+        TrafficSpec(rate_rps=0.0)
+    with pytest.raises(ValueError, match="p_shared"):
+        TrafficSpec(p_shared=1.5)
+
+
+def test_generator_is_seed_deterministic():
+    spec = TrafficSpec(num_requests=20, seed=42, num_prefix_groups=3,
+                       prefix_len=16, vocab=300)
+    r1 = TrafficGenerator(spec).requests()
+    r2 = TrafficGenerator(spec).requests()
+    assert len(r1) == len(r2) == 20
+    for a, b in zip(r1, r2):
+        assert a.arrival_s == b.arrival_s and a.group == b.group
+        np.testing.assert_array_equal(a.prompt, b.prompt)
+    # a different seed really changes the workload
+    r3 = TrafficGenerator(
+        TrafficSpec(num_requests=20, seed=43, num_prefix_groups=3,
+                    prefix_len=16, vocab=300)).requests()
+    assert any(not np.array_equal(a.prompt, b.prompt)
+               for a, b in zip(r1, r3))
+
+
+def test_generator_workload_shape():
+    spec = TrafficSpec(num_requests=40, seed=5, num_prefix_groups=2,
+                       prefix_len=32, p_shared=0.8, tail_len_mean=6.0,
+                       tail_len_max=12, vocab=100)
+    gen = TrafficGenerator(spec)
+    reqs = gen.requests()
+    # arrivals are sorted Poisson offsets
+    arr = [r.arrival_s for r in reqs]
+    assert arr == sorted(arr) and arr[0] > 0
+    shared = [r for r in reqs if r.group >= 0]
+    unique = [r for r in reqs if r.group < 0]
+    assert shared and unique            # the mixture has both kinds
+    for r in shared:
+        np.testing.assert_array_equal(r.prompt[:32],
+                                      gen.prefixes[r.group])
+        assert 33 <= len(r.prompt) <= 32 + 12
+    for r in unique:
+        assert 1 <= len(r.prompt) <= 12
+    # tokens avoid the reserved 0/1 ids
+    for r in reqs:
+        assert r.prompt.min() >= 2 and r.prompt.max() < 100
+        assert r.prompt.dtype == np.int32
+
+
+def test_traffic_32_requests_through_paged_engine():
+    """Tier-1 canary: a seeded 32-request shared-prefix burst through
+    the paged continuous engine — everything completes, prefix reuse
+    is visible in engine stats, and the report is self-consistent."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from ray_tpu.serve.traffic import run_traffic
+
+    spec = TrafficSpec(num_requests=32, seed=0, rate_rps=100.0,
+                       num_prefix_groups=2, prefix_len=32,
+                       p_shared=0.75, tail_len_mean=5.0,
+                       tail_len_max=12, vocab=500)
+    rep = run_traffic(spec, family="gpt2", preset="nano",
+                      kv_layout="paged", max_slots=4,
+                      max_new_tokens=4, prefill_bucket=16,
+                      time_scale=0.0, latency_slo_ms=600000.0,
+                      config_overrides=_overrides())
+    assert rep["offered"] == 32
+    assert rep["completed"] == 32 and rep["shed"] == 0
+    assert rep["latency_ms"]["count"] == 32
+    assert rep["slo_attainment"] == 1.0   # SLO is generous on purpose
+    assert rep["prefix_hit_rate"] > 0
+    eng = rep["engine"]
+    assert eng["requests"]["finished"] == 32
+    assert eng["kv_cache"]["blocks_in_use"] == 0
+    assert eng["kv_cache"]["prefix_block_hits"] > 0
+
+
+@pytest.mark.slow
+def test_traffic_poisson_soak_with_shedding():
+    """Soak: sustained Poisson load with a tight queue bound — the
+    engine must stay healthy across many admit/retire/evict cycles,
+    shed cleanly instead of erroring, and account for every request."""
+    jax = pytest.importorskip("jax")  # noqa: F841
+    from ray_tpu.serve.batching import AdmissionPolicy
+    from ray_tpu.serve.traffic import run_traffic
+
+    spec = TrafficSpec(num_requests=160, seed=1, rate_rps=400.0,
+                       num_prefix_groups=3, prefix_len=32,
+                       p_shared=0.7, tail_len_mean=6.0,
+                       tail_len_max=16, vocab=500)
+    rep = run_traffic(spec, family="gpt2", preset="nano",
+                      kv_layout="paged", max_slots=4,
+                      max_new_tokens=4, prefill_bucket=16,
+                      time_scale=0.02, latency_slo_ms=600000.0,
+                      admission_policy=AdmissionPolicy(
+                          max_queue_depth=8),
+                      config_overrides=_overrides())
+    eng = rep["engine"]
+    assert rep["completed"] + rep["shed"] == 160
+    assert eng["requests"]["errors"] == 0
+    assert eng["requests"]["finished"] == rep["completed"]
+    assert eng["rejections_by_reason"].get("shed_queue_full", 0) \
+        == rep["shed"]
+    # the pool fully drains after the storm
+    assert eng["kv_cache"]["blocks_in_use"] == 0
+    assert rep["prefix_hit_rate"] > 0
