@@ -33,9 +33,12 @@ def parse_args(argv=None):
                          "multi-chip program is exercised end-to-end "
                          "(numbers are then NOT hardware numbers)")
     ap.add_argument("--mesh", default="data",
-                    choices=["data", "fsdp", "data_fsdp"],
+                    choices=["data", "fsdp", "data_fsdp", "tensor"],
                     help="parallelism layout across chips: pure data, "
-                         "pure ZeRO-3 fsdp, or data×2-way-fsdp")
+                         "pure ZeRO-3 fsdp, data×2-way-fsdp (train), or "
+                         "tensor (serve: --decode/--traffic shard the "
+                         "engine over `tensor`=--chips; A/B degree 1 "
+                         "vs 4 vs 8 for the round-9 decode bench)")
     ap.add_argument("--preset", default="",
                     help="model preset override (e.g. gpt2-medium for the "
                          "fsdp benchmark); default gpt2 on TPU, tiny on CPU")
@@ -234,26 +237,49 @@ def time_config(batch, seq=1024, n_steps=20, preset="gpt2", mesh="data",
     return tok_s_chip, mfu, final_loss, n_chips
 
 
-def time_decode(batch, prompt_len=128, new_tokens=64, preset="gpt2",
-                **overrides):
-    """Compile and time the GPT-2 serve path on the local chip: ONE
-    batched prefill dispatch of a (batch, prompt_len) prompt (TTFT,
-    3 repetitions) followed by `new_tokens` jitted greedy decode steps
-    against the KV cache (steady-state decode tokens/s).
+def decode_mesh(tensor_degree):
+    """(mesh, n_chips) for a tensor-parallel serve bench — None/1 when
+    the degree is 1 (single-chip path unchanged).  Uses the first
+    `tensor_degree` local devices; `--chips` emulation upstream means
+    those exist even on a laptop."""
+    if tensor_degree <= 1:
+        return None, 1
+    import jax
 
-    Returns (ttft_best_ms, tok_s, engine_stats) — the measurements flow
-    through the serve engine-telemetry layer (serve/telemetry.py), so
-    the reported p50/p95/p99 TTFT and inter-token percentiles come from
-    the SAME code path `engine_stats()` serves in production.  Per-step
-    timestamps are host-side dispatch intervals (no extra device syncs;
-    under async dispatch they track device step time once the pipeline
-    backpressures).  Single-device — the decode path is not
-    mesh-sharded yet; shared by main(--decode) and sweep_tpu.py decode
-    variants so the methodology has one source of truth."""
+    from ray_tpu.parallel import MeshSpec, make_mesh
+
+    devices = list(jax.devices())[:tensor_degree]
+    if len(devices) < tensor_degree:
+        raise ValueError(f"tensor degree {tensor_degree} needs "
+                         f"{tensor_degree} devices, have {len(devices)}")
+    return (make_mesh(MeshSpec(tensor=tensor_degree), devices=devices),
+            tensor_degree)
+
+
+def time_decode(batch, prompt_len=128, new_tokens=64, preset="gpt2",
+                mesh=None, **overrides):
+    """Compile and time the GPT-2 serve path: ONE batched prefill
+    dispatch of a (batch, prompt_len) prompt (TTFT, 3 repetitions)
+    followed by `new_tokens` jitted greedy decode steps against the KV
+    cache (steady-state decode tokens/s).
+
+    Returns (ttft_best_ms, tok_s, engine_stats, n_chips) — the
+    measurements flow through the serve engine-telemetry layer
+    (serve/telemetry.py), so the reported p50/p95/p99 TTFT and
+    inter-token percentiles come from the SAME code path
+    `engine_stats()` serves in production.  Per-step timestamps are
+    host-side dispatch intervals (no extra device syncs; under async
+    dispatch they track device step time once the pipeline
+    backpressures).  `mesh` tensor-parallelises the whole path: params
+    are committed under DECODE_RULES and the prefilled cache inherits
+    their sharding through GSPMD, so the step program spans every mesh
+    chip.  Shared by main(--decode) and sweep_tpu.py decode variants
+    so the methodology has one source of truth."""
     import jax
     import jax.numpy as jnp
 
-    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models import (gpt2_config, gpt2_init,
+                                gpt2_logical_axes)
     from ray_tpu.models.decode_common import (make_vocab_tail_mask,
                                               sample_token)
     from ray_tpu.models.gpt2_decode import decode_step, prefill
@@ -264,6 +290,14 @@ def time_decode(batch, prompt_len=128, new_tokens=64, preset="gpt2",
         raise ValueError(f"prompt_len {prompt_len} + new_tokens "
                          f"{new_tokens} exceeds max_seq={cfg.max_seq}")
     params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    n_chips = 1
+    if mesh is not None:
+        from ray_tpu.parallel.sharding import (DECODE_RULES,
+                                               shard_by_shape)
+
+        params = shard_by_shape(params, gpt2_logical_axes(cfg), mesh,
+                                DECODE_RULES)
+        n_chips = int(mesh.size)
     toks = jax.random.randint(jax.random.PRNGKey(1),
                               (batch, prompt_len), 0, cfg.vocab_size)
     tail = make_vocab_tail_mask(cfg)
@@ -308,7 +342,7 @@ def time_decode(batch, prompt_len=128, new_tokens=64, preset="gpt2",
     dt = time.perf_counter() - t0
     tok_s = batch * new_tokens / dt
     telemetry.record_finish(rec, n_tokens=new_tokens)
-    return ttft_ms, tok_s, telemetry.engine_stats()
+    return ttft_ms, tok_s, telemetry.engine_stats(), n_chips
 
 
 def main_decode(args, on_tpu: bool) -> None:
@@ -333,9 +367,13 @@ def main_decode(args, on_tpu: bool) -> None:
     cfg_kw = {}
     if args.flash_resident:
         cfg_kw["flash_resident"] = args.flash_resident
-    ttft_best_ms, tok_s, stats = time_decode(
+    mesh, n_chips = (decode_mesh(args.chips or 1)
+                     if args.mesh == "tensor" else (None, 1))
+    if mesh is not None:
+        base += "_sharded"
+    ttft_best_ms, tok_s, stats, n_chips = time_decode(
         batch, prompt_len=prompt_len, new_tokens=new_tokens,
-        preset=preset, **cfg_kw)
+        preset=preset, mesh=mesh, **cfg_kw)
     # Headline TTFT is the p50 from engine_stats() (the same snapshot
     # the serve layer exposes), not the ad-hoc best-of-3 min — that
     # stays in detail as ttft_best_ms for continuity with old lines.
@@ -345,8 +383,10 @@ def main_decode(args, on_tpu: bool) -> None:
     engine = {"ttft_ms": stats["ttft_ms"],
               "inter_token_ms": stats["inter_token_ms"],
               "tokens_per_sec": stats["tokens_per_sec"]}
-    detail = {"chips": 1, "batch": batch, "prompt_len": prompt_len,
+    detail = {"chips": n_chips, "batch": batch,
+              "prompt_len": prompt_len,
               "new_tokens": new_tokens, "preset": preset,
+              "mesh": ({"tensor": n_chips} if mesh is not None else {}),
               "flash_resident": args.flash_resident or "auto",
               "backend": jax.default_backend(), "tpu_error": TPU_ERROR,
               "ttft_best_ms": round(ttft_best_ms, 2), "engine": engine}
@@ -359,6 +399,14 @@ def main_decode(args, on_tpu: bool) -> None:
         "value": round(tok_s, 1), "unit": "tokens/s",
         "vs_baseline": None,
         "detail": dict(detail, prefill_ttft_ms=round(ttft_ms, 2))}))
+    # Per-chip normalization is the A/B-able number for tensor degree
+    # 1 vs 4 vs 8: raw tokens/s conflates chip count with efficiency.
+    print(json.dumps({
+        "metric": f"{base}_tokens_per_sec_per_chip",
+        "value": round(tok_s / max(1, n_chips), 1),
+        "unit": "tokens/s/chip", "vs_baseline": None,
+        "detail": dict(detail, tokens_per_sec=round(tok_s, 1),
+                       prefill_ttft_ms=round(ttft_ms, 2))}))
 
 
 def main_traffic(args, on_tpu: bool) -> None:
@@ -396,18 +444,32 @@ def main_traffic(args, on_tpu: bool) -> None:
                   latency_slo_ms=60000.0, time_scale=0.0,
                   config_overrides={"dtype": jnp.float32,
                                     "use_flash": False})
+    mesh, n_chips = (decode_mesh(args.chips or 1)
+                     if args.mesh == "tensor" else (None, 1))
+    if mesh is not None:
+        base += "_sharded"
     rep = run_traffic(
         spec, family="gpt2", preset=preset,
-        kv_layout=args.kv_layout,
+        kv_layout=args.kv_layout, mesh=mesh,
         admission_policy=AdmissionPolicy(max_queue_depth=4 * n),
         **kw)
     eng = rep["engine"]
-    detail = {"chips": 1, "requests": rep["offered"],
+    # Per-chip normalized throughput + the mesh axes the engine
+    # actually ran with (from its own stats block — axes of size 1 are
+    # already dropped there), so sharded traffic lines are A/B-able
+    # against the single-chip ones without re-deriving chip counts.
+    mesh_axes = eng.get("mesh", {}).get("axes", {})
+    tok_s = eng["tokens_per_sec"]
+    detail = {"chips": n_chips, "requests": rep["offered"],
               "completed": rep["completed"], "shed": rep["shed"],
               "kv_layout": args.kv_layout, "preset": preset,
+              "mesh_axes": mesh_axes,
               "backend": jax.default_backend(), "tpu_error": TPU_ERROR,
               "latency_ms": rep["latency_ms"],
-              "tokens_per_sec": eng["tokens_per_sec"],
+              "tokens_per_sec": tok_s,
+              "tokens_per_sec_per_chip":
+                  (round(tok_s / max(1, n_chips), 1)
+                   if isinstance(tok_s, (int, float)) else tok_s),
               "ttft_ms": eng["ttft_ms"],
               "kv_cache": eng.get("kv_cache"),
               "rejections_by_reason": eng["rejections_by_reason"]}
@@ -449,6 +511,10 @@ def main(args=None):
         return main_decode(args, jax.default_backend() == "tpu")
     if args.traffic:
         return main_traffic(args, jax.default_backend() == "tpu")
+    if args.mesh == "tensor":
+        raise SystemExit("--mesh tensor is a serve layout; combine it "
+                         "with --decode or --traffic (train layouts: "
+                         "data, fsdp, data_fsdp)")
     n_chips = len(jax.devices())
     if args.chips:
         n_chips = min(n_chips, args.chips)

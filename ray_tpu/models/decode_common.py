@@ -87,6 +87,51 @@ def paged_update_and_view(layer, block_tables, pos, new):
     return layer, view.reshape(b, nb * bs, *layer.shape[2:])
 
 
+def cache_logical_axes(cache):
+    """Logical-axis pytree matching a decode cache, dense or paged.
+    The heads axis sits at index 3 in BOTH layouts — dense K/V is
+    (L, B, S, H, hd), the paged pool is (L, num_blocks, bs, H, hd) —
+    so one annotation serves both, and under DECODE_RULES only that
+    dim splits (over `tensor`).  pos/start/block_tables stay
+    replicated: they are the host scheduler's view of the pool and
+    must be readable without collectives."""
+    axes = {"k": (None, None, None, "heads", "head_dim"),
+            "v": (None, None, None, "heads", "head_dim"),
+            "pos": (None,), "start": (None,)}
+    if "block_tables" in cache:
+        axes["block_tables"] = (None, None)
+    return axes
+
+
+def cache_shardings(cache, mesh, rules=None):
+    """NamedSharding pytree for a cache on `mesh` (shape-guarded, so
+    a KV-head count that doesn't divide the tensor degree replicates
+    instead of erroring — llama nano GQA with one KV head)."""
+    from ray_tpu.parallel.sharding import (DECODE_RULES,
+                                           shardings_by_shape)
+    return shardings_by_shape(cache, cache_logical_axes(cache), mesh,
+                              rules if rules is not None
+                              else DECODE_RULES)
+
+
+def shard_cache(cache, mesh, rules=None):
+    """Commit an existing cache's leaves to the mesh (device_put).
+    Used when re-laying an already-populated cache; fresh caches
+    should go through partitioned_cache_init instead so the full pool
+    never materialises on one chip."""
+    return jax.device_put(cache, cache_shardings(cache, mesh, rules))
+
+
+def partitioned_cache_init(build_fn, mesh, rules=None):
+    """Materialise a zeros cache directly in partitioned form:
+    eval_shape the builder, derive guarded shardings, then jit it with
+    out_shardings so each chip allocates only its own KV-pool shard.
+    A 7B-class pool born this way never exists unsharded anywhere."""
+    shapes = jax.eval_shape(build_fn)
+    shardings = cache_shardings(shapes, mesh, rules)
+    return jax.jit(build_fn, out_shardings=shardings)()
+
+
 def dense_to_paged(cache, block_size: int):
     """Re-lay a dense cache into a fresh block pool (row-major block
     tables, block 0 reserved as the null block).  Pure reshape +

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.models import decode_common
 from ray_tpu.models.decode_common import (generate_with, is_paged,
                                           paged_update_and_view,
                                           scan_prefill, slot_mask)
@@ -42,26 +43,38 @@ __all__ = ["init_cache", "init_paged_cache", "prefill", "paged_prefill",
            "decode_step", "generate"]
 
 
-def init_cache(cfg: GPT2Config, batch: int) -> Dict[str, jnp.ndarray]:
+def init_cache(cfg: GPT2Config, batch: int,
+               mesh=None) -> Dict[str, jnp.ndarray]:
     """Preallocated (L, B, S, H, hd) key/value cache + per-sequence
-    position vectors (decode_common cache contract)."""
+    position vectors (decode_common cache contract).  With `mesh`, the
+    cache is born partitioned (heads over `tensor`; each chip
+    allocates only its shard)."""
     if cfg.n_experts:
         raise NotImplementedError(
             "KV-cache decoding currently supports dense GPT-2 configs "
             "only (n_experts=0); MoE decode needs per-step routing")
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_head, cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-            "pos": jnp.zeros((batch,), jnp.int32),
-            "start": jnp.zeros((batch,), jnp.int32)}
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "pos": jnp.zeros((batch,), jnp.int32),
+                "start": jnp.zeros((batch,), jnp.int32)}
+
+    if mesh is None:
+        return build()
+    return decode_common.partitioned_cache_init(build, mesh)
 
 
 def init_paged_cache(cfg: GPT2Config, batch: int, *, num_blocks: int,
-                     block_size: int) -> Dict[str, jnp.ndarray]:
+                     block_size: int,
+                     mesh=None) -> Dict[str, jnp.ndarray]:
     """Block-pool cache (decode_common paged contract): K/V pools of
     (L, num_blocks, block_size, H, hd) shared by all rows, per-row
     block tables initialized to the reserved null block 0 (rows hold no
-    storage until the pager assigns blocks)."""
+    storage until the pager assigns blocks).  With `mesh`, the pool is
+    born partitioned — pool heads split over `tensor`, block tables /
+    pos / start replicated so the host pager stays layout-agnostic."""
     if cfg.n_experts:
         raise NotImplementedError(
             "KV-cache decoding currently supports dense GPT-2 configs "
@@ -71,12 +84,18 @@ def init_paged_cache(cfg: GPT2Config, batch: int, *, num_blocks: int,
                          f"block_size={block_size}")
     shape = (cfg.n_layer, num_blocks, block_size, cfg.n_head,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-            "block_tables": jnp.zeros(
-                (batch, cfg.max_seq // block_size), jnp.int32),
-            "pos": jnp.zeros((batch,), jnp.int32),
-            "start": jnp.zeros((batch,), jnp.int32)}
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "block_tables": jnp.zeros(
+                    (batch, cfg.max_seq // block_size), jnp.int32),
+                "pos": jnp.zeros((batch,), jnp.int32),
+                "start": jnp.zeros((batch,), jnp.int32)}
+
+    if mesh is None:
+        return build()
+    return decode_common.partitioned_cache_init(build, mesh)
 
 
 def prefill(params, tokens: jnp.ndarray, cfg: GPT2Config, *,

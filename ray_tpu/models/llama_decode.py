@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ray_tpu.models import decode_common
 from ray_tpu.models.decode_common import (generate_with, is_paged,
                                           paged_update_and_view,
                                           scan_prefill, slot_mask)
@@ -29,35 +30,51 @@ __all__ = ["llama_init_cache", "llama_init_paged_cache",
            "llama_generate"]
 
 
-def llama_init_cache(cfg: LlamaConfig, batch: int
-                     ) -> Dict[str, jnp.ndarray]:
+def llama_init_cache(cfg: LlamaConfig, batch: int,
+                     mesh=None) -> Dict[str, jnp.ndarray]:
     """(L, B, S, n_kv_head, hd) key/value cache + per-sequence position
-    vectors (decode_common cache contract)."""
+    vectors (decode_common cache contract).  With `mesh`, the cache is
+    born partitioned — KV heads over `tensor` when n_kv_head divides
+    the tensor degree, replicated otherwise (GQA guard)."""
     shape = (cfg.n_layer, batch, cfg.max_seq, cfg.n_kv_head,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-            "pos": jnp.zeros((batch,), jnp.int32),
-            "start": jnp.zeros((batch,), jnp.int32)}
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "pos": jnp.zeros((batch,), jnp.int32),
+                "start": jnp.zeros((batch,), jnp.int32)}
+
+    if mesh is None:
+        return build()
+    return decode_common.partitioned_cache_init(build, mesh)
 
 
 def llama_init_paged_cache(cfg: LlamaConfig, batch: int, *,
-                           num_blocks: int, block_size: int
-                           ) -> Dict[str, jnp.ndarray]:
+                           num_blocks: int, block_size: int,
+                           mesh=None) -> Dict[str, jnp.ndarray]:
     """Block-pool cache (decode_common paged contract): K/V pools of
     (L, num_blocks, block_size, n_kv_head, hd) shared by all rows,
-    per-row block tables initialized to the reserved null block 0."""
+    per-row block tables initialized to the reserved null block 0.
+    With `mesh`, the pool is born partitioned (see llama_init_cache;
+    tables/pos/start stay replicated for the host pager)."""
     if cfg.max_seq % block_size:
         raise ValueError(f"max_seq={cfg.max_seq} must be a multiple of "
                          f"block_size={block_size}")
     shape = (cfg.n_layer, num_blocks, block_size, cfg.n_kv_head,
              cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype),
-            "v": jnp.zeros(shape, cfg.dtype),
-            "block_tables": jnp.zeros(
-                (batch, cfg.max_seq // block_size), jnp.int32),
-            "pos": jnp.zeros((batch,), jnp.int32),
-            "start": jnp.zeros((batch,), jnp.int32)}
+
+    def build():
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype),
+                "block_tables": jnp.zeros(
+                    (batch, cfg.max_seq // block_size), jnp.int32),
+                "pos": jnp.zeros((batch,), jnp.int32),
+                "start": jnp.zeros((batch,), jnp.int32)}
+
+    if mesh is None:
+        return build()
+    return decode_common.partitioned_cache_init(build, mesh)
 
 
 def _rope_at(x, cos_t, sin_t):

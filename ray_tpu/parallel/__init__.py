@@ -31,9 +31,13 @@ from ray_tpu.parallel.mesh import (
 from ray_tpu.parallel.sharding import (
     LogicalAxisRules,
     logical_to_mesh_axes,
+    mesh_axes_for_shape,
+    shard_by_shape,
+    shardings_by_shape,
     shard_params,
     with_logical_constraint,
     DEFAULT_RULES,
+    DECODE_RULES,
 )
 from ray_tpu.parallel import collective
 
@@ -41,7 +45,9 @@ __all__ = [
     "TpuGeneration", "SliceTopology", "parse_accelerator_type",
     "ici_domains", "MeshSpec", "make_mesh", "make_hybrid_mesh",
     "active_mesh", "fake_mesh", "local_mesh", "LogicalAxisRules", "logical_to_mesh_axes",
-    "shard_params", "with_logical_constraint", "DEFAULT_RULES", "collective",
+    "mesh_axes_for_shape", "shard_by_shape", "shardings_by_shape",
+    "shard_params", "with_logical_constraint", "DEFAULT_RULES",
+    "DECODE_RULES", "collective",
     "AXIS_DATA", "AXIS_FSDP", "AXIS_TENSOR", "AXIS_SEQ", "AXIS_EXPERT",
     "AXIS_PIPELINE",
 ]

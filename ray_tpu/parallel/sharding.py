@@ -36,6 +36,28 @@ DEFAULT_RULES: LogicalAxisRules = (
     ("norm", None),
 )
 
+# Serving table (round 9): pure tensor parallelism.  Decode is
+# latency-bound with tiny per-step batches, so there is no ZeRO
+# (embed stays replicated — gathering fsdp-sharded weights every token
+# would dominate the step) and the batch/seq dims stay local to keep
+# the slot pool addressable from the host scheduler.  Only the
+# model-parallel dims split: attention heads + KV pool heads, MLP
+# hidden, and the lm-head vocab over `tensor`.
+DECODE_RULES: LogicalAxisRules = (
+    ("batch", None),
+    ("seq", None),
+    ("embed", None),
+    ("mlp", AXIS_TENSOR),
+    ("heads", AXIS_TENSOR),
+    ("kv_heads", AXIS_TENSOR),
+    ("kv", None),
+    ("head_dim", None),
+    ("vocab", AXIS_TENSOR),
+    ("expert", None),
+    ("stage", None),
+    ("norm", None),
+)
+
 
 def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
                          rules: LogicalAxisRules = DEFAULT_RULES):
@@ -60,6 +82,58 @@ def logical_to_mesh_axes(logical_axes: Sequence[Optional[str]],
     while out and out[-1] is None:
         out.pop()
     return PartitionSpec(*out)
+
+
+def mesh_axes_for_shape(shape, logical_axes, mesh,
+                        rules: LogicalAxisRules = DEFAULT_RULES):
+    """logical_to_mesh_axes with a divisibility guard: any mesh axis
+    group whose size product does not divide the corresponding array
+    dim is dropped (the dim replicates instead of erroring).  This is
+    what lets one rule table serve every model shape — e.g. llama
+    nano's single KV head cannot split over tensor=2, so its wk/wv and
+    KV pool replicate while the 2 query heads still shard."""
+    from jax.sharding import PartitionSpec
+
+    spec = logical_to_mesh_axes(logical_axes, rules)
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    out: List[Union[str, Tuple[str, ...], None]] = []
+    for dim, ax in zip(shape, parts):
+        names = (ax,) if isinstance(ax, str) else tuple(ax or ())
+        size = 1
+        for a in names:
+            size *= mesh.shape[a]
+        out.append(ax if (size > 1 and dim % size == 0) else None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def shardings_by_shape(tree, logical_axes, mesh,
+                       rules: LogicalAxisRules = DEFAULT_RULES):
+    """NamedSharding pytree for `tree` (arrays or ShapeDtypeStructs)
+    under the shape-guarded mapping — for jit in_/out_shardings."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    def one(leaf, axes):
+        spec = mesh_axes_for_shape(leaf.shape, axes, mesh, rules)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree.map(one, tree, logical_axes,
+                        is_leaf=lambda x: x is None)
+
+
+def shard_by_shape(tree, logical_axes, mesh,
+                   rules: LogicalAxisRules = DEFAULT_RULES):
+    """Device-put a pytree onto the mesh with the shape-guarded
+    mapping (non-dividing dims replicate).  The committed shardings
+    propagate through jit, so existing jitted programs become SPMD
+    without re-annotation."""
+    import jax
+
+    return jax.device_put(tree,
+                          shardings_by_shape(tree, logical_axes, mesh,
+                                             rules))
 
 
 def shard_params(params, logical_axes, mesh, rules: LogicalAxisRules =

@@ -46,7 +46,8 @@ class BlockPager:
     sequence (max_seq // block_size) or admission could never succeed.
     """
 
-    def __init__(self, num_blocks: int, block_size: int, max_seq: int):
+    def __init__(self, num_blocks: int, block_size: int, max_seq: int,
+                 *, bytes_per_block: int = 0, tensor_shards: int = 1):
         if max_seq % block_size:
             raise ValueError(f"max_seq={max_seq} must be a multiple of "
                              f"block_size={block_size}")
@@ -57,6 +58,13 @@ class BlockPager:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.max_seq = int(max_seq)
+        # accounting only — the pager never touches device memory.
+        # bytes_per_block is the GLOBAL K+V footprint of one block
+        # across all layers; tensor_shards is how many ways the pool's
+        # head dim is split over the mesh, so stats() can report the
+        # per-chip resident bytes a sharded pool actually costs.
+        self.bytes_per_block = int(bytes_per_block)
+        self.tensor_shards = max(1, int(tensor_shards))
         # LIFO free list: recently-freed blocks are re-used first
         # (warmer HBM pages on real hardware, denser tests)
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
@@ -219,7 +227,7 @@ class BlockPager:
 
     def stats(self) -> Dict[str, float]:
         total = self.prefix_hits + self.prefix_misses
-        return {
+        out = {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "blocks_in_use": self.blocks_in_use,
@@ -232,3 +240,9 @@ class BlockPager:
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
         }
+        if self.bytes_per_block:
+            out["pool_bytes"] = self.bytes_per_block * self.num_blocks
+            out["pool_bytes_per_chip"] = \
+                out["pool_bytes"] // self.tensor_shards
+            out["tensor_shards"] = self.tensor_shards
+        return out

@@ -41,18 +41,21 @@ from ray_tpu.serve.telemetry import EngineTelemetry
 
 def _family_fns(family: str):
     """(config_fn, init_fn, generate_fn, prefill_fn, step_fn,
-    init_cache_fn, init_paged_cache_fn, paged_prefill_fn) for a
-    decoder family."""
+    init_cache_fn, init_paged_cache_fn, paged_prefill_fn,
+    logical_axes_fn) for a decoder family."""
     if family == "gpt2":
-        from ray_tpu.models import gpt2_config, gpt2_init
+        from ray_tpu.models import (gpt2_config, gpt2_init,
+                                    gpt2_logical_axes)
         from ray_tpu.models.gpt2_decode import (decode_step, generate,
                                                 init_cache,
                                                 init_paged_cache,
                                                 paged_prefill, prefill)
 
         return (gpt2_config, gpt2_init, generate, prefill, decode_step,
-                init_cache, init_paged_cache, paged_prefill)
-    from ray_tpu.models import llama_config, llama_init
+                init_cache, init_paged_cache, paged_prefill,
+                gpt2_logical_axes)
+    from ray_tpu.models import (llama_config, llama_init,
+                                llama_logical_axes)
     from ray_tpu.models.llama_decode import (llama_decode_step,
                                              llama_generate,
                                              llama_init_cache,
@@ -62,25 +65,30 @@ def _family_fns(family: str):
 
     return (llama_config, llama_init, llama_generate, llama_prefill,
             llama_decode_step, llama_init_cache,
-            llama_init_paged_cache, llama_paged_prefill)
+            llama_init_paged_cache, llama_paged_prefill,
+            llama_logical_axes)
 
 
 # jax's compile cache is keyed by the jitted function OBJECT, so a
 # fresh `jax.jit(closure)` per engine instance recompiles every
 # program for every instance — pathological for test suites and
 # notebooks that build many short-lived engines.  The continuous
-# engine's programs depend only on (family fns, config, temperature);
-# configs are frozen dataclasses (hashable, value-equal), so
-# equal-config engines can share ONE set of jitted callables and
-# therefore one compile.
+# engine's programs depend only on (family fns, config, temperature,
+# kv layout, mesh); configs are frozen dataclasses and jax Meshes are
+# hashable by (axis names, device assignment), so equal-config engines
+# can share ONE set of jitted callables and therefore one compile —
+# while engines that differ only in layout or mesh get their own
+# entries instead of colliding.
 _JIT_CACHE: Dict[Any, Any] = {}
 
 
 def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
-                       temperature):
+                       temperature, kv_layout="dense", mesh=None):
     """(prefill, paged_prefill, pool_step, admit, copy_block,
-    clear_row) jitted programs for one (family, cfg, temperature)."""
-    key = (prefill_fn, step_fn, paged_prefill_fn, cfg, temperature)
+    clear_row) jitted programs for one (family, cfg, temperature,
+    kv_layout, mesh) engine identity."""
+    key = (prefill_fn, step_fn, paged_prefill_fn, cfg, temperature,
+           kv_layout, mesh)
     cached = _JIT_CACHE.get(key)
     if cached is not None:
         return cached
@@ -148,6 +156,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          kv_block_size: int = 16,
                          kv_num_blocks: Optional[int] = None,
                          admission_policy=None,
+                         mesh=None,
                          config_overrides: Optional[Dict[str, Any]]
                          = None):
     """A serve Deployment generating continuations for int32
@@ -169,6 +178,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     admission_policy: a serve.batching.AdmissionPolicy closing the
     telemetry loop — requests are load-shed with OverloadedError when
     its queue-depth / queue-wait / TTFT gates trip.
+    mesh: a `jax.sharding.Mesh` to tensor-parallelise the engine over
+    (continuous scheduler only).  Params and the KV pool are committed
+    to the mesh under parallel.sharding.DECODE_RULES — attention
+    heads, MLP hidden, lm-head vocab, and the pool's KV-head dim split
+    over the `tensor` axis (dims the degree doesn't divide replicate);
+    the committed input shardings propagate through the existing
+    jitted programs, so one pool step spans all chips.  Block tables
+    and the BlockPager stay host-side and layout-agnostic.  None (the
+    default) keeps today's single-device behaviour.
     checkpoint_path: pickled param pytree (matching the family's init
     layout); absent → fresh init from `seed` (tests/demos)."""
     if family not in ("gpt2", "llama"):
@@ -183,6 +201,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
         raise ValueError("kv_layout='paged' requires "
                          "scheduler='continuous' (the block pager "
                          "lives in the continuous engine)")
+    if mesh is not None and scheduler != "continuous":
+        raise ValueError("mesh-sharded serving requires "
+                         "scheduler='continuous' (the batch scheduler "
+                         "is single-device)")
 
     class LLM:
         def __init__(self):
@@ -191,8 +213,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
             overrides = dict(config_overrides or {})
             (config_fn, init_fn, gen_fn, prefill_fn, step_fn,
-             init_cache_fn, init_paged_fn,
-             paged_prefill_fn) = _family_fns(family)
+             init_cache_fn, init_paged_fn, paged_prefill_fn,
+             logical_axes_fn) = _family_fns(family)
             self.cfg = config_fn(preset, **overrides)
             if checkpoint_path:
                 with open(checkpoint_path, "rb") as f:
@@ -201,6 +223,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             else:
                 self.params = init_fn(jax.random.PRNGKey(seed),
                                       self.cfg)
+            self.mesh = mesh
+            if mesh is not None:
+                # commit params to the mesh once at construction; the
+                # committed shardings propagate through every jitted
+                # program below, turning them SPMD without annotation
+                from ray_tpu.parallel.sharding import (DECODE_RULES,
+                                                       shard_by_shape)
+                self.params = shard_by_shape(
+                    self.params, logical_axes_fn(self.cfg), mesh,
+                    DECODE_RULES)
             # per-call PRNG threading: without it every temperature>0
             # request would sample under the same default key and
             # return identical "random" continuations
@@ -297,8 +329,26 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
         # "continuous" scheduler: slot pool with mid-flight admission
         # ------------------------------------------------------------
 
+        @staticmethod
+        def _kv_heads(cfg):
+            # llama GQA caches n_kv_head; gpt2 caches n_head
+            return getattr(cfg, "n_kv_head", None) or cfg.n_head
+
+        def _kv_shards(self) -> int:
+            """How many ways the KV pool's head dim actually splits on
+            the active mesh (1 when mesh-less or when the head count
+            doesn't divide the tensor degree — the GQA guard)."""
+            if self.mesh is None:
+                return 1
+            from ray_tpu.parallel.mesh import AXIS_TENSOR
+            t = int(self.mesh.shape.get(AXIS_TENSOR, 1))
+            return t if t > 1 and self._kv_heads(self.cfg) % t == 0 \
+                else 1
+
         def _init_continuous(self, prefill_fn, step_fn, init_cache_fn,
                              init_paged_fn, paged_prefill_fn):
+            import jax.numpy as jnp
+
             cfg = self.cfg
             self._pager = None
             if kv_layout == "paged":
@@ -310,13 +360,21 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # COW forks survive a fully-occupied pool
                 n_blocks = (kv_num_blocks if kv_num_blocks is not None
                             else 1 + (max_slots + 1) * max_blk)
+                bytes_per_block = (2 * cfg.n_layer * kv_block_size
+                                   * self._kv_heads(cfg)
+                                   * cfg.head_dim
+                                   * jnp.dtype(cfg.dtype).itemsize)
                 self._pager = BlockPager(n_blocks, kv_block_size,
-                                         cfg.max_seq)
+                                         cfg.max_seq,
+                                         bytes_per_block=bytes_per_block,
+                                         tensor_shards=self._kv_shards())
                 self._cache = init_paged_fn(cfg, max_slots,
                                             num_blocks=n_blocks,
-                                            block_size=kv_block_size)
+                                            block_size=kv_block_size,
+                                            mesh=self.mesh)
             else:
-                self._cache = init_cache_fn(cfg, max_slots)
+                self._cache = init_cache_fn(cfg, max_slots,
+                                            mesh=self.mesh)
             self._cur = np.zeros((max_slots,), np.int32)
             self._slots = [None] * max_slots
             self._queue = RequestQueue()
@@ -326,7 +384,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             (self._prefill, self._paged_prefill, self._pool_step,
              self._admit, self._copy_block, self._clear_row) = \
                 _jitted_engine_fns(prefill_fn, step_fn,
-                                   paged_prefill_fn, cfg, temperature)
+                                   paged_prefill_fn, cfg, temperature,
+                                   kv_layout=kv_layout, mesh=self.mesh)
 
         def _admit_pending(self) -> None:
             """Prefill queued requests into free slots (one batched
@@ -592,6 +651,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             stats = self._telemetry.engine_stats()
             if admission_policy is not None:
                 stats["admission_policy"] = admission_policy.describe()
+            if getattr(self, "mesh", None) is not None:
+                stats["mesh"] = {
+                    "axes": {a: int(s)
+                             for a, s in self.mesh.shape.items()
+                             if int(s) > 1},
+                    "n_devices": int(self.mesh.size),
+                    "kv_shards": self._kv_shards(),
+                }
             return stats
 
         def export_timeline(self, path=None):

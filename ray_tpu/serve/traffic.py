@@ -166,14 +166,17 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 max_new_tokens: int = 8, prefill_bucket: int = 16,
                 time_scale: float = 0.0,
                 latency_slo_ms: Optional[float] = None,
-                admission_policy=None,
+                admission_policy=None, mesh=None,
                 config_overrides: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
     """One synthetic-traffic run against a fresh in-process engine
     (no serve cluster: the deployment class is instantiated directly,
     same trick the serve tests use).  Returns the :func:`drive` report
     plus the engine's ``engine_stats()`` snapshot — prefix-hit rate
-    and kv_cache occupancy ride along when ``kv_layout="paged"``."""
+    and kv_cache occupancy ride along when ``kv_layout="paged"``.
+    `mesh` tensor-parallelises the engine (see build_llm_deployment);
+    the report then carries the engine's mesh block for per-chip
+    normalisation downstream (bench --traffic, SWEEPJSON)."""
     import asyncio
 
     from ray_tpu.serve.llm import build_llm_deployment
@@ -183,7 +186,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         max_new_tokens=max_new_tokens, temperature=0.0,
         prefill_bucket=prefill_bucket, kv_layout=kv_layout,
         kv_block_size=kv_block_size,
-        admission_policy=admission_policy,
+        admission_policy=admission_policy, mesh=mesh,
         config_overrides=config_overrides)
     requests = TrafficGenerator(spec).requests()
 

@@ -31,6 +31,22 @@ Rules (ids as reported / suppressed):
   dropped donation doubles parameter+optimizer HBM.
 * ``hbm-budget`` — a liveness-based peak-bytes estimate of the traced
   program checked against the budget the program declares.
+* ``collectives`` — a mesh-sharded program's COMPILED HLO must contain
+  the collectives its sharding implies (``require_collectives``
+  substrings, e.g. the tensor-axis all-gather/all-reduce of TP
+  attention) and must NOT contain any ``forbid_hlo_shapes`` substring
+  (full-shape buffers that prove an input was silently replicated —
+  the KV pool showing up unsharded is the regression this catches).
+* ``per-chip-hbm`` — the compiled per-partition footprint
+  (``memory_analysis().argument_size_in_bytes + temp_size_in_bytes``,
+  which SPMD partitioning reports per chip) checked against
+  ``per_chip_hbm_budget_bytes``.  Unlike ``hbm-budget`` this sees the
+  post-partitioning sizes, so a pool that stopped sharding trips it
+  even if the traced (global) program is unchanged.
+
+Sharded specs declare ``min_devices``; on hosts with fewer devices the
+spec is skipped with an info note instead of failing (tier-1 forces 8
+virtual CPU devices via tests/conftest.py, so CI always runs them).
 
 The estimator is conservative-but-approximate: it walks the flattened
 equation list with last-use liveness and adds each inner jaxpr's own
@@ -201,6 +217,16 @@ class ProgramSpec:
     hbm_budget_bytes: Optional[int] = None
     allow_f32_matmul: bool = False
     skip_rules: Tuple[str, ...] = ()
+    #: skip the spec (info note, not a failure) below this device count
+    min_devices: int = 1
+    #: substrings that must appear in the compiled HLO (collectives a
+    #: sharded program cannot be correct without)
+    require_collectives: Tuple[str, ...] = ()
+    #: substrings that must NOT appear in the compiled HLO (full
+    #: unsharded buffer shapes = silent replication)
+    forbid_hlo_shapes: Tuple[str, ...] = ()
+    #: compiled per-partition arg+temp byte ceiling
+    per_chip_hbm_budget_bytes: Optional[int] = None
 
 
 def _check_host_transfer(jaxpr, spec) -> List[Violation]:
@@ -300,6 +326,54 @@ def _check_donation(fn, args, spec) -> List[Violation]:
     return []
 
 
+def _check_compiled(fn, args, spec) -> Tuple[List[Violation],
+                                             Dict[str, Any]]:
+    """Lower + compile once and run the HLO-text rules: required
+    collectives, forbidden (replicated) shapes, and the per-partition
+    footprint.  Compilation is the only way to see these — collectives
+    are inserted by the SPMD partitioner, after the jaxpr."""
+    import jax
+
+    out: List[Violation] = []
+    compiled = jax.jit(fn).lower(*args).compile()
+    hlo = compiled.as_text()
+    if "collectives" not in spec.skip_rules:
+        for pat in spec.require_collectives:
+            if pat not in hlo:
+                out.append(Violation(
+                    "collectives",
+                    f"compiled program contains no '{pat}' — the mesh "
+                    f"sharding this spec declares implies one; the "
+                    f"inputs are likely no longer committed to the "
+                    f"mesh", program=spec.name))
+        for pat in spec.forbid_hlo_shapes:
+            if pat in hlo:
+                out.append(Violation(
+                    "collectives",
+                    f"compiled program materializes forbidden "
+                    f"full-shape buffer '{pat}' — an input meant to be "
+                    f"sharded is being replicated", program=spec.name))
+    info: Dict[str, Any] = {}
+    if spec.per_chip_hbm_budget_bytes \
+            and "per-chip-hbm" not in spec.skip_rules:
+        ma = compiled.memory_analysis()
+        # arg+temp is the per-partition resident footprint; outputs
+        # alias args under donation so counting them would double-bill
+        per_chip = int(ma.argument_size_in_bytes
+                       + ma.temp_size_in_bytes)
+        info["per_chip_hbm_bytes"] = per_chip
+        info["per_chip_hbm_budget_bytes"] = \
+            spec.per_chip_hbm_budget_bytes
+        if per_chip > spec.per_chip_hbm_budget_bytes:
+            out.append(Violation(
+                "per-chip-hbm",
+                f"compiled per-chip footprint {per_chip / 2**20:.2f} "
+                f"MiB exceeds the declared per-chip budget "
+                f"{spec.per_chip_hbm_budget_bytes / 2**20:.2f} MiB",
+                program=spec.name))
+    return out, info
+
+
 def audit_program(spec: ProgramSpec
                   ) -> Tuple[List[Violation], Dict[str, Any]]:
     """Trace one program and run every rule it doesn't skip.  Returns
@@ -307,6 +381,9 @@ def audit_program(spec: ProgramSpec
     rides into the JSON report (eqn count, peak-HBM estimate)."""
     import jax
 
+    if len(jax.devices()) < spec.min_devices:
+        return [], {"skipped": f"requires >= {spec.min_devices} "
+                               f"devices, have {len(jax.devices())}"}
     fn, args = spec.build()
     closed = jax.make_jaxpr(fn)(*args)
     jaxpr = closed.jaxpr
@@ -342,6 +419,11 @@ def audit_program(spec: ProgramSpec
                 f"declared budget "
                 f"{spec.hbm_budget_bytes / 2**20:.2f} MiB",
                 program=spec.name))
+    if (spec.require_collectives or spec.forbid_hlo_shapes
+            or spec.per_chip_hbm_budget_bytes):
+        vs, compiled_info = _check_compiled(fn, args, spec)
+        violations.extend(vs)
+        info.update(compiled_info)
     return violations, info
 
 

@@ -166,6 +166,39 @@ def _build_gpt2_paged_decode_step():
             (params, cache, toks))
 
 
+def _build_gpt2_sharded_decode_step():
+    """The paged decode step with params + pool committed to an
+    8-device (data=4, tensor=2) mesh under DECODE_RULES — the serve
+    engine's tensor-parallel configuration.  Compiled-HLO rules assert
+    the TP collectives exist and the full (unsharded) pool shape does
+    NOT: GSPMD silently replicating an input it can no longer shard is
+    exactly the regression class this spec exists to catch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init, gpt2_logical_axes
+    from ray_tpu.models.decode_common import shard_cache
+    from ray_tpu.models.gpt2_decode import decode_step, init_paged_cache
+    from ray_tpu.parallel import MeshSpec, fake_mesh
+    from ray_tpu.parallel.sharding import DECODE_RULES, shard_by_shape
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    mesh = fake_mesh(8, MeshSpec(data=4, tensor=2))
+    params = shard_by_shape(gpt2_init(jax.random.PRNGKey(0), cfg),
+                            gpt2_logical_axes(cfg), mesh, DECODE_RULES)
+    bs = 16
+    per_row = cfg.max_seq // bs
+    cache = init_paged_cache(cfg, _PB, num_blocks=1 + _PB * per_row,
+                             block_size=bs, mesh=mesh)
+    cache["block_tables"] = 1 + jnp.arange(
+        _PB * per_row, dtype=jnp.int32).reshape(_PB, per_row)
+    cache = shard_cache(cache, mesh)   # re-commit the edited tables
+    toks = jnp.zeros((_PB,), jnp.int32)
+    return (lambda p, c, t: decode_step(p, c, t, cfg),
+            (params, cache, toks))
+
+
 def _ce_inputs():
     import jax
     import jax.numpy as jnp
@@ -251,6 +284,26 @@ def default_programs() -> List[ProgramSpec]:
             # hidden dense re-materialization of the WHOLE pool per
             # layer would blow straight through it
             hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_sharded_decode_step",
+            build=_build_gpt2_sharded_decode_step,
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            min_devices=8,
+            # TP attention/MLP insert a tensor-axis all-gather (per-chip
+            # KV head shards -> the attention view) and all-reduce (the
+            # row-parallel o/proj partial sums); the full (L, 1+B*8,
+            # bs, H, hd) pool shape must never appear in the compiled
+            # HLO — its presence means GSPMD replicated the pool
+            require_collectives=("all-gather", "all-reduce"),
+            forbid_hlo_shapes=("f32[2,33,16,2,32]",),
+            hbm_budget_bytes=6 * _MiB,
+            # measured compiled per-partition arg+temp ~0.74 MiB on 8
+            # CPU devices (jax 0.4.37); ~2x headroom.  Pool-replication
+            # regressions are caught by the forbidden-shape rule above;
+            # this budget catches per-chip blowups from new temps (e.g.
+            # a densified per-layer pool copy inside the scan)
+            per_chip_hbm_budget_bytes=int(1.6 * _MiB)),
         ProgramSpec(
             name="fused_ce_fwd",
             build=_build_fused_ce_fwd,

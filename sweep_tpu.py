@@ -12,24 +12,39 @@ fence, per-chip batch and MFU normalization) stays identical to the
 official bench.
 
 Decode variants: {"mode": "decode", ...} routes the entry to
-bench.time_decode instead — batch is the TOTAL decode batch (the
-decode path is single-device), "seq"/"prompt_len" sets the prompt
-length, "new_tokens" the generated tokens; the SWEEPJSON record
-carries prefill_ttft_ms + decode_tok_s plus an "engine" sub-dict of
-p50/p95 TTFT and inter-token percentiles from engine_stats().  E.g.:
+bench.time_decode instead — batch is the TOTAL decode batch,
+"seq"/"prompt_len" sets the prompt length, "new_tokens" the generated
+tokens; the SWEEPJSON record carries prefill_ttft_ms + decode_tok_s
+plus an "engine" sub-dict of p50/p95 TTFT and inter-token percentiles
+from engine_stats().  E.g.:
 
   python sweep_tpu.py '[[8, {"mode": "decode"}],
                         [16, {"mode": "decode", "flash_resident": "on"}]]'
+
+{"mode": "decode_sharded", ...} tensor-parallelises the same decode
+harness over "tensor" local devices (default: every local device) via
+bench.decode_mesh — params committed under DECODE_RULES, the cache
+inheriting their sharding — and adds decode_tok_s_chip + the tensor
+degree so A/Bs of degree 1 vs 4 vs 8 come straight from the spec:
+
+  python sweep_tpu.py '[[8, {"mode": "decode"}],
+                        [8, {"mode": "decode_sharded", "tensor": 4}],
+                        [8, {"mode": "decode_sharded", "tensor": 8}]]'
 
 Traffic variants: {"mode": "traffic", ...} drives the continuous serve
 engine under seeded shared-prefix Poisson load (serve/traffic.py) —
 batch is max_slots, "requests"/"kv_layout"/"prefix_len"/"p_shared"/
 "rate_rps"/"block_size" tune the workload; the SWEEPJSON record
 carries prefix_hit_rate + slo_attainment plus shed counts and latency
-percentiles, so dense-vs-paged A/Bs come straight from the sweep spec:
+percentiles, so dense-vs-paged A/Bs come straight from the sweep spec.
+Add "tensor": N to shard the engine (tensor-parallel weights + paged
+KV pool split over N chips); the record then carries mesh axes and
+tok_s_chip:
 
   python sweep_tpu.py '[[8, {"mode": "traffic", "kv_layout": "dense"}],
-                        [8, {"mode": "traffic", "kv_layout": "paged"}]]'
+                        [8, {"mode": "traffic", "kv_layout": "paged"}],
+                        [8, {"mode": "traffic", "kv_layout": "paged",
+                             "tensor": 4}]]'
 
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
@@ -44,7 +59,7 @@ remain analyzable after the fact.
 import json
 import sys
 
-from bench import time_config, time_decode
+from bench import decode_mesh, time_config, time_decode
 
 
 def _failure_tag(e: Exception) -> str:
@@ -84,6 +99,8 @@ def _run_traffic_variant(max_slots, kw, out):
     from ray_tpu.serve.traffic import TrafficSpec, run_traffic
 
     kv_layout = kw.pop("kv_layout", "paged")
+    tensor = kw.pop("tensor", 1)
+    mesh, n_chips = decode_mesh(tensor)
     spec = TrafficSpec(
         num_requests=kw.pop("requests", 64),
         seed=kw.pop("seed", 0),
@@ -109,17 +126,20 @@ def _run_traffic_variant(max_slots, kw, out):
                "kv_layout": kv_layout, "requests": spec.num_requests,
                "prefix_len": spec.prefix_len,
                "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
+               "tensor": n_chips,
                "preset": run_kw["preset"], "overrides": kw}
     try:
         rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
-                          max_slots=max_slots,
+                          max_slots=max_slots, mesh=mesh,
                           admission_policy=policy,
                           config_overrides=kw or None, **run_kw)
         eng = rep["engine"]
+        tok_s = eng["tokens_per_sec"]
         print(f"traffic slots={max_slots} layout={kv_layout} "
+              f"chips={n_chips} "
               f"n={rep['offered']}: hit_rate={rep['prefix_hit_rate']} "
               f"slo={rep['slo_attainment']} shed={rep['shed']} "
-              f"{eng['tokens_per_sec']:,.0f} tok/s", file=out,
+              f"{tok_s:,.0f} tok/s", file=out,
               flush=True)
         rec = {"sweep": variant,
                "prefix_hit_rate": rep["prefix_hit_rate"],
@@ -128,7 +148,9 @@ def _run_traffic_variant(max_slots, kw, out):
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],
                "engine": {
-                   "tokens_per_sec": eng["tokens_per_sec"],
+                   "tokens_per_sec": tok_s,
+                   "tok_s_chip": round(tok_s / max(1, n_chips), 1),
+                   "mesh": eng.get("mesh"),
                    "ttft_p50_ms": (eng["ttft_ms"] or {}).get("p50"),
                    "ttft_p95_ms": (eng["ttft_ms"] or {}).get("p95"),
                    "kv_cache": eng.get("kv_cache"),
@@ -158,22 +180,28 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
     for batch_per_chip, kw in configs:
         kw = dict(kw)
         mode = kw.pop("mode", "train")
-        if mode == "decode":
+        if mode in ("decode", "decode_sharded"):
             prompt_len = kw.pop("prompt_len",
                                 kw.pop("max_seq", kw.pop("seq", 128)))
             new_tokens = kw.pop("new_tokens", 64)
             preset = kw.pop("preset", "gpt2")
-            variant = {"mode": "decode", "batch": batch_per_chip,
+            tensor = kw.pop("tensor",
+                            n_chips if mode == "decode_sharded" else 1)
+            variant = {"mode": mode, "batch": batch_per_chip,
                        "prompt_len": prompt_len,
                        "new_tokens": new_tokens, "preset": preset,
-                       "overrides": kw}
+                       "tensor": tensor, "overrides": kw}
             try:
-                ttft_ms, tok_s, stats = time_decode(
+                mesh, _ = decode_mesh(tensor)
+                ttft_ms, tok_s, stats, chips = time_decode(
                     batch_per_chip, prompt_len=prompt_len,
-                    new_tokens=new_tokens, preset=preset, **kw)
-                print(f"decode batch={batch_per_chip} "
-                      f"prompt={prompt_len} new={new_tokens} {kw}: "
-                      f"TTFT={ttft_ms:.2f}ms  {tok_s:,.0f} tok/s",
+                    new_tokens=new_tokens, preset=preset, mesh=mesh,
+                    **kw)
+                print(f"{mode} batch={batch_per_chip} "
+                      f"prompt={prompt_len} new={new_tokens} "
+                      f"chips={chips} {kw}: "
+                      f"TTFT={ttft_ms:.2f}ms  {tok_s:,.0f} tok/s "
+                      f"({tok_s / max(1, chips):,.0f} tok/s/chip)",
                       file=out, flush=True)
 
                 def _r(v, nd=2):
@@ -182,6 +210,9 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                 rec = {"sweep": variant,
                        "prefill_ttft_ms": round(ttft_ms, 2),
                        "decode_tok_s": round(tok_s, 1),
+                       "decode_tok_s_chip":
+                           round(tok_s / max(1, chips), 1),
+                       "chips": chips,
                        # percentiles from the serve engine_stats() path
                        "engine": {
                            "ttft_p50_ms": _r(stats["ttft_ms"]["p50"]),
@@ -193,7 +224,7 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                            "tokens_per_sec":
                                _r(stats["tokens_per_sec"], 1)}}
             except Exception as e:
-                print(f"decode batch={batch_per_chip} "
+                print(f"{mode} batch={batch_per_chip} "
                       f"prompt={prompt_len} {kw}: FAILED "
                       f"{type(e).__name__}: {str(e)[:160]}", file=out,
                       flush=True)

@@ -56,12 +56,23 @@ def test_repo_audit_covers_canonical_programs(repo_report):
     audited = set(repo_report["programs"])
     assert {"gpt2_train_step", "llama_train_step",
             "gpt2_prefill_ragged", "llama_prefill_ragged",
-            "gpt2_decode_step", "fused_ce_fwd",
-            "fused_ce_bwd"} <= audited
+            "gpt2_decode_step", "gpt2_sharded_decode_step",
+            "fused_ce_fwd", "fused_ce_bwd"} <= audited
     for name, info in repo_report["programs"].items():
         assert "error" not in info, f"{name} failed to trace: {info}"
+        assert "skipped" not in info, \
+            f"{name} skipped under CI's forced 8 devices: {info}"
         assert info["eqns"] > 0
         assert info["peak_hbm_bytes"] > 0
+
+
+def test_repo_sharded_spec_ran_compiled_rules(repo_report):
+    # conftest forces 8 CPU devices, so the sharded spec must have
+    # compiled and reported its per-partition footprint — and a
+    # sharded pool means strictly less than the global estimate
+    info = repo_report["programs"]["gpt2_sharded_decode_step"]
+    assert info["per_chip_hbm_bytes"] > 0
+    assert info["per_chip_hbm_bytes"] < info["peak_hbm_bytes"]
 
 
 def test_repo_suppressions_are_visible(repo_report):
@@ -211,6 +222,41 @@ def test_skip_rules_waives_a_jaxpr_rule():
     vs, _ = audit_program(_spec(fn, (jnp.zeros((8,)),),
                                 skip_rules=("host-transfer",)))
     assert "host-transfer" not in _rules(vs)
+
+
+def test_planted_missing_collective_detected():
+    # an unsharded program can never contain an all-reduce, so a spec
+    # requiring one must fire
+    vs, _ = audit_program(_spec(lambda x: x + 1.0,
+                                (jnp.zeros((8, 8)),),
+                                require_collectives=("all-reduce",)))
+    assert "collectives" in _rules(vs)
+
+
+def test_planted_replicated_shape_detected():
+    # the input's own full shape appears in the compiled HLO — the
+    # forbidden-shape form of the collectives rule must fire on it
+    vs, _ = audit_program(_spec(lambda x: x + 1.0,
+                                (jnp.zeros((8, 8)),),
+                                forbid_hlo_shapes=("f32[8,8]",)))
+    assert "collectives" in _rules(vs)
+
+
+def test_planted_per_chip_hbm_blowup_detected():
+    x = jnp.zeros((256, 256), jnp.float32)   # 256 KiB unsharded
+    vs, info = audit_program(
+        _spec(lambda a: a @ a.T, (x,), allow_f32_matmul=True,
+              per_chip_hbm_budget_bytes=1024))
+    assert "per-chip-hbm" in _rules(vs)
+    assert info["per_chip_hbm_bytes"] > 1024
+
+
+def test_min_devices_skips_not_fails():
+    vs, info = audit_program(
+        _spec(lambda x: x + 1.0, (jnp.zeros((8,)),),
+              min_devices=10_000))
+    assert vs == []
+    assert "skipped" in info
 
 
 # ---------------------------------------------------------------------------
