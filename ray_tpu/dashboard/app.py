@@ -232,6 +232,84 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/stats", serve_stats)
 
+        # Perf observatory (_private/device_stats.py): per-program
+        # compiled cost model / recompile watchdog / live MFU, plus
+        # per-chip allocator stats — the device-side complement of
+        # /api/serve/stats.  Registries are per-process, so the
+        # dashboard merges every live deployment's engine_stats()
+        # "programs" block over its own (mostly empty) local registry;
+        # on a name collision the busiest replica view wins, and the
+        # raw per-deployment blocks stay under "deployments".
+        async def perf_programs(_req):
+            def _collect():
+                from ray_tpu._private import device_stats as ds
+
+                devices = ds.device_memory_stats()
+                programs = ds.get_registry().snapshot(
+                    n_devices=max(1, len(devices)))
+                per_dep = {}
+                try:
+                    from ray_tpu.serve import api as serve_api
+
+                    for name in serve_api.status():
+                        try:
+                            stats = serve_api.engine_stats(name,
+                                                           timeout=15)
+                        except Exception:  # noqa: BLE001 - no stats
+                            continue
+                        blocks = stats.get("programs")
+                        if not isinstance(blocks, dict):
+                            continue
+                        per_dep[name] = blocks
+                        for prog, blk in blocks.items():
+                            cur = programs.get(prog)
+                            if (cur is None or blk.get(
+                                    "compile_events", 0) >= cur.get(
+                                    "compile_events", 0)):
+                                programs[prog] = blk
+                except Exception:  # noqa: BLE001 - serve not running
+                    pass
+                return {
+                    "programs": programs,
+                    "deployments": per_dep,
+                    "devices": devices,
+                    "peak_flops_per_chip": ds.peak_flops_per_chip(),
+                }
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/perf/programs", perf_programs)
+
+        # On-demand profiler capture (util/state.py profile_device):
+        # POST {"logdir": ..., "seconds": 1.0} traces this process for
+        # the window and returns where the trace landed.  Degrades to
+        # {"ok": false} where jax.profiler is unavailable — same no-op
+        # contract as profile_device itself.
+        async def perf_profile(req):
+            try:
+                body = await req.json()
+            except Exception:  # noqa: BLE001 - empty body is fine
+                body = {}
+            logdir = str(body.get("logdir", "/tmp/raytpu_profile"))
+            seconds = min(60.0, max(0.0,
+                                    float(body.get("seconds", 1.0))))
+
+            def _capture():
+                import time as _time
+
+                from ray_tpu.util.state import profile_device
+
+                with profile_device(logdir) as prof:
+                    _time.sleep(seconds)
+                return bool(prof._active)
+
+            ok = await loop.run_in_executor(None, _capture)
+            return web.json_response(
+                {"ok": ok, "logdir": logdir, "seconds": seconds})
+
+        app.router.add_post("/api/perf/profile", perf_profile)
+
         # Structured events (reference: dashboard event module consuming
         # RAY_EVENT files, src/ray/util/event.h:41).
         async def events_list(req):

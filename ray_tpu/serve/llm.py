@@ -135,8 +135,23 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         out["pos"] = cache["pos"].at[slot].set(0)
         return out
 
-    fns = (jax.jit(prefill_sample), jax.jit(paged_prefill_sample),
-           jax.jit(pool_step), jax.jit(admit), jax.jit(copy_block),
+    # perf observatory: the three heavy programs report compiles /
+    # compiler cost model / invoke walltimes to the process-wide
+    # registry under stable names (sharded engines get their own so
+    # single- and multi-chip cost models never mix)
+    from ray_tpu._private.device_stats import get_registry
+
+    registry = get_registry()
+    shard = "serve.sharded_" if mesh is not None else "serve."
+    n_dev = len(getattr(mesh, "devices", [[None]]).flat) \
+        if mesh is not None else 1
+    fns = (registry.instrument(shard + "prefill",
+                               jax.jit(prefill_sample), n_dev),
+           registry.instrument(shard + "paged_prefill",
+                               jax.jit(paged_prefill_sample), n_dev),
+           registry.instrument(shard + "decode",
+                               jax.jit(pool_step), n_dev),
+           jax.jit(admit), jax.jit(copy_block),
            jax.jit(clear_row))
     _JIT_CACHE[key] = fns
     return fns
@@ -386,6 +401,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 _jitted_engine_fns(prefill_fn, step_fn,
                                    paged_prefill_fn, cfg, temperature,
                                    kv_layout=kv_layout, mesh=self.mesh)
+            # perf observatory: mirror process-wide program compile
+            # events into this deployment's program-keyed recompile
+            # counter (decode/sharded-decode shape churn visible, not
+            # just prefill buckets); weak subscription — a retired
+            # engine drops out of the registry automatically
+            from ray_tpu._private.device_stats import get_registry
+
+            get_registry().subscribe(
+                self._telemetry.record_program_compile)
 
         def _admit_pending(self) -> None:
             """Prefill queued requests into free slots (one batched
@@ -651,13 +675,27 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             stats = self._telemetry.engine_stats()
             if admission_policy is not None:
                 stats["admission_policy"] = admission_policy.describe()
-            if getattr(self, "mesh", None) is not None:
+            # perf observatory: compiled-cost / recompile / live-MFU
+            # block for this engine's programs (process-wide registry,
+            # filtered to the serve namespace)
+            from ray_tpu._private.device_stats import (
+                device_memory_stats, get_registry)
+
+            mesh = getattr(self, "mesh", None)
+            stats["programs"] = get_registry().snapshot(
+                prefix="serve.",
+                n_devices=int(mesh.size) if mesh is not None else 1)
+            if mesh is not None:
                 stats["mesh"] = {
                     "axes": {a: int(s)
                              for a, s in self.mesh.shape.items()
                              if int(s) > 1},
                     "n_devices": int(self.mesh.size),
                     "kv_shards": self._kv_shards(),
+                    # per-chip allocator stats (stable keys; values
+                    # are None on backends without memory_stats())
+                    "devices": device_memory_stats(
+                        list(self.mesh.devices.flat)),
                 }
             return stats
 

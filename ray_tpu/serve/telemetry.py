@@ -110,6 +110,13 @@ def _engine_metrics() -> Dict[str, Any]:
                     "serve_prefill_compiles_total",
                     "first-seen prefill bucket shapes (one XLA compile "
                     "each)", tag_keys=("deployment", "bucket")),
+                "program_compiles": Counter(
+                    "serve_program_compile_events_total",
+                    "XLA compile events by engine program name "
+                    "(prefill / decode / sharded_decode / ...) — the "
+                    "recompile counter beyond prefill buckets, fed by "
+                    "the device_stats program registry",
+                    tag_keys=("deployment", "program")),
                 "prefix_hits": Counter(
                     "serve_prefix_blocks_hit_total",
                     "prompt KV blocks served from the prefix cache "
@@ -160,6 +167,7 @@ class EngineTelemetry:
         self._busy_slot_s = 0.0     # sum(active * dur) over steps
         self._step_s = 0.0          # sum(dur) over steps
         self._buckets: Dict[int, int] = {}  # prefill bucket -> admits
+        self._program_compiles: Dict[str, int] = {}
         self._rejections_by_reason: Dict[str, int] = {}
         self._kv_stats: Optional[Dict[str, Any]] = None
 
@@ -208,6 +216,18 @@ class EngineTelemetry:
             # compile of the prefill program for this bucket
             self._m["prefill_compiles"].inc(
                 tags=dict(self._tags, bucket=str(int(bucket))))
+
+    def record_program_compile(self, program: str) -> None:
+        """One XLA compile of a named engine program (``serve.decode``,
+        ``serve.sharded_decode``, ...) observed while this engine is
+        live — usually subscribed to the ``device_stats`` program
+        registry, so decode-path shape churn shows up next to the
+        prefill-bucket counter instead of staying invisible."""
+        with self._lock:
+            self._program_compiles[program] = \
+                self._program_compiles.get(program, 0) + 1
+        self._m["program_compiles"].inc(
+            tags=dict(self._tags, program=program))
 
     def record_first_token(self, rec: Dict[str, Any],
                            now: Optional[float] = None) -> None:
@@ -328,6 +348,7 @@ class EngineTelemetry:
             tokens = self._tokens
             busy, step_s = self._busy_slot_s, self._step_s
             buckets = dict(self._buckets)
+            program_compiles = dict(self._program_compiles)
             rejections = dict(self._rejections_by_reason)
             kv_stats = (dict(self._kv_stats)
                         if self._kv_stats is not None else None)
@@ -364,6 +385,11 @@ class EngineTelemetry:
             "prefill_buckets": {str(k): v
                                 for k, v in sorted(buckets.items())},
             "prefill_compiles": len(buckets),
+            # round-10: XLA compiles keyed by engine program name
+            # (device_stats registry subscription) — decode-path
+            # recompile churn, not just prefill buckets
+            "program_compiles": {k: v for k, v
+                                 in sorted(program_compiles.items())},
             # round-8: paged-KV + admission-control surfaces (top-level
             # keys — the "requests" dict shape is a stable contract)
             "rejections_by_reason": rejections,

@@ -37,6 +37,12 @@ Rule ids:
   CPU-unverifiable.
 * ``kernel-exports`` — the public kernel entry points must stay
   exported (and resolvable) from ``ray_tpu.ops``.
+* ``observatory-mapping`` — every ProgramSpec in
+  ``tools/graftcheck/programs.py`` must map to a runtime program name
+  in ``_private/device_stats.py``'s ``STATIC_PROGRAM_MAP`` (and every
+  mapping must target a KNOWN_PROGRAMS name): the static auditor's
+  catalog of hot-path programs and the runtime perf observatory's must
+  not drift apart.
 """
 
 from __future__ import annotations
@@ -342,6 +348,47 @@ def _kernel_exports() -> List[Violation]:
     return out
 
 
+def _observatory_mapping() -> List[Violation]:
+    """Every audited ProgramSpec must have a runtime observatory
+    mapping, and every mapping must point at a program name the
+    runtime hooks actually register — otherwise the static and
+    runtime views of 'the hot-path programs' silently diverge."""
+    ds_file = "ray_tpu/_private/device_stats.py"
+    try:
+        from ray_tpu._private.device_stats import (KNOWN_PROGRAMS,
+                                                   STATIC_PROGRAM_MAP)
+        from ray_tpu.tools.graftcheck.programs import default_programs
+
+        spec_names = [s.name for s in default_programs()]
+    except Exception as e:  # noqa: BLE001 - import failure IS the finding
+        return [Violation(
+            "observatory-mapping",
+            f"observatory mapping unavailable: {type(e).__name__}: {e}",
+            file=ds_file)]
+    out: List[Violation] = []
+    for name in spec_names:
+        if name not in STATIC_PROGRAM_MAP:
+            out.append(Violation(
+                "observatory-mapping",
+                f"ProgramSpec '{name}' has no entry in "
+                f"STATIC_PROGRAM_MAP — map it to the runtime program "
+                f"name the perf observatory registers it under",
+                file=ds_file))
+    for spec, runtime in STATIC_PROGRAM_MAP.items():
+        if runtime not in KNOWN_PROGRAMS:
+            out.append(Violation(
+                "observatory-mapping",
+                f"STATIC_PROGRAM_MAP['{spec}'] -> '{runtime}' is not a "
+                f"KNOWN_PROGRAMS runtime name", file=ds_file))
+        if spec not in spec_names:
+            out.append(Violation(
+                "observatory-mapping",
+                f"STATIC_PROGRAM_MAP entry '{spec}' matches no "
+                f"ProgramSpec in tools/graftcheck/programs.py — stale "
+                f"mapping for a removed/renamed spec", file=ds_file))
+    return out
+
+
 def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
     """Lint every package file under ``root`` plus the repo-level
     checks.  Returns (violations, stats) where stats carries
@@ -363,6 +410,7 @@ def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
         n_files += 1
     violations.extend(_pallas_interpret_tests(root))
     violations.extend(_kernel_exports())
+    violations.extend(_observatory_mapping())
     stats = {"files": n_files, "suppressed": n_suppressed,
              "metric_names": metric_names_seen}
     return violations, stats

@@ -1,0 +1,402 @@
+"""Perf ledger: persistent bench trajectory + regression gates.
+
+Every bench.py run prints JSON metric lines and every sweep_tpu.py run
+prints ``SWEEPJSON`` records — and until now they evaporated with the
+terminal scrollback (PERF_NOTES: "everything since round 5 unmeasured").
+This module gives them a durable home, ``BENCH_HISTORY.jsonl`` at the
+repo root, and turns the accumulated trajectory into CI-style verdicts:
+
+    python -m ray_tpu.tools.perfledger ingest bench_out.log
+    python -m ray_tpu.tools.perfledger ingest BENCH_r0*.json
+    python -m ray_tpu.tools.perfledger check            # exit 1 on regress
+    python -m ray_tpu.tools.perfledger report           # markdown trends
+
+``bench.py`` and ``sweep_tpu.py`` append automatically (``--no-ledger``
+opts out), so every future TPU session grows the trajectory instead of
+losing it.
+
+Ledger entries are one JSON object per line::
+
+    {"recorded_at": ..., "source": "bench"|"sweep"|"ingest",
+     "record": {...original bench/sweep record...},
+     "metrics": {name: {"value": v, "unit": u,
+                        "higher_is_better": bool}}}
+
+``metrics`` is flattened at append time: bench lines contribute their
+``metric`` name directly; sweep records contribute one series per
+numeric field, keyed by the variant's canonical hash so e.g. the
+``[32, {"remat_policy": "dots_nb"}]`` series never gets compared
+against ``[24, {}]``.  Direction is inferred from the name (latencies —
+``*_ms`` / ``ttft`` — regress upward; throughput/MFU/hit-rates regress
+downward).
+
+``check`` compares the newest point of every series against the
+previous point and against ``BASELINE.json``'s ``published`` table
+(empty today — the comparison is skipped until someone publishes
+numbers) with a relative tolerance band (default 5%), and exits
+nonzero when anything regresses — the gate ROADMAP item 3's MFU push
+reports through.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_TOLERANCE = 0.05
+
+#: numeric fields of a sweep record that form trend series (anything
+#: else in the record is context, not a measurement)
+_SWEEP_FIELDS = (
+    "tok_s_chip", "mfu", "mfu_xla", "prefill_ttft_ms", "decode_tok_s",
+    "decode_tok_s_chip", "prefix_hit_rate", "slo_attainment",
+    "latency_p50_ms", "latency_p95_ms",
+)
+
+#: substrings marking a metric where SMALLER is better
+_LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile")
+
+
+def repo_root() -> str:
+    """The repo checkout this installed/source tree lives in (ledger
+    and BASELINE.json live at its root)."""
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def history_path(path: Optional[str] = None) -> str:
+    if path:
+        return path
+    env = os.environ.get("RAYTPU_BENCH_HISTORY")
+    if env:
+        return env
+    return os.path.join(repo_root(), "BENCH_HISTORY.jsonl")
+
+
+def baseline_path(path: Optional[str] = None) -> str:
+    return path or os.path.join(repo_root(), "BASELINE.json")
+
+
+def higher_is_better(name: str) -> bool:
+    low = name.lower()
+    return not any(tok in low for tok in _LOWER_IS_BETTER)
+
+
+def _variant_key(variant: Dict[str, Any]) -> str:
+    """Stable 8-hex identity for one sweep variant (mode + every knob),
+    so series only ever compare like-for-like configurations."""
+    canon = json.dumps(variant, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()[:8]
+
+
+def extract_metrics(record: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Flatten one bench / sweep record into named numeric series."""
+    out: Dict[str, Dict[str, Any]] = {}
+    if "metric" in record and isinstance(
+            record.get("value"), (int, float)):
+        name = str(record["metric"])
+        out[name] = {"value": float(record["value"]),
+                     "unit": record.get("unit"),
+                     "higher_is_better": higher_is_better(name)}
+        return out
+    variant = record.get("sweep")
+    if isinstance(variant, dict) and "failed" not in record:
+        mode = variant.get("mode", "train")
+        vk = _variant_key(variant)
+        for field in _SWEEP_FIELDS:
+            val = record.get(field)
+            if isinstance(val, (int, float)):
+                name = f"sweep.{mode}.{field}#{vk}"
+                out[name] = {"value": float(val), "unit": None,
+                             "higher_is_better": higher_is_better(field)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ingest
+# ---------------------------------------------------------------------------
+
+def parse_text(text: str) -> List[Dict[str, Any]]:
+    """Recover bench/sweep records from arbitrary captured output:
+    bench JSON lines, ``SWEEPJSON``-prefixed lines, whole-file JSON
+    (including the historical ``BENCH_rNN.json`` wrappers whose payload
+    sits under ``parsed``), or lists of any of those.  Non-records are
+    skipped, never fatal."""
+
+    def _norm(obj: Any) -> List[Dict[str, Any]]:
+        if isinstance(obj, list):
+            return [r for item in obj for r in _norm(item)]
+        if not isinstance(obj, dict):
+            return []
+        if isinstance(obj.get("parsed"), dict):
+            return _norm(obj["parsed"])
+        if "metric" in obj or "sweep" in obj:
+            return [obj]
+        return []
+
+    try:
+        return _norm(json.loads(text))
+    except ValueError:
+        pass
+    records: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("SWEEPJSON "):
+            line = line[len("SWEEPJSON "):]
+        if not line.startswith("{"):
+            continue
+        try:
+            records.extend(_norm(json.loads(line)))
+        except ValueError:
+            continue
+    return records
+
+
+def append_records(records: Iterable[Dict[str, Any]], source: str,
+                   path: Optional[str] = None) -> int:
+    """Append each record (with its flattened metric series) as one
+    ledger line; returns how many lines landed.  Records with no
+    numeric series (audit summaries, failures) are kept too — they
+    document the trajectory — but contribute nothing to ``check``."""
+    path = history_path(path)
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    n = 0
+    with open(path, "a") as f:
+        for rec in records:
+            if not isinstance(rec, dict):
+                continue
+            entry = {"recorded_at": stamp, "source": source,
+                     "record": rec, "metrics": extract_metrics(rec)}
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+            n += 1
+    return n
+
+
+def load_history(path: Optional[str] = None) -> List[Dict[str, Any]]:
+    path = history_path(path)
+    entries: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    entries.append(obj)
+    except OSError:
+        pass
+    return entries
+
+
+def metric_series(entries: List[Dict[str, Any]]
+                  ) -> Dict[str, List[Tuple[int, Dict[str, Any]]]]:
+    """name -> [(entry_index, {"value", "unit", "higher_is_better"})]
+    in ledger order."""
+    series: Dict[str, List[Tuple[int, Dict[str, Any]]]] = {}
+    for i, entry in enumerate(entries):
+        for name, m in (entry.get("metrics") or {}).items():
+            if isinstance(m, dict) and isinstance(
+                    m.get("value"), (int, float)):
+                series.setdefault(name, []).append((i, m))
+    return series
+
+
+# ---------------------------------------------------------------------------
+# check
+# ---------------------------------------------------------------------------
+
+def _classify(new: float, ref: float, better: bool,
+              tolerance: float) -> Tuple[str, float]:
+    """(verdict, relative_delta) of `new` vs `ref` under a relative
+    tolerance band.  delta is signed in the metric's raw direction."""
+    if ref == 0:
+        delta = 0.0 if new == 0 else float("inf") * (1 if new > 0 else -1)
+    else:
+        delta = (new - ref) / abs(ref)
+    gain = delta if better else -delta
+    if gain < -tolerance:
+        return "regress", delta
+    if gain > tolerance:
+        return "improve", delta
+    return "flat", delta
+
+
+def load_baseline(path: Optional[str] = None) -> Dict[str, float]:
+    """BASELINE.json's ``published`` table as {metric: value}; empty
+    when nothing is published (the common case today) — then the
+    baseline comparison is skipped, not failed."""
+    try:
+        with open(baseline_path(path)) as f:
+            pub = json.load(f).get("published") or {}
+    except Exception:  # noqa: BLE001 - missing/invalid baseline file
+        return {}
+    return {k: float(v) for k, v in pub.items()
+            if isinstance(v, (int, float))}
+
+
+def check(history: Optional[str] = None,
+          baseline: Optional[str] = None,
+          tolerance: float = DEFAULT_TOLERANCE) -> Dict[str, Any]:
+    """Verdict for the newest point of every metric series vs its
+    previous point and vs the published baseline.  ``ok`` is False iff
+    anything regressed beyond the tolerance band."""
+    entries = load_history(history)
+    series = metric_series(entries)
+    published = load_baseline(baseline)
+    verdicts: Dict[str, Any] = {}
+    ok = True
+    for name, points in sorted(series.items()):
+        idx, cur = points[-1]
+        v: Dict[str, Any] = {"value": cur["value"],
+                             "unit": cur.get("unit"),
+                             "higher_is_better": cur["higher_is_better"],
+                             "entry": idx, "n_points": len(points)}
+        if len(points) >= 2:
+            prev = points[-2][1]["value"]
+            verdict, delta = _classify(cur["value"], prev,
+                                       cur["higher_is_better"],
+                                       tolerance)
+            v.update(prev=prev, delta=round(delta, 4), verdict=verdict)
+        else:
+            v.update(prev=None, delta=None, verdict="new")
+        if name in published:
+            bverdict, bdelta = _classify(cur["value"], published[name],
+                                         cur["higher_is_better"],
+                                         tolerance)
+            v.update(baseline=published[name],
+                     vs_baseline=round(bdelta, 4),
+                     baseline_verdict=bverdict)
+            if bverdict == "regress":
+                ok = False
+        if v["verdict"] == "regress":
+            ok = False
+        verdicts[name] = v
+    return {"ok": ok, "tolerance": tolerance,
+            "entries": len(entries), "verdicts": verdicts}
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.4g}" if abs(v) < 1000 else f"{v:,.0f}"
+    return str(v)
+
+
+def report(history: Optional[str] = None,
+           baseline: Optional[str] = None,
+           tolerance: float = DEFAULT_TOLERANCE) -> str:
+    """Markdown trend table over the whole ledger."""
+    entries = load_history(history)
+    result = check(history, baseline, tolerance)
+    lines = [
+        "# Perf ledger trend report",
+        "",
+        f"{len(entries)} ledger entries, "
+        f"{len(result['verdicts'])} metric series, "
+        f"tolerance ±{tolerance:.0%}.",
+        "",
+        "| metric | points | previous | latest | delta | verdict |",
+        "|---|---:|---:|---:|---:|---|",
+    ]
+    for name, v in result["verdicts"].items():
+        delta = ("—" if v["delta"] is None
+                 else f"{v['delta']:+.1%}")
+        arrow = {"improve": "improve ✅", "regress": "regress ❌",
+                 "flat": "flat", "new": "new"}[v["verdict"]]
+        lines.append(f"| `{name}` | {v['n_points']} "
+                     f"| {_fmt(v['prev'])} | {_fmt(v['value'])} "
+                     f"| {delta} | {arrow} |")
+    lines.append("")
+    if not any(v.get("baseline") is not None
+               for v in result["verdicts"].values()):
+        lines.append("No published baselines in BASELINE.json "
+                     "(`published: {}`) — verdicts are vs the previous "
+                     "ledger point only.")
+    lines.append("")
+    lines.append("ok" if result["ok"] else
+                 "REGRESSIONS DETECTED — see verdicts above.")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ray_tpu.tools.perfledger",
+        description="persistent bench/sweep trajectory with "
+                    "regression gates")
+    ap.add_argument("--history", default=None,
+                    help="ledger path (default: <repo>/"
+                         "BENCH_HISTORY.jsonl, env RAYTPU_BENCH_HISTORY"
+                         " overrides)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_in = sub.add_parser("ingest",
+                          help="parse bench/sweep output into the "
+                               "ledger")
+    p_in.add_argument("files", nargs="*",
+                      help="bench logs / JSON files ('-' or empty = "
+                           "stdin)")
+    p_in.add_argument("--source", default="ingest")
+    p_chk = sub.add_parser("check",
+                           help="exit 1 when the newest point of any "
+                                "series regressed")
+    p_chk.add_argument("--baseline", default=None)
+    p_chk.add_argument("--tolerance", type=float,
+                       default=DEFAULT_TOLERANCE)
+    p_rep = sub.add_parser("report", help="markdown trend report")
+    p_rep.add_argument("--baseline", default=None)
+    p_rep.add_argument("--tolerance", type=float,
+                       default=DEFAULT_TOLERANCE)
+    p_rep.add_argument("--out", default="",
+                       help="write the report here as well as stdout")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "ingest":
+        records: List[Dict[str, Any]] = []
+        if not args.files or args.files == ["-"]:
+            records.extend(parse_text(sys.stdin.read()))
+        else:
+            for fname in args.files:
+                try:
+                    with open(fname) as f:
+                        records.extend(parse_text(f.read()))
+                except OSError as e:
+                    print(f"perfledger: skipping {fname}: {e}",
+                          file=sys.stderr)
+        n = append_records(records, source=args.source,
+                           path=args.history)
+        print(f"perfledger: appended {n} record(s) to "
+              f"{history_path(args.history)}")
+        return 0
+
+    if args.cmd == "check":
+        result = check(args.history, args.baseline, args.tolerance)
+        print(json.dumps(result, indent=1, sort_keys=True))
+        return 0 if result["ok"] else 1
+
+    text = report(args.history, args.baseline, args.tolerance)
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,9 +101,16 @@ class jax_utils:
         jitted = jax.jit(step, **kw)
         if not telemetry:
             return jitted
+        from ray_tpu._private.device_stats import get_registry
         from ray_tpu.train.telemetry import (get_train_telemetry,
                                              instrument_train_step)
 
+        # perf observatory first (compiled-cost harvest + recompile
+        # watchdog under "train.step"), host step-time telemetry on
+        # the outside — both are signature-keyed, neither adds a sync
+        n_dev = int(mesh.size) if mesh is not None else 1
+        jitted = get_registry().instrument("train.step", jitted,
+                                           n_devices=n_dev)
         return instrument_train_step(
             jitted, telemetry=get_train_telemetry(telemetry_name))
 

@@ -166,12 +166,15 @@ def _run_traffic_variant(max_slots, kw, out):
 
 
 def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
-              audit=False):
+              audit=False, ledger=True, ledger_path=None):
     """Run each [batch_per_chip, overrides] variant; returns the list of
     result records that were also emitted as SWEEPJSON lines.  With
     ``audit=True`` the first record is the graftcheck summary for the
     current tree (``python sweep_tpu.py`` turns this on; pass
-    --no-audit to skip)."""
+    --no-audit to skip).  Unless ``ledger=False`` (--no-ledger), every
+    record is also appended to BENCH_HISTORY.jsonl through
+    ray_tpu/tools/perfledger so the sweep trajectory outlives the
+    terminal — SWEEPJSON lines used to evaporate with the scrollback."""
     records = []
     if audit:
         rec = _graftcheck_record()
@@ -243,14 +246,20 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
         variant = {"batch_per_chip": batch_per_chip, "seq": seq,
                    "preset": preset, "overrides": kw}
         try:
-            tok_s_chip, mfu, _, n = time_config(
+            tok_s_chip, mfu, _, n, cost = time_config(
                 batch_per_chip * n_chips, seq=seq, n_steps=n_steps,
                 preset=preset, **kw)
             print(f"batch/chip={batch_per_chip} seq={seq} {kw}: "
                   f"{tok_s_chip:,.0f} tok/s/chip (x{n} chips)  "
                   f"MFU={mfu:.4f}", file=out, flush=True)
             rec = {"sweep": variant, "tok_s_chip": round(tok_s_chip, 1),
-                   "mfu": round(mfu, 4), "chips": n}
+                   "mfu": round(mfu, 4), "chips": n,
+                   # compiler-side numbers (bench.time_config AOT cost
+                   # harvest): MFU from XLA's own FLOP count + peak HBM
+                   "mfu_xla": (round(cost["mfu_xla"], 4)
+                               if cost.get("mfu_xla") else None),
+                   "xla_flops": cost.get("xla_flops"),
+                   "peak_hbm_bytes": cost.get("peak_hbm_bytes")}
         except Exception as e:
             print(f"batch/chip={batch_per_chip} seq={seq} {kw}: FAILED "
                   f"{type(e).__name__}: {str(e)[:160]}", file=out,
@@ -259,15 +268,29 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
         records.append(rec)
+    if ledger and records:
+        try:
+            from ray_tpu.tools import perfledger
+
+            n = perfledger.append_records(records, source="sweep",
+                                          path=ledger_path)
+            print(f"sweep: {n} record(s) appended to "
+                  f"{perfledger.history_path(ledger_path)}", file=out,
+                  flush=True)
+        except Exception as e:  # noqa: BLE001 - ledger is best-effort
+            print(f"sweep: perf ledger append failed: {e!r}",
+                  file=out, flush=True)
     return records
 
 
 if __name__ == "__main__":
     import jax
 
-    argv = [a for a in sys.argv[1:] if a != "--no-audit"]
+    argv = [a for a in sys.argv[1:]
+            if a not in ("--no-audit", "--no-ledger")]
     n_chips = len(jax.devices())
     configs = json.loads(argv[0]) if argv else [
         [32, {}],
     ]
-    run_sweep(configs, n_chips, audit="--no-audit" not in sys.argv)
+    run_sweep(configs, n_chips, audit="--no-audit" not in sys.argv,
+              ledger="--no-ledger" not in sys.argv)

@@ -17,6 +17,13 @@ if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8").strip()
 
+# The perf observatory's AOT cost harvest (device_stats.instrument)
+# adds one extra XLA compile per engine program; across the dozens of
+# engine configs this suite builds that would eat real minutes of the
+# tier-1 870s budget.  Default it off for tests — the observatory test
+# opts back in explicitly for the programs it asserts on.
+os.environ.setdefault("RAYTPU_DEVICE_STATS_COST", "0")
+
 # A site hook may have force-registered a TPU backend and overridden
 # jax_platforms at interpreter start; jax.config wins over the env var,
 # so set it through jax.config too.

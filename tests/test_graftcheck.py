@@ -29,8 +29,10 @@ import pytest
 
 from ray_tpu.tools import graftcheck as gc
 from ray_tpu.tools.graftcheck.jaxpr_audit import ProgramSpec, audit_program
-from ray_tpu.tools.graftcheck.lint import (KERNEL_EXPORTS, lint_repo,
-                                           lint_source, pallas_modules)
+from ray_tpu.tools.graftcheck.lint import (KERNEL_EXPORTS,
+                                           _observatory_mapping,
+                                           lint_repo, lint_source,
+                                           pallas_modules)
 
 pytestmark = pytest.mark.fast
 
@@ -106,6 +108,38 @@ def test_kernel_exports_not_vacuous():
     for name in KERNEL_EXPORTS:
         assert name in ops.__all__
         assert callable(getattr(ops, name))
+
+
+def test_observatory_mapping_clean():
+    # round 10: the repo's own spec->runtime map must be complete
+    assert _observatory_mapping() == []
+
+
+def test_observatory_mapping_planted_violations(monkeypatch):
+    from ray_tpu._private import device_stats as ds
+
+    # a spec with no runtime mapping
+    missing = dict(ds.STATIC_PROGRAM_MAP)
+    spec = next(iter(missing))
+    del missing[spec]
+    monkeypatch.setattr(ds, "STATIC_PROGRAM_MAP", missing)
+    rules = {v.rule for v in _observatory_mapping()}
+    assert rules == {"observatory-mapping"}
+
+    # a mapping pointing at a program the runtime never registers
+    bad_value = dict(ds.STATIC_PROGRAM_MAP)
+    bad_value[spec] = "serve.bogus"
+    monkeypatch.setattr(ds, "STATIC_PROGRAM_MAP", bad_value)
+    msgs = [v.message for v in _observatory_mapping()]
+    assert any("not a KNOWN_PROGRAMS" in m for m in msgs)
+
+    # a stale mapping for a spec that no longer exists
+    stale = dict(ds.STATIC_PROGRAM_MAP)
+    stale[spec] = ds.STATIC_PROGRAM_MAP[spec]
+    stale["ghost_spec"] = "train.step"
+    monkeypatch.setattr(ds, "STATIC_PROGRAM_MAP", stale)
+    msgs = [v.message for v in _observatory_mapping()]
+    assert any("matches no" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,382 @@
+"""Perf observatory (round 10): compiled-cost registry, recompile
+watchdog, per-chip HBM surfaces, program-keyed compile telemetry, and
+the perfledger regression gates.
+
+The registry tests opt INTO the AOT cost harvest
+(RAYTPU_DEVICE_STATS_COST=1 — conftest defaults it off to protect the
+tier-1 time budget) and use unique engine identities (temperature) so
+their programs get fresh jit-cache wrappers regardless of what other
+serve tests compiled earlier in the process.
+
+conftest.py forces 8 virtual CPU devices, so the mesh tests run in
+tier-1; CPU devices report ``memory_stats() -> None``, which is exactly
+what the stable-key contract of ``device_memory_stats()`` pins.
+"""
+
+import asyncio
+import io
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu._private import device_stats as ds  # noqa: E402
+from ray_tpu.parallel import MeshSpec, fake_mesh  # noqa: E402
+
+_OVR = {"dtype": jnp.float32, "use_flash": False, "remat": False}
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices (conftest forces them in CI)")
+    return fake_mesh(8, MeshSpec(data=4, tensor=2))
+
+
+def _run_engine(dep, prompts, timeout=300):
+    async def main():
+        inst = dep.func_or_class()
+        try:
+            outs = await asyncio.wait_for(
+                asyncio.gather(*[inst(p) for p in prompts]), timeout)
+            stats = inst.engine_stats()
+        finally:
+            inst.shutdown_engine()
+        return outs, stats
+
+    return asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# registry core
+# ---------------------------------------------------------------------------
+
+def test_instrument_counts_compiles_and_harvests_cost(monkeypatch):
+    monkeypatch.setenv("RAYTPU_DEVICE_STATS_COST", "1")
+    reg = ds.ProgramRegistry()
+    f = reg.instrument("serve.decode", jax.jit(lambda x: x * 2 + 1))
+    for n in (4, 4, 8, 8, 8):
+        f(jnp.ones((n,), jnp.float32))
+    snap = reg.snapshot()["serve.decode"]
+    assert snap["compile_events"] == 2        # two distinct shapes
+    assert snap["invokes"] == 3               # re-used signatures only
+    assert snap["xla_flops"] is not None
+    assert snap["peak_hbm_bytes"] is not None
+    assert snap["compile_seconds"] > 0
+
+
+def test_instrument_cost_harvest_gated_by_env(monkeypatch):
+    monkeypatch.setenv("RAYTPU_DEVICE_STATS_COST", "0")
+    reg = ds.ProgramRegistry()
+    f = reg.instrument("serve.decode", jax.jit(lambda x: x + 1))
+    f(jnp.ones((3,), jnp.float32))
+    snap = reg.snapshot()["serve.decode"]
+    assert snap["compile_events"] == 1        # counting stays on
+    assert snap["xla_flops"] is None          # harvest skipped
+
+
+def test_cost_summary_shape():
+    compiled = jax.jit(
+        lambda x: x @ x).lower(jnp.ones((8, 8), jnp.float32)).compile()
+    cost = ds._cost_summary(compiled)
+    assert cost["xla_flops"] > 0
+    assert cost["peak_hbm_bytes"] > 0
+    assert "arithmetic_intensity" in cost
+
+
+def test_static_program_map_covers_all_specs():
+    """Runtime counterpart of the graftcheck ``observatory-mapping``
+    rule: every audited spec maps to a known runtime program."""
+    from ray_tpu.tools.graftcheck.programs import default_programs
+
+    names = {s.name for s in default_programs()}
+    assert names == set(ds.STATIC_PROGRAM_MAP)
+    assert set(ds.STATIC_PROGRAM_MAP.values()) <= ds.KNOWN_PROGRAMS
+
+
+# ---------------------------------------------------------------------------
+# recompile watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_deterministic_clock(monkeypatch):
+    events = []
+    monkeypatch.setattr("ray_tpu._private.events.report_event",
+                        lambda *a, **k: events.append((a, k)))
+    reg = ds.ProgramRegistry(storm_window_s=60.0, storm_threshold=3)
+    reg.record_compile("p", 0.1, now=0.0)
+    reg.record_compile("p", 0.1, now=1.0)
+    assert not reg.snapshot()["p"]["recompile_storm"]
+    reg.record_compile("p", 0.1, now=2.0)     # 3rd inside the window
+    snap = reg.snapshot()["p"]
+    assert snap["recompile_storm"]
+    assert snap["recompile_storms_total"] == 1
+    assert len(events) == 1 and events[0][1]["severity"] == "WARNING"
+    # compiles spaced wider than the window never storm
+    reg2 = ds.ProgramRegistry(storm_window_s=60.0, storm_threshold=3)
+    for t in (0.0, 100.0, 200.0, 300.0):
+        reg2.record_compile("q", 0.1, now=t)
+    assert not reg2.snapshot()["q"]["recompile_storm"]
+    assert reg2.snapshot()["q"]["recompile_storms_total"] == 0
+
+
+def test_watchdog_fires_on_planted_shape_churn():
+    """The classic bug the watchdog exists for: a decode loop whose
+    batch dimension is never bucketed, compiling per request."""
+    reg = ds.ProgramRegistry(storm_window_s=600.0, storm_threshold=4)
+    step = reg.instrument("serve.decode",
+                          jax.jit(lambda x: jnp.tanh(x).sum()))
+    for n in range(1, 6):                     # 5 distinct shapes
+        step(jnp.ones((n, 4), jnp.float32))
+    snap = reg.snapshot()["serve.decode"]
+    assert snap["compile_events"] == 5
+    assert snap["recompile_storm"]
+    assert snap["recompile_storms_total"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# program-keyed compile telemetry (satellite: beyond prefill buckets)
+# ---------------------------------------------------------------------------
+
+def test_telemetry_program_compile_counter():
+    from ray_tpu.serve.telemetry import EngineTelemetry
+
+    t = EngineTelemetry("obs_test", max_slots=2)
+    t.record_program_compile("serve.decode")
+    t.record_program_compile("serve.decode")
+    t.record_program_compile("serve.sharded_decode")
+    stats = t.engine_stats()
+    assert stats["program_compiles"] == {"serve.decode": 2,
+                                         "serve.sharded_decode": 1}
+    # prefill-bucket counter contract untouched
+    assert stats["prefill_compiles"] == len(stats["prefill_buckets"])
+
+
+def test_registry_subscription_feeds_telemetry():
+    from ray_tpu.serve.telemetry import EngineTelemetry
+
+    t = EngineTelemetry("obs_sub", max_slots=2)
+    reg = ds.ProgramRegistry()
+    reg.subscribe(t.record_program_compile)
+    reg.record_compile("serve.decode", 0.01)
+    reg.record_compile("serve.decode", 0.01)
+    assert t.engine_stats()["program_compiles"] == {"serve.decode": 2}
+
+
+# ---------------------------------------------------------------------------
+# engine integration: registry populated, per-chip HBM under a mesh
+# ---------------------------------------------------------------------------
+
+def test_registry_populated_after_engine_build(monkeypatch):
+    monkeypatch.setenv("RAYTPU_DEVICE_STATS_COST", "1")
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(2, 400, n).astype(np.int32) for n in (7, 11)]
+    # unique temperature -> fresh _JIT_CACHE entry -> fresh
+    # instrumented wrappers that harvest under the env opt-in above
+    dep = build_llm_deployment(
+        "gpt2", "nano", max_new_tokens=4, temperature=0.0127,
+        scheduler="continuous", max_slots=2, prefill_bucket=16,
+        config_overrides=_OVR)
+    outs, stats = _run_engine(dep, prompts)
+    assert len(outs) == 2
+    snap = ds.get_registry().snapshot()
+    for program in ("serve.prefill", "serve.decode"):
+        assert snap[program]["compile_events"] >= 1
+        assert snap[program]["xla_flops"] is not None
+        assert snap[program]["peak_hbm_bytes"] is not None
+    # the same block rides engine_stats(), serve namespace only
+    assert "serve.decode" in stats["programs"]
+    assert stats["programs"]["serve.decode"]["compile_events"] >= 1
+    # the registry subscription mirrored compiles into the
+    # program-keyed telemetry counter
+    assert stats["program_compiles"].get("serve.decode", 0) >= 1
+
+
+def test_sharded_engine_reports_programs_and_per_chip_hbm(
+        monkeypatch, mesh):
+    monkeypatch.setenv("RAYTPU_DEVICE_STATS_COST", "1")
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(2, 400, n).astype(np.int32) for n in (9, 9)]
+    dep = build_llm_deployment(
+        "gpt2", "nano", max_new_tokens=4, temperature=0.0127,
+        scheduler="continuous", kv_layout="paged", kv_block_size=16,
+        prefill_bucket=16, max_slots=2, mesh=mesh,
+        config_overrides=_OVR)
+    outs, stats = _run_engine(dep, prompts)
+    assert len(outs) == 2
+    # acceptance: per-program xla_flops / peak_hbm_bytes /
+    # compile_events on the 8-virtual-device sharded engine
+    progs = stats["programs"]
+    assert "serve.sharded_decode" in progs
+    for name in ("serve.sharded_decode", "serve.sharded_paged_prefill"):
+        assert progs[name]["compile_events"] >= 1
+        assert progs[name]["xla_flops"] is not None
+        assert progs[name]["peak_hbm_bytes"] is not None
+    # acceptance: per-chip HBM entries with stable keys (None values
+    # on CPU, real byte counts on TPU)
+    devices = stats["mesh"]["devices"]
+    assert len(devices) == 8
+    for entry in devices:
+        for key in ("id", "platform", "device_kind", "bytes_in_use",
+                    "peak_bytes_in_use", "bytes_limit"):
+            assert key in entry
+    assert sorted(e["id"] for e in devices) == list(range(8))
+
+
+def test_device_memory_stats_stable_keys():
+    entries = ds.device_memory_stats()
+    assert len(entries) == len(jax.devices())
+    for entry in entries:
+        assert entry["platform"] == "cpu"
+        assert "bytes_in_use" in entry      # key present, value None
+        assert entry["bytes_in_use"] is None
+
+
+# ---------------------------------------------------------------------------
+# perfledger: golden verdict fixtures + CLI gates
+# ---------------------------------------------------------------------------
+
+def _bench_rec(value, metric="obs_tokens_per_sec"):
+    return {"metric": metric, "value": value, "unit": "tok/s",
+            "vs_baseline": None, "detail": {}}
+
+
+def test_perfledger_verdicts_improve_flat_regress(tmp_path):
+    from ray_tpu.tools import perfledger as pl
+
+    hist = str(tmp_path / "hist.jsonl")
+    pl.append_records([_bench_rec(100.0)], "bench", path=hist)
+    assert pl.check(hist)["verdicts"][
+        "obs_tokens_per_sec"]["verdict"] == "new"
+    pl.append_records([_bench_rec(101.0)], "bench", path=hist)
+    assert pl.check(hist)["verdicts"][
+        "obs_tokens_per_sec"]["verdict"] == "flat"
+    pl.append_records([_bench_rec(120.0)], "bench", path=hist)
+    assert pl.check(hist)["verdicts"][
+        "obs_tokens_per_sec"]["verdict"] == "improve"
+    pl.append_records([_bench_rec(80.0)], "bench", path=hist)
+    result = pl.check(hist)
+    assert result["verdicts"]["obs_tokens_per_sec"]["verdict"] \
+        == "regress"
+    assert result["ok"] is False
+
+
+def test_perfledger_latency_direction(tmp_path):
+    from ray_tpu.tools import perfledger as pl
+
+    hist = str(tmp_path / "hist.jsonl")
+    rec = lambda v: _bench_rec(v, metric="obs_prefill_ttft_ms")  # noqa: E731
+    pl.append_records([rec(10.0)], "bench", path=hist)
+    pl.append_records([rec(20.0)], "bench", path=hist)
+    assert pl.check(hist)["verdicts"][
+        "obs_prefill_ttft_ms"]["verdict"] == "regress"
+
+
+def test_perfledger_check_cli_exit_codes(tmp_path, capsys):
+    """Acceptance: ``python -m ray_tpu.tools.perfledger check`` exits
+    nonzero on a fixture regression (and zero when clean)."""
+    from ray_tpu.tools import perfledger as pl
+
+    hist = str(tmp_path / "hist.jsonl")
+    pl.append_records([_bench_rec(100.0)], "bench", path=hist)
+    pl.append_records([_bench_rec(100.0)], "bench", path=hist)
+    assert pl.main(["--history", hist, "check"]) == 0
+    pl.append_records([_bench_rec(50.0)], "bench", path=hist)
+    assert pl.main(["--history", hist, "check"]) == 1
+    capsys.readouterr()
+
+
+def test_perfledger_ingest_sweepjson_and_wrappers(tmp_path):
+    from ray_tpu.tools import perfledger as pl
+
+    sweep = {"sweep": {"mode": "train", "batch_per_chip": 8,
+                       "overrides": {}},
+             "tok_s_chip": 500.0, "mfu": 0.2, "chips": 8}
+    wrapper = {"n": 5, "cmd": "python bench.py",
+               "parsed": _bench_rec(77.0)}
+    text = ("human noise line\n"
+            "SWEEPJSON " + json.dumps(sweep) + "\n"
+            + json.dumps(_bench_rec(42.0)) + "\n")
+    recs = pl.parse_text(text)
+    assert len(recs) == 2
+    recs += pl.parse_text(json.dumps(wrapper, indent=1))
+    assert len(recs) == 3
+    hist = str(tmp_path / "hist.jsonl")
+    assert pl.append_records(recs, "ingest", path=hist) == 3
+    series = pl.metric_series(pl.load_history(hist))
+    assert "obs_tokens_per_sec" in series
+    assert any(k.startswith("sweep.train.tok_s_chip") for k in series)
+
+
+def test_perfledger_variant_series_do_not_mix(tmp_path):
+    """Different sweep variants must form different series — a b24
+    point never gates a b32 point."""
+    from ray_tpu.tools import perfledger as pl
+
+    hist = str(tmp_path / "hist.jsonl")
+    a = {"sweep": {"mode": "train", "batch_per_chip": 24,
+                   "overrides": {}}, "tok_s_chip": 900.0}
+    b = {"sweep": {"mode": "train", "batch_per_chip": 32,
+                   "overrides": {}}, "tok_s_chip": 100.0}
+    pl.append_records([a, b], "sweep", path=hist)
+    result = pl.check(hist)
+    assert all(v["verdict"] == "new"
+               for v in result["verdicts"].values())
+    assert result["ok"]
+
+
+def test_perfledger_report_renders(tmp_path):
+    from ray_tpu.tools import perfledger as pl
+
+    hist = str(tmp_path / "hist.jsonl")
+    pl.append_records([_bench_rec(100.0)], "bench", path=hist)
+    pl.append_records([_bench_rec(80.0)], "bench", path=hist)
+    text = pl.report(hist)
+    assert "obs_tokens_per_sec" in text
+    assert "regress" in text
+    assert "REGRESSIONS DETECTED" in text
+
+
+# ---------------------------------------------------------------------------
+# sweep -> ledger end-to-end
+# ---------------------------------------------------------------------------
+
+def test_sweep_appends_to_bench_history(tmp_path, monkeypatch):
+    """Acceptance: sweep_tpu.py appends its records to
+    BENCH_HISTORY.jsonl end-to-end (time_config stubbed — the sweep
+    plumbing, record shape, and ledger append are what's under test)."""
+    import sweep_tpu
+
+    calls = []
+
+    def fake_time_config(batch, seq=1024, n_steps=20, preset="gpt2",
+                         **kw):
+        calls.append(batch)
+        return 1000.0, 0.33, 2.5, 1, {"mfu_xla": 0.31,
+                                      "xla_flops": 1.0e9,
+                                      "peak_hbm_bytes": 1 << 20,
+                                      "model_flops": 1.1e9,
+                                      "compile_seconds": 0.5}
+
+    monkeypatch.setattr(sweep_tpu, "time_config", fake_time_config)
+    hist = str(tmp_path / "BENCH_HISTORY.jsonl")
+    out = io.StringIO()
+    records = sweep_tpu.run_sweep([[2, {"preset": "tiny"}]], 1,
+                                  out=out, audit=False,
+                                  ledger_path=hist)
+    assert calls == [2]
+    assert records[0]["mfu_xla"] == 0.31
+    assert "SWEEPJSON" in out.getvalue()
+    from ray_tpu.tools import perfledger as pl
+
+    entries = pl.load_history(hist)
+    assert len(entries) == 1
+    series = pl.metric_series(entries)
+    assert any(k.startswith("sweep.train.mfu_xla") for k in series)
+    assert any(k.startswith("sweep.train.tok_s_chip") for k in series)
