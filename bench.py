@@ -71,6 +71,17 @@ def parse_args(argv=None):
                     help="--decode prompt length (default 128 on TPU)")
     ap.add_argument("--new-tokens", type=int, default=0,
                     help="--decode generated tokens (default 64 on TPU)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="--decode with speculative decoding: draft k "
+                         "tokens per round, one batched verify "
+                         "dispatch (0 = off); emits "
+                         "gpt2_decode_spec_tokens_per_sec and "
+                         "spec accept-rate JSON lines")
+    ap.add_argument("--spec-draft", default="aligned",
+                    help="--spec-k draft: 'aligned' (a draft with the "
+                         "TARGET's family/preset/seed — acceptance "
+                         "~1.0, isolates the dispatch-amortization "
+                         "ceiling), 'ngram', or '<family>:<preset>'")
     ap.add_argument("--traffic", action="store_true",
                     help="benchmark the continuous serve engine under "
                          "synthetic shared-prefix Poisson traffic "
@@ -525,6 +536,130 @@ def main_decode(args, on_tpu: bool) -> None:
                        prefill_ttft_ms=round(ttft_ms, 2))})
 
 
+def time_decode_spec(batch, prompt_len=128, new_tokens=64,
+                     preset="gpt2", spec_k=4, spec_draft="aligned",
+                     kv_layout="dense", mesh=None, seed=0,
+                     config_overrides=None):
+    """Time the CONTINUOUS engine with speculative decoding: `batch`
+    concurrent requests through build_llm_deployment(spec_decode=...),
+    greedy, measured end-to-end through the same engine-telemetry
+    layer production serves.
+
+    'aligned' draft = a draft model with the target's own
+    family/preset/seed — its proposals always match the target argmax,
+    so acceptance is ~1.0 and the run measures the pure
+    dispatch-amortization ceiling (the floor on target dispatches per
+    token at a given k).  Real drafts land between this and the
+    non-spec engine.
+
+    Returns (tok_s, stats, dispatches_per_token, n_chips):
+    dispatches_per_token counts TARGET model dispatches per emitted
+    token, slot-normalized — one prefill per request plus one verify
+    per slot-round, over all emitted tokens.  Non-spec decode is
+    exactly 1.0 by construction; spec at acceptance rate a gives
+    ~1/(1 + a*k)."""
+    import asyncio
+
+    import numpy as np
+
+    from ray_tpu.serve.llm import SpecConfig, build_llm_deployment
+
+    draft = (f"gpt2:{preset}" if spec_draft == "aligned"
+             else spec_draft)
+    dep = build_llm_deployment(
+        "gpt2", preset, scheduler="continuous",
+        max_new_tokens=new_tokens, max_slots=batch,
+        prefill_bucket=max(16, prompt_len), kv_layout=kv_layout,
+        mesh=mesh, seed=seed,
+        spec_decode=SpecConfig(draft=draft, k=spec_k),
+        config_overrides=config_overrides)
+    inst = dep.func_or_class()
+    rng = np.random.default_rng(1)
+    vocab = int(inst.cfg.vocab_size)
+    prompts = [rng.integers(0, vocab, size=prompt_len).astype(np.int32)
+               for _ in range(batch)]
+
+    async def go():
+        try:
+            return await asyncio.gather(*[inst(p) for p in prompts])
+        finally:
+            inst.shutdown_engine()
+
+    t0 = time.perf_counter()
+    outs = asyncio.run(go())
+    dt = time.perf_counter() - t0
+    stats = inst.engine_stats()
+    n_tokens = sum(len(o) - prompt_len for o in outs)
+    spec = stats["spec"]
+    # one target prefill per request + one verify per slot-round
+    dispatches = batch + spec["rounds"]
+    n_chips = int(mesh.size) if mesh is not None else 1
+    return (n_tokens / dt, stats, dispatches / max(1, n_tokens),
+            n_chips)
+
+
+def main_decode_spec(args, on_tpu: bool) -> None:
+    """--decode --spec-k K: speculative decoding on the continuous
+    engine, same machine-readable shape as the plain decode metrics.
+    Headlines are decode_spec tokens/s and the measured acceptance
+    rate; target dispatches per token (the amortization the whole
+    feature buys) rides in detail.  No published baseline exists, so
+    vs_baseline is null."""
+    import jax
+
+    if on_tpu:
+        batch = args.batch or 8
+        preset = args.preset or "gpt2"
+        prompt_len = args.prompt_len or 128
+        new_tokens = args.new_tokens or 64
+        base = "gpt2_decode"
+        overrides = None
+    else:  # CPU smoke so the spec bench always emits its lines
+        import jax.numpy as jnp
+
+        batch = args.batch or 4
+        preset = args.preset or "nano"
+        prompt_len = args.prompt_len or 16
+        new_tokens = args.new_tokens or 12
+        base = "gpt2_decode_cpu_smoke"
+        overrides = {"dtype": jnp.float32, "use_flash": False,
+                     "remat": False}
+    mesh, n_chips = (decode_mesh(args.chips or 1)
+                     if args.mesh == "tensor" else (None, 1))
+    spec_base = base.replace("_decode", "_decode_spec")
+    if mesh is not None:
+        spec_base += "_sharded"
+    with _maybe_profile(args.profile):
+        tok_s, stats, dpt, n_chips = time_decode_spec(
+            batch, prompt_len=prompt_len, new_tokens=new_tokens,
+            preset=preset, spec_k=args.spec_k,
+            spec_draft=args.spec_draft, kv_layout=args.kv_layout,
+            mesh=mesh, config_overrides=overrides)
+    spec = stats["spec"]
+    detail = {"chips": n_chips, "batch": batch,
+              "prompt_len": prompt_len, "new_tokens": new_tokens,
+              "preset": preset, "spec_k": args.spec_k,
+              "spec_draft": args.spec_draft,
+              "kv_layout": args.kv_layout,
+              "mesh": ({"tensor": n_chips} if mesh is not None
+                       else {}),
+              "backend": jax.default_backend(),
+              "tpu_error": TPU_ERROR,
+              "target_dispatches_per_token": round(dpt, 4),
+              "spec": spec}
+    emit({
+        "metric": f"{spec_base}_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tokens/s",
+        "vs_baseline": None,
+        "detail": dict(detail,
+                       accept_rate=spec["accept_rate"])})
+    emit({
+        "metric": f"{spec_base}_accept_rate",
+        "value": spec["accept_rate"], "unit": "ratio",
+        "vs_baseline": None,
+        "detail": dict(detail, tokens_per_sec=round(tok_s, 1))})
+
+
 def main_traffic(args, on_tpu: bool) -> None:
     """--traffic: the continuous engine under seeded shared-prefix
     Poisson load (serve/traffic.py run_traffic — the same entry the
@@ -625,7 +760,10 @@ def main(args=None):
 
     del _EMITTED[:]
     if args.decode:
-        main_decode(args, jax.default_backend() == "tpu")
+        if args.spec_k > 0:
+            main_decode_spec(args, jax.default_backend() == "tpu")
+        else:
+            main_decode(args, jax.default_backend() == "tpu")
         return _ledger_append(args)
     if args.traffic:
         main_traffic(args, jax.default_backend() == "tpu")

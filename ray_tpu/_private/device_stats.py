@@ -59,8 +59,10 @@ _PEAK_FLOPS_TABLE = {
 #: silently pointing at a program that never exists.
 KNOWN_PROGRAMS = frozenset({
     "serve.prefill", "serve.paged_prefill", "serve.decode",
+    "serve.spec_verify", "serve.spec_draft",
     "serve.sharded_prefill", "serve.sharded_paged_prefill",
     "serve.sharded_decode",
+    "serve.sharded_spec_verify", "serve.sharded_spec_draft",
     "train.step",
     "bench.train_step",
 })
@@ -79,6 +81,7 @@ STATIC_PROGRAM_MAP: Dict[str, str] = {
     "gpt2_decode_step": "serve.decode",
     "gpt2_paged_decode_step": "serve.decode",
     "gpt2_sharded_decode_step": "serve.sharded_decode",
+    "gpt2_spec_verify_step": "serve.spec_verify",
 }
 
 _metrics_lock = threading.Lock()

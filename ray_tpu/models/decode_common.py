@@ -43,11 +43,39 @@ prefix hashing lives in ray_tpu/serve/kv_pager.py.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Jit-static sampling knobs (round 11).
+
+    Frozen + hashable on purpose: the serve engine keys its compiled
+    program cache on this object, so two engines (or two requests)
+    with different knobs can never alias one stale XLA program.
+    top_k=0 disables the top-k filter; top_p=1.0 disables nucleus
+    filtering; temperature 0 is greedy (filters become no-ops since
+    argmax of a superset equals argmax of the kept set's union with
+    -inf tails).
+    """
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self):
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}")
 
 
 def slot_mask(start: jnp.ndarray, end: jnp.ndarray,
@@ -180,17 +208,262 @@ def make_vocab_tail_mask(cfg) -> Optional[jnp.ndarray]:
     return jnp.arange(cfg.padded_vocab) < cfg.vocab_size
 
 
-def sample_token(logits, key, temperature: float,
-                 tail_mask: Optional[jnp.ndarray]) -> jnp.ndarray:
-    """(B, padded_vocab) logits → (B,) int32 token; the padded vocab
-    tail can never be sampled.  temperature 0 = greedy (key unused)."""
+def _mask_to_top_k(logits, top_k: int):
+    """Keep only entries >= the k-th largest per row (last axis); ties
+    at the threshold all survive.  Any leading batch dims."""
+    kth = lax.top_k(logits, top_k)[0][..., -1:]
+    return jnp.where(logits >= kth, logits,
+                     jnp.asarray(-1e30, logits.dtype))
+
+
+def _mask_to_top_p(logits, top_p: float):
+    """Nucleus filter over the last axis: keep the smallest
+    descending-probability prefix whose mass reaches top_p.  A token
+    is kept iff the mass STRICTLY BEFORE it is < top_p, so the top-1
+    token always survives.  Works on logits already scaled by
+    temperature (the nucleus is defined on the sampling
+    distribution)."""
+    order = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < top_p
+    inv = jnp.argsort(order, axis=-1)
+    keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+    return jnp.where(keep, logits, jnp.asarray(-1e30, logits.dtype))
+
+
+def filter_logits(logits, temperature: float,
+                  tail_mask: Optional[jnp.ndarray],
+                  top_k: int = 0, top_p: float = 1.0):
+    """Temperature-scale then apply the static tail/top-k/top-p masks;
+    returns the filtered f32-safe logits the categorical (or the
+    spec-decode accept test) draws from.  temperature must be > 0."""
     if tail_mask is not None:
         logits = jnp.where(tail_mask, logits,
                            jnp.asarray(-1e30, logits.dtype))
+    scaled = logits / jnp.float32(temperature)
+    if top_k > 0:
+        scaled = _mask_to_top_k(scaled, top_k)
+    if top_p < 1.0:
+        scaled = _mask_to_top_p(scaled, top_p)
+    return scaled
+
+
+def sample_token(logits, key, temperature: float,
+                 tail_mask: Optional[jnp.ndarray],
+                 top_k: int = 0, top_p: float = 1.0) -> jnp.ndarray:
+    """(..., padded_vocab) logits → (...,) int32 token; the padded
+    vocab tail can never be sampled.  temperature 0 = greedy (key and
+    the filters are unused — argmax is filter-invariant).  top_k /
+    top_p are jit-STATIC knobs (python ints/floats baked into the
+    compiled program): top_k keeps the k most likely tokens, top_p
+    keeps the smallest nucleus reaching that probability mass, both
+    composed AFTER temperature scaling and with the tail mask
+    preserved."""
     if temperature == 0.0:
+        if tail_mask is not None:
+            logits = jnp.where(tail_mask, logits,
+                               jnp.asarray(-1e30, logits.dtype))
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits / jnp.float32(temperature)).astype(jnp.int32)
+    scaled = filter_logits(logits, temperature, tail_mask, top_k,
+                           top_p)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+def spec_accept(logits, block, key, temperature: float,
+                tail_mask: Optional[jnp.ndarray],
+                top_k: int = 0, top_p: float = 1.0,
+                draft_probs=None):
+    """Speculative accept/reject over one verify round (round 11).
+
+    block (B, T=k+1) int32 is [cur, d_1..d_k] — the last sampled token
+    followed by the draft's k proposals; logits (B, T, padded_vocab)
+    is the target model's verify forward over exactly those positions,
+    so logits[:, t] is the target's distribution for the token AFTER
+    block[:, t].  Returns (out_tokens (B, T) int32, n_acc (B,) int32);
+    row b emitted out_tokens[b, :n_acc[b] + 1] — the accepted draft
+    prefix plus one target-sampled correction/bonus token, so every
+    round nets at least one token and the greedy path is bit-identical
+    to sequential argmax decoding.
+
+    temperature 0: accept d_{t+1} iff it equals argmax(logits[:, t])
+    cumulatively (deterministic, key unused).  temperature > 0:
+    standard rejection sampling — accept with prob min(1, p/q) where q
+    is draft_probs (B, k, V), the draft's post-filter sampling
+    distribution, or a one-hot on the proposal when the draft supplies
+    no distribution (n-gram draft); the correction token comes from
+    the normalised residual max(p - q, 0), which degenerates to p for
+    the all-accepted bonus position (q is zero-padded there).
+    """
+    B, T = block.shape
+    k = T - 1
+    drafts = block[:, 1:]                                   # (B, k)
+    cols = jnp.arange(T)
+    if temperature == 0.0:
+        if tail_mask is not None:
+            logits = jnp.where(tail_mask, logits,
+                               jnp.asarray(-1e30, logits.dtype))
+        g = jnp.argmax(logits, axis=-1).astype(jnp.int32)   # (B, T)
+        match = (drafts == g[:, :-1]).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        corr = jnp.take_along_axis(g, n_acc[:, None], axis=1)
+    else:
+        filt = filter_logits(logits, temperature, tail_mask, top_k,
+                             top_p)
+        p = jax.nn.softmax(filt.astype(jnp.float32), axis=-1)
+        V = p.shape[-1]
+        if draft_probs is None:
+            q = jax.nn.one_hot(drafts, V, dtype=p.dtype)
+        else:
+            q = draft_probs.astype(p.dtype)
+        u_key, s_key = jax.random.split(key)
+        u = jax.random.uniform(u_key, (B, k))
+        idx = drafts[..., None]
+        p_d = jnp.take_along_axis(p[:, :k], idx, axis=-1)[..., 0]
+        q_d = jnp.take_along_axis(q, idx, axis=-1)[..., 0]
+        ratio = p_d / jnp.maximum(q_d, 1e-20)
+        accept = (u < jnp.minimum(1.0, ratio)).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)
+        q_pad = jnp.concatenate(
+            [q, jnp.zeros((B, 1, V), q.dtype)], axis=1)
+        sel = n_acc[:, None, None]
+        p_at = jnp.take_along_axis(p, jnp.broadcast_to(sel, (B, 1, V)),
+                                   axis=1)[:, 0]             # (B, V)
+        q_at = jnp.take_along_axis(q_pad,
+                                   jnp.broadcast_to(sel, (B, 1, V)),
+                                   axis=1)[:, 0]
+        residual = jnp.maximum(p_at - q_at, 0.0)
+        mass = jnp.sum(residual, axis=-1, keepdims=True)
+        residual = jnp.where(mass > 0, residual / mass, p_at)
+        corr = jax.random.categorical(
+            s_key, jnp.log(residual + 1e-30))[:, None]
+    drafts_pad = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), drafts.dtype)], axis=1)
+    out = jnp.where(cols[None, :] < n_acc[:, None], drafts_pad,
+                    corr.astype(drafts_pad.dtype))
+    return out.astype(jnp.int32), n_acc.astype(jnp.int32)
+
+
+def make_spec_verify(verify_step_fn, cfg, temperature: float = 0.0,
+                     top_k: int = 0, top_p: float = 1.0):
+    """Compose a family's verify_step with spec_accept into the
+    canonical spec-decode verify program: ONE target dispatch checks a
+    whole draft block and advances pos by the tokens actually kept.
+
+    Returned greedy signature: (params, cache, block, key) →
+    (out_tokens, n_acc, cache); sampled adds a trailing draft_probs
+    arg.  The cache's pos lands at old_pos + n_acc + 1 — the next
+    write slot after the last EMITTED token's K/V (the correction
+    token itself has no K/V yet, exactly like a freshly sampled token
+    in the plain decode step).  K/V written for rejected draft
+    positions sits at slots >= the new pos: never attendable under
+    slot_mask, overwritten by later rounds — the dense rollback IS the
+    pos rewind.  Paged caches need no block surgery either: every
+    row's blocks are reserved for the full request at admission, so
+    rejected writes land in row-private blocks (or the null block past
+    max_seq) that the row still owns."""
+    tail = make_vocab_tail_mask(cfg)
+    if temperature == 0.0:
+        def spec_verify(params, cache, block, key):
+            logits, cache = verify_step_fn(params, cache, block, cfg)
+            out, n_acc = spec_accept(logits, block, key, 0.0, tail)
+            cache = dict(cache)
+            cache["pos"] = cache["pos"] + n_acc + 1
+            return out, n_acc, cache
+        return spec_verify
+
+    def spec_verify(params, cache, block, key, draft_probs=None):
+        logits, cache = verify_step_fn(params, cache, block, cfg)
+        out, n_acc = spec_accept(logits, block, key, temperature,
+                                 tail, top_k, top_p, draft_probs)
+        cache = dict(cache)
+        cache["pos"] = cache["pos"] + n_acc + 1
+        return out, n_acc, cache
+    return spec_verify
+
+
+def spec_rewind(cache, n_rejected):
+    """Roll a cache back over rejected draft positions: pure per-row
+    pos arithmetic (n_rejected (B,) int32).  The stale K/V needs no
+    scrubbing — slot_mask derives attendability from pos, so rewound
+    slots are invisible until overwritten."""
+    out = dict(cache)
+    out["pos"] = cache["pos"] - jnp.asarray(n_rejected, jnp.int32)
+    return out
+
+
+def make_draft_propose(decode_step_fn, cfg, k: int,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, with_probs: bool = False):
+    """Build the jitted draft-side program for model-draft spec
+    decode: rewind the draft cache over last round's rejections, then
+    run k+1 chained draft decode steps in a scan — feeding
+    [cur, d_1..d_k] so the final step writes d_k's K/V, which makes
+    the rewind arithmetic uniform (the draft cache always holds K/V
+    for every fed token; pos nets +n_acc+1 per round, mirroring the
+    target).
+
+    Returned signature: (params, cache, cur (B,), n_rejected (B,),
+    key) → (drafts (B, k), cache) — or (drafts, probs (B, k, V),
+    cache) when with_probs (the post-filter distribution each d_t was
+    sampled from, required by sampled-mode spec_accept)."""
+    if with_probs and temperature == 0.0:
+        raise ValueError("with_probs requires temperature > 0 (greedy "
+                         "spec_accept never consults draft_probs)")
+    tail = make_vocab_tail_mask(cfg)
+
+    def draft_propose(params, cache, cur, n_rejected, key):
+        cache = spec_rewind(cache, n_rejected)
+
+        def body(carry, kk):
+            cache, tok = carry
+            logits, cache = decode_step_fn(params, cache, tok, cfg)
+            if temperature == 0.0:
+                nxt = sample_token(logits, kk, 0.0, tail)
+                probs = jnp.zeros((), jnp.float32)      # unused
+            else:
+                filt = filter_logits(logits, temperature, tail,
+                                     top_k, top_p)
+                probs = jax.nn.softmax(filt.astype(jnp.float32),
+                                       axis=-1)
+                nxt = jax.random.categorical(kk, filt).astype(
+                    jnp.int32)
+            return (cache, nxt), (nxt, probs)
+
+        keys = jax.random.split(key, k)
+        (cache, last), (drafts, probs) = lax.scan(
+            body, (cache, cur), keys)
+        # Extra (k+1)-th step: ingest d_k's K/V, logits discarded.
+        _, cache = decode_step_fn(params, cache, last, cfg)
+        drafts = drafts.T                               # (B, k)
+        if with_probs:
+            return drafts, jnp.swapaxes(probs, 0, 1), cache
+        return drafts, cache
+    return draft_propose
+
+
+def ngram_propose(tokens, k: int, order: int = 2):
+    """Host-side zero-weight draft: propose the k tokens that followed
+    the most recent previous occurrence of the current trailing
+    `order`-gram in this request's own history (prompt + emitted).
+    Falls back to repeating the last token when no prior occurrence
+    (or history shorter than the gram) exists — proposal quality only
+    moves the acceptance rate, never correctness, because every
+    proposal is target-verified."""
+    toks = list(tokens)
+    n = len(toks)
+    fallback = [toks[-1]] * k if toks else [0] * k
+    if n <= order:
+        return fallback
+    gram = toks[n - order:]
+    for i in range(n - order - 1, -1, -1):
+        if toks[i:i + order] == gram:
+            cont = toks[i + order:i + order + k]
+            if cont:
+                return (cont + [cont[-1]] * (k - len(cont)))[:k]
+            break
+    return fallback
 
 
 def scan_prefill(init_cache_fn, decode_step_fn, params, prompt, cfg):
@@ -213,6 +486,7 @@ def generate_with(prefill_fn, decode_step_fn, params,
                   prompt: jnp.ndarray, cfg, *, max_new_tokens: int,
                   lengths: Optional[jnp.ndarray] = None,
                   temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0,
                   key: Optional[jax.Array] = None,
                   kv_layout: str = "dense",
                   kv_block_size: int = 16) -> jnp.ndarray:
@@ -221,7 +495,8 @@ def generate_with(prefill_fn, decode_step_fn, params,
     family's decode_step.  prompt (B, T0) int32 → (B, T0 +
     max_new_tokens) int32; `lengths` (B,) marks ragged LEFT-padded
     prompts (row b's real tokens occupy columns [T0 - lengths[b], T0));
-    temperature 0 = greedy; the whole program jits (static cfg /
+    temperature 0 = greedy; top_k/top_p are jit-static sampling
+    filters (see sample_token); the whole program jits (static cfg /
     max_new_tokens).  kv_layout="paged" re-lays the prefilled cache
     into kv_block_size blocks and decodes through the block-table
     gather/scatter path — the dense layout is its parity oracle."""
@@ -246,7 +521,8 @@ def generate_with(prefill_fn, decode_step_fn, params,
 
     def gen_step(carry, k):
         cache, logits = carry
-        tok = sample_token(logits, k, temperature, tail_mask)
+        tok = sample_token(logits, k, temperature, tail_mask,
+                           top_k, top_p)
         new_logits, cache = decode_step_fn(params, cache, tok, cfg)
         return (cache, new_logits), tok
 

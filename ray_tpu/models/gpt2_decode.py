@@ -40,7 +40,7 @@ from ray_tpu.models.decode_common import (generate_with, is_paged,
 from ray_tpu.models.gpt2 import GPT2Config, _layernorm
 
 __all__ = ["init_cache", "init_paged_cache", "prefill", "paged_prefill",
-           "decode_step", "generate"]
+           "decode_step", "verify_step", "generate"]
 
 
 def init_cache(cfg: GPT2Config, batch: int,
@@ -323,6 +323,99 @@ def decode_step(params, cache, tokens, cfg: GPT2Config
     return logits, out
 
 
+def verify_step(params, cache, block, cfg: GPT2Config
+                ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Speculative-decode verify forward: T=k+1 tokens per row in ONE
+    dispatch (round 11).  block (B, T) int32 is [cur, d_1..d_k] — the
+    last sampled-but-not-yet-ingested token followed by the draft's k
+    proposals; row b's t-th token lands at cache slot pos[b] + t, and
+    logits[:, t] is the target's distribution for the token AFTER
+    block[:, t] — exactly what T sequential decode_step dispatches
+    would produce, which is what makes greedy spec decode bit-exact
+    against the non-speculative oracle.
+
+    Shares decode_step's per-slot masking discipline (the PR 2 ragged
+    prefill shape: per-row pos/start, causal within the block) and
+    both KV layouts.  Writes past max_seq — possible only in a
+    request's final rounds, when the accepted prefix can't reach them
+    anyway — are routed to the null block (paged) or dropped (dense)
+    instead of clamping onto live slots.  pos is NOT advanced: the
+    caller (decode_common.make_spec_verify) moves it by the accepted
+    count, which IS the rollback."""
+    B, T = block.shape
+    d, h, hd = cfg.d_model, cfg.n_head, cfg.head_dim
+    paged = is_paged(cache)
+    pos = cache["pos"]                                   # (B,)
+    start = cache["start"]                               # (B,)
+    rows = jnp.arange(B)
+    offs = jnp.arange(T, dtype=jnp.int32)
+    slot_ids = pos[:, None] + offs[None, :]              # (B, T)
+    in_range = slot_ids < cfg.max_seq
+    pos_ids = jnp.minimum(jnp.maximum(slot_ids - start[:, None], 0),
+                          cfg.max_seq - 1)
+    x = params["wte"].astype(cfg.dtype)[block]           # (B, T, d)
+    x = x + params["wpe"].astype(cfg.dtype)[pos_ids]
+    # (B, T, S): query t attends slots start[b] <= s <= pos[b] + t
+    s = jnp.arange(cfg.max_seq)
+    attn_mask = (s[None, None, :] >= start[:, None, None]) & \
+                (s[None, None, :] <= slot_ids[:, :, None])
+    if paged:
+        bt = cache["block_tables"]
+        bs = cache["k"].shape[2]
+        blk_col = jnp.minimum(slot_ids // bs, bt.shape[1] - 1)
+        blk = jnp.where(in_range, bt[rows[:, None], blk_col], 0)
+        off = jnp.where(in_range, slot_ids % bs, 0)
+    else:
+        # OOB rows dropped by the scatter (mode="drop")
+        write_idx = jnp.where(in_range, slot_ids, cfg.max_seq)
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _layernorm(x, p["ln1"]["scale"], p["ln1"]["bias"])
+        w = p["attn"]["qkv_w"].astype(cfg.dtype).reshape(d, 3 * h * hd)
+        qkv = (xa @ w).reshape(B, T, 3, h, hd) \
+            + p["attn"]["qkv_b"].astype(cfg.dtype)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if paged:
+            lk = lk.at[blk, off].set(k_new)
+            lv = lv.at[blk, off].set(v_new)
+            ck = lk[bt].reshape(B, cfg.max_seq, h, hd)
+            cv = lv[bt].reshape(B, cfg.max_seq, h, hd)
+        else:
+            lk = ck = lk.at[rows[:, None], write_idx].set(
+                k_new, mode="drop")
+            lv = cv = lv.at[rows[:, None], write_idx].set(
+                v_new, mode="drop")
+        scores = jnp.einsum("bthd,bshd->bhts", q,
+                            ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(attn_mask[:, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", probs, cv)     # (B,T,h,hd)
+        wo = p["attn"]["o_w"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, T, h * hd) @ wo
+                 + p["attn"]["o_b"].astype(cfg.dtype))
+        xm = _layernorm(x, p["ln2"]["scale"], p["ln2"]["bias"])
+        hmid = jax.nn.gelu(xm @ p["mlp"]["fc_w"].astype(cfg.dtype)
+                           + p["mlp"]["fc_b"].astype(cfg.dtype))
+        x = x + (hmid @ p["mlp"]["proj_w"].astype(cfg.dtype)
+                 + p["mlp"]["proj_b"].astype(cfg.dtype))
+        return (x, lidx + 1), (lk, lv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    out = dict(cache)
+    out["k"], out["v"] = new_k, new_v
+    return logits, out
+
+
 def _scan_prefill(params, tokens, cfg, *, lengths=None):
     """prefill-shaped wrapper over the per-token reference scan."""
     if lengths is not None:
@@ -334,6 +427,7 @@ def _scan_prefill(params, tokens, cfg, *, lengths=None):
 
 def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
              max_new_tokens: int, temperature: float = 1.0,
+             top_k: int = 0, top_p: float = 1.0,
              lengths: Optional[jnp.ndarray] = None,
              key: Optional[jax.Array] = None,
              prefill_impl: str = "batched",
@@ -342,10 +436,12 @@ def generate(params, prompt: jnp.ndarray, cfg: GPT2Config, *,
     """GPT-2 generation (see decode_common.generate_with).  `lengths`
     marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
     per-token reference prefill for parity testing; kv_layout="paged"
-    decodes through the block-pool layout (dense is its oracle)."""
+    decodes through the block-pool layout (dense is its oracle);
+    top_k/top_p are jit-static sampling filters."""
     prefill_fn = prefill if prefill_impl == "batched" else _scan_prefill
     return generate_with(prefill_fn, decode_step, params, prompt, cfg,
                          max_new_tokens=max_new_tokens,
                          lengths=lengths, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
                          key=key, kv_layout=kv_layout,
                          kv_block_size=kv_block_size)

@@ -27,7 +27,7 @@ from ray_tpu.models.llama import (LlamaConfig, _rmsnorm,
 
 __all__ = ["llama_init_cache", "llama_init_paged_cache",
            "llama_prefill", "llama_paged_prefill", "llama_decode_step",
-           "llama_generate"]
+           "llama_verify_step", "llama_generate"]
 
 
 def llama_init_cache(cfg: LlamaConfig, batch: int,
@@ -347,6 +347,101 @@ def llama_decode_step(params, cache, tokens, cfg: LlamaConfig
     return logits, out
 
 
+def llama_verify_step(params, cache, block, cfg: LlamaConfig
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Speculative-decode verify forward, llama flavour (see
+    gpt2_decode.verify_step for the shared contract): block (B, T=k+1)
+    int32 = [cur, d_1..d_k], one dispatch producing logits (B, T,
+    padded_vocab) equal to T sequential llama_decode_step calls.  RoPE
+    rotates each (row, column) at its own logical position via the
+    per-row-per-column tables (_rope_bt); GQA attends through the
+    kv-head cache with the (kv, group) query reshape.  Writes past
+    max_seq route to the null block (paged) / drop (dense); pos is NOT
+    advanced — make_spec_verify moves it by the accepted count."""
+    B, T = block.shape
+    d, h, kv, hd = (cfg.d_model, cfg.n_head, cfg.n_kv_head,
+                    cfg.head_dim)
+    g = h // kv
+    paged = is_paged(cache)
+    pos = cache["pos"]                                   # (B,)
+    start = cache["start"]                               # (B,)
+    rows = jnp.arange(B)
+    offs = jnp.arange(T, dtype=jnp.int32)
+    slot_ids = pos[:, None] + offs[None, :]              # (B, T)
+    in_range = slot_ids < cfg.max_seq
+    pos_ids = jnp.minimum(jnp.maximum(slot_ids - start[:, None], 0),
+                          cfg.max_seq - 1)
+    x = params["wte"].astype(cfg.dtype)[block]           # (B, T, d)
+    cos, sin = rope_frequencies(cfg.max_seq, hd, cfg.rope_theta)
+    cos_p, sin_p = cos[pos_ids], sin[pos_ids]            # (B, T, hd/2)
+    s = jnp.arange(cfg.max_seq)
+    attn_mask = (s[None, None, :] >= start[:, None, None]) & \
+                (s[None, None, :] <= slot_ids[:, :, None])
+    if paged:
+        bt = cache["block_tables"]
+        bs = cache["k"].shape[2]
+        blk_col = jnp.minimum(slot_ids // bs, bt.shape[1] - 1)
+        blk = jnp.where(in_range, bt[rows[:, None], blk_col], 0)
+        off = jnp.where(in_range, slot_ids % bs, 0)
+    else:
+        write_idx = jnp.where(in_range, slot_ids, cfg.max_seq)
+
+    def body(carry, layer):
+        x, lidx = carry
+        p, = layer
+        lk = lax.dynamic_index_in_dim(cache["k"], lidx, axis=0,
+                                      keepdims=False)
+        lv = lax.dynamic_index_in_dim(cache["v"], lidx, axis=0,
+                                      keepdims=False)
+        xa = _rmsnorm(x, p["ln1"]["scale"], cfg.rms_eps)
+        xa = xa.astype(cfg.dtype)
+        q = (xa @ p["attn"]["wq"].astype(cfg.dtype).reshape(d, h * hd)
+             ).reshape(B, T, h, hd)
+        k_new = (xa @ p["attn"]["wk"].astype(cfg.dtype)
+                 .reshape(d, kv * hd)).reshape(B, T, kv, hd)
+        v_new = (xa @ p["attn"]["wv"].astype(cfg.dtype)
+                 .reshape(d, kv * hd)).reshape(B, T, kv, hd)
+        q = _rope_bt(q, cos_p, sin_p)
+        k_new = _rope_bt(k_new, cos_p, sin_p)
+        if paged:
+            lk = lk.at[blk, off].set(k_new)
+            lv = lv.at[blk, off].set(v_new)
+            ck = lk[bt].reshape(B, cfg.max_seq, kv, hd)
+            cv = lv[bt].reshape(B, cfg.max_seq, kv, hd)
+        else:
+            lk = ck = lk.at[rows[:, None], write_idx].set(
+                k_new, mode="drop")
+            lv = cv = lv.at[rows[:, None], write_idx].set(
+                v_new, mode="drop")
+        qg = q.reshape(B, T, kv, g, hd)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg,
+                            ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(hd))
+        scores = jnp.where(attn_mask[:, None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        o = jnp.einsum("bkgts,bskd->btkgd", probs, cv)
+        wo = p["attn"]["wo"].astype(cfg.dtype).reshape(h * hd, d)
+        x = x + (o.reshape(B, T, h * hd) @ wo).astype(x.dtype)
+        xm = _rmsnorm(x, p["ln2"]["scale"], cfg.rms_eps)
+        xm = xm.astype(cfg.dtype)
+        gate = xm @ p["mlp"]["w_gate"].astype(cfg.dtype)
+        up = xm @ p["mlp"]["w_up"].astype(cfg.dtype)
+        hmid = jax.nn.silu(gate) * up
+        x = x + (hmid @ p["mlp"]["w_down"].astype(cfg.dtype)
+                 ).astype(x.dtype)
+        return (x, lidx + 1), (lk, lv)
+
+    (x, _), (new_k, new_v) = lax.scan(body, (x, jnp.int32(0)),
+                                      (params["blocks"],))
+    x = _rmsnorm(x, params["ln_f"]["scale"], cfg.rms_eps)
+    logits = (x.astype(cfg.dtype)
+              @ params["lm_head"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    out = dict(cache)
+    out["k"], out["v"] = new_k, new_v
+    return logits, out
+
+
 def _scan_prefill(params, tokens, cfg, *, lengths=None):
     """prefill-shaped wrapper over the per-token reference scan."""
     if lengths is not None:
@@ -359,6 +454,7 @@ def _scan_prefill(params, tokens, cfg, *, lengths=None):
 
 def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
                    max_new_tokens: int, temperature: float = 1.0,
+                   top_k: int = 0, top_p: float = 1.0,
                    lengths: Optional[jnp.ndarray] = None,
                    key: Optional[jax.Array] = None,
                    prefill_impl: str = "batched",
@@ -367,11 +463,13 @@ def llama_generate(params, prompt: jnp.ndarray, cfg: LlamaConfig, *,
     """LLaMA generation via the shared loop (decode_common).  `lengths`
     marks LEFT-padded ragged prompts; prefill_impl="scan" keeps the
     per-token reference prefill for parity testing; kv_layout="paged"
-    decodes through the block-pool layout (dense is its oracle)."""
+    decodes through the block-pool layout (dense is its oracle);
+    top_k/top_p are jit-static sampling filters."""
     prefill_fn = (llama_prefill if prefill_impl == "batched"
                   else _scan_prefill)
     return generate_with(prefill_fn, llama_decode_step, params, prompt,
                          cfg, max_new_tokens=max_new_tokens,
                          lengths=lengths, temperature=temperature,
+                         top_k=top_k, top_p=top_p,
                          key=key, kv_layout=kv_layout,
                          kv_block_size=kv_block_size)

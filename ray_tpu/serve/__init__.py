@@ -6,7 +6,8 @@ from ray_tpu.serve.api import (Deployment, delete, deployment,
 from ray_tpu.serve.batching import (AdmissionPolicy, OverloadedError,
                                     batch)
 from ray_tpu.serve.kv_pager import BlockPager
-from ray_tpu.serve.llm import build_llm_deployment
+from ray_tpu.serve.llm import (SamplingParams, SpecConfig,
+                               build_llm_deployment)
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.schema import (DeploymentSchema,
                                   ServeApplicationSchema)
@@ -20,4 +21,5 @@ __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "ServeApplicationSchema", "DeploymentSchema",
            "apply_config", "build_llm_deployment", "AdmissionPolicy",
            "OverloadedError", "BlockPager", "TrafficSpec",
-           "TrafficGenerator", "run_traffic"]
+           "TrafficGenerator", "run_traffic", "SamplingParams",
+           "SpecConfig"]

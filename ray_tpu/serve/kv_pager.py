@@ -103,8 +103,17 @@ class BlockPager:
         """Blocks an `allocate` call could produce right now."""
         return len(self._free) + len(self._cached)
 
-    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
-        return -(-(prompt_len + max_new_tokens) // self.block_size)
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int,
+                      headroom: int = 0) -> int:
+        """Blocks a request needs end-to-end.  `headroom` reserves
+        extra write positions past the generation budget — spec-decode
+        verify rounds scatter up to k draft K/V writes beyond the last
+        kept token, and those overshoot writes must land in blocks the
+        row OWNS (never a shared prefix block or a block the pager has
+        re-handed out).  Capped at max_seq: writes past the sequence
+        bound are null-routed on-device and need no backing block."""
+        want = min(prompt_len + max_new_tokens + headroom, self.max_seq)
+        return -(-want // self.block_size)
 
     # -- allocation ----------------------------------------------------
 

@@ -28,15 +28,55 @@ Two schedulers:
 
 from __future__ import annotations
 
+import dataclasses
 import pickle
+import types
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ray_tpu.models.decode_common import SamplingParams
 from ray_tpu.serve.api import deployment
 from ray_tpu.serve.batching import OverloadedError, RequestQueue
 from ray_tpu.serve.batching import batch as _batch
 from ray_tpu.serve.telemetry import EngineTelemetry
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knob for the continuous engine (round 11).
+
+    draft: "ngram" (host-side zero-weight n-gram draft built from each
+    request's own history) or "<family>:<preset>" (a small draft
+    MODEL, e.g. "gpt2:nano" — its decode steps run in one jitted
+    k+1-step scan per round).  k drafted tokens are verified per slot
+    per round by ONE target verify dispatch, so at acceptance rate a
+    the target runs ~1/(1 + a*k) dispatches per emitted token.
+    draft_seed: PRNG seed for the draft model's init (None → the
+    engine seed, so draft == target arch + preset + seed gives the
+    perfectly aligned draft the CPU benches use).
+
+    Frozen + hashable: part of the jitted-program cache key, so
+    engines differing in k or draft can never alias one compiled
+    program."""
+    draft: str = "ngram"
+    k: int = 4
+    ngram_order: int = 2
+    draft_seed: Optional[int] = None
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {self.k}")
+        if self.draft != "ngram":
+            parts = self.draft.split(":")
+            if len(parts) != 2 or parts[0] not in ("gpt2", "llama"):
+                raise ValueError(
+                    f"spec draft must be 'ngram' or "
+                    f"'<family>:<preset>' with family gpt2|llama, "
+                    f"got {self.draft!r}")
+        if self.ngram_order < 1:
+            raise ValueError(
+                f"ngram_order must be >= 1, got {self.ngram_order}")
 
 
 def _family_fns(family: str):
@@ -73,22 +113,43 @@ def _family_fns(family: str):
 # fresh `jax.jit(closure)` per engine instance recompiles every
 # program for every instance — pathological for test suites and
 # notebooks that build many short-lived engines.  The continuous
-# engine's programs depend only on (family fns, config, temperature,
-# kv layout, mesh); configs are frozen dataclasses and jax Meshes are
-# hashable by (axis names, device assignment), so equal-config engines
-# can share ONE set of jitted callables and therefore one compile —
-# while engines that differ only in layout or mesh get their own
-# entries instead of colliding.
+# engine's programs depend only on (family fns, config, sampling
+# config, kv layout, mesh, spec config + draft fns); configs /
+# SamplingParams / SpecConfig are frozen dataclasses and jax Meshes
+# are hashable by (axis names, device assignment), so equal-config
+# engines can share ONE set of jitted callables and therefore one
+# compile — while engines that differ in ANY closure input (layout,
+# mesh, a sampling knob, spec k, the draft) get their own entries
+# instead of aliasing a stale compiled program (round-11 regression:
+# the key once carried only `temperature`, so a top_k change or a
+# different spec k would silently reuse the old sampler).
 _JIT_CACHE: Dict[Any, Any] = {}
 
 
 def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
-                       temperature, kv_layout="dense", mesh=None):
-    """(prefill, paged_prefill, pool_step, admit, copy_block,
-    clear_row) jitted programs for one (family, cfg, temperature,
-    kv_layout, mesh) engine identity."""
-    key = (prefill_fn, step_fn, paged_prefill_fn, cfg, temperature,
-           kv_layout, mesh)
+                       sampling, kv_layout="dense", mesh=None,
+                       spec=None, verify_fn=None, draft_fns=None):
+    """Namespace of jitted programs for one engine identity:
+
+      prefill / paged_prefill / pool_step  — fused sample-included
+          programs (engine-default sampling baked in; the hot path
+          stays one dispatch)
+      prefill_raw / paged_prefill_raw / pool_logits — logits-returning
+          twins for requests overriding SamplingParams (compiled only
+          if such a request arrives)
+      admit / copy_block / clear_row       — pool bookkeeping
+      spec_verify                          — (spec only) ONE target
+          dispatch verifying a (B, k+1) draft block, KV donated
+      draft_propose                        — (model draft only) the
+          k+1-step draft scan
+
+    `sampling` is a SamplingParams (a bare float is accepted as
+    temperature-only for backward compatibility).  The cache key
+    carries the FULL sampling + spec identity."""
+    if not isinstance(sampling, SamplingParams):
+        sampling = SamplingParams(temperature=float(sampling))
+    key = (prefill_fn, step_fn, paged_prefill_fn, cfg, sampling,
+           kv_layout, mesh, spec, verify_fn, draft_fns)
     cached = _JIT_CACHE.get(key)
     if cached is not None:
         return cached
@@ -96,25 +157,45 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
     from jax import lax
 
     from ray_tpu.models.decode_common import (copy_block,
+                                              make_draft_propose,
+                                              make_spec_verify,
                                               make_vocab_tail_mask,
                                               sample_token)
 
     tail = make_vocab_tail_mask(cfg)
+    temperature = sampling.temperature
+    top_k, top_p = sampling.top_k, sampling.top_p
 
     def prefill_sample(p, toks, lens, k):
         logits, cache = prefill_fn(p, toks, cfg, lengths=lens)
-        return sample_token(logits, k, temperature, tail), cache
+        return sample_token(logits, k, temperature, tail, top_k,
+                            top_p), cache
+
+    def prefill_raw(p, toks, lens):
+        return prefill_fn(p, toks, cfg, lengths=lens)
 
     def paged_prefill_sample(p, cache, toks, row_bt, prefix_len,
                              n_tail, slot, k):
         logits, cache = paged_prefill_fn(
             p, cache, toks, cfg, row_bt=row_bt,
             prefix_len=prefix_len, n_tail=n_tail, slot=slot)
-        return sample_token(logits[None], k, temperature, tail), cache
+        return sample_token(logits[None], k, temperature, tail,
+                            top_k, top_p), cache
+
+    def paged_prefill_raw(p, cache, toks, row_bt, prefix_len, n_tail,
+                          slot):
+        logits, cache = paged_prefill_fn(
+            p, cache, toks, cfg, row_bt=row_bt,
+            prefix_len=prefix_len, n_tail=n_tail, slot=slot)
+        return logits[None], cache
 
     def pool_step(p, cache, toks, k):
         logits, cache = step_fn(p, cache, toks, cfg)
-        return sample_token(logits, k, temperature, tail), cache
+        return sample_token(logits, k, temperature, tail, top_k,
+                            top_p), cache
+
+    def pool_logits(p, cache, toks):
+        return step_fn(p, cache, toks, cfg)
 
     def admit(pool, row, slot):
         out = dict(pool)
@@ -135,24 +216,59 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         out["pos"] = cache["pos"].at[slot].set(0)
         return out
 
-    # perf observatory: the three heavy programs report compiles /
-    # compiler cost model / invoke walltimes to the process-wide
-    # registry under stable names (sharded engines get their own so
-    # single- and multi-chip cost models never mix)
+    # perf observatory: the heavy programs report compiles / compiler
+    # cost model / invoke walltimes to the process-wide registry under
+    # stable names (sharded engines get their own so single- and
+    # multi-chip cost models never mix)
     from ray_tpu._private.device_stats import get_registry
 
     registry = get_registry()
     shard = "serve.sharded_" if mesh is not None else "serve."
     n_dev = len(getattr(mesh, "devices", [[None]]).flat) \
         if mesh is not None else 1
-    fns = (registry.instrument(shard + "prefill",
-                               jax.jit(prefill_sample), n_dev),
-           registry.instrument(shard + "paged_prefill",
-                               jax.jit(paged_prefill_sample), n_dev),
-           registry.instrument(shard + "decode",
-                               jax.jit(pool_step), n_dev),
-           jax.jit(admit), jax.jit(copy_block),
-           jax.jit(clear_row))
+    spec_verify = draft_propose = draft_prefill = None
+    if spec is not None:
+        verify = make_spec_verify(verify_fn, cfg,
+                                  temperature=temperature,
+                                  top_k=top_k, top_p=top_p)
+        # the target KV pool (arg 1) is donated: the verify round is
+        # the engine's steady-state hot program and the old pool is
+        # dead the moment the new one lands
+        spec_verify = registry.instrument(
+            shard + "spec_verify",
+            jax.jit(verify, donate_argnums=(1,)), n_dev)
+        if draft_fns is not None:
+            d_prefill_fn, d_step_fn, d_cfg = draft_fns
+            d_tail = make_vocab_tail_mask(d_cfg)
+            propose = make_draft_propose(
+                d_step_fn, d_cfg, spec.k, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                with_probs=temperature > 0.0)
+            draft_propose = registry.instrument(
+                shard + "spec_draft", jax.jit(propose), n_dev)
+
+            def d_prefill(p, toks, lens, k):
+                logits, cache = d_prefill_fn(p, toks, d_cfg,
+                                             lengths=lens)
+                return sample_token(logits, k, temperature, d_tail,
+                                    top_k, top_p), cache
+
+            draft_prefill = jax.jit(d_prefill)
+    fns = types.SimpleNamespace(
+        prefill=registry.instrument(shard + "prefill",
+                                    jax.jit(prefill_sample), n_dev),
+        paged_prefill=registry.instrument(
+            shard + "paged_prefill", jax.jit(paged_prefill_sample),
+            n_dev),
+        pool_step=registry.instrument(shard + "decode",
+                                      jax.jit(pool_step), n_dev),
+        prefill_raw=jax.jit(prefill_raw),
+        paged_prefill_raw=jax.jit(paged_prefill_raw),
+        pool_logits=jax.jit(pool_logits),
+        admit=jax.jit(admit), copy_block=jax.jit(copy_block),
+        clear_row=jax.jit(clear_row),
+        spec_verify=spec_verify, draft_propose=draft_propose,
+        draft_prefill=draft_prefill)
     _JIT_CACHE[key] = fns
     return fns
 
@@ -160,6 +276,9 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
 def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          *, max_new_tokens: int = 16,
                          temperature: float = 0.0,
+                         top_k: int = 0, top_p: float = 1.0,
+                         stop_sequences=None,
+                         eos_id: Optional[int] = None,
                          max_batch_size: int = 8,
                          batch_wait_timeout_s: float = 0.05,
                          checkpoint_path: Optional[str] = None,
@@ -172,6 +291,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          kv_num_blocks: Optional[int] = None,
                          admission_policy=None,
                          mesh=None,
+                         spec_decode: Optional[SpecConfig] = None,
                          config_overrides: Optional[Dict[str, Any]]
                          = None):
     """A serve Deployment generating continuations for int32
@@ -202,6 +322,25 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     jitted programs, so one pool step spans all chips.  Block tables
     and the BlockPager stay host-side and layout-agnostic.  None (the
     default) keeps today's single-device behaviour.
+    top_k / top_p: engine-default nucleus knobs composed with
+    `temperature` (jit-static, baked into the fused sample-included
+    programs).  Continuous-scheduler callers may override per request
+    with `handle.remote(prompt, sampling=SamplingParams(...))` — the
+    engine routes those slots through a logits-returning twin program
+    plus a per-SamplingParams jitted sampler, so the default hot path
+    stays one fused dispatch.
+    stop_sequences / eos_id: host-side stop matching on the GENERATED
+    tokens (continuous scheduler): a slot whose tail matches any stop
+    sequence (or whose last token == eos_id) finishes immediately,
+    freeing its slot (and paged blocks) mid-flight for the next queued
+    request — generation never burns the full max_new_tokens budget on
+    a sequence that already ended.
+    spec_decode: a SpecConfig enabling speculative decoding on the
+    continuous engine — a draft (n-gram or small model) proposes k
+    tokens per slot per round and ONE jitted target verify dispatch
+    checks all k+1 positions, so at acceptance rate a the target runs
+    ~1/(1 + a*k) dispatches per emitted token.  Greedy (temperature 0)
+    spec output is bit-identical to the non-speculative engine.
     checkpoint_path: pickled param pytree (matching the family's init
     layout); absent → fresh init from `seed` (tests/demos)."""
     if family not in ("gpt2", "llama"):
@@ -220,6 +359,24 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
         raise ValueError("mesh-sharded serving requires "
                          "scheduler='continuous' (the batch scheduler "
                          "is single-device)")
+    if spec_decode is not None:
+        if not isinstance(spec_decode, SpecConfig):
+            raise ValueError("spec_decode must be a SpecConfig, got "
+                             f"{type(spec_decode).__name__}")
+        if scheduler != "continuous":
+            raise ValueError("spec_decode requires "
+                             "scheduler='continuous' (speculation "
+                             "lives in the slot-pool engine loop)")
+    # validates the knobs (and is the engine's default per-request
+    # params — requests that don't override sample through the fused
+    # programs this bakes in)
+    default_sp = SamplingParams(temperature=temperature, top_k=top_k,
+                                top_p=top_p)
+    stop_seqs = tuple(
+        tuple(int(t) for t in np.asarray(s, np.int64).reshape(-1))
+        for s in (stop_sequences or ()))
+    if any(len(s) == 0 for s in stop_seqs):
+        raise ValueError("empty stop sequence")
 
     class LLM:
         def __init__(self):
@@ -264,12 +421,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     lambda p, toks, k: gen_fn(
                         p, toks, self.cfg,
                         max_new_tokens=max_new_tokens,
-                        temperature=temperature, key=k))
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, key=k))
                 self._generate_ragged = jax.jit(
                     lambda p, toks, lens, k: gen_fn(
                         p, toks, self.cfg, lengths=lens,
                         max_new_tokens=max_new_tokens,
-                        temperature=temperature, key=k))
+                        temperature=temperature, top_k=top_k,
+                        top_p=top_p, key=k))
             else:
                 self._init_continuous(prefill_fn, step_fn,
                                       init_cache_fn, init_paged_fn,
@@ -312,7 +471,12 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             return [np.asarray(row)[t0 - n:]
                     for row, n in zip(out, lens)]
 
-        async def _call_batch_traced(self, prompt):
+        async def _call_batch_traced(self, prompt, sampling=None):
+            if sampling is not None:
+                raise ValueError(
+                    "per-request sampling requires "
+                    "scheduler='continuous' (the batch scheduler runs "
+                    "one fused generate per micro-batch)")
             # request-level telemetry wraps the @serve.batch queue so
             # the recorded latency includes the batch-collection wait
             # prompt is a host-side list; measuring its length moves
@@ -395,12 +559,77 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._queue = RequestQueue()
             self._wake = None           # asyncio.Event, made on-loop
             self._engine_task = None
+            self._default_sp = default_sp
+            self._samplers = {}     # SamplingParams -> jitted sampler
 
+            # spec decode: resolve the verify program and (model
+            # drafts) the draft family's fns/config/params/cache pool
+            verify_fn = draft_fns = None
+            self._draft_params = self._draft_cache = None
+            self._draft_cfg = None
+            self._spec_sampled = (spec_decode is not None
+                                  and temperature > 0.0)
+            if spec_decode is not None:
+                if family == "gpt2":
+                    from ray_tpu.models.gpt2_decode import verify_step
+                    verify_fn = verify_step
+                else:
+                    from ray_tpu.models.llama_decode import \
+                        llama_verify_step
+                    verify_fn = llama_verify_step
+                # draft rewind bookkeeping: per slot, how many of last
+                # round's drafted tokens the target rejected (the
+                # draft cache rolls back exactly this many positions
+                # at the top of the next propose dispatch)
+                self._spec_rej = np.zeros((max_slots,), np.int32)
+                if spec_decode.draft != "ngram":
+                    d_family, d_preset = spec_decode.draft.split(":")
+                    (d_config_fn, d_init_fn, _g, d_prefill_fn,
+                     d_step_fn, d_init_cache_fn, *_rest) = \
+                        _family_fns(d_family)
+                    # overrides describe THIS family's config fields;
+                    # a cross-family draft takes its preset verbatim
+                    d_over = (dict(config_overrides or {})
+                              if d_family == family else {})
+                    d_cfg = d_config_fn(d_preset, **d_over)
+                    if (d_cfg.vocab_size != cfg.vocab_size
+                            or d_cfg.padded_vocab != cfg.padded_vocab):
+                        raise ValueError(
+                            f"spec draft vocab "
+                            f"{d_cfg.vocab_size}/{d_cfg.padded_vocab} "
+                            f"!= target "
+                            f"{cfg.vocab_size}/{cfg.padded_vocab} — "
+                            "draft proposals index the target vocab")
+                    if d_cfg.max_seq < cfg.max_seq:
+                        raise ValueError(
+                            f"spec draft max_seq {d_cfg.max_seq} < "
+                            f"target max_seq {cfg.max_seq} — the "
+                            "draft cache must track every target "
+                            "position")
+                    d_seed = (spec_decode.draft_seed
+                              if spec_decode.draft_seed is not None
+                              else seed)
+                    import jax as _jax
+                    self._draft_params = d_init_fn(
+                        _jax.random.PRNGKey(d_seed), d_cfg)
+                    # draft pool: always dense, never mesh-sharded —
+                    # the draft is small by construction and a dense
+                    # row pool keeps its pos arithmetic trivial
+                    self._draft_cache = d_init_cache_fn(d_cfg,
+                                                        max_slots)
+                    self._draft_cfg = d_cfg
+                    draft_fns = (d_prefill_fn, d_step_fn, d_cfg)
+
+            fns = _jitted_engine_fns(
+                prefill_fn, step_fn, paged_prefill_fn, cfg,
+                default_sp, kv_layout=kv_layout, mesh=self.mesh,
+                spec=spec_decode, verify_fn=verify_fn,
+                draft_fns=draft_fns)
+            self._fns = fns
             (self._prefill, self._paged_prefill, self._pool_step,
-             self._admit, self._copy_block, self._clear_row) = \
-                _jitted_engine_fns(prefill_fn, step_fn,
-                                   paged_prefill_fn, cfg, temperature,
-                                   kv_layout=kv_layout, mesh=self.mesh)
+             self._admit, self._copy_block, self._clear_row) = (
+                fns.prefill, fns.paged_prefill, fns.pool_step,
+                fns.admit, fns.copy_block, fns.clear_row)
             # perf observatory: mirror process-wide program compile
             # events into this deployment's program-keyed recompile
             # counter (decode/sharded-decode shape churn visible, not
@@ -410,6 +639,62 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
             get_registry().subscribe(
                 self._telemetry.record_program_compile)
+
+        def _sampler_for(self, sp):
+            """Per-SamplingParams jitted full-batch sampler for
+            requests overriding the engine default.  Cached per sp —
+            the override path costs one extra dispatch per step, never
+            a recompile storm."""
+            fn = self._samplers.get(sp)
+            if fn is None:
+                import jax
+
+                from ray_tpu.models.decode_common import (
+                    make_vocab_tail_mask, sample_token)
+
+                tail = make_vocab_tail_mask(self.cfg)
+                fn = jax.jit(lambda lg, kk: sample_token(
+                    lg, kk, sp.temperature, tail, sp.top_k, sp.top_p))
+                self._samplers[sp] = fn
+            return fn
+
+        def _hit_stop(self, out) -> bool:
+            """Host-side stop matching over the GENERATED tokens (the
+            prompt can never trigger a stop)."""
+            if eos_id is not None and out[-1] == eos_id:
+                return True
+            for s in stop_seqs:
+                if len(out) >= len(s) and tuple(out[-len(s):]) == s:
+                    return True
+            return False
+
+        def _draft_admit(self, slot, arr) -> None:
+            """Mirror a just-admitted request into the draft cache
+            pool: full-prompt draft prefill (even when the paged
+            target reused a resident prefix — the dense draft pool has
+            no prefix cache) + row admit.  The draft's own first-token
+            sample is discarded; the TARGET's prefill token is
+            authoritative and becomes `cur`."""
+            if self._draft_params is None:
+                if spec_decode is not None:
+                    self._spec_rej[slot] = 0
+                return
+            import jax
+            import jax.numpy as jnp
+
+            n = int(arr.shape[0])
+            t_pad = -(-n // prefill_bucket) * prefill_bucket
+            t_pad = max(n, min(t_pad, self._draft_cfg.max_seq
+                               - max_new_tokens))
+            padded = np.zeros((1, t_pad), np.int32)
+            padded[0, t_pad - n:] = arr
+            self._rng, k = jax.random.split(self._rng)
+            _tok, row = self._fns.draft_prefill(
+                self._draft_params, jnp.asarray(padded),
+                jnp.asarray([n], jnp.int32), k)
+            self._draft_cache = self._admit(self._draft_cache, row,
+                                            slot)
+            self._spec_rej[slot] = 0
 
         def _admit_pending(self) -> None:
             """Prefill queued requests into free slots (one batched
@@ -426,7 +711,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         if s is None]
                 if not free:
                     return
-                ((arr, rec), fut), = self._queue.pop(1)
+                ((arr, rec, sp), fut), = self._queue.pop(1)
                 n = int(arr.shape[0])
                 if n == 0 or n + max_new_tokens > self.cfg.max_seq:
                     self._telemetry.record_reject(
@@ -440,7 +725,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     continue
                 slot = free[0]
                 if self._pager is not None:
-                    if not self._admit_one_paged(arr, rec, fut, slot):
+                    if not self._admit_one_paged(arr, rec, sp, fut,
+                                                 slot):
                         return          # pool exhausted — retry later
                     continue
                 # pad up to the bucket so the prefill program compiles
@@ -452,14 +738,23 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 padded = np.zeros((1, t_pad), np.int32)
                 padded[0, t_pad - n:] = arr
                 self._rng, k = jax.random.split(self._rng)
-                tok, row = self._prefill(
-                    self.params, jnp.asarray(padded),
-                    jnp.asarray([n], jnp.int32), k)
+                if sp is not None:
+                    # override path: logits-returning twin + the
+                    # per-sp sampler (default requests keep the fused
+                    # single-dispatch program)
+                    logits, row = self._fns.prefill_raw(
+                        self.params, jnp.asarray(padded),
+                        jnp.asarray([n], jnp.int32))
+                    tok = self._sampler_for(sp)(logits, k)
+                else:
+                    tok, row = self._prefill(
+                        self.params, jnp.asarray(padded),
+                        jnp.asarray([n], jnp.int32), k)
                 # int() is the engine's existing host fence for the
                 # prefill result; the timestamp behind it is the TTFT
                 first = int(np.asarray(tok)[0])
                 self._telemetry.record_first_token(rec)
-                if max_new_tokens <= 1:
+                if max_new_tokens <= 1 or self._hit_stop([first]):
                     self._telemetry.record_finish(rec, n_tokens=1)
                     if not fut.done():
                         fut.set_result(np.concatenate(
@@ -468,9 +763,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 self._cache = self._admit(self._cache, row, slot)
                 self._cur[slot] = first
                 self._slots[slot] = {"prompt": arr, "out": [first],
-                                     "fut": fut, "rec": rec}
+                                     "fut": fut, "rec": rec, "sp": sp}
+                self._draft_admit(slot, arr)
 
-        def _admit_one_paged(self, arr, rec, fut, slot) -> bool:
+        def _admit_one_paged(self, arr, rec, sp, fut, slot) -> bool:
             """Admit one request through the block pager: match the
             longest resident prompt prefix, allocate the remaining
             blocks up front (decode never allocates), COW-fork the
@@ -483,12 +779,18 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             pager = self._pager
             n = int(arr.shape[0])
             tokens = arr.tolist()
-            need = pager.blocks_needed(n, max_new_tokens)
+            # spec decode: reserve k blocks' worth of verify-overshoot
+            # headroom so rejected draft K/V writes land in blocks this
+            # row owns, never one the pager re-hands out
+            need = pager.blocks_needed(
+                n, max_new_tokens,
+                headroom=spec_decode.k if spec_decode is not None
+                else 0)
             prefix_len, matched = pager.match_prefix(tokens)
             alloc = pager.allocate(need - len(matched))
             if alloc is None:
                 pager.release(matched)
-                self._queue.push_front((arr, rec), fut)
+                self._queue.push_front((arr, rec, sp), fut)
                 return False
             blocks = matched + alloc
             wb = prefix_len // kv_block_size
@@ -498,7 +800,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     new_blk, src = pager.ensure_private(blocks[wb])
                 except MemoryError:
                     pager.release(blocks)
-                    self._queue.push_front((arr, rec), fut)
+                    self._queue.push_front((arr, rec, sp), fut)
                     return False
                 if src is not None:
                     blocks[wb] = new_blk
@@ -517,10 +819,17 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                               np.int32)
             row_bt[:len(blocks)] = blocks
             self._rng, k = jax.random.split(self._rng)
-            tok, self._cache = self._paged_prefill(
-                self.params, self._cache, jnp.asarray(tail_toks),
-                jnp.asarray(row_bt), np.int32(prefix_len),
-                np.int32(n_tail), np.int32(slot), k)
+            if sp is not None:
+                logits, self._cache = self._fns.paged_prefill_raw(
+                    self.params, self._cache, jnp.asarray(tail_toks),
+                    jnp.asarray(row_bt), np.int32(prefix_len),
+                    np.int32(n_tail), np.int32(slot))
+                tok = self._sampler_for(sp)(logits, k)
+            else:
+                tok, self._cache = self._paged_prefill(
+                    self.params, self._cache, jnp.asarray(tail_toks),
+                    jnp.asarray(row_bt), np.int32(prefix_len),
+                    np.int32(n_tail), np.int32(slot), k)
             # int() is the engine's existing host fence for the
             # prefill result; the timestamp behind it is the TTFT
             first = int(np.asarray(tok)[0])
@@ -528,7 +837,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # the prompt's full blocks now hold exactly its K/V —
             # index them so later prompts can skip this work
             pager.register_prefix(tokens, blocks)
-            if max_new_tokens <= 1:
+            if max_new_tokens <= 1 or self._hit_stop([first]):
                 self._telemetry.record_finish(rec, n_tokens=1)
                 if not fut.done():
                     fut.set_result(np.concatenate(
@@ -537,8 +846,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 return True
             self._cur[slot] = first
             self._slots[slot] = {"prompt": arr, "out": [first],
-                                 "fut": fut, "rec": rec,
+                                 "fut": fut, "rec": rec, "sp": sp,
                                  "blocks": blocks}
+            self._draft_admit(slot, arr)
             self._telemetry.record_kv_stats(pager.stats())
             return True
 
@@ -551,10 +861,123 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._pager.release(blocks)
             self._telemetry.record_kv_stats(self._pager.stats())
 
+        def _finish_slot(self, i, st) -> None:
+            """Retire a finished slot NOW — the freed slot (and its
+            paged blocks) is admissible in the same engine wave."""
+            self._telemetry.record_finish(st["rec"],
+                                          n_tokens=len(st["out"]))
+            if not st["fut"].done():
+                # st["out"] is a python int list — no device fetch
+                tail = np.asarray(st["out"], np.int32)
+                st["fut"].set_result(np.concatenate(
+                    [st["prompt"], tail]))
+            self._slots[i] = None           # slot freed NOW
+            if self._pager is not None:
+                self._retire_paged_row(i, st["blocks"])
+
+        def _mixed_step(self, key):
+            """One decode step when any active slot overrides the
+            engine SamplingParams: the logits-twin program once, then
+            one jitted sampler dispatch per DISTINCT SamplingParams
+            among active slots, rows gathered host-side."""
+            import jax
+            import jax.numpy as jnp
+
+            logits, self._cache = self._fns.pool_logits(
+                self.params, self._cache, jnp.asarray(self._cur))
+            toks = np.zeros((max_slots,), np.int32)
+            groups: Dict[Any, list] = {}
+            for i, st in enumerate(self._slots):
+                if st is None:
+                    continue
+                groups.setdefault(st["sp"] or self._default_sp,
+                                  []).append(i)
+            for sp, rows in groups.items():
+                key, kk = jax.random.split(key)
+                full = np.asarray(self._sampler_for(sp)(logits, kk))
+                for r in rows:
+                    toks[r] = full[r]
+            return toks
+
+        def _spec_round(self) -> int:
+            """One speculative round over the whole slot pool: draft
+            proposes k tokens per row, ONE target verify dispatch
+            checks all k+1 positions, accepted tokens are emitted and
+            the caches advance by exactly the kept count.  Returns the
+            number of tokens emitted (for step telemetry)."""
+            import jax
+            import jax.numpy as jnp
+
+            from ray_tpu.models.decode_common import ngram_propose
+
+            kd = spec_decode.k
+            qprobs = None
+            if self._draft_params is not None:
+                self._rng, dk = jax.random.split(self._rng)
+                if self._spec_sampled:
+                    drafts, qprobs, self._draft_cache = \
+                        self._fns.draft_propose(
+                            self._draft_params, self._draft_cache,
+                            jnp.asarray(self._cur),
+                            jnp.asarray(self._spec_rej), dk)
+                else:
+                    drafts, self._draft_cache = \
+                        self._fns.draft_propose(
+                            self._draft_params, self._draft_cache,
+                            jnp.asarray(self._cur),
+                            jnp.asarray(self._spec_rej), dk)
+                drafts = np.asarray(drafts)
+            else:
+                # host-side n-gram draft over each request's own
+                # history: zero extra weights, zero extra dispatches
+                drafts = np.zeros((max_slots, kd), np.int32)
+                for i, st in enumerate(self._slots):
+                    if st is None:
+                        continue
+                    drafts[i] = ngram_propose(
+                        st["prompt"].tolist() + st["out"], kd,
+                        order=spec_decode.ngram_order)
+            block = np.concatenate([self._cur[:, None], drafts],
+                                   axis=1)
+            self._rng, vk = jax.random.split(self._rng)
+            if self._spec_sampled:
+                out_toks, n_acc, self._cache = self._fns.spec_verify(
+                    self.params, self._cache, jnp.asarray(block), vk,
+                    qprobs)
+            else:
+                out_toks, n_acc, self._cache = self._fns.spec_verify(
+                    self.params, self._cache, jnp.asarray(block), vk)
+            # the round's one deliberate host fence (same role as the
+            # plain engine's np.asarray(toks))
+            out_toks = np.asarray(out_toks)
+            n_acc = np.asarray(n_acc)
+            total = 0
+            for i, st in enumerate(self._slots):
+                if st is None:
+                    continue
+                n = int(n_acc[i])
+                self._telemetry.record_spec(st["rec"], proposed=kd,
+                                            accepted=n)
+                finished = False
+                for t in out_toks[i, :n + 1]:
+                    st["out"].append(int(t))
+                    total += 1
+                    if len(st["out"]) >= max_new_tokens \
+                            or self._hit_stop(st["out"]):
+                        finished = True
+                        break
+                # the correction token is always the row's new `cur`
+                # (it has no K/V yet — exactly a fresh sampled token)
+                self._cur[i] = out_toks[i, n]
+                self._spec_rej[i] = 0 if finished else kd - n
+                if finished:
+                    self._finish_slot(i, st)
+            return total
+
         async def _engine(self):
-            """The scheduler loop: admit → one pooled decode step →
-            retire finished slots → yield (so new requests enqueue
-            mid-generation)."""
+            """The scheduler loop: admit → one pooled decode step (or
+            one speculative draft+verify round) → retire finished
+            slots → yield (so new requests enqueue mid-generation)."""
             import asyncio
             import time as _time
 
@@ -574,14 +997,27 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     # fence the engine already performs — perf_counter
                     # pairs only, no extra device sync
                     t_step = _time.perf_counter()
+                    if spec_decode is not None:
+                        n_tokens = self._spec_round()
+                        self._telemetry.record_step(
+                            n_active,
+                            _time.perf_counter() - t_step,
+                            n_tokens=n_tokens)
+                        await asyncio.sleep(0)
+                        continue
                     self._rng, k = jax.random.split(self._rng)
-                    toks, self._cache = self._pool_step(
-                        self.params, self._cache,
-                        jnp.asarray(self._cur), k)
-                    # the engine's one deliberate per-step host fence
-                    # (documented above; telemetry brackets it)
-                    # graftcheck: disable=blocking-call-in-async
-                    toks = np.asarray(toks)
+                    if any(st is not None and st["sp"] is not None
+                           for st in self._slots):
+                        toks = self._mixed_step(k)
+                    else:
+                        toks, self._cache = self._pool_step(
+                            self.params, self._cache,
+                            jnp.asarray(self._cur), k)
+                        # the engine's one deliberate per-step host
+                        # fence (documented above; telemetry brackets
+                        # it)
+                        # graftcheck: disable=blocking-call-in-async
+                        toks = np.asarray(toks)
                     self._telemetry.record_step(
                         n_active, _time.perf_counter() - t_step)
                     for i, st in enumerate(self._slots):
@@ -589,19 +1025,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                             continue
                         st["out"].append(int(toks[i]))
                         self._cur[i] = toks[i]
-                        if len(st["out"]) >= max_new_tokens:
-                            self._telemetry.record_finish(
-                                st["rec"], n_tokens=len(st["out"]))
-                            if not st["fut"].done():
-                                # st["out"] is a python int list — no
-                                # device fetch here
-                                # graftcheck: disable=blocking-call-in-async
-                                tail = np.asarray(st["out"], np.int32)
-                                st["fut"].set_result(np.concatenate(
-                                    [st["prompt"], tail]))
-                            self._slots[i] = None   # slot freed NOW
-                            if self._pager is not None:
-                                self._retire_paged_row(i, st["blocks"])
+                        if len(st["out"]) >= max_new_tokens \
+                                or self._hit_stop(st["out"]):
+                            self._finish_slot(i, st)
                 except Exception as e:  # noqa: BLE001 - fail loudly
                     for i, st in enumerate(self._slots):
                         if st is not None:
@@ -613,7 +1039,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                     and "blocks" in st:
                                 self._pager.release(st["blocks"])
                         self._slots[i] = None
-                    for (arr, rec), fut in self._queue.pop(
+                    for (arr, rec, _sp), fut in self._queue.pop(
                             len(self._queue)):
                         self._telemetry.record_error(rec, error=repr(e))
                         if not fut.done():
@@ -621,9 +1047,23 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # yield the loop so callers can enqueue mid-flight
                 await asyncio.sleep(0)
 
-        async def _call_continuous(self, prompt):
+        async def _call_continuous(self, prompt, sampling=None):
             import asyncio
 
+            sp = None
+            if sampling is not None:
+                if not isinstance(sampling, SamplingParams):
+                    raise ValueError(
+                        "sampling must be a SamplingParams, got "
+                        f"{type(sampling).__name__}")
+                if spec_decode is not None:
+                    raise ValueError(
+                        "per-request sampling overrides are not "
+                        "supported with spec_decode (the verify "
+                        "program bakes in ONE sampling config; build "
+                        "a separate deployment per config)")
+                if sampling != self._default_sp:
+                    sp = sampling
             if self._wake is None:
                 self._wake = asyncio.Event()
             if self._engine_task is None or self._engine_task.done():
@@ -648,7 +1088,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         f"request shed ({shed}): engine over SLO "
                         f"with {len(self._queue)} queued")
             rec = self._telemetry.record_enqueue(int(arr.shape[0]))
-            fut = self._queue.put((arr, rec))
+            fut = self._queue.put((arr, rec, sp))
             self._wake.set()
             return await fut
 

@@ -133,6 +133,18 @@ def _engine_metrics() -> Dict[str, Any]:
                     "serve_kv_blocks_in_use",
                     "pool blocks referenced by live sequences",
                     tag_keys=tags),
+                "spec_proposed": Counter(
+                    "serve_spec_tokens_proposed_total",
+                    "draft tokens proposed to the spec-decode "
+                    "verifier", tag_keys=tags),
+                "spec_accepted": Counter(
+                    "serve_spec_tokens_accepted_total",
+                    "draft tokens the target model accepted",
+                    tag_keys=tags),
+                "spec_rounds": Counter(
+                    "serve_spec_rounds_total",
+                    "speculative propose+verify rounds (one target "
+                    "dispatch each)", tag_keys=tags),
             }
         return _metrics
 
@@ -155,7 +167,9 @@ class EngineTelemetry:
         #: retired request records (finished / rejected / errored)
         self._done: Deque[Dict[str, Any]] = collections.deque(
             maxlen=history)
-        #: (end_ts, dur_s, n_active) per pooled decode step
+        #: (end_ts, dur_s, n_tokens) per pooled decode step (n_tokens
+        #: == n_active except spec-decode rounds, which emit several
+        #: tokens per slot per dispatch)
         self._steps: Deque[tuple] = collections.deque(maxlen=history)
         self._active: Dict[int, Dict[str, Any]] = {}
         self._counts = {"enqueued": 0, "admitted": 0, "finished": 0,
@@ -170,6 +184,7 @@ class EngineTelemetry:
         self._program_compiles: Dict[str, int] = {}
         self._rejections_by_reason: Dict[str, int] = {}
         self._kv_stats: Optional[Dict[str, Any]] = None
+        self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
 
     def _now(self, now: Optional[float]) -> float:
         return time.perf_counter() if now is None else now
@@ -183,6 +198,7 @@ class EngineTelemetry:
             "id": next(self._ids), "prompt_len": int(prompt_len),
             "enqueue": now, "admit": None, "first_token": None,
             "finish": None, "slot": None, "bucket": None, "tokens": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
             "status": "queued", "trace": None,
         }
         if tracing.is_enabled():
@@ -238,14 +254,18 @@ class EngineTelemetry:
             (now - rec["enqueue"]) * 1e3, tags=self._tags)
 
     def record_step(self, n_active: int, dur_s: float,
-                    now: Optional[float] = None) -> None:
-        """One pooled decode step: `n_active` slots each sampled one
-        token in `dur_s` seconds of host walltime."""
+                    now: Optional[float] = None,
+                    n_tokens: Optional[int] = None) -> None:
+        """One pooled decode step: `n_active` slots sampled in `dur_s`
+        seconds of host walltime.  `n_tokens` overrides the tokens
+        credited to the step (spec-decode rounds emit up to k+1 per
+        slot per dispatch); default one per active slot."""
         now = self._now(now)
+        n_tokens = int(n_active) if n_tokens is None else int(n_tokens)
         with self._lock:
-            self._steps.append((now, float(dur_s), int(n_active)))
+            self._steps.append((now, float(dur_s), n_tokens))
             self._n_steps += 1
-            self._tokens += int(n_active)
+            self._tokens += n_tokens
             self._max_active = max(self._max_active, int(n_active))
             self._busy_slot_s += n_active * dur_s
             self._step_s += dur_s
@@ -253,11 +273,30 @@ class EngineTelemetry:
                     if self.max_slots and self._step_s else 0.0)
         self._m["inter_token"].observe(dur_s * 1e3, tags=self._tags)
         self._m["active_slots"].set(n_active, tags=self._tags)
-        self._m["tokens"].inc(int(n_active), tags=self._tags)
+        self._m["tokens"].inc(n_tokens, tags=self._tags)
         self._m["slot_utilization"].set(round(util, 4), tags=self._tags)
         if dur_s > 0:
             self._m["tokens_per_sec"].set(
-                round(n_active / dur_s, 1), tags=self._tags)
+                round(n_tokens / dur_s, 1), tags=self._tags)
+
+    def record_spec(self, rec: Dict[str, Any], proposed: int,
+                    accepted: int) -> None:
+        """One speculative verify round for one request: the draft
+        proposed `proposed` tokens, the target accepted `accepted` of
+        them (0 <= accepted <= proposed; the +1 correction/bonus token
+        every round also emits is counted by record_step, not here).
+        Feeds the per-request acceptance-rate percentiles in
+        engine_stats()["spec"] and the serve_spec_* counters."""
+        proposed, accepted = int(proposed), int(accepted)
+        rec["spec_proposed"] += proposed
+        rec["spec_accepted"] += accepted
+        with self._lock:
+            self._spec["proposed"] += proposed
+            self._spec["accepted"] += accepted
+            self._spec["rounds"] += 1
+        self._m["spec_proposed"].inc(proposed, tags=self._tags)
+        self._m["spec_accepted"].inc(accepted, tags=self._tags)
+        self._m["spec_rounds"].inc(tags=self._tags)
 
     def record_finish(self, rec: Dict[str, Any],
                       n_tokens: Optional[int] = None,
@@ -352,6 +391,7 @@ class EngineTelemetry:
             rejections = dict(self._rejections_by_reason)
             kv_stats = (dict(self._kv_stats)
                         if self._kv_stats is not None else None)
+            spec = dict(self._spec)
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
                 if r["first_token"] is not None]
         qwait = [(r["admit"] - r["enqueue"]) * 1e3 for r in recs
@@ -394,6 +434,21 @@ class EngineTelemetry:
             # keys — the "requests" dict shape is a stable contract)
             "rejections_by_reason": rejections,
             "kv_cache": kv_stats,
+            # round-11: speculative decoding — engine totals plus
+            # per-request acceptance-rate percentiles (requests that
+            # saw at least one verify round)
+            "spec": {
+                "proposed": spec["proposed"],
+                "accepted": spec["accepted"],
+                "rejected": spec["proposed"] - spec["accepted"],
+                "rounds": spec["rounds"],
+                "accept_rate": round(
+                    spec["accepted"] / spec["proposed"], 4)
+                    if spec["proposed"] else None,
+                "accept_rate_per_request": _core.summarize(
+                    [r["spec_accepted"] / r["spec_proposed"]
+                     for r in recs if r.get("spec_proposed", 0)]),
+            },
         }
 
     def export_timeline(self, filename: Optional[str] = None

@@ -199,6 +199,38 @@ def _build_gpt2_sharded_decode_step():
             (params, cache, toks))
 
 
+def _build_gpt2_spec_verify_step():
+    """The spec-decode verify program (round 11): ONE dispatch ingests
+    a (B, k+1) draft block, scores every position, runs the
+    accept/reject fold, and advances the paged pool by the kept
+    count.  The logits rule forbids a (B*max_seq, V) buffer — the
+    whole point of the verify step is that its logits are (B, k+1, V),
+    never the full-sequence shape; the KV pool (arg 1) is donated
+    because the verify round is the engine's steady-state hot program
+    and keeping two pools alive would double decode HBM."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.decode_common import make_spec_verify
+    from ray_tpu.models.gpt2_decode import init_paged_cache, verify_step
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    bs = 16
+    per_row = cfg.max_seq // bs
+    cache = init_paged_cache(cfg, _PB, num_blocks=1 + _PB * per_row,
+                             block_size=bs)
+    cache["block_tables"] = 1 + jnp.arange(
+        _PB * per_row, dtype=jnp.int32).reshape(_PB, per_row)
+    spec_verify = make_spec_verify(verify_step, cfg)
+    block = jnp.zeros((_PB, 5), jnp.int32)      # [cur, d_1..d_4], k=4
+    key = jax.random.PRNGKey(0)
+    return (lambda p, c, b, k: spec_verify(p, c, b, k),
+            (params, cache, block, key))
+
+
 def _ce_inputs():
     import jax
     import jax.numpy as jnp
@@ -304,6 +336,15 @@ def default_programs() -> List[ProgramSpec]:
             # this budget catches per-chip blowups from new temps (e.g.
             # a densified per-layer pool copy inside the scan)
             per_chip_hbm_budget_bytes=int(1.6 * _MiB)),
+        ProgramSpec(
+            name="gpt2_spec_verify_step",
+            build=_build_gpt2_spec_verify_step,
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            donate_argnums=(1,),
+            # same pool sizing as the paged decode step plus the tiny
+            # (B, k+1, V) verify logits and accept-fold temps
+            hbm_budget_bytes=6 * _MiB),
         ProgramSpec(
             name="fused_ce_fwd",
             build=_build_fused_ce_fwd,

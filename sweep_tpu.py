@@ -31,6 +31,19 @@ degree so A/Bs of degree 1 vs 4 vs 8 come straight from the spec:
                         [8, {"mode": "decode_sharded", "tensor": 4}],
                         [8, {"mode": "decode_sharded", "tensor": 8}]]'
 
+{"mode": "decode_spec", ...} runs speculative decoding on the
+CONTINUOUS engine (bench.time_decode_spec): "spec_k" drafted tokens
+per round, "spec_draft" ("aligned" = a draft with the target's own
+weights, acceptance ~1.0; "ngram"; or "<family>:<preset>"), plus
+"kv_layout"/"tensor".  The record carries spec_accept_rate and
+target_dispatches_per_token, so spec on/off × k A/Bs come straight
+from the spec:
+
+  python sweep_tpu.py '[[8, {"mode": "decode"}],
+                        [8, {"mode": "decode_spec", "spec_k": 2}],
+                        [8, {"mode": "decode_spec", "spec_k": 4}],
+                        [8, {"mode": "decode_spec", "spec_k": 8}]]'
+
 Traffic variants: {"mode": "traffic", ...} drives the continuous serve
 engine under seeded shared-prefix Poisson load (serve/traffic.py) —
 batch is max_slots, "requests"/"kv_layout"/"prefix_len"/"p_shared"/
@@ -59,7 +72,8 @@ remain analyzable after the fact.
 import json
 import sys
 
-from bench import decode_mesh, time_config, time_decode
+from bench import (decode_mesh, time_config, time_decode,
+                   time_decode_spec)
 
 
 def _failure_tag(e: Exception) -> str:
@@ -231,6 +245,51 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                       f"prompt={prompt_len} {kw}: FAILED "
                       f"{type(e).__name__}: {str(e)[:160]}", file=out,
                       flush=True)
+                rec = {"sweep": variant, "failed": _failure_tag(e),
+                       "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
+        if mode == "decode_spec":
+            prompt_len = kw.pop("prompt_len", 128)
+            new_tokens = kw.pop("new_tokens", 64)
+            preset = kw.pop("preset", "gpt2")
+            spec_k = kw.pop("spec_k", kw.pop("k", 4))
+            spec_draft = kw.pop("spec_draft", "aligned")
+            kv_layout = kw.pop("kv_layout", "dense")
+            tensor = kw.pop("tensor", 1)
+            variant = {"mode": mode, "batch": batch_per_chip,
+                       "prompt_len": prompt_len,
+                       "new_tokens": new_tokens, "preset": preset,
+                       "spec_k": spec_k, "spec_draft": spec_draft,
+                       "kv_layout": kv_layout, "tensor": tensor,
+                       "overrides": kw}
+            try:
+                mesh, _ = decode_mesh(tensor)
+                tok_s, stats, dpt, chips = time_decode_spec(
+                    batch_per_chip, prompt_len=prompt_len,
+                    new_tokens=new_tokens, preset=preset,
+                    spec_k=spec_k, spec_draft=spec_draft,
+                    kv_layout=kv_layout, mesh=mesh,
+                    config_overrides=kw or None)
+                spec = stats["spec"]
+                print(f"{mode} batch={batch_per_chip} k={spec_k} "
+                      f"draft={spec_draft} chips={chips}: "
+                      f"{tok_s:,.0f} tok/s "
+                      f"accept={spec['accept_rate']} "
+                      f"dispatch/tok={dpt:.3f}", file=out, flush=True)
+                rec = {"sweep": variant,
+                       "decode_tok_s": round(tok_s, 1),
+                       "decode_tok_s_chip":
+                           round(tok_s / max(1, chips), 1),
+                       "spec_accept_rate": spec["accept_rate"],
+                       "target_dispatches_per_token": round(dpt, 4),
+                       "chips": chips,
+                       "engine": {"spec": spec}}
+            except Exception as e:
+                print(f"{mode} batch={batch_per_chip} k={spec_k} "
+                      f"{kw}: FAILED {type(e).__name__}: "
+                      f"{str(e)[:160]}", file=out, flush=True)
                 rec = {"sweep": variant, "failed": _failure_tag(e),
                        "error": f"{type(e).__name__}: {str(e)[:300]}"}
             print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)

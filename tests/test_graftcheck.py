@@ -59,6 +59,7 @@ def test_repo_audit_covers_canonical_programs(repo_report):
     assert {"gpt2_train_step", "llama_train_step",
             "gpt2_prefill_ragged", "llama_prefill_ragged",
             "gpt2_decode_step", "gpt2_sharded_decode_step",
+            "gpt2_spec_verify_step",
             "fused_ce_fwd", "fused_ce_bwd"} <= audited
     for name, info in repo_report["programs"].items():
         assert "error" not in info, f"{name} failed to trace: {info}"
@@ -80,7 +81,9 @@ def test_repo_sharded_spec_ran_compiled_rules(repo_report):
 def test_repo_suppressions_are_visible(repo_report):
     # serve/llm.py carries deliberate host fences behind disable
     # comments; the report must surface (not hide) that they exist
-    assert repo_report["summary"]["n_suppressed"] >= 7
+    # (round 11 moved the finish-path fence into a sync helper, so
+    # the count dropped from 7 to 6)
+    assert repo_report["summary"]["n_suppressed"] >= 6
     assert repo_report["summary"]["files_scanned"] > 100
 
 
@@ -239,6 +242,35 @@ def test_planted_hbm_budget_blowup_detected():
               hbm_budget_bytes=100 * 1024))
     assert "hbm-budget" in _rules(vs)
     assert info["peak_hbm_bytes"] > 100 * 1024
+
+
+def test_planted_spec_verify_full_logits_detected():
+    """The spec-verify ProgramSpec's whole point is that verify logits
+    are (B, k+1, V), never the full-sequence class — a verify that
+    materializes the (B*max_seq, V) buffer must trip the rule under
+    the real spec's own constraints (and the real spec must carry the
+    KV-pool donation + budget the engine depends on)."""
+    from ray_tpu.tools.graftcheck.programs import default_programs
+
+    spec = next(s for s in default_programs()
+                if s.name == "gpt2_spec_verify_step")
+    assert spec.donate_argnums == (1,)
+    assert spec.hbm_budget_bytes > 0
+    fn, args = spec.build()
+
+    def bad(p, c, b, k):
+        out, n_acc, cache = fn(p, c, b, k)
+        full = jnp.zeros(spec.forbid_logits, jnp.float32)  # planted
+        return out, n_acc + jnp.sum(full).astype(jnp.int32), cache
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # cpu donation warning
+        vs, _ = audit_program(
+            ProgramSpec(name="planted", build=lambda: (bad, args),
+                        forbid_logits=spec.forbid_logits,
+                        donate_argnums=spec.donate_argnums,
+                        allow_f32_matmul=True))
+    assert "logits-buffer" in _rules(vs)
 
 
 def test_peak_estimate_counts_live_buffers():
@@ -485,7 +517,7 @@ def test_cli_json_clean_on_repo(capsys):
     report = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert report["ok"] is True
-    assert report["summary"]["n_suppressed"] >= 7
+    assert report["summary"]["n_suppressed"] >= 6
 
 
 def test_cli_nonzero_on_planted_violation(tmp_path, capsys):
