@@ -666,11 +666,16 @@ def main_traffic(args, on_tpu: bool) -> None:
     tier-1 traffic test and sweep_tpu.py traffic variants call).
     Headline metrics are the paged KV cache's prefix-hit rate and the
     fraction of requests finishing inside the latency SLO; throughput
-    and shed counts ride in detail.  No published baseline exists, so
-    vs_baseline is null."""
+    and shed counts ride in detail.  Per-objective engine-side SLO
+    attainment (SLOConfig: TTFT at half the e2e bound) emits its own
+    `{base}_{objective}_slo_attainment` lines; `--spec-k K` runs the
+    traffic through the speculative engine and adds accept-rate
+    lines.  No published baseline exists, so vs_baseline is null."""
     import jax
 
     from ray_tpu.serve.batching import AdmissionPolicy
+    from ray_tpu.serve.llm import SpecConfig
+    from ray_tpu.serve.slo import SLOConfig
     from ray_tpu.serve.traffic import TrafficSpec, run_traffic
 
     if on_tpu:
@@ -699,10 +704,21 @@ def main_traffic(args, on_tpu: bool) -> None:
                      if args.mesh == "tensor" else (None, 1))
     if mesh is not None:
         base += "_sharded"
+    # engine-side SLO targets derived from the client latency bound:
+    # TTFT gets half the e2e budget (prefill must not eat the window)
+    slo_cfg = SLOConfig(ttft_ms=kw["latency_slo_ms"] / 2,
+                        e2e_ms=kw["latency_slo_ms"])
+    spec_cfg = None
+    if args.spec_k > 0:
+        base += "_spec"
+        draft = (f"gpt2:{preset}" if args.spec_draft == "aligned"
+                 else args.spec_draft)
+        spec_cfg = SpecConfig(draft=draft, k=args.spec_k)
     rep = run_traffic(
         spec, family="gpt2", preset=preset,
         kv_layout=args.kv_layout, mesh=mesh,
         admission_policy=AdmissionPolicy(max_queue_depth=4 * n),
+        slo=slo_cfg, spec_decode=spec_cfg,
         **kw)
     eng = rep["engine"]
     # Per-chip normalized throughput + the mesh axes the engine
@@ -724,6 +740,16 @@ def main_traffic(args, on_tpu: bool) -> None:
               "ttft_ms": eng["ttft_ms"],
               "kv_cache": eng.get("kv_cache"),
               "rejections_by_reason": eng["rejections_by_reason"]}
+    if spec_cfg is not None:
+        # spec counters join every traffic record so ledger series
+        # cover spec+traffic runs, not just --decode --spec-k
+        eng_spec = eng.get("spec") or {}
+        detail["spec"] = {"k": args.spec_k,
+                          "draft": spec_cfg.draft,
+                          "accept_rate": eng_spec.get("accept_rate"),
+                          "rounds": eng_spec.get("rounds"),
+                          "proposed": eng_spec.get("proposed"),
+                          "accepted": eng_spec.get("accepted")}
     emit({
         "metric": f"{base}_prefix_hit_rate",
         "value": rep["prefix_hit_rate"], "unit": "fraction",
@@ -737,6 +763,26 @@ def main_traffic(args, on_tpu: bool) -> None:
         "detail": dict(detail,
                        latency_slo_ms=rep["latency_slo_ms"],
                        prefix_hit_rate=rep["prefix_hit_rate"])})
+    # per-objective engine-side attainment (serve/slo.py burn-rate
+    # tracker): one line per configured objective
+    for name, obj in (rep.get("slo") or {}).items():
+        if not isinstance(obj.get("attainment"), (int, float)):
+            continue
+        emit({
+            "metric": f"{base}_{name}_slo_attainment",
+            "value": obj["attainment"], "unit": "fraction",
+            "vs_baseline": None,
+            "detail": dict(detail, target_ms=obj["target_ms"],
+                           burn_rate=obj["burn_rate"])})
+    if spec_cfg is not None and isinstance(
+            rep.get("spec_accept_rate"), (int, float)):
+        # base already carries the "_spec" suffix in spec mode, so
+        # this lands as `{...}_spec_accept_rate`
+        emit({
+            "metric": f"{base}_accept_rate",
+            "value": rep["spec_accept_rate"], "unit": "ratio",
+            "vs_baseline": None,
+            "detail": dict(detail, rounds=rep.get("spec_rounds"))})
 
 
 def main(args=None):

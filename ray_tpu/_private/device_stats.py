@@ -226,6 +226,7 @@ class ProgramRegistry:
         self._m = _device_metrics()
         self._programs: Dict[str, Dict[str, Any]] = {}
         self._subscribers: List[Any] = []
+        self._storm_subscribers: List[Any] = []
 
     # -- bookkeeping -------------------------------------------------------
 
@@ -288,6 +289,7 @@ class ProgramRegistry:
                 severity="WARNING", program=program,
                 compiles_in_window=len(recent),
                 window_s=self.storm_window_s)
+            self._notify_storms(program)
         self._notify(program)
 
     def record_invoke(self, program: str, seconds: float) -> None:
@@ -311,9 +313,31 @@ class ProgramRegistry:
         with self._lock:
             self._subscribers.append(ref)
 
-    def _notify(self, program: str) -> None:
+    def subscribe_storms(self, callback: Callable[[str], None]) -> None:
+        """Call `callback(program)` on every FRESH recompile-storm
+        trip (inactive → active transition, same condition that fires
+        the WARNING event).  Weakly held like `subscribe` — the SLO
+        watchdog (serve/slo.py via EngineTelemetry.record_storm) uses
+        this to postmortem-dump the flight record when the decode path
+        starts thrashing the compiler."""
+        import weakref
+
+        try:
+            ref = weakref.WeakMethod(callback)
+        except TypeError:
+            ref = (lambda cb=callback: cb)
         with self._lock:
-            refs = list(self._subscribers)
+            self._storm_subscribers.append(ref)
+
+    def _notify(self, program: str) -> None:
+        self._fanout("_subscribers", program)
+
+    def _notify_storms(self, program: str) -> None:
+        self._fanout("_storm_subscribers", program)
+
+    def _fanout(self, attr: str, program: str) -> None:
+        with self._lock:
+            refs = list(getattr(self, attr))
         dead = []
         for ref in refs:
             cb = ref()
@@ -326,8 +350,8 @@ class ProgramRegistry:
                 pass
         if dead:
             with self._lock:
-                self._subscribers = [r for r in self._subscribers
-                                     if r not in dead]
+                setattr(self, attr, [r for r in getattr(self, attr)
+                                     if r not in dead])
 
     # -- instrumentation ---------------------------------------------------
 

@@ -232,6 +232,37 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/stats", serve_stats)
 
+        # SLO burn rates + flight-recorder occupancy (serve/slo.py,
+        # _private/flightrec.py): the "slo"/"flightrec" blocks of each
+        # deployment's engine_stats(), without the heavyweight rest —
+        # the poll target for burn-rate dashboards and autoscalers.
+        async def serve_slo(_req):
+            def _collect():
+                from ray_tpu.serve import api as serve_api
+
+                out = {}
+                try:
+                    deployments = serve_api.status()
+                except Exception:  # noqa: BLE001 - serve not running
+                    return out
+                for name in deployments:
+                    try:
+                        stats = serve_api.engine_stats(name,
+                                                       timeout=15)
+                        out[name] = {
+                            "slo": stats.get("slo"),
+                            "flightrec": stats.get("flightrec"),
+                        }
+                    except Exception as e:  # noqa: BLE001 - no stats
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/serve/slo", serve_slo)
+
         # Perf observatory (_private/device_stats.py): per-program
         # compiled cost model / recompile watchdog / live MFU, plus
         # per-chip allocator stats — the device-side complement of

@@ -12,6 +12,7 @@ from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.schema import (DeploymentSchema,
                                   ServeApplicationSchema)
 from ray_tpu.serve.schema import apply as apply_config
+from ray_tpu.serve.slo import SLOConfig
 from ray_tpu.serve.traffic import (TrafficGenerator, TrafficSpec,
                                    run_traffic)
 
@@ -22,4 +23,4 @@ __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "apply_config", "build_llm_deployment", "AdmissionPolicy",
            "OverloadedError", "BlockPager", "TrafficSpec",
            "TrafficGenerator", "run_traffic", "SamplingParams",
-           "SpecConfig"]
+           "SpecConfig", "SLOConfig"]

@@ -47,7 +47,8 @@ class BlockPager:
     """
 
     def __init__(self, num_blocks: int, block_size: int, max_seq: int,
-                 *, bytes_per_block: int = 0, tensor_shards: int = 1):
+                 *, bytes_per_block: int = 0, tensor_shards: int = 1,
+                 recorder=None):
         if max_seq % block_size:
             raise ValueError(f"max_seq={max_seq} must be a multiple of "
                              f"block_size={block_size}")
@@ -81,6 +82,10 @@ class BlockPager:
         self.prefix_misses = 0    # blocks that had to be prefilled
         self.cow_copies = 0
         self.evictions = 0
+        #: optional flight recorder (_private/flightrec.py): block
+        #: reserve / evict / free / COW decisions journal themselves
+        #: so a postmortem can replay pool pressure around an anomaly
+        self._recorder = recorder
 
     # -- capacity ------------------------------------------------------
 
@@ -124,23 +129,33 @@ class BlockPager:
         request — the caller requeues and retries after a retirement.
         """
         if count > self.available:
+            if self._recorder is not None and count:
+                self._recorder.record("kv_exhausted", need=count,
+                                      available=self.available)
             return None
         out: List[int] = []
+        evicted = 0
         for _ in range(count):
             if not self._free:
                 blk, _ = self._cached.popitem(last=False)  # LRU
                 self._deregister(blk)
                 self.evictions += 1
+                evicted += 1
                 self._free.append(blk)
             blk = self._free.pop()
             self._ref[blk] = 1
             out.append(blk)
+        if self._recorder is not None and count:
+            self._recorder.record("kv_reserve", blocks=count,
+                                  evicted=evicted,
+                                  free=len(self._free))
         return out
 
     def release(self, block_ids: Sequence[int]) -> None:
         """Drop one reference on each block.  Zero-ref registered
         blocks park in the cached pool (prefix stays warm); zero-ref
         unregistered blocks return to the free list."""
+        freed = 0
         for blk in block_ids:
             ref = self._ref.get(blk, 0) - 1
             if ref > 0:
@@ -154,6 +169,11 @@ class BlockPager:
                 self._cached.move_to_end(blk)
             else:
                 self._free.append(blk)
+            freed += 1
+        if self._recorder is not None and freed:
+            self._recorder.record("kv_free", blocks=freed,
+                                  free=len(self._free),
+                                  cached=len(self._cached))
 
     # -- prefix cache --------------------------------------------------
 
@@ -225,6 +245,9 @@ class BlockPager:
             raise MemoryError("no free block for copy-on-write fork")
         self.release([block_id])       # our ref moves to the fork
         self.cow_copies += 1
+        if self._recorder is not None:
+            self._recorder.record("kv_cow", src=block_id,
+                                  fork=fresh[0])
         return fresh[0], block_id
 
     def _deregister(self, block_id: int) -> None:

@@ -290,6 +290,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          kv_block_size: int = 16,
                          kv_num_blocks: Optional[int] = None,
                          admission_policy=None,
+                         slo=None,
                          mesh=None,
                          spec_decode: Optional[SpecConfig] = None,
                          config_overrides: Optional[Dict[str, Any]]
@@ -313,6 +314,13 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     admission_policy: a serve.batching.AdmissionPolicy closing the
     telemetry loop — requests are load-shed with OverloadedError when
     its queue-depth / queue-wait / TTFT gates trip.
+    slo: a serve.slo.SLOConfig (continuous scheduler only) turning the
+    telemetry stream into multi-window burn rates —
+    engine_stats()["slo"], serve_slo_* metrics, and an anomaly
+    watchdog that postmortem-dumps the engine's flight record
+    (_private/flightrec.py) on burn-rate breaches and recompile
+    storms.  Without it engine_stats()["slo"] is None; the flight
+    recorder itself is always on (RAYTPU_FLIGHTREC=0 disables).
     mesh: a `jax.sharding.Mesh` to tensor-parallelise the engine over
     (continuous scheduler only).  Params and the KV pool are committed
     to the mesh under parallel.sharding.DECODE_RULES — attention
@@ -367,6 +375,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             raise ValueError("spec_decode requires "
                              "scheduler='continuous' (speculation "
                              "lives in the slot-pool engine loop)")
+    if slo is not None:
+        from ray_tpu.serve.slo import SLOConfig
+        if not isinstance(slo, SLOConfig):
+            raise ValueError("slo must be a serve.slo.SLOConfig, got "
+                             f"{type(slo).__name__}")
+        if scheduler != "continuous":
+            raise ValueError("slo requires scheduler='continuous' "
+                             "(the burn-rate watchdog runs from the "
+                             "slot-pool engine loop)")
     # validates the knobs (and is the engine's default per-request
     # params — requests that don't override sample through the fused
     # programs this bakes in)
@@ -543,10 +560,11 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                    * self._kv_heads(cfg)
                                    * cfg.head_dim
                                    * jnp.dtype(cfg.dtype).itemsize)
-                self._pager = BlockPager(n_blocks, kv_block_size,
-                                         cfg.max_seq,
-                                         bytes_per_block=bytes_per_block,
-                                         tensor_shards=self._kv_shards())
+                self._pager = BlockPager(
+                    n_blocks, kv_block_size, cfg.max_seq,
+                    bytes_per_block=bytes_per_block,
+                    tensor_shards=self._kv_shards(),
+                    recorder=self._telemetry.flightrec)
                 self._cache = init_paged_fn(cfg, max_slots,
                                             num_blocks=n_blocks,
                                             block_size=kv_block_size,
@@ -639,6 +657,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
             get_registry().subscribe(
                 self._telemetry.record_program_compile)
+            # recompile-storm trips journal into the flight recorder
+            # and (with an SLOConfig) trigger postmortem dumps
+            get_registry().subscribe_storms(
+                self._telemetry.record_storm)
+            if slo is not None:
+                from ray_tpu.serve.slo import SLOTracker
+
+                self._telemetry.slo = SLOTracker(
+                    slo, self._telemetry,
+                    recorder=self._telemetry.flightrec)
 
         def _sampler_for(self, sp):
             """Per-SamplingParams jitted full-batch sampler for
@@ -790,6 +818,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             alloc = pager.allocate(need - len(matched))
             if alloc is None:
                 pager.release(matched)
+                self._telemetry.flightrec.record(
+                    "requeue", req=rec["id"], need=need,
+                    reason="pool_exhausted")
                 self._queue.push_front((arr, rec, sp), fut)
                 return False
             blocks = matched + alloc
@@ -800,6 +831,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     new_blk, src = pager.ensure_private(blocks[wb])
                 except MemoryError:
                     pager.release(blocks)
+                    self._telemetry.flightrec.record(
+                        "requeue", req=rec["id"], need=need,
+                        reason="cow_exhausted")
                     self._queue.push_front((arr, rec, sp), fut)
                     return False
                 if src is not None:
@@ -1003,6 +1037,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                             n_active,
                             _time.perf_counter() - t_step,
                             n_tokens=n_tokens)
+                        if self._telemetry.slo is not None:
+                            self._telemetry.slo.check()
                         await asyncio.sleep(0)
                         continue
                     self._rng, k = jax.random.split(self._rng)
@@ -1020,6 +1056,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         toks = np.asarray(toks)
                     self._telemetry.record_step(
                         n_active, _time.perf_counter() - t_step)
+                    if self._telemetry.slo is not None:
+                        # throttled burn-rate watchdog: breach / storm
+                        # transitions postmortem-dump the flight record
+                        self._telemetry.slo.check()
                     for i, st in enumerate(self._slots):
                         if st is None:
                             continue
@@ -1029,6 +1069,17 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                 or self._hit_stop(st["out"]):
                             self._finish_slot(i, st)
                 except Exception as e:  # noqa: BLE001 - fail loudly
+                    # crash postmortem: the journal around the failure
+                    # is exactly what the flight recorder exists for —
+                    # dump BEFORE unwinding mutates engine state
+                    self._telemetry.flightrec.record(
+                        "engine_crash", error=repr(e)[:200])
+                    try:
+                        self._telemetry.flightrec.dump(
+                            reason="engine_crash",
+                            context={"error": repr(e)[:500]})
+                    except Exception:  # noqa: BLE001 - dump best-effort
+                        pass
                     for i, st in enumerate(self._slots):
                         if st is not None:
                             self._telemetry.record_error(

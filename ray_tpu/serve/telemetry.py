@@ -35,6 +35,7 @@ import time
 from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
+from ray_tpu._private.flightrec import FlightRecorder
 from ray_tpu.util import tracing
 
 #: ms boundaries for request-level latencies (TTFT, queue wait, total)
@@ -185,6 +186,13 @@ class EngineTelemetry:
         self._rejections_by_reason: Dict[str, int] = {}
         self._kv_stats: Optional[Dict[str, Any]] = None
         self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
+        #: round-12 flight recorder: every lifecycle transition below
+        #: also journals a compact decision event (one deque append)
+        #: so postmortems can replay what the engine DID, not just its
+        #: percentiles.  The SLO watchdog (serve/slo.py) attaches
+        #: itself as `slo` when the deployment configures targets.
+        self.flightrec = FlightRecorder(deployment)
+        self.slo = None
 
     def _now(self, now: Optional[float]) -> float:
         return time.perf_counter() if now is None else now
@@ -227,6 +235,10 @@ class EngineTelemetry:
         self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
         self._m["queue_wait"].observe(
             (now - rec["enqueue"]) * 1e3, tags=self._tags)
+        self.flightrec.record(
+            "admit", ts=now, req=rec["id"], slot=int(slot),
+            bucket=int(bucket),
+            wait_ms=round((now - rec["enqueue"]) * 1e3, 3))
         if first_seen:
             # a never-seen padded prompt shape means one fresh XLA
             # compile of the prefill program for this bucket
@@ -244,6 +256,16 @@ class EngineTelemetry:
                 self._program_compiles.get(program, 0) + 1
         self._m["program_compiles"].inc(
             tags=dict(self._tags, program=program))
+        self.flightrec.record("compile", program=program)
+
+    def record_storm(self, program: str) -> None:
+        """One recompile-storm trip from the device_stats registry
+        watchdog (``subscribe_storms``): journaled, and queued for the
+        SLO tracker's next check so the anomaly auto-dumps a
+        postmortem."""
+        self.flightrec.record("recompile_storm", program=program)
+        if self.slo is not None:
+            self.slo.note_storm(program)
 
     def record_first_token(self, rec: Dict[str, Any],
                            now: Optional[float] = None) -> None:
@@ -252,6 +274,9 @@ class EngineTelemetry:
         rec["tokens"] = max(1, rec["tokens"])
         self._m["ttft"].observe(
             (now - rec["enqueue"]) * 1e3, tags=self._tags)
+        self.flightrec.record(
+            "first_token", ts=now, req=rec["id"],
+            ttft_ms=round((now - rec["enqueue"]) * 1e3, 3))
 
     def record_step(self, n_active: int, dur_s: float,
                     now: Optional[float] = None,
@@ -278,6 +303,9 @@ class EngineTelemetry:
         if dur_s > 0:
             self._m["tokens_per_sec"].set(
                 round(n_tokens / dur_s, 1), tags=self._tags)
+        self.flightrec.record(
+            "step", ts=now, n_active=int(n_active),
+            dur_ms=round(dur_s * 1e3, 3), tokens=n_tokens)
 
     def record_spec(self, rec: Dict[str, Any], proposed: int,
                     accepted: int) -> None:
@@ -297,6 +325,8 @@ class EngineTelemetry:
         self._m["spec_proposed"].inc(proposed, tags=self._tags)
         self._m["spec_accepted"].inc(accepted, tags=self._tags)
         self._m["spec_rounds"].inc(tags=self._tags)
+        self.flightrec.record("spec_round", req=rec["id"],
+                              proposed=proposed, accepted=accepted)
 
     def record_finish(self, rec: Dict[str, Any],
                       n_tokens: Optional[int] = None,
@@ -310,6 +340,10 @@ class EngineTelemetry:
         self._m["finished"].inc(tags=self._tags)
         self._m["latency"].observe(
             (now - rec["enqueue"]) * 1e3, tags=self._tags)
+        self.flightrec.record(
+            "finish", ts=now, req=rec["id"], slot=rec["slot"],
+            tokens=rec["tokens"],
+            latency_ms=round((now - rec["enqueue"]) * 1e3, 3))
         if rec["trace"] is not None:
             trace_id, span_id = rec["trace"]
             tracing.record_span(f"engine {self.deployment}.generate",
@@ -330,6 +364,9 @@ class EngineTelemetry:
                 self._rejections_by_reason.get(label, 0) + 1
         self._retire(rec, "rejected")
         self._m["rejected"].inc(tags=dict(self._tags, reason=label))
+        self.flightrec.record(
+            "shed" if label.startswith("shed") else "reject",
+            req=rec["id"], label=label, reason=reason[:120])
 
     # -- paged KV cache (serve/kv_pager.py feeds these) --------------------
 
@@ -344,6 +381,7 @@ class EngineTelemetry:
 
     def record_cow(self) -> None:
         self._m["cow_copies"].inc(tags=self._tags)
+        self.flightrec.record("cow_fork")
 
     def record_kv_stats(self, stats: Dict[str, Any]) -> None:
         """Latest BlockPager.stats() snapshot — mirrored into
@@ -360,6 +398,8 @@ class EngineTelemetry:
         rec["reason"] = error
         self._retire(rec, "errors")
         self._m["errors"].inc(tags=self._tags)
+        self.flightrec.record("error", req=rec["id"],
+                              error=error[:200])
 
     def _retire(self, rec: Dict[str, Any], count_key: str) -> None:
         with self._lock:
@@ -371,6 +411,29 @@ class EngineTelemetry:
         self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
 
     # -- sinks -------------------------------------------------------------
+
+    def slo_samples(self) -> Dict[str, List[tuple]]:
+        """(event_ts, value_ms) series per SLO objective over the
+        retained records — the raw stream serve/slo.py's burn-rate
+        windows slice.  Timestamps are the perf_counter instant each
+        value became OBSERVABLE (first token, admit, finish), so a
+        window query sees exactly what a live observer saw."""
+        with self._lock:
+            recs = list(self._done) + list(self._active.values())
+        out: Dict[str, List[tuple]] = {"ttft": [], "e2e": [],
+                                       "queue_wait": []}
+        for r in recs:
+            if r["first_token"] is not None:
+                out["ttft"].append(
+                    (r["first_token"],
+                     (r["first_token"] - r["enqueue"]) * 1e3))
+            if r["admit"] is not None:
+                out["queue_wait"].append(
+                    (r["admit"], (r["admit"] - r["enqueue"]) * 1e3))
+            if r["finish"] is not None and r["status"] == "ok":
+                out["e2e"].append(
+                    (r["finish"], (r["finish"] - r["enqueue"]) * 1e3))
+        return out
 
     def engine_stats(self) -> Dict[str, Any]:
         """Snapshot of everything ``bench``/dashboards ask the engine:
@@ -449,6 +512,12 @@ class EngineTelemetry:
                     [r["spec_accepted"] / r["spec_proposed"]
                      for r in recs if r.get("spec_proposed", 0)]),
             },
+            # round-12: SLO burn rates (None until the deployment
+            # configures an SLOConfig — key presence is the contract)
+            # and the flight recorder's ring occupancy/drop counters
+            "slo": (self.slo.snapshot() if self.slo is not None
+                    else None),
+            "flightrec": self.flightrec.stats(),
         }
 
     def export_timeline(self, filename: Optional[str] = None

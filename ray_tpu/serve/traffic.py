@@ -166,7 +166,8 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 max_new_tokens: int = 8, prefill_bucket: int = 16,
                 time_scale: float = 0.0,
                 latency_slo_ms: Optional[float] = None,
-                admission_policy=None, mesh=None,
+                admission_policy=None, slo=None, spec_decode=None,
+                mesh=None,
                 config_overrides: Optional[Dict[str, Any]] = None
                 ) -> Dict[str, Any]:
     """One synthetic-traffic run against a fresh in-process engine
@@ -176,7 +177,17 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     and kv_cache occupancy ride along when ``kv_layout="paged"``.
     `mesh` tensor-parallelises the engine (see build_llm_deployment);
     the report then carries the engine's mesh block for per-chip
-    normalisation downstream (bench --traffic, SWEEPJSON)."""
+    normalisation downstream (bench --traffic, SWEEPJSON).
+
+    `latency_slo_ms` keeps the legacy client-side measure: the single
+    ``slo_attainment`` fraction of completed requests inside one e2e
+    latency bound.  `slo` (a serve.slo.SLOConfig) is the engine-side
+    richer form — per-objective (TTFT / e2e / queue-wait) attainment
+    lands in ``report["slo"]`` and burn rates in
+    ``report["engine"]["slo"]``.  `spec_decode` (a SpecConfig) runs
+    the traffic through the speculative engine; accept-rate/rounds
+    then ride in ``report["spec_accept_rate"]``/``["spec_rounds"]`` so
+    ledger series cover spec+traffic runs."""
     import asyncio
 
     from ray_tpu.serve.llm import build_llm_deployment
@@ -186,7 +197,8 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         max_new_tokens=max_new_tokens, temperature=0.0,
         prefill_bucket=prefill_bucket, kv_layout=kv_layout,
         kv_block_size=kv_block_size,
-        admission_policy=admission_policy, mesh=mesh,
+        admission_policy=admission_policy, slo=slo,
+        spec_decode=spec_decode, mesh=mesh,
         config_overrides=config_overrides)
     requests = TrafficGenerator(spec).requests()
 
@@ -204,6 +216,20 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     report = asyncio.run(main())
     report["spec"] = dataclasses.asdict(spec)
     report["kv_layout"] = kv_layout
-    kv = report["engine"].get("kv_cache") or {}
+    eng = report["engine"]
+    kv = eng.get("kv_cache") or {}
     report["prefix_hit_rate"] = kv.get("prefix_hit_rate", 0.0)
+    # engine-side SLO: per-objective attainment (TTFT + e2e + queue
+    # wait as configured), flattened for SWEEPJSON consumers
+    slo_block = eng.get("slo")
+    if isinstance(slo_block, dict):
+        report["slo"] = {
+            name: {"target_ms": obj["target_ms"],
+                   "attainment": obj["attainment"],
+                   "burn_rate": obj["burn_rate"]}
+            for name, obj in slo_block["objectives"].items()}
+    if spec_decode is not None:
+        sp = eng.get("spec") or {}
+        report["spec_accept_rate"] = sp.get("accept_rate")
+        report["spec_rounds"] = sp.get("rounds")
     return report

@@ -20,10 +20,13 @@ Rule ids:
   each blocks the event loop (and usually the decode engine) on a
   device or cluster round-trip.  Deliberate host fences carry a
   disable comment naming the reason.
-* ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``
-  or ``util/tracing.py``: telemetry takes an injectable ``now`` (tests
-  drive deterministic clocks) and intervals must use the monotonic
-  ``perf_counter``.
+* ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``,
+  ``util/tracing.py``, ``_private/flightrec.py`` or ``serve/slo.py``:
+  telemetry takes an injectable ``now`` (tests drive deterministic
+  clocks) and intervals must use the monotonic ``perf_counter`` —
+  the flight-recorder journal and SLO burn-rate windows are interval
+  math end to end, so one wall-clock read corrupts them under NTP
+  steps.
 * ``mutable-global-in-remote`` — a ``@remote`` function or
   remote-actor method mutating a module-level list/dict/set: each
   worker process gets its own copy, so the mutation is a silent no-op
@@ -119,7 +122,9 @@ def _blocking_calls_in_async(tree: ast.AST, rel: str) -> List[Violation]:
 def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
     rel_posix = rel.replace("\\", "/")
     if not (rel_posix.endswith("/telemetry.py")
-            or rel_posix.endswith("util/tracing.py")):
+            or rel_posix.endswith("util/tracing.py")
+            or rel_posix.endswith("_private/flightrec.py")
+            or rel_posix.endswith("serve/slo.py")):
         return []
     out: List[Violation] = []
     for node in ast.walk(tree):

@@ -55,11 +55,16 @@ DEFAULT_TOLERANCE = 0.05
 _SWEEP_FIELDS = (
     "tok_s_chip", "mfu", "mfu_xla", "prefill_ttft_ms", "decode_tok_s",
     "decode_tok_s_chip", "prefix_hit_rate", "slo_attainment",
+    "ttft_slo_attainment", "e2e_slo_attainment", "spec_accept_rate",
     "latency_p50_ms", "latency_p95_ms",
 )
 
 #: substrings marking a metric where SMALLER is better
 _LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile")
+
+#: substrings that trump _LOWER_IS_BETTER: "ttft_slo_attainment"
+#: contains "ttft" but is a fraction where BIGGER is better
+_HIGHER_OVERRIDES = ("slo_attainment", "accept_rate")
 
 
 def repo_root() -> str:
@@ -84,6 +89,8 @@ def baseline_path(path: Optional[str] = None) -> str:
 
 def higher_is_better(name: str) -> bool:
     low = name.lower()
+    if any(tok in low for tok in _HIGHER_OVERRIDES):
+        return True
     return not any(tok in low for tok in _LOWER_IS_BETTER)
 
 

@@ -110,10 +110,16 @@ def _run_traffic_variant(max_slots, kw, out):
     prefix_hit_rate + slo_attainment (the two fields a dense-vs-paged
     A/B compares) plus shed counts and client latency percentiles."""
     from ray_tpu.serve.batching import AdmissionPolicy
+    from ray_tpu.serve.llm import SpecConfig
+    from ray_tpu.serve.slo import SLOConfig
     from ray_tpu.serve.traffic import TrafficSpec, run_traffic
 
     kv_layout = kw.pop("kv_layout", "paged")
     tensor = kw.pop("tensor", 1)
+    spec_k = kw.pop("spec_k", 0)
+    spec_draft = kw.pop("spec_draft", "aligned")
+    ttft_slo_ms = kw.pop("ttft_slo_ms", None)
+    e2e_slo_ms = kw.pop("e2e_slo_ms", None)
     mesh, n_chips = decode_mesh(tensor)
     spec = TrafficSpec(
         num_requests=kw.pop("requests", 64),
@@ -136,16 +142,29 @@ def _run_traffic_variant(max_slots, kw, out):
     policy = AdmissionPolicy(
         max_queue_depth=kw.pop("max_queue_depth",
                                4 * spec.num_requests))
+    # engine-side SLO tracker: explicit ttft_slo_ms/e2e_slo_ms knobs,
+    # defaulting to the legacy client-side bound (TTFT at half of it)
+    slo_cfg = SLOConfig(
+        ttft_ms=ttft_slo_ms if ttft_slo_ms is not None
+        else run_kw["latency_slo_ms"] / 2,
+        e2e_ms=e2e_slo_ms if e2e_slo_ms is not None
+        else run_kw["latency_slo_ms"])
+    spec_cfg = None
+    if spec_k > 0:
+        draft = (f"gpt2:{run_kw['preset']}" if spec_draft == "aligned"
+                 else spec_draft)
+        spec_cfg = SpecConfig(draft=draft, k=spec_k)
     variant = {"mode": "traffic", "max_slots": max_slots,
                "kv_layout": kv_layout, "requests": spec.num_requests,
                "prefix_len": spec.prefix_len,
                "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
-               "tensor": n_chips,
+               "tensor": n_chips, "spec_k": spec_k,
                "preset": run_kw["preset"], "overrides": kw}
     try:
         rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
                           max_slots=max_slots, mesh=mesh,
-                          admission_policy=policy,
+                          admission_policy=policy, slo=slo_cfg,
+                          spec_decode=spec_cfg,
                           config_overrides=kw or None, **run_kw)
         eng = rep["engine"]
         tok_s = eng["tokens_per_sec"]
@@ -155,9 +174,15 @@ def _run_traffic_variant(max_slots, kw, out):
               f"slo={rep['slo_attainment']} shed={rep['shed']} "
               f"{tok_s:,.0f} tok/s", file=out,
               flush=True)
+        slo_rep = rep.get("slo") or {}
         rec = {"sweep": variant,
                "prefix_hit_rate": rep["prefix_hit_rate"],
                "slo_attainment": rep["slo_attainment"],
+               "ttft_slo_attainment":
+                   (slo_rep.get("ttft") or {}).get("attainment"),
+               "e2e_slo_attainment":
+                   (slo_rep.get("e2e") or {}).get("attainment"),
+               "spec_accept_rate": rep.get("spec_accept_rate"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],

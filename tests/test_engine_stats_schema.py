@@ -1,0 +1,149 @@
+"""Golden-schema guard for ``engine_stats()``.
+
+Dashboards, ``bench --traffic``, sweep records, the SLO admission
+policy, and the postmortem tooling all pattern-match this dict; a
+renamed or dropped key breaks them silently.  This test pins the
+top-level key set and the shapes of the ``slo`` / ``programs`` /
+``spec`` / ``flightrec`` blocks across the engine matrix: dense and
+paged KV, speculative decoding on and off, and the mesh-sharded
+engine on the 8-virtual-device CPU mesh.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.serve.llm import SpecConfig, build_llm_deployment  # noqa: E402
+from ray_tpu.serve.slo import SLOConfig  # noqa: E402
+
+_OVR = {"dtype": jnp.float32, "use_flash": False, "remat": False}
+
+#: every key engine_stats() promises, regardless of configuration
+TOP_KEYS = {
+    "deployment", "uptime_s", "requests", "ttft_ms", "queue_wait_ms",
+    "request_latency_ms", "inter_token_ms", "engine_steps",
+    "tokens_generated", "tokens_per_sec", "slot_utilization",
+    "max_active_slots", "max_slots", "prefill_buckets",
+    "prefill_compiles", "program_compiles", "rejections_by_reason",
+    "kv_cache", "spec", "slo", "flightrec", "programs",
+}
+
+SPEC_KEYS = {"proposed", "accepted", "rejected", "rounds",
+             "accept_rate", "accept_rate_per_request"}
+
+FLIGHTREC_KEYS = {"enabled", "capacity", "recorded", "retained",
+                  "dropped", "dumps"}
+
+SLO_OBJECTIVE_KEYS = {"target_ms", "samples", "violations",
+                      "attainment", "burn_rate", "breached", "windows"}
+
+PROGRAM_KEYS = {"compile_events", "compile_seconds", "invokes",
+                "invoke_ms", "xla_flops", "bytes_accessed",
+                "arithmetic_intensity", "peak_hbm_bytes",
+                "recompile_storm", "recompile_storms_total", "mfu"}
+
+
+def _mesh():
+    from ray_tpu.parallel import MeshSpec, fake_mesh
+
+    return fake_mesh(8, MeshSpec(data=4, tensor=2))
+
+
+def _stats(kv_layout, spec, mesh):
+    # generous targets: the SLO block must take its well-behaved
+    # (unbreached) shape, not just the breach shape test_flightrec pins
+    slo = SLOConfig(ttft_ms=60_000.0, e2e_ms=120_000.0,
+                    queue_wait_ms=60_000.0)
+    dep = build_llm_deployment(
+        "gpt2", "nano", scheduler="continuous", kv_layout=kv_layout,
+        kv_block_size=16, prefill_bucket=16, max_slots=2,
+        max_new_tokens=3, temperature=0.0, slo=slo,
+        spec_decode=spec, mesh=mesh, config_overrides=_OVR)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, 50, size=rng.randint(8, 14))
+               .astype(np.int32) for _ in range(2)]
+
+    async def main():
+        inst = dep.func_or_class()
+        try:
+            await asyncio.gather(*[inst(p) for p in prompts])
+            return inst.engine_stats()
+        finally:
+            inst.shutdown_engine()
+
+    return asyncio.run(main())
+
+
+@pytest.mark.parametrize("kv_layout,spec,sharded", [
+    ("dense", None, False),
+    ("paged", None, False),
+    ("dense", SpecConfig(draft="ngram", k=2), False),
+    ("paged", SpecConfig(draft="ngram", k=2), False),
+    ("paged", None, True),
+    ("paged", SpecConfig(draft="ngram", k=2), True),
+], ids=["dense", "paged", "dense-spec", "paged-spec", "paged-mesh",
+        "paged-spec-mesh"])
+def test_engine_stats_schema(kv_layout, spec, sharded):
+    stats = _stats(kv_layout, spec, _mesh() if sharded else None)
+
+    missing = TOP_KEYS - set(stats)
+    assert not missing, f"engine_stats() lost keys: {missing}"
+
+    # requests sub-dict is a stable contract of its own
+    for k in ("enqueued", "admitted", "finished", "rejected", "errors",
+              "active", "queued"):
+        assert k in stats["requests"], k
+
+    # kv_cache: a pager block iff paged
+    if kv_layout == "paged":
+        assert isinstance(stats["kv_cache"], dict)
+        assert "prefix_hit_rate" in stats["kv_cache"]
+    else:
+        assert stats["kv_cache"] is None
+
+    # spec block always present; counters move iff spec decoding ran
+    assert set(stats["spec"]) == SPEC_KEYS
+    if spec is not None:
+        assert stats["spec"]["rounds"] > 0
+        assert stats["spec"]["proposed"] >= stats["spec"]["accepted"]
+    else:
+        assert stats["spec"]["rounds"] == 0
+
+    # slo block: configured here, so never None
+    blk = stats["slo"]
+    assert set(blk) == {"config", "objectives", "breached", "breaches",
+                        "dumps"}
+    assert set(blk["config"]) == {"objective", "windows_s",
+                                  "burn_threshold", "targets_ms"}
+    assert set(blk["objectives"]) == {"ttft", "e2e", "queue_wait"}
+    for obj in blk["objectives"].values():
+        assert set(obj) == SLO_OBJECTIVE_KEYS
+        for win in obj["windows"].values():
+            assert set(win) == {"samples", "violations", "attainment",
+                                "burn_rate"}
+    assert blk["breached"] is False      # targets are unreachable-slow
+    assert blk["breaches"] == 0 and blk["dumps"] == []
+
+    # flight recorder: always on by default, journaling this run
+    fr = stats["flightrec"]
+    assert set(fr) == FLIGHTREC_KEYS
+    assert fr["enabled"] and fr["recorded"] > 0
+    assert fr["retained"] <= fr["capacity"]
+
+    # perf observatory: serve-namespace programs with the full block
+    assert isinstance(stats["programs"], dict)
+    for name, prog in stats["programs"].items():
+        assert name.startswith("serve."), name
+        assert PROGRAM_KEYS <= set(prog), (name, prog.keys())
+
+    # mesh block present exactly when sharded
+    if sharded:
+        assert set(stats["mesh"]) == {"axes", "n_devices", "kv_shards",
+                                      "devices"}
+        assert stats["mesh"]["n_devices"] == 8
+    else:
+        assert "mesh" not in stats

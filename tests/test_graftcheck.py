@@ -426,6 +426,27 @@ def test_lint_wallclock_positive_and_suppressed():
     assert n_sup == 1
 
 
+def test_lint_wallclock_covers_flightrec_and_slo():
+    # the flight recorder and SLO burn-rate engine promised monotonic
+    # clocks — a planted time.time() in either path must flag
+    src = textwrap.dedent("""\
+        import time
+
+        def record(kind):
+            return time.time()
+    """)
+    for rel in ("ray_tpu/_private/flightrec.py",
+                "ray_tpu/serve/slo.py"):
+        kept, _ = lint_source(src, rel)
+        assert [v.rule for v in kept] == ["wallclock-in-telemetry"], rel
+        kept, _ = lint_source(src.replace("time.time()",
+                                          "time.perf_counter()"), rel)
+        assert not kept, rel
+    # neighbours of the scoped files stay out of scope
+    kept, _ = lint_source(src, "ray_tpu/serve/kv_pager.py")
+    assert not kept
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote
