@@ -89,6 +89,13 @@ def parse_args(argv=None):
                          "SLO-attainment JSON lines")
     ap.add_argument("--requests", type=int, default=0,
                     help="--traffic request count (default 64 on TPU)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="--traffic fleet size: N>1 drives a multi-"
+                         "tenant mixture through N continuous-engine "
+                         "replicas behind the prefix-affinity router "
+                         "(serve/router.py build_llm_fleet); emits "
+                         "router_prefix_hit_rate and per-tenant "
+                         "slo_attainment lines")
     ap.add_argument("--kv-layout", default="paged",
                     choices=["dense", "paged"],
                     help="--traffic KV-cache layout (paged enables "
@@ -670,7 +677,10 @@ def main_traffic(args, on_tpu: bool) -> None:
     attainment (SLOConfig: TTFT at half the e2e bound) emits its own
     `{base}_{objective}_slo_attainment` lines; `--spec-k K` runs the
     traffic through the speculative engine and adds accept-rate
-    lines.  No published baseline exists, so vs_baseline is null."""
+    lines.  No published baseline exists, so vs_baseline is null.
+    `--replicas N` (N>1) switches to the fleet path below."""
+    if args.replicas > 1:
+        return main_traffic_fleet(args, on_tpu)
     import jax
 
     from ray_tpu.serve.batching import AdmissionPolicy
@@ -783,6 +793,89 @@ def main_traffic(args, on_tpu: bool) -> None:
             "value": rep["spec_accept_rate"], "unit": "ratio",
             "vs_baseline": None,
             "detail": dict(detail, rounds=rep.get("spec_rounds"))})
+
+
+def main_traffic_fleet(args, on_tpu: bool) -> None:
+    """--traffic --replicas N: a two-tenant mixture (interactive +
+    batch, disjoint prefix pools) through N continuous-engine replicas
+    behind the prefix-affinity router with WFQ tenant classes
+    (serve/router.py build_llm_fleet / serve/traffic.py
+    run_traffic_fleet — the same entry `sweep_tpu.py`'s traffic_fleet
+    mode calls).  Headline metrics: the FLEET prefix-hit rate (pooled
+    over replicas — routing quality, not just cache quality) and
+    per-tenant `{tenant}_{objective}_slo_attainment`."""
+    import jax
+
+    from ray_tpu.serve.slo import SLOConfig
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    if on_tpu:
+        base, preset = "gpt2_traffic_fleet", "gpt2"
+        n = args.requests or 64
+        slo_ms = 20000.0
+        tenants = (
+            TenantSpec("interactive", rate_share=1.0,
+                       slo_class="interactive", prefix_groups=(0, 1),
+                       ttft_slo_ms=slo_ms / 2, e2e_slo_ms=slo_ms),
+            TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                       prefix_groups=(2, 3), e2e_slo_ms=2 * slo_ms))
+        spec = TrafficSpec(num_requests=n, seed=0, rate_rps=32.0,
+                           num_prefix_groups=4, prefix_len=256,
+                           p_shared=0.75, tail_len_mean=32.0,
+                           tail_len_max=128, vocab=50000,
+                           tenants=tenants)
+        kw = dict(max_slots=8, max_new_tokens=64, prefill_bucket=128,
+                  time_scale=1.0)
+    else:  # CPU smoke so the fleet bench always emits its lines
+        base, preset = "gpt2_traffic_fleet_cpu_smoke", "nano"
+        import jax.numpy as jnp
+
+        n = args.requests or 16
+        slo_ms = 60000.0
+        tenants = (
+            TenantSpec("interactive", rate_share=1.0,
+                       slo_class="interactive", prefix_groups=(0,),
+                       ttft_slo_ms=slo_ms / 2, e2e_slo_ms=slo_ms),
+            TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                       prefix_groups=(1,), e2e_slo_ms=2 * slo_ms))
+        spec = TrafficSpec(num_requests=n, seed=0, rate_rps=100.0,
+                           num_prefix_groups=2, prefix_len=32,
+                           p_shared=0.75, tail_len_mean=6.0,
+                           tail_len_max=16, vocab=500,
+                           tenants=tenants)
+        kw = dict(max_slots=4, max_new_tokens=8, prefill_bucket=16,
+                  time_scale=0.0,
+                  config_overrides={"dtype": jnp.float32,
+                                    "use_flash": False})
+    rep = run_traffic_fleet(
+        spec, num_replicas=args.replicas, family="gpt2",
+        preset=preset, kv_block_size=16,
+        slo=SLOConfig(ttft_ms=slo_ms / 2, e2e_ms=slo_ms), **kw)
+    fleet = rep["fleet"]
+    detail = {"replicas": args.replicas, "requests": rep["offered"],
+              "completed": rep["completed"], "shed": rep["shed"],
+              "preset": preset, "routing": rep["routing"],
+              "wfq": rep["wfq"],
+              "backend": jax.default_backend(),
+              "tpu_error": TPU_ERROR,
+              "latency_ms": rep["latency_ms"],
+              "latency_ms_by_tenant": rep["latency_ms_by_tenant"],
+              "routed_by_policy":
+                  fleet["router"]["routed_by_policy"]}
+    emit({
+        "metric": f"{base}_router_prefix_hit_rate",
+        "value": rep["router_prefix_hit_rate"], "unit": "fraction",
+        "vs_baseline": None, "detail": detail})
+    for name, value in sorted(rep["tenant_slo_attainment"].items()):
+        if not isinstance(value, (int, float)):
+            continue
+        emit({
+            "metric": f"{base}_{name}",
+            "value": value, "unit": "fraction", "vs_baseline": None,
+            "detail": dict(detail,
+                           tenant_report=rep["tenants"].get(
+                               name.split("_", 1)[0]))})
 
 
 def main(args=None):

@@ -263,6 +263,28 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/slo", serve_slo)
 
+        # Fleet control plane (serve/router.py): every live
+        # build_llm_fleet() in this process — routing policy mix,
+        # pooled prefix hit rate, per-tenant SLO attainment, and the
+        # autoscaler's current signals, keyed by fleet name.
+        async def serve_fleet(_req):
+            def _collect():
+                from ray_tpu.serve.router import fleet_registry
+
+                out = {}
+                for name, fleet in fleet_registry().items():
+                    try:
+                        out[name] = fleet.fleet_stats()
+                    except Exception as e:  # noqa: BLE001
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/serve/fleet", serve_fleet)
+
         # Perf observatory (_private/device_stats.py): per-program
         # compiled cost model / recompile watchdog / live MFU, plus
         # per-chip allocator stats — the device-side complement of

@@ -11,10 +11,14 @@ from ray_tpu.serve.llm import (SamplingParams, SpecConfig,
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.schema import (DeploymentSchema,
                                   ServeApplicationSchema)
+from ray_tpu.serve.router import (AutoscalePolicy, LLMFleet,
+                                  LLMRouter, TenantClass,
+                                  build_llm_fleet)
 from ray_tpu.serve.schema import apply as apply_config
-from ray_tpu.serve.slo import SLOConfig
-from ray_tpu.serve.traffic import (TrafficGenerator, TrafficSpec,
-                                   run_traffic)
+from ray_tpu.serve.slo import SLOConfig, worst_burn_rate
+from ray_tpu.serve.traffic import (TenantSpec, TrafficGenerator,
+                                   TrafficSpec, run_traffic,
+                                   run_traffic_fleet)
 
 __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "DeploymentHandle", "get_deployment_handle",
@@ -23,4 +27,7 @@ __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "apply_config", "build_llm_deployment", "AdmissionPolicy",
            "OverloadedError", "BlockPager", "TrafficSpec",
            "TrafficGenerator", "run_traffic", "SamplingParams",
-           "SpecConfig", "SLOConfig"]
+           "SpecConfig", "SLOConfig", "worst_burn_rate",
+           "TenantSpec", "TenantClass", "AutoscalePolicy",
+           "LLMRouter", "LLMFleet", "build_llm_fleet",
+           "run_traffic_fleet"]

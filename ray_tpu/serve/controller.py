@@ -17,6 +17,59 @@ from typing import Any, Dict, List, Optional
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+def _load_value(v: Any) -> float:
+    """Per-replica ongoing load from a probe result: probes historically
+    return a bare pending count (float); richer probes return a dict
+    carrying at least {"pending": ...}.  Both shapes are accepted
+    everywhere loads are consumed so signals can evolve without
+    breaking the controller."""
+    if isinstance(v, dict):
+        return float(v.get("pending", 0.0))
+    return float(v)
+
+
+def _queue_depth_signal(loads: Dict[Any, Any],
+                        ac: Dict[str, Any]) -> float:
+    """LEGACY DEFAULT load signal: total ongoing requests across
+    replicas (the reconcile probe's pending counts).  This is the
+    reference autoscaling_policy behavior — desired replicas =
+    ceil(total / target_ongoing_requests) — and what every deployment
+    gets unless its autoscaling config names another signal."""
+    return sum(_load_value(v) for v in loads.values())
+
+
+def _burn_rate_signal(loads: Dict[Any, Any],
+                      ac: Dict[str, Any]) -> float:
+    """SLO-aware load signal: queue depth inflated by burn rate.  A
+    replica burning its error budget at b× the configured
+    ``burn_threshold`` counts as b× its pending load (never less than
+    its raw pending), so a fleet meeting SLOs scales exactly like the
+    legacy signal while a breaching fleet scales up even at modest
+    queue depth.  Probe values must be dicts carrying "burn_rate"
+    (worst objective, 30s window — see serve/slo.py worst_burn_rate);
+    bare floats degrade to the legacy behavior."""
+    threshold = float(ac.get("burn_threshold", 1.0)) or 1.0
+    total = 0.0
+    for v in loads.values():
+        pending = _load_value(v)
+        burn = float(v.get("burn_rate", 0.0)) if isinstance(v, dict) \
+            else 0.0
+        total += pending * max(1.0, burn / threshold)
+    return total
+
+
+#: Pluggable autoscaling load signals, selected per deployment via
+#: ``autoscaling_config={"load_signal": "<name>", ...}``.  Values map a
+#: per-replica loads dict (probe results) + the autoscaling config to
+#: ONE total-load float that feeds desired = ceil(total / target).
+#: The in-process fleet autoscaler (serve/router.py) routes its
+#: burn-rate decisions through the same "burn_rate" entry.
+LOAD_SIGNALS = {
+    "queue_depth": _queue_depth_signal,
+    "burn_rate": _burn_rate_signal,
+}
+
+
 class ServeController:
     def __init__(self):
         # name -> {config, replicas: [ActorHandle], version}
@@ -278,11 +331,16 @@ class ServeController:
 
     def _autoscale_one(self, name: str,
                        loads: Optional[Dict[Any, float]] = None) -> None:
-        """Queue-depth-driven replica scaling (reference:
+        """Replica scaling from a PLUGGABLE load signal (reference:
         autoscaling_policy.py:93 calculate_desired_num_replicas — desired
-        = ceil(total_ongoing / target) — and :127's upscale/downscale
-        delay smoothing).  ``loads``: per-replica pending counts from the
-        reconcile probe (running + queued)."""
+        = ceil(total_load / target) — and :127's upscale/downscale delay
+        smoothing).  ``loads``: per-replica probe results — bare pending
+        counts (legacy) or dicts with "pending" and optionally
+        "burn_rate".  The signal is chosen by the deployment's
+        ``autoscaling_config["load_signal"]`` from LOAD_SIGNALS;
+        the default "queue_depth" reproduces the historical raw-queue-
+        length behavior exactly, so deployments that don't opt in see
+        no change."""
         import math
 
         with self._lock:
@@ -290,7 +348,10 @@ class ServeController:
             if dep is None or not dep["config"].get("autoscaling"):
                 return
             ac = dep["config"]["autoscaling"]
-        total = sum((loads or {}).values())
+        signal = LOAD_SIGNALS.get(str(ac.get("load_signal",
+                                             "queue_depth")),
+                                  _queue_depth_signal)
+        total = signal(loads or {}, ac)
         desired = max(ac["min_replicas"],
                       min(ac["max_replicas"],
                           math.ceil(total / ac["target_ongoing_requests"])
@@ -327,8 +388,9 @@ class ServeController:
                 # (removed from the table), then drain before killing so
                 # in-flight requests finish (reference: graceful replica
                 # shutdown in deployment_state reconciliation).
-                ordered = sorted(dep["replicas"],
-                                 key=lambda r: (loads or {}).get(r, 0.0))
+                ordered = sorted(
+                    dep["replicas"],
+                    key=lambda r: _load_value((loads or {}).get(r, 0.0)))
                 victims = ordered[:cur - desired]
                 dep["replicas"] = [r for r in dep["replicas"]
                                    if r not in victims]

@@ -82,6 +82,9 @@ class BlockPager:
         self.prefix_misses = 0    # blocks that had to be prefilled
         self.cow_copies = 0
         self.evictions = 0
+        #: total keys handed out by prefix_keys() — how much affinity
+        #: metadata this pager has published to routers
+        self.prefix_keys_exported = 0
         #: optional flight recorder (_private/flightrec.py): block
         #: reserve / evict / free / COW decisions journal themselves
         #: so a postmortem can replay pool pressure around an anomaly
@@ -250,6 +253,22 @@ class BlockPager:
                                   fork=fresh[0])
         return fresh[0], block_id
 
+    def prefix_keys(self) -> List[Tuple[int, ...]]:
+        """Resident prefix keys (exact block-aligned token tuples),
+        exported as cluster-visible routing metadata.
+
+        A fleet router (serve/router.py) matches an incoming prompt's
+        block-aligned prefixes against each replica's exported keys and
+        sends the request where the KV blocks already live.  The keys
+        are content (token tuples), not block ids — a router on another
+        host can match them without sharing this pager's id space.
+        Every call bumps `prefix_keys_exported` (surfaced in stats()),
+        so dashboards can see how much metadata the replica publishes.
+        """
+        keys = list(self._index.keys())
+        self.prefix_keys_exported += len(keys)
+        return keys
+
     def _deregister(self, block_id: int) -> None:
         key = self._block_key.pop(block_id, None)
         if key is not None:
@@ -271,6 +290,8 @@ class BlockPager:
             if total else 0.0,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
+            "prefix_keys_resident": len(self._index),
+            "prefix_keys_exported": self.prefix_keys_exported,
         }
         if self.bytes_per_block:
             out["pool_bytes"] = self.bytes_per_block * self.num_blocks

@@ -1098,7 +1098,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # yield the loop so callers can enqueue mid-flight
                 await asyncio.sleep(0)
 
-        async def _call_continuous(self, prompt, sampling=None):
+        async def _call_continuous(self, prompt, sampling=None, *,
+                                   tenant=None, enqueue_ts=None):
+            """`tenant` / `enqueue_ts` are the fleet-router hooks
+            (serve/router.py): the router backdates `enqueue_ts` to the
+            instant the request entered ITS queue, so this engine's
+            telemetry charges router wait to the request's TTFT/e2e
+            series, and `tenant` tags the record for per-class SLO
+            slicing.  Direct callers omit both."""
             import asyncio
 
             sp = None
@@ -1131,14 +1138,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     self._telemetry.engine_stats(), len(self._queue))
                 if shed is not None:
                     rec = self._telemetry.record_enqueue(
-                        int(arr.shape[0]))
+                        int(arr.shape[0]), now=enqueue_ts,
+                        tenant=tenant)
                     self._telemetry.record_reject(
                         rec, reason=f"load shed: {shed}",
                         label=f"shed_{shed}")
                     raise OverloadedError(
                         f"request shed ({shed}): engine over SLO "
                         f"with {len(self._queue)} queued")
-            rec = self._telemetry.record_enqueue(int(arr.shape[0]))
+            rec = self._telemetry.record_enqueue(
+                int(arr.shape[0]), now=enqueue_ts, tenant=tenant)
             fut = self._queue.put((arr, rec, sp))
             self._wake.set()
             return await fut

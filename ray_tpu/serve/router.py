@@ -1,0 +1,654 @@
+"""Fleet control plane: prefix-aware routing, per-tenant weighted
+fair queueing, and SLO-driven autoscaling over N continuous engines.
+
+One continuous-batching engine (serve/llm.py) cannot serve heavy
+traffic alone; this module composes N of them into a horizontally
+scalable fleet behind one router, in the shape of Ray Serve's
+controller/router split (reference: serve controller.py ServeController
++ router.py assign_request) with the Ray paper's resource-demand
+scaling as the autoscaling model:
+
+* **Prefix-affinity routing** — every replica's BlockPager publishes
+  its resident prefix keys (`prefix_keys()`, exact block-aligned token
+  tuples) as cluster-visible metadata.  The router matches an incoming
+  prompt's block prefixes against each replica's export and sends the
+  request where the KV blocks already live, so shared-prefix traffic
+  concentrates its cache instead of re-prefilling the same system
+  prompt on every replica.  On a miss it falls back to
+  least-outstanding-requests over two random candidates
+  (power-of-two-choices), the classic load-balancing compromise
+  between random (no state) and global-least-loaded (herd risk).
+
+* **Weighted fair queueing** — requests carry a tenant; each tenant
+  class has a weight, and a virtual-time WFQ (start-time fair
+  queueing: tag = max(V, tenant_last_finish) + cost/weight, serve
+  min-tag first) decides which queued request dispatches when replica
+  capacity frees.  A saturating batch tenant therefore cannot starve
+  an interactive tenant's TTFT: the interactive class's small virtual
+  cost lets its requests overtake the batch backlog.
+
+* **SLO-driven autoscaling** — `LLMFleet.autoscale_step` reads
+  burn-rate (serve/slo.py, 30s window) and queue-depth signals through
+  the same pluggable signal seam as ServeController (LOAD_SIGNALS in
+  serve/controller.py), scales up on a sustained breach, scales down
+  on sustained idle, respects cooldowns and min/max bounds, and
+  retires replicas with a graceful drain: stop admitting, finish
+  in-flight requests, verify every KV block is freed, then shut the
+  engine down.  Every decision journals to the fleet flight recorder
+  (`route` / `scale_up` / `scale_down` / `drain` events via
+  serve/telemetry.py), so `python -m ray_tpu.tools.flightrec report`
+  can reconstruct the routing table post-hoc.
+
+Everything here is host-side control logic — replicas are in-process
+engine instances sharing one jit cache (equal configs compile once),
+and the router never touches device memory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import heapq
+import itertools
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_tpu._private import telemetry as _core
+from ray_tpu.serve.slo import worst_burn_rate
+from ray_tpu.serve.telemetry import EngineTelemetry
+
+__all__ = ["TenantClass", "DEFAULT_TENANT", "FairQueue",
+           "AutoscalePolicy", "LLMRouter", "LLMFleet",
+           "build_llm_fleet", "fleet_registry"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One traffic class: a WFQ weight plus optional latency targets.
+
+    `weight` is the tenant's fair share of router dispatch slots —
+    an interactive class with weight 8 overtakes a batch class with
+    weight 1 whenever both have queued requests.  `ttft_ms` / `e2e_ms`
+    are the per-tenant SLO targets the fleet's `tenant_report()`
+    scores attainment against (None = objective not tracked);
+    `objective` is the attainment the tenant is promised."""
+
+    name: str
+    weight: float = 1.0
+    ttft_ms: Optional[float] = None
+    e2e_ms: Optional[float] = None
+    objective: float = 0.95
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant {self.name!r}: weight must be "
+                             f"> 0, got {self.weight}")
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError(f"tenant {self.name!r}: objective must "
+                             f"be in (0, 1), got {self.objective}")
+
+    def objectives(self) -> Dict[str, float]:
+        out = {}
+        if self.ttft_ms is not None:
+            out["ttft"] = float(self.ttft_ms)
+        if self.e2e_ms is not None:
+            out["e2e"] = float(self.e2e_ms)
+        return out
+
+
+DEFAULT_TENANT = TenantClass("default", weight=1.0)
+
+
+class FairQueue:
+    """Virtual-time weighted fair queue (start-time fair queueing).
+
+    Each pushed item gets a finish tag ``start + cost/weight`` where
+    ``start = max(V, tenant's last finish)``; pop serves the minimum
+    finish tag and advances V to the served item's start tag.  With
+    unit cost per request, a tenant with weight w receives a w-
+    proportional share of pops whenever it is backlogged, and an idle
+    tenant's unused share redistributes automatically — no token
+    buckets, no timers, fully deterministic given arrival order."""
+
+    def __init__(self, tenants: Optional[Dict[str, TenantClass]] = None):
+        self._tenants = dict(tenants or {})
+        self._vtime = 0.0
+        self._last_finish: Dict[str, float] = {}
+        self._heap: List[Tuple[float, int, float, Any]] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def _class_of(self, tenant: Optional[str]) -> TenantClass:
+        if tenant is None:
+            return DEFAULT_TENANT
+        return self._tenants.get(tenant,
+                                 TenantClass(tenant, weight=1.0))
+
+    def push(self, item: Any, tenant: Optional[str] = None,
+             cost: float = 1.0) -> None:
+        tc = self._class_of(tenant)
+        start = max(self._vtime,
+                    self._last_finish.get(tc.name, 0.0))
+        finish = start + float(cost) / tc.weight
+        self._last_finish[tc.name] = finish
+        heapq.heappush(self._heap,
+                       (finish, next(self._seq), start, item))
+
+    def pop(self) -> Any:
+        finish, _seq, start, item = heapq.heappop(self._heap)
+        self._vtime = max(self._vtime, start)
+        return item
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Knobs for `LLMFleet.autoscale_step` (see docs/serve.md).
+
+    Scale UP when the worst replica burn rate exceeds `burn_threshold`
+    or router backlog per live replica exceeds `queue_high`, sustained
+    for `sustain_s`; scale DOWN when the fleet is completely idle (no
+    queue, no in-flight, no burn) for `idle_s`.  `up_cooldown_s` /
+    `down_cooldown_s` are minimum gaps between same-direction actions
+    so one breach cannot thrash the fleet."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    burn_threshold: float = 1.0
+    queue_high: float = 4.0
+    sustain_s: float = 5.0
+    idle_s: float = 30.0
+    up_cooldown_s: float = 10.0
+    down_cooldown_s: float = 30.0
+
+    def __post_init__(self):
+        if not 1 <= self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"{self.min_replicas}..{self.max_replicas}")
+
+
+class ReplicaHandle:
+    """Router-side view of one engine replica: identity, outstanding
+    count, drain flag, and the latest prefix-key export."""
+
+    def __init__(self, name: str, inst: Any):
+        self.name = name
+        self.inst = inst
+        self.inflight = 0
+        self.routed = 0
+        self.draining = False
+        self._keys: frozenset = frozenset()
+
+    def refresh_metadata(self) -> None:
+        """Pull the replica's resident prefix keys (the BlockPager
+        export) into the router's view.  In-process this is a dict-key
+        copy; a cross-host router would receive the same token tuples
+        over the metadata channel."""
+        pager = getattr(self.inst, "_pager", None)
+        self._keys = (frozenset(pager.prefix_keys())
+                      if pager is not None else frozenset())
+
+    def prefix_match(self, tokens: Tuple[int, ...],
+                     block_size: int) -> int:
+        """Longest run of this replica's resident blocks covering a
+        prefix of `tokens`, in blocks."""
+        n = 0
+        for i in range(1, len(tokens) // block_size + 1):
+            if tokens[:i * block_size] in self._keys:
+                n = i
+            else:
+                break
+        return n
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return self.inst.engine_stats()
+
+
+class LLMRouter:
+    """Routes requests over a mutable set of replicas.
+
+    `policy` is "prefix" (affinity by resident prefix keys, p2c
+    fallback) or "round_robin" (the baseline the fleet tests compare
+    against).  With `wfq=True` queued requests dispatch in weighted-
+    fair order per tenant; otherwise strict FIFO.  At most
+    `max_inflight_per_replica` requests are outstanding per replica —
+    the backlog stays HERE, where WFQ can reorder it, instead of in
+    the engines' FIFO queues where it could not."""
+
+    def __init__(self, replicas: List[ReplicaHandle], *,
+                 block_size: int = 16,
+                 tenants: Optional[Sequence[TenantClass]] = None,
+                 policy: str = "prefix", wfq: bool = True,
+                 max_inflight_per_replica: Optional[int] = None,
+                 seed: int = 0,
+                 telemetry: Optional[EngineTelemetry] = None,
+                 name: str = "llm_fleet"):
+        if policy not in ("prefix", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self._replicas = replicas          # shared with LLMFleet
+        self._block_size = int(block_size)
+        self.tenants: Dict[str, TenantClass] = {
+            t.name: t for t in (tenants or ())}
+        self.policy = policy
+        self._wfq = FairQueue(self.tenants) if wfq else None
+        self._fifo: collections.deque = collections.deque()
+        self._cap = max_inflight_per_replica
+        self._rng = random.Random(seed)
+        self._rr = 0
+        self._ids = itertools.count()
+        self.telemetry = telemetry or EngineTelemetry(name)
+        self.routed_by_policy = {"prefix_affinity": 0, "p2c": 0,
+                                 "round_robin": 0}
+
+    # -- introspection -------------------------------------------------
+
+    @property
+    def live_replicas(self) -> List[ReplicaHandle]:
+        return [r for r in self._replicas if not r.draining]
+
+    def queue_depth(self) -> int:
+        return len(self._wfq) if self._wfq is not None \
+            else len(self._fifo)
+
+    def total_inflight(self) -> int:
+        return sum(r.inflight for r in self._replicas)
+
+    # -- submission ----------------------------------------------------
+
+    def _normalize(self, prompt) -> np.ndarray:
+        return np.asarray(prompt, np.int32).reshape(-1)
+
+    async def submit(self, prompt, tenant: Optional[str] = None,
+                     sampling=None):
+        """Route one request and await its completion.  `tenant`
+        selects the WFQ class and tags the engine-side record for
+        per-tenant SLO slicing; the submit instant is threaded to the
+        engine as the request's enqueue time so TTFT/e2e include any
+        router queueing."""
+        if not self.live_replicas:
+            raise RuntimeError("no live replicas to route to")
+        arr = self._normalize(prompt)
+        t_submit = time.perf_counter()
+        fut = asyncio.get_running_loop().create_future()
+        item = (arr, tenant, sampling, t_submit, fut,
+                next(self._ids))
+        if self._wfq is not None:
+            self._wfq.push(item, tenant)
+        else:
+            self._fifo.append(item)
+        self._pump()
+        return await fut
+
+    # -- dispatch ------------------------------------------------------
+
+    def _candidates(self) -> List[ReplicaHandle]:
+        live = self.live_replicas
+        if self._cap is None:
+            return live
+        return [r for r in live if r.inflight < self._cap]
+
+    def _pick(self, tokens: Tuple[int, ...],
+              cands: List[ReplicaHandle]
+              ) -> Tuple[ReplicaHandle, str, int]:
+        if self.policy == "round_robin":
+            rep = cands[self._rr % len(cands)]
+            self._rr += 1
+            return rep, "round_robin", 0
+        best, best_match = None, 0
+        for rep in cands:
+            rep.refresh_metadata()
+            m = rep.prefix_match(tokens, self._block_size)
+            if m > best_match:
+                best, best_match = rep, m
+        if best is not None:
+            return best, "prefix_affinity", best_match
+        if len(cands) == 1:
+            return cands[0], "p2c", 0
+        a, b = self._rng.sample(cands, 2)
+        rep = a if a.inflight <= b.inflight else b
+        return rep, "p2c", 0
+
+    def _pump(self) -> None:
+        """Dispatch queued requests while replica capacity is free.
+        Synchronous and re-entrant-safe: called on submit, on every
+        completion, and when the replica set changes."""
+        while self.queue_depth() > 0:
+            cands = self._candidates()
+            if not cands:
+                return
+            if self._wfq is not None:
+                item = self._wfq.pop()
+            else:
+                item = self._fifo.popleft()
+            arr, tenant, sampling, t_submit, fut, rid = item
+            tokens = tuple(int(t) for t in arr)
+            rep, policy, matched = self._pick(tokens, cands)
+            self.routed_by_policy[policy] += 1
+            self.telemetry.record_route(
+                req=rid, replica=rep.name, policy=policy,
+                tenant=tenant, matched_blocks=matched,
+                outstanding=rep.inflight)
+            rep.inflight += 1
+            rep.routed += 1
+            asyncio.get_running_loop().create_task(
+                self._dispatch(rep, arr, tenant, sampling, t_submit,
+                               fut))
+
+    async def _dispatch(self, rep: ReplicaHandle, arr, tenant,
+                        sampling, t_submit: float, fut) -> None:
+        try:
+            out = await rep.inst(arr, sampling=sampling,
+                                 tenant=tenant, enqueue_ts=t_submit)
+            if not fut.done():
+                fut.set_result(out)
+        except Exception as e:  # noqa: BLE001 - surface to caller
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            rep.inflight -= 1
+            self._pump()
+
+    # -- drain ---------------------------------------------------------
+
+    async def drain(self, rep: ReplicaHandle,
+                    timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Gracefully drain one replica: stop admitting (the dispatch
+        loop skips draining replicas), wait for in-flight requests to
+        finish, and verify the engine freed every KV block.  Journals
+        a `drain` event; the caller shuts the engine down."""
+        rep.draining = True
+        n0 = rep.inflight
+        deadline = time.perf_counter() + timeout_s
+        while rep.inflight > 0 and time.perf_counter() < deadline:
+            await asyncio.sleep(0.002)
+        stats = rep.engine_stats()
+        kv = stats.get("kv_cache") or {}
+        blocks = int(kv.get("blocks_in_use", 0))
+        ok = rep.inflight == 0 and blocks == 0
+        self.telemetry.record_drain(rep.name, ok,
+                                    blocks_in_use=blocks,
+                                    drained_requests=n0)
+        return {"replica": rep.name, "ok": ok,
+                "blocks_in_use": blocks, "drained_requests": n0}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "policy": self.policy,
+            "wfq": self._wfq is not None,
+            "queue_depth": self.queue_depth(),
+            "inflight": self.total_inflight(),
+            "routed_by_policy": dict(self.routed_by_policy),
+            "max_inflight_per_replica": self._cap,
+            "tenants": {n: {"weight": t.weight,
+                            "objective": t.objective,
+                            "targets_ms": t.objectives()}
+                        for n, t in self.tenants.items()},
+        }
+
+
+#: live fleets by name — the dashboard's /api/serve/fleet surface
+#: (in-process direct-instance fleets: bench, tests, notebooks)
+_FLEETS: Dict[str, "LLMFleet"] = {}
+
+
+def fleet_registry() -> Dict[str, "LLMFleet"]:
+    return dict(_FLEETS)
+
+
+class LLMFleet:
+    """N continuous-engine replicas + router + autoscaler, one object.
+
+    Replicas are in-process engine instances from `factory` (all equal
+    configs, so the module-level jit cache compiles each program
+    once).  `await fleet(prompt, tenant=...)` routes a request;
+    `await fleet.autoscale_step()` runs one control-loop tick."""
+
+    def __init__(self, factory: Callable[[], Any], num_replicas: int,
+                 *, name: str = "llm_fleet", block_size: int = 16,
+                 tenants: Optional[Sequence[TenantClass]] = None,
+                 policy: str = "prefix", wfq: bool = True,
+                 autoscale: Optional[AutoscalePolicy] = None,
+                 max_inflight_per_replica: Optional[int] = None,
+                 seed: int = 0):
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        self.name = name
+        self._factory = factory
+        self.telemetry = EngineTelemetry(name)
+        self._replicas: List[ReplicaHandle] = []
+        self._retired: List[ReplicaHandle] = []
+        self._next_replica = itertools.count()
+        self.autoscale_policy = autoscale or AutoscalePolicy()
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_up: Optional[float] = None
+        self._last_down: Optional[float] = None
+        for _ in range(num_replicas):
+            self._add_replica()
+        self.router = LLMRouter(
+            self._replicas, block_size=block_size, tenants=tenants,
+            policy=policy, wfq=wfq,
+            max_inflight_per_replica=max_inflight_per_replica,
+            seed=seed, telemetry=self.telemetry, name=name)
+        _FLEETS[name] = self
+
+    # -- replica lifecycle ---------------------------------------------
+
+    @property
+    def num_replicas(self) -> int:
+        return len([r for r in self._replicas if not r.draining])
+
+    def _add_replica(self) -> ReplicaHandle:
+        rep = ReplicaHandle(f"{self.name}/r{next(self._next_replica)}",
+                            self._factory())
+        self._replicas.append(rep)
+        return rep
+
+    async def __call__(self, prompt, tenant: Optional[str] = None,
+                       sampling=None):
+        return await self.router.submit(prompt, tenant=tenant,
+                                        sampling=sampling)
+
+    # -- autoscaling ---------------------------------------------------
+
+    def _signals(self) -> Dict[str, float]:
+        live = [r for r in self._replicas if not r.draining]
+        burn = 0.0
+        for rep in live:
+            slo = getattr(rep.inst, "_telemetry", None)
+            slo = getattr(slo, "slo", None)
+            if slo is not None:
+                burn = max(burn, worst_burn_rate(slo.snapshot()))
+        backlog = self.router.queue_depth()
+        per_rep = backlog / max(1, len(live))
+        return {"burn_rate": round(burn, 4),
+                "queue_depth": backlog,
+                "queue_per_replica": round(per_rep, 4),
+                "inflight": self.router.total_inflight()}
+
+    async def autoscale_step(self, now: Optional[float] = None
+                             ) -> Optional[Dict[str, Any]]:
+        """One control-loop tick: read burn-rate + queue-depth
+        signals, apply the policy (sustain windows, cooldowns, min/max
+        bounds), and act — returns the action dict when the fleet
+        scaled, else None.  `now` is injectable for deterministic
+        tests; scale-down AWAITS the victim's graceful drain so a
+        returned "down" action implies zero lost requests and zero
+        resident KV blocks."""
+        p = self.autoscale_policy
+        now = time.perf_counter() if now is None else now
+        sig = self._signals()
+        n = self.num_replicas
+        reason = None
+        if sig["burn_rate"] > p.burn_threshold:
+            reason, value = "burn_rate", sig["burn_rate"]
+        elif sig["queue_per_replica"] > p.queue_high:
+            reason, value = "queue_depth", sig["queue_per_replica"]
+        if reason is not None:
+            self._idle_since = None
+            if self._breach_since is None:
+                self._breach_since = now
+            sustained = now - self._breach_since >= p.sustain_s
+            cooled = (self._last_up is None
+                      or now - self._last_up >= p.up_cooldown_s)
+            if sustained and cooled and n < p.max_replicas:
+                self._add_replica()
+                self._breach_since = None
+                self._last_up = now
+                self.telemetry.record_scale(
+                    "up", n, n + 1, reason, signal=value)
+                self.router._pump()
+                return {"action": "up", "reason": reason,
+                        "signal": value, "n_replicas": n + 1}
+            return None
+        idle = (sig["queue_depth"] == 0 and sig["inflight"] == 0
+                and sig["burn_rate"] <= p.burn_threshold)
+        if not idle:
+            self._breach_since = None
+            self._idle_since = None
+            return None
+        self._breach_since = None
+        if self._idle_since is None:
+            self._idle_since = now
+        sustained = now - self._idle_since >= p.idle_s
+        cooled = (self._last_down is None
+                  or now - self._last_down >= p.down_cooldown_s)
+        if not (sustained and cooled and n > p.min_replicas):
+            return None
+        live = [r for r in self._replicas if not r.draining]
+        victim = min(reversed(live), key=lambda r: r.inflight)
+        idle_for = now - self._idle_since
+        self._idle_since = None
+        self._last_down = now
+        self.telemetry.record_scale(
+            "down", n, n - 1, "idle", signal=idle_for,
+            replica=victim.name)
+        drain = await self.router.drain(victim)
+        self._replicas.remove(victim)
+        self._retired.append(victim)
+        victim.inst.shutdown_engine()
+        self.router._pump()
+        return {"action": "down", "reason": "idle",
+                "n_replicas": n - 1, "drain": drain}
+
+    # -- reporting -----------------------------------------------------
+
+    def tenant_report(self) -> Dict[str, Any]:
+        """Per-tenant SLO attainment over every request the fleet has
+        served (live + retired replicas): for each tenant objective
+        with a target, the fraction of samples within target plus
+        p50/p95 — the numbers bench/sweep publish as
+        `{tenant}_{obj}_slo_attainment`."""
+        out: Dict[str, Any] = {}
+        reps = self._replicas + self._retired
+        for tc in self.router.tenants.values():
+            merged: Dict[str, List[float]] = {}
+            for rep in reps:
+                tele = getattr(rep.inst, "_telemetry", None)
+                if tele is None:
+                    continue
+                for obj, series in tele.slo_samples(
+                        tenant=tc.name).items():
+                    merged.setdefault(obj, []).extend(
+                        v for _ts, v in series)
+            objectives = {}
+            for obj, target in tc.objectives().items():
+                vals = merged.get(obj, [])
+                ok = sum(1 for v in vals if v <= target)
+                objectives[obj] = {
+                    "target_ms": target,
+                    "samples": len(vals),
+                    "attainment": round(ok / len(vals), 4)
+                    if vals else None,
+                    "latency_ms": _core.summarize(vals),
+                }
+            out[tc.name] = {
+                "weight": tc.weight,
+                "objective": tc.objective,
+                "requests": len(merged.get("e2e", [])),
+                "objectives": objectives,
+            }
+        return out
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        """The dashboard /api/serve/fleet document: router counters,
+        autoscaler state, per-replica engine summaries, and the
+        fleet-wide prefix hit rate (pooled over replicas)."""
+        hits = misses = 0
+        replicas = {}
+        for rep in self._replicas + self._retired:
+            st = rep.engine_stats()
+            kv = st.get("kv_cache") or {}
+            hits += int(kv.get("prefix_block_hits", 0))
+            misses += int(kv.get("prefix_block_misses", 0))
+            replicas[rep.name] = {
+                "draining": rep.draining,
+                "retired": rep in self._retired,
+                "inflight": rep.inflight,
+                "routed": rep.routed,
+                "requests": st.get("requests"),
+                "kv_cache": kv,
+                "slo_breached": (st.get("slo") or {}).get("breached")
+                if st.get("slo") else None,
+            }
+        total = hits + misses
+        return {
+            "name": self.name,
+            "num_replicas": self.num_replicas,
+            "router": self.router.stats(),
+            "autoscale": dataclasses.asdict(self.autoscale_policy),
+            "signals": self._signals(),
+            "prefix_hit_rate": round(hits / total, 4) if total
+            else 0.0,
+            "tenants": self.tenant_report(),
+            "replicas": replicas,
+            "flightrec": self.telemetry.flightrec.stats(),
+        }
+
+    def shutdown(self) -> None:
+        """Stop every engine (live and retired) and deregister."""
+        for rep in self._replicas + self._retired:
+            try:
+                rep.inst.shutdown_engine()
+            except Exception:  # noqa: BLE001 - already dead
+                pass
+        _FLEETS.pop(self.name, None)
+
+
+def build_llm_fleet(family: str = "gpt2", preset: str = "nano", *,
+                    num_replicas: int = 2,
+                    tenants: Optional[Sequence[TenantClass]] = None,
+                    routing: str = "prefix", wfq: bool = True,
+                    autoscale: Optional[AutoscalePolicy] = None,
+                    max_inflight_per_replica: Optional[int] = None,
+                    fleet_name: Optional[str] = None, seed: int = 0,
+                    **engine_kw) -> LLMFleet:
+    """Stand up `num_replicas` independent continuous-engine replicas
+    (each its own jitted programs / BlockPager / SLOTracker) behind an
+    `LLMRouter`.  `engine_kw` is forwarded to `build_llm_deployment`;
+    the continuous scheduler and paged KV layout are forced on (prefix
+    routing needs the pager's key export — a dense-layout fleet would
+    route by load only).  `max_inflight_per_replica` defaults to the
+    engine's `max_slots`, keeping any backlog at the router where WFQ
+    can reorder it."""
+    from ray_tpu.serve.llm import build_llm_deployment
+
+    engine_kw.setdefault("scheduler", "continuous")
+    engine_kw.setdefault("kv_layout", "paged")
+    max_slots = int(engine_kw.get("max_slots", 4))
+    if max_inflight_per_replica is None:
+        max_inflight_per_replica = max_slots
+    dep = build_llm_deployment(family, preset, **engine_kw)
+    return LLMFleet(
+        dep.func_or_class, num_replicas,
+        name=fleet_name or f"fleet_{family}_{preset}",
+        block_size=int(engine_kw.get("kv_block_size", 16)),
+        tenants=tenants, policy=routing, wfq=wfq,
+        autoscale=autoscale,
+        max_inflight_per_replica=max_inflight_per_replica, seed=seed)

@@ -36,7 +36,24 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["SLOConfig", "SLOTracker"]
+__all__ = ["SLOConfig", "SLOTracker", "worst_burn_rate"]
+
+
+def worst_burn_rate(slo_block) -> float:
+    """Max burn rate across objectives in an ``SLOTracker.snapshot()``
+    / ``engine_stats()["slo"]`` block — the scalar the fleet autoscaler
+    (serve/router.py) and the controller's "burn_rate" load signal
+    consume.  0.0 for engines without an SLO config (None block) or
+    malformed blocks, so callers can feed it unconditionally."""
+    if not isinstance(slo_block, dict):
+        return 0.0
+    worst = 0.0
+    for obj in (slo_block.get("objectives") or {}).values():
+        try:
+            worst = max(worst, float(obj.get("burn_rate", 0.0)))
+        except (TypeError, ValueError):
+            continue
+    return worst
 
 _metrics_lock = threading.Lock()
 _metrics: Optional[Dict[str, Any]] = None

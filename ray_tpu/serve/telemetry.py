@@ -200,14 +200,20 @@ class EngineTelemetry:
     # -- lifecycle ---------------------------------------------------------
 
     def record_enqueue(self, prompt_len: int,
-                       now: Optional[float] = None) -> Dict[str, Any]:
+                       now: Optional[float] = None,
+                       tenant: Optional[str] = None) -> Dict[str, Any]:
+        """`tenant` tags the record for per-tenant SLO slicing (fleet
+        router traffic classes); `now` may be BACKDATED to the instant
+        the request entered the fleet router, so TTFT/e2e/queue-wait
+        series charge router queueing to the request — the fleet-level
+        latency a client actually observed, not just engine wait."""
         now = self._now(now)
         rec: Dict[str, Any] = {
             "id": next(self._ids), "prompt_len": int(prompt_len),
             "enqueue": now, "admit": None, "first_token": None,
             "finish": None, "slot": None, "bucket": None, "tokens": 0,
             "spec_proposed": 0, "spec_accepted": 0,
-            "status": "queued", "trace": None,
+            "status": "queued", "trace": None, "tenant": tenant,
         }
         if tracing.is_enabled():
             rec["trace"] = tracing.record_span(
@@ -391,6 +397,50 @@ class EngineTelemetry:
         self._m["kv_blocks_in_use"].set(
             int(stats.get("blocks_in_use", 0)), tags=self._tags)
 
+    # -- fleet control plane (serve/router.py journals through here) -------
+
+    def record_route(self, req: int, replica: str, policy: str,
+                     tenant: Optional[str] = None,
+                     matched_blocks: int = 0,
+                     outstanding: int = 0,
+                     now: Optional[float] = None) -> None:
+        """One routing decision: request `req` dispatched to `replica`
+        under `policy` ("prefix_affinity" | "p2c" | "round_robin"),
+        having matched `matched_blocks` resident prefix blocks there.
+        `outstanding` is the replica's in-flight count at dispatch —
+        the load the power-of-two-choices fallback compared."""
+        self.flightrec.record(
+            "route", ts=now, req=int(req), replica=str(replica),
+            policy=str(policy), tenant=tenant,
+            matched_blocks=int(matched_blocks),
+            outstanding=int(outstanding))
+
+    def record_scale(self, direction: str, n_before: int, n_after: int,
+                     reason: str, signal: float = 0.0,
+                     replica: Optional[str] = None,
+                     now: Optional[float] = None) -> None:
+        """One autoscaling decision.  `direction` is "up" or "down"
+        (journaled as the `scale_up` / `scale_down` event kinds),
+        `reason` names the tripped signal ("burn_rate" | "queue_depth"
+        | "idle"), `signal` its value at the decision."""
+        kind = "scale_up" if direction == "up" else "scale_down"
+        self.flightrec.record(
+            kind, ts=now, n_before=int(n_before), n_after=int(n_after),
+            reason=str(reason), signal=round(float(signal), 4),
+            replica=replica)
+
+    def record_drain(self, replica: str, ok: bool,
+                     blocks_in_use: int = 0, drained_requests: int = 0,
+                     now: Optional[float] = None) -> None:
+        """Graceful-drain outcome for one replica: admission was
+        stopped, `drained_requests` in-flight requests finished, and
+        `blocks_in_use` KV blocks remained after retirement (0 on a
+        clean drain)."""
+        self.flightrec.record(
+            "drain", ts=now, replica=str(replica), ok=bool(ok),
+            blocks_in_use=int(blocks_in_use),
+            drained_requests=int(drained_requests))
+
     def record_error(self, rec: Dict[str, Any], error: str = "",
                      now: Optional[float] = None) -> None:
         rec["finish"] = self._now(now)
@@ -412,14 +462,19 @@ class EngineTelemetry:
 
     # -- sinks -------------------------------------------------------------
 
-    def slo_samples(self) -> Dict[str, List[tuple]]:
+    def slo_samples(self, tenant: Optional[str] = None
+                    ) -> Dict[str, List[tuple]]:
         """(event_ts, value_ms) series per SLO objective over the
         retained records — the raw stream serve/slo.py's burn-rate
         windows slice.  Timestamps are the perf_counter instant each
         value became OBSERVABLE (first token, admit, finish), so a
-        window query sees exactly what a live observer saw."""
+        window query sees exactly what a live observer saw.  With
+        `tenant` the series are restricted to that traffic class's
+        records (fleet per-tenant attainment); default is all."""
         with self._lock:
             recs = list(self._done) + list(self._active.values())
+        if tenant is not None:
+            recs = [r for r in recs if r.get("tenant") == tenant]
         out: Dict[str, List[tuple]] = {"ttft": [], "e2e": [],
                                        "queue_wait": []}
         for r in recs:

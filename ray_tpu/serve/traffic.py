@@ -33,8 +33,57 @@ import numpy as np
 from ray_tpu._private.telemetry import summarize
 from ray_tpu.serve.batching import OverloadedError
 
-__all__ = ["TrafficSpec", "TrafficRequest", "TrafficGenerator",
-           "drive", "run_traffic"]
+__all__ = ["TrafficSpec", "TenantSpec", "TrafficRequest",
+           "TrafficGenerator", "drive", "drive_fleet", "run_traffic",
+           "run_traffic_fleet"]
+
+#: default WFQ weights by SLO class — interactive overtakes batch
+#: whenever both are backlogged at the fleet router
+_CLASS_WEIGHTS = {"interactive": 8.0, "batch": 1.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One traffic class inside a multi-tenant mixture.
+
+    `rate_share` is the tenant's relative share of the spec's offered
+    rate (normalized over all tenants); `slo_class` picks the fleet
+    router's default WFQ weight ("interactive" | "batch", overridable
+    via `weight`); `prefix_groups` restricts the tenant to a subset of
+    the spec's shared-prefix pool (its own "system prompts" — empty =
+    the whole pool); `ttft_slo_ms` / `e2e_slo_ms` are the per-tenant
+    latency targets scored by ``LLMFleet.tenant_report()``."""
+
+    name: str
+    rate_share: float = 1.0
+    slo_class: str = "interactive"
+    prefix_groups: tuple = ()
+    ttft_slo_ms: Optional[float] = None
+    e2e_slo_ms: Optional[float] = None
+    objective: float = 0.95
+    weight: Optional[float] = None
+
+    def __post_init__(self):
+        if self.rate_share <= 0:
+            raise ValueError(f"tenant {self.name!r}: rate_share must "
+                             "be > 0")
+        if self.slo_class not in _CLASS_WEIGHTS:
+            raise ValueError(
+                f"tenant {self.name!r}: slo_class must be one of "
+                f"{sorted(_CLASS_WEIGHTS)}, got {self.slo_class!r}")
+        object.__setattr__(self, "prefix_groups",
+                           tuple(int(g) for g in self.prefix_groups))
+
+    def to_class(self):
+        """The router-side TenantClass this spec maps to."""
+        from ray_tpu.serve.router import TenantClass
+
+        return TenantClass(
+            self.name,
+            weight=self.weight if self.weight is not None
+            else _CLASS_WEIGHTS[self.slo_class],
+            ttft_ms=self.ttft_slo_ms, e2e_ms=self.e2e_slo_ms,
+            objective=self.objective)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,6 +109,11 @@ class TrafficSpec:
     tail_len_mean: float = 8.0
     tail_len_max: int = 24
     vocab: int = 256
+    #: multi-tenant mixture: each request is assigned a tenant in
+    #: proportion to rate_share, drawing its shared prefix from the
+    #: tenant's pool.  Empty = legacy single-class traffic (the RNG
+    #: stream is then bit-identical to pre-tenant specs).
+    tenants: tuple = ()
 
     def __post_init__(self):
         if self.num_requests < 1:
@@ -68,6 +122,16 @@ class TrafficSpec:
             raise ValueError("rate_rps must be > 0")
         if not 0.0 <= self.p_shared <= 1.0:
             raise ValueError("p_shared must be in [0, 1]")
+        object.__setattr__(self, "tenants", tuple(self.tenants))
+        names = [t.name for t in self.tenants]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate tenant names: {names}")
+        for t in self.tenants:
+            for g in t.prefix_groups:
+                if not 0 <= g < self.num_prefix_groups:
+                    raise ValueError(
+                        f"tenant {t.name!r}: prefix group {g} out of "
+                        f"range [0, {self.num_prefix_groups})")
 
 
 @dataclasses.dataclass
@@ -75,6 +139,7 @@ class TrafficRequest:
     arrival_s: float          # offset from the start of the run
     prompt: np.ndarray        # int32 (len,)
     group: int                # shared-prefix group id, -1 = unique
+    tenant: str = ""          # traffic class, "" = untagged
 
 
 class TrafficGenerator:
@@ -95,8 +160,20 @@ class TrafficGenerator:
         inter = rng.exponential(1.0 / spec.rate_rps,
                                 size=spec.num_requests)
         arrivals = np.cumsum(inter)
+        shares = None
+        if spec.tenants:
+            shares = np.array([t.rate_share for t in spec.tenants],
+                              dtype=np.float64)
+            shares = np.cumsum(shares / shares.sum())
         out: List[TrafficRequest] = []
         for i in range(spec.num_requests):
+            tenant, pool = "", None
+            if shares is not None:
+                idx = min(int(np.searchsorted(shares, rng.rand())),
+                          len(spec.tenants) - 1)
+                t = spec.tenants[idx]
+                tenant = t.name
+                pool = t.prefix_groups or None
             tail_len = 1 + min(int(rng.poisson(
                 max(spec.tail_len_mean - 1.0, 0.0))),
                 spec.tail_len_max - 1)
@@ -104,12 +181,15 @@ class TrafficGenerator:
                                size=tail_len).astype(np.int32)
             if spec.num_prefix_groups > 0 \
                     and rng.rand() < spec.p_shared:
-                group = int(rng.randint(spec.num_prefix_groups))
+                if pool is not None:
+                    group = int(pool[rng.randint(len(pool))])
+                else:
+                    group = int(rng.randint(spec.num_prefix_groups))
                 prompt = np.concatenate([self.prefixes[group], tail])
             else:
                 group, prompt = -1, tail
             out.append(TrafficRequest(float(arrivals[i]), prompt,
-                                      group))
+                                      group, tenant))
         return out
 
 
@@ -232,4 +312,105 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         sp = eng.get("spec") or {}
         report["spec_accept_rate"] = sp.get("accept_rate")
         report["spec_rounds"] = sp.get("rounds")
+    return report
+
+
+async def drive_fleet(fleet, requests: List[TrafficRequest], *,
+                      time_scale: float = 1.0) -> Dict[str, Any]:
+    """:func:`drive` for an :class:`~ray_tpu.serve.router.LLMFleet`:
+    requests carry their tenant tag into the router (WFQ class +
+    per-tenant SLO slicing).  Client-side latency percentiles are
+    reported overall and per tenant; engine-side per-tenant attainment
+    comes from ``fleet.tenant_report()`` afterwards."""
+    import asyncio
+
+    t0 = time.perf_counter()
+
+    async def one(req: TrafficRequest) -> Dict[str, Any]:
+        delay = req.arrival_s * time_scale - (time.perf_counter() - t0)
+        if delay > 0:
+            await asyncio.sleep(delay)
+        start = time.perf_counter()
+        try:
+            await fleet(req.prompt, tenant=req.tenant or None)
+        except OverloadedError:
+            return {"shed": True, "tenant": req.tenant,
+                    "latency_ms": None}
+        return {"shed": False, "tenant": req.tenant,
+                "latency_ms": (time.perf_counter() - start) * 1e3}
+
+    results = await asyncio.gather(*[one(r) for r in requests])
+    lat = [r["latency_ms"] for r in results if not r["shed"]]
+    by_tenant: Dict[str, List[float]] = {}
+    for r in results:
+        if not r["shed"]:
+            by_tenant.setdefault(r["tenant"] or "default",
+                                 []).append(r["latency_ms"])
+    return {
+        "offered": len(requests),
+        "completed": len(lat),
+        "shed": sum(1 for r in results if r["shed"]),
+        "latency_ms": summarize(lat),
+        "latency_ms_by_tenant": {t: summarize(v)
+                                 for t, v in by_tenant.items()},
+        "wall_s": round(time.perf_counter() - t0, 4),
+    }
+
+
+def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
+                      family: str = "gpt2", preset: str = "nano",
+                      kv_block_size: int = 16, max_slots: int = 4,
+                      max_new_tokens: int = 8,
+                      prefill_bucket: int = 16,
+                      time_scale: float = 0.0,
+                      routing: str = "prefix", wfq: bool = True,
+                      autoscale=None, slo=None, admission_policy=None,
+                      mesh=None,
+                      config_overrides: Optional[Dict[str, Any]] = None
+                      ) -> Dict[str, Any]:
+    """One multi-tenant traffic run against a fresh in-process fleet
+    (``build_llm_fleet``): N paged continuous engines behind the
+    prefix-affinity router with WFQ tenant classes.  The report merges
+    the client-side :func:`drive_fleet` numbers with the fleet's own
+    stats — ``router_prefix_hit_rate`` (pooled over replicas) and
+    ``tenants`` (per-tenant SLO attainment) are the headline fields
+    bench/sweep publish."""
+    import asyncio
+
+    from ray_tpu.serve.router import build_llm_fleet
+
+    fleet = build_llm_fleet(
+        family, preset, num_replicas=num_replicas,
+        tenants=[t.to_class() for t in spec.tenants],
+        routing=routing, wfq=wfq, autoscale=autoscale,
+        max_slots=max_slots, max_new_tokens=max_new_tokens,
+        temperature=0.0, prefill_bucket=prefill_bucket,
+        kv_block_size=kv_block_size, slo=slo,
+        admission_policy=admission_policy, mesh=mesh,
+        config_overrides=config_overrides)
+    requests = TrafficGenerator(spec).requests()
+
+    async def main():
+        try:
+            report = await drive_fleet(fleet, requests,
+                                       time_scale=time_scale)
+            report["fleet"] = fleet.fleet_stats()
+        finally:
+            fleet.shutdown()
+        return report
+
+    report = asyncio.run(main())
+    report["spec"] = dataclasses.asdict(spec)
+    report["num_replicas"] = num_replicas
+    report["routing"] = routing
+    report["wfq"] = wfq
+    report["router_prefix_hit_rate"] = \
+        report["fleet"]["prefix_hit_rate"]
+    report["tenants"] = report["fleet"]["tenants"]
+    #: flattened for SWEEPJSON consumers: {tenant}_{obj}_slo_attainment
+    flat: Dict[str, Any] = {}
+    for tname, blk in report["tenants"].items():
+        for obj, o in blk["objectives"].items():
+            flat[f"{tname}_{obj}_slo_attainment"] = o["attainment"]
+    report["tenant_slo_attainment"] = flat
     return report

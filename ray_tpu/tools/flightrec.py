@@ -5,9 +5,11 @@ postmortem files the SLO watchdog / engine crash handler write
 (``_private/flightrec.py`` ``dump()``):
 
 * ``report``    — human summary: trigger, event counts by kind, drop
-  counter, step-duration percentiles, recent sheds/errors, and the
+  counter, step-duration percentiles, recent sheds/errors, the
   breaching objective's burn rates when the dump carries an SLO
-  context.  Exits 0 on a readable dump — scripts gate on it.
+  context, and — on fleet dumps (serve/router.py) — the per-replica
+  routing table plus the last scale-up/scale-down/drain decisions.
+  Exits 0 on a readable dump — scripts gate on it.
 * ``events``    — the journal itself, filtered (``--kind``,
   ``--last``, ``--since/--until`` seconds) and printed one JSON
   object per line for ``jq`` piping; the correlate workflow is
@@ -107,6 +109,43 @@ def report_lines(doc: Dict[str, Any]) -> List[str]:
         lines.append(f"recompile storm: program={ctx['program']}")
     if ctx.get("error"):
         lines.append(f"engine error: {ctx['error']}")
+    # fleet routing table: aggregate the router's `route` events per
+    # replica so a postmortem shows where traffic actually landed and
+    # why (prefix affinity vs. load fallback vs. round-robin)
+    routes = [e for e in events if e.get("kind") == "route"]
+    if routes:
+        table: Dict[str, Dict[str, Any]] = {}
+        for e in routes:
+            row = table.setdefault(str(e.get("replica", "?")), {
+                "routed": 0, "prefix_affinity": 0, "p2c": 0,
+                "round_robin": 0, "matched_blocks": 0,
+                "tenants": set()})
+            row["routed"] += 1
+            policy = str(e.get("policy", "?"))
+            if policy in row:
+                row[policy] += 1
+            row["matched_blocks"] += int(e.get("matched_blocks", 0))
+            if e.get("tenant"):
+                row["tenants"].add(str(e["tenant"]))
+        lines.append("routing table (route events by replica):")
+        lines.append("  replica  routed  prefix  p2c  rr  "
+                     "matched_blocks  tenants")
+        for name in sorted(table):
+            row = table[name]
+            tenants = ",".join(sorted(row["tenants"])) or "-"
+            lines.append(
+                f"  {name}  {row['routed']}  "
+                f"{row['prefix_affinity']}  {row['p2c']}  "
+                f"{row['round_robin']}  {row['matched_blocks']}  "
+                f"{tenants}")
+    for label, kind in (("scale-ups", "scale_up"),
+                        ("scale-downs", "scale_down"),
+                        ("drains", "drain")):
+        tail = filter_events(events, kinds=[kind], last=3)
+        if tail:
+            lines.append(f"last {label}:")
+            for e in tail:
+                lines.append("  " + json.dumps(e, sort_keys=True))
     for label, kind in (("sheds", "shed"), ("errors", "error"),
                         ("requeues", "requeue"),
                         ("pool exhaustions", "kv_exhausted")):

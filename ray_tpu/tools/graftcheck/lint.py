@@ -21,7 +21,10 @@ Rule ids:
   device or cluster round-trip.  Deliberate host fences carry a
   disable comment naming the reason.
 * ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``,
-  ``util/tracing.py``, ``_private/flightrec.py`` or ``serve/slo.py``:
+  ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py`` or
+  ``serve/router.py`` (the fleet router timestamps routing/autoscale
+  decisions and measures drain deadlines — interval math like the
+  rest):
   telemetry takes an injectable ``now`` (tests drive deterministic
   clocks) and intervals must use the monotonic ``perf_counter`` —
   the flight-recorder journal and SLO burn-rate windows are interval
@@ -124,7 +127,8 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
     if not (rel_posix.endswith("/telemetry.py")
             or rel_posix.endswith("util/tracing.py")
             or rel_posix.endswith("_private/flightrec.py")
-            or rel_posix.endswith("serve/slo.py")):
+            or rel_posix.endswith("serve/slo.py")
+            or rel_posix.endswith("serve/router.py")):
         return []
     out: List[Violation] = []
     for node in ast.walk(tree):

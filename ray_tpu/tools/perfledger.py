@@ -57,6 +57,13 @@ _SWEEP_FIELDS = (
     "decode_tok_s_chip", "prefix_hit_rate", "slo_attainment",
     "ttft_slo_attainment", "e2e_slo_attainment", "spec_accept_rate",
     "latency_p50_ms", "latency_p95_ms",
+    # traffic_fleet records: router-pooled hit rate + per-tenant
+    # attainment (all fractions — higher is better via the
+    # slo_attainment override, including the ttft-named ones)
+    "router_prefix_hit_rate",
+    "interactive_ttft_slo_attainment",
+    "interactive_e2e_slo_attainment",
+    "batch_ttft_slo_attainment", "batch_e2e_slo_attainment",
 )
 
 #: substrings marking a metric where SMALLER is better

@@ -204,6 +204,100 @@ def _run_traffic_variant(max_slots, kw, out):
     return rec
 
 
+def _run_traffic_fleet_variant(max_slots, kw, out):
+    """One {"mode": "traffic_fleet"} sweep entry → SWEEPJSON record.
+
+    Drives a multi-replica router fleet (prefix-affinity routing +
+    per-tenant WFQ) and surfaces the two fleet headline numbers at the
+    record's top level — ``router_prefix_hit_rate`` and the flattened
+    ``{tenant}_{obj}_slo_attainment`` fields — because perfledger's
+    extract_metrics only lifts top-level sweep-record keys."""
+    from ray_tpu.serve.slo import SLOConfig
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    replicas = kw.pop("replicas", 2)
+    routing = kw.pop("routing", "prefix")
+    wfq = kw.pop("wfq", True)
+    ttft_slo_ms = kw.pop("ttft_slo_ms", None)
+    e2e_slo_ms = kw.pop("e2e_slo_ms", None)
+    latency_slo_ms = kw.pop("latency_slo_ms", 20000.0)
+    if ttft_slo_ms is None:
+        ttft_slo_ms = latency_slo_ms / 2
+    if e2e_slo_ms is None:
+        e2e_slo_ms = latency_slo_ms
+    groups = kw.pop("prefix_groups", 4)
+    # default tenant mix: latency-sensitive interactive tenant on the
+    # first half of the prefix pools, throughput batch tenant (loose
+    # e2e-only objective) on the second half
+    lo = tuple(range(groups // 2)) or (0,)
+    hi = tuple(range(groups // 2, groups)) or (0,)
+    p_int = min(max(kw.pop("p_interactive", 0.5), 0.01), 0.99)
+    tenants = (
+        TenantSpec("interactive", rate_share=p_int,
+                   slo_class="interactive", prefix_groups=lo,
+                   ttft_slo_ms=ttft_slo_ms, e2e_slo_ms=e2e_slo_ms),
+        TenantSpec("batch", rate_share=1.0 - p_int,
+                   slo_class="batch", prefix_groups=hi,
+                   e2e_slo_ms=2 * e2e_slo_ms),
+    )
+    spec = TrafficSpec(
+        num_requests=kw.pop("requests", 64),
+        seed=kw.pop("seed", 0),
+        rate_rps=kw.pop("rate_rps", 32.0),
+        num_prefix_groups=groups,
+        prefix_len=kw.pop("prefix_len", 256),
+        p_shared=kw.pop("p_shared", 0.75),
+        tail_len_mean=kw.pop("tail_len_mean", 32.0),
+        tail_len_max=kw.pop("tail_len_max", 128),
+        vocab=kw.pop("vocab", 50000),
+        tenants=tenants)
+    run_kw = {
+        "preset": kw.pop("preset", "gpt2"),
+        "kv_block_size": kw.pop("block_size", 16),
+        "max_new_tokens": kw.pop("new_tokens", 64),
+        "prefill_bucket": kw.pop("prefill_bucket", 128),
+        "time_scale": kw.pop("time_scale", 1.0),
+    }
+    slo_cfg = SLOConfig(ttft_ms=ttft_slo_ms, e2e_ms=e2e_slo_ms)
+    variant = {"mode": "traffic_fleet", "max_slots": max_slots,
+               "replicas": replicas, "routing": routing, "wfq": wfq,
+               "requests": spec.num_requests,
+               "prefix_len": spec.prefix_len,
+               "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
+               "preset": run_kw["preset"], "overrides": kw}
+    try:
+        rep = run_traffic_fleet(spec, num_replicas=replicas,
+                                family="gpt2", max_slots=max_slots,
+                                routing=routing, wfq=wfq, slo=slo_cfg,
+                                config_overrides=kw or None, **run_kw)
+        print(f"traffic_fleet slots={max_slots} replicas={replicas} "
+              f"routing={routing} wfq={wfq} n={rep['offered']}: "
+              f"router_hit_rate={rep['router_prefix_hit_rate']} "
+              f"shed={rep['shed']}", file=out, flush=True)
+        rec = {"sweep": variant,
+               "router_prefix_hit_rate":
+                   rep["router_prefix_hit_rate"],
+               "completed": rep["completed"], "shed": rep["shed"],
+               "latency_p50_ms": rep["latency_ms"]["p50"],
+               "latency_p95_ms": rep["latency_ms"]["p95"],
+               "fleet": {
+                   "num_replicas": rep["num_replicas"],
+                   "routed_by_policy":
+                       rep["fleet"]["router"]["routed_by_policy"],
+                   "tenants": rep["tenants"]}}
+        # flatten {tenant}_{obj}_slo_attainment to the top level so
+        # perfledger picks them up as trend series
+        rec.update(rep.get("tenant_slo_attainment") or {})
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        print(f"traffic_fleet slots={max_slots} replicas={replicas} "
+              f"{kw}: FAILED {type(e).__name__}: {str(e)[:160]}",
+              file=out, flush=True)
+        rec = {"sweep": variant, "failed": _failure_tag(e),
+               "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    return rec
+
+
 def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
               audit=False, ledger=True, ledger_path=None):
     """Run each [batch_per_chip, overrides] variant; returns the list of
@@ -322,6 +416,11 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
             continue
         if mode == "traffic":
             rec = _run_traffic_variant(batch_per_chip, kw, out)
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
+        if mode == "traffic_fleet":
+            rec = _run_traffic_fleet_variant(batch_per_chip, kw, out)
             print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
             records.append(rec)
             continue

@@ -390,6 +390,38 @@ def test_report_lines_summarize_breach():
     assert "last sheds:" in text and "queue full" in text
 
 
+def test_report_lines_render_fleet_routing_table():
+    doc = _synthetic_doc()
+    doc["events"] = doc["events"] + [
+        {"seq": 6, "t_s": 0.6, "kind": "route", "req": 0,
+         "replica": "fleet/r0", "policy": "prefix_affinity",
+         "tenant": "interactive", "matched_blocks": 3,
+         "outstanding": 0},
+        {"seq": 7, "t_s": 0.7, "kind": "route", "req": 1,
+         "replica": "fleet/r1", "policy": "p2c", "tenant": "batch",
+         "matched_blocks": 0, "outstanding": 1},
+        {"seq": 8, "t_s": 0.8, "kind": "route", "req": 2,
+         "replica": "fleet/r0", "policy": "prefix_affinity",
+         "tenant": "batch", "matched_blocks": 2, "outstanding": 1},
+        {"seq": 9, "t_s": 0.9, "kind": "scale_up", "n_before": 2,
+         "n_after": 3, "reason": "burn_rate", "signal": 4.2},
+        {"seq": 10, "t_s": 1.0, "kind": "scale_down", "n_before": 3,
+         "n_after": 2, "reason": "idle", "signal": 31.0,
+         "replica": "fleet/r2"},
+        {"seq": 11, "t_s": 1.1, "kind": "drain", "replica": "fleet/r2",
+         "ok": True, "blocks_in_use": 0, "drained_requests": 0},
+    ]
+    text = "\n".join(report_lines(doc))
+    assert "routing table (route events by replica):" in text
+    # per-replica aggregation: r0 got 2 prefix-affinity routes with
+    # 3+2 matched blocks across both tenants; r1 one p2c fallback
+    assert "fleet/r0  2  2  0  0  5  batch,interactive" in text
+    assert "fleet/r1  1  0  1  0  0  batch" in text
+    assert "last scale-ups:" in text and '"reason": "burn_rate"' in text
+    assert "last scale-downs:" in text and '"reason": "idle"' in text
+    assert "last drains:" in text and '"blocks_in_use": 0' in text
+
+
 def test_trace_events_merge_and_lane():
     doc = _synthetic_doc()
     base = [{"ph": "X", "name": "engine step", "pid": 1}]

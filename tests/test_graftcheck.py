@@ -447,6 +447,32 @@ def test_lint_wallclock_covers_flightrec_and_slo():
     assert not kept
 
 
+def test_lint_fleet_router_in_both_rule_scopes():
+    # round 11: the fleet router schedules WFQ virtual time and
+    # journals routing decisions — both the monotonic-clock and the
+    # no-blocking-in-async invariants extend to it
+    wall = textwrap.dedent("""\
+        import time
+
+        def record_route(req):
+            return time.time()
+    """)
+    kept, _ = lint_source(wall, "ray_tpu/serve/router.py")
+    assert [v.rule for v in kept] == ["wallclock-in-telemetry"]
+    kept, _ = lint_source(wall.replace("time.time()",
+                                       "time.perf_counter()"),
+                          "ray_tpu/serve/router.py")
+    assert not kept
+    block = textwrap.dedent("""\
+        import numpy as np
+
+        async def submit(prompt):
+            return np.asarray(prompt)
+    """)
+    kept, _ = lint_source(block, "ray_tpu/serve/router.py")
+    assert [v.rule for v in kept] == ["blocking-call-in-async"]
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote

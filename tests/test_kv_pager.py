@@ -164,6 +164,41 @@ def test_register_prefix_first_writer_wins():
     assert p.blocks_cached == 1
 
 
+def test_prefix_keys_export_content_and_counter():
+    p = _pager()
+    assert p.prefix_keys() == []       # empty index, no keys
+    a = p.allocate(1)
+    b = p.allocate(1)
+    p.register_prefix([1, 2, 3, 4], a)
+    p.register_prefix([5, 6, 7, 8], b)
+    keys = p.prefix_keys()
+    assert sorted(keys) == [(1, 2, 3, 4), (5, 6, 7, 8)]
+    # every exported key is hashable router material
+    assert all(isinstance(k, tuple) for k in keys)
+    # the export counter accumulates per call (0 + 2 + 2)
+    assert p.prefix_keys_exported == 2
+    p.prefix_keys()
+    assert p.prefix_keys_exported == 4
+    s = p.stats()
+    assert s["prefix_keys_resident"] == 2
+    assert s["prefix_keys_exported"] == 4
+    p.release(a)
+    p.release(b)
+
+
+def test_prefix_keys_track_eviction_and_deregistration():
+    p = _pager(num_blocks=6, block_size=4, max_seq=16)  # 5 usable
+    a, b = p.allocate(1), p.allocate(1)
+    p.register_prefix([1, 2, 3, 4], a)
+    p.register_prefix([5, 6, 7, 8], b)
+    p.release(a)
+    p.release(b)
+    got = p.allocate(4)                # evicts the colder prefix (a)
+    assert p.evictions == 1
+    assert p.prefix_keys() == [(5, 6, 7, 8)]
+    p.release(got)
+
+
 def test_stats_shape_and_hit_rate():
     p = _pager()
     prompt = list(range(8))

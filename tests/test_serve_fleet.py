@@ -1,0 +1,318 @@
+"""Fleet control plane acceptance: prefix-affinity routing, weighted
+fair queueing, and SLO-driven autoscaling (serve/router.py).
+
+Three end-to-end scenarios over real in-process engine replicas:
+
+1. **Prefix affinity** — on a shared-prefix mix, the prefix-affinity
+   fleet's pooled KV hit rate strictly exceeds round-robin's (which
+   re-prefills each system prompt on every replica it scatters to),
+   while greedy outputs stay bit-identical to the dense single-engine
+   oracle — routing is a pure placement decision, never a semantic
+   one.
+2. **WFQ isolation** — a saturating batch tenant cannot starve an
+   interactive tenant: with WFQ the interactive TTFT attainment stays
+   above its objective; the same flood through a round-robin fleet
+   without WFQ breaches it.  TTFT here includes router queueing (the
+   router threads its submit instant to the engine as the enqueue
+   time), so the scheduler's reordering is what the metric sees.
+3. **Autoscaling** — a burn-rate breach scales up within the policy's
+   sustain window; sustained idle scales back down through a graceful
+   drain (zero lost requests, zero resident KV blocks), and every
+   decision is visible in the flight-recorder dump.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.serve.router import (AutoscalePolicy, FairQueue,
+                                  TenantClass,
+                                  build_llm_fleet)  # noqa: E402
+from ray_tpu.serve.slo import SLOConfig  # noqa: E402
+from ray_tpu.tools.flightrec import (load_dump,
+                                     report_lines)  # noqa: E402
+
+MAX_NEW = 6
+_OVR = {"dtype": jnp.float32, "use_flash": False, "remat": False}
+_ENGINE_KW = dict(max_new_tokens=MAX_NEW, temperature=0.0,
+                  kv_block_size=16, prefill_bucket=16, max_slots=2,
+                  config_overrides=_OVR)
+
+
+def _fleet(name, **kw):
+    kw = {**_ENGINE_KW, **kw}
+    return build_llm_fleet("gpt2", "nano", fleet_name=name, **kw)
+
+
+def _oracle(prompt, max_new=MAX_NEW):
+    """Dense solo greedy continuation — the parity reference."""
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import generate
+
+    cfg = gpt2_config("nano", **_OVR)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    out = generate(params, jnp.asarray(np.asarray(prompt)[None]), cfg,
+                   max_new_tokens=max_new, temperature=0.0)
+    return np.asarray(out)[0]
+
+
+def _shared_prefix_mix(n_groups=3, per_group=4, prefix_len=32,
+                       seed=11):
+    """Shuffled multi-group shared-prefix workload: every request is
+    one group's 2-full-block system prompt plus a tiny unique tail."""
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(2, 500, prefix_len)
+                for _ in range(n_groups)]
+    order = rng.permutation(np.repeat(np.arange(n_groups), per_group))
+    return [np.concatenate(
+        [prefixes[g], rng.randint(2, 500, 2 + int(rng.randint(3)))]
+    ).astype(np.int32) for g in order]
+
+
+def _drive_sequential(fleet, prompts, tenant=None):
+    async def main():
+        try:
+            return [await fleet(p, tenant=tenant) for p in prompts]
+        finally:
+            fleet.shutdown()
+
+    return asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# FairQueue unit semantics (host-only, no engines)
+# ---------------------------------------------------------------------------
+
+def test_fair_queue_weighted_interleave_and_idle_redistribution():
+    q = FairQueue({"hot": TenantClass("hot", weight=2.0),
+                   "cold": TenantClass("cold", weight=1.0)})
+    for i in range(4):
+        q.push(("cold", i), "cold")
+    for i in range(4):
+        q.push(("hot", i), "hot")
+    served = [q.pop() for _ in range(len(q))]
+    # weight 2 tenant gets ~2 of every 3 pops while both backlogged
+    first6 = [t for t, _ in served[:6]]
+    assert first6.count("hot") == 4 and first6.count("cold") == 2
+    # per-tenant order is always FIFO
+    assert [i for t, i in served if t == "hot"] == [0, 1, 2, 3]
+    assert [i for t, i in served if t == "cold"] == [0, 1, 2, 3]
+    # an idle tenant's share redistributes: nothing blocks the
+    # remaining backlog once hot drains
+    assert [t for t, _ in served[6:]] == ["cold", "cold"]
+
+
+def test_fair_queue_unknown_tenant_defaults_to_weight_one():
+    q = FairQueue()
+    q.push("a", "mystery")
+    q.push("b", None)
+    assert len(q) == 2
+    assert {q.pop(), q.pop()} == {"a", "b"}
+
+
+# ---------------------------------------------------------------------------
+# 1. prefix affinity beats round-robin, outputs stay oracle-identical
+# ---------------------------------------------------------------------------
+
+def test_prefix_affinity_beats_round_robin_and_matches_oracle():
+    prompts = _shared_prefix_mix()
+
+    fleet = _fleet("t_prefix", num_replicas=2, routing="prefix")
+    outs = _drive_sequential(fleet, prompts)
+    stats_prefix = fleet.fleet_stats()
+
+    fleet = _fleet("t_rr", num_replicas=2, routing="round_robin")
+    _drive_sequential(fleet, prompts)
+    stats_rr = fleet.fleet_stats()
+
+    # placement quality: affinity concentrates each group's KV on one
+    # replica; round-robin re-prefills the prefix on both
+    assert stats_prefix["prefix_hit_rate"] > stats_rr["prefix_hit_rate"]
+    assert stats_prefix["prefix_hit_rate"] >= 0.45
+    routed = stats_prefix["router"]["routed_by_policy"]
+    assert routed["prefix_affinity"] > 0      # followers stuck
+    assert routed["round_robin"] == 0
+    assert stats_rr["router"]["routed_by_policy"]["round_robin"] \
+        == len(prompts)
+    # both replicas actually served traffic (no accidental collapse
+    # onto one replica, which would fake a high hit rate)
+    per_rep = [r["routed"] for r in stats_prefix["replicas"].values()]
+    assert len(per_rep) == 2 and all(n > 0 for n in per_rep)
+
+    # semantics: every fleet output is bit-identical to the dense
+    # single-engine greedy continuation
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(o, _oracle(p))
+
+
+# ---------------------------------------------------------------------------
+# 2. WFQ protects the interactive tenant's TTFT under a batch flood
+# ---------------------------------------------------------------------------
+
+def _flood(fleet, warm_prompts, batch_prompts, inter_prompts):
+    """Warm this fleet's own engine (compile + first-call allocation
+    spikes must not pollute the measured TTFTs), then submit the batch
+    flood followed by the interactive requests — all concurrent,
+    ordering preserved (each submit enqueues at task start, before any
+    dispatch completes)."""
+    async def main():
+        try:
+            for p in warm_prompts:
+                await fleet(p)                     # tenant-less: not
+            return await asyncio.gather(           # scored below
+                *[fleet(p, tenant="batch") for p in batch_prompts],
+                *[fleet(p, tenant="interactive")
+                  for p in inter_prompts])
+        finally:
+            fleet.shutdown()
+
+    return asyncio.run(main())
+
+
+def test_wfq_keeps_interactive_ttft_attainment_under_batch_flood():
+    rng = np.random.RandomState(3)
+    warm = [rng.randint(2, 500, 24).astype(np.int32)
+            for _ in range(2)]
+    batch = [rng.randint(2, 500, 24).astype(np.int32)
+             for _ in range(24)]
+    inter = [rng.randint(2, 500, 24).astype(np.int32)
+             for _ in range(3)]
+
+    # calibrate one solo request's wall time on this machine (after
+    # compile warmup) so the TTFT target scales with the host instead
+    # of hard-coding milliseconds
+    cal = _fleet("t_cal", num_replicas=1)
+
+    async def calibrate():
+        try:
+            await cal(warm[0])                     # compile warmup
+            ts = []
+            for p in batch[:3]:
+                t0 = time.perf_counter()
+                await cal(p)
+                ts.append(time.perf_counter() - t0)
+            return sorted(ts)[1]                   # median of 3
+        finally:
+            cal.shutdown()
+
+    t_solo = asyncio.run(calibrate())
+    target_ms = 8.0 * t_solo * 1000.0
+    tenants = [TenantClass("interactive", weight=8.0,
+                           ttft_ms=target_ms, objective=0.95),
+               TenantClass("batch", weight=1.0)]
+
+    # WFQ on: interactive requests overtake the queued batch backlog
+    fleet = _fleet("t_wfq", num_replicas=1, tenants=tenants, wfq=True)
+    _flood(fleet, warm, batch, inter)
+    rep_wfq = fleet.tenant_report()
+
+    # WFQ off (plain FIFO round-robin fleet): interactive waits behind
+    # the whole flood
+    fleet = _fleet("t_fifo", num_replicas=1, tenants=tenants,
+                   routing="round_robin", wfq=False)
+    _flood(fleet, warm, batch, inter)
+    rep_fifo = fleet.tenant_report()
+
+    got_wfq = rep_wfq["interactive"]["objectives"]["ttft"]
+    got_fifo = rep_fifo["interactive"]["objectives"]["ttft"]
+    assert got_wfq["samples"] == len(inter)
+    assert got_fifo["samples"] == len(inter)
+    # attainment above the tenant objective with WFQ, breached without
+    assert got_wfq["attainment"] >= 0.95, (got_wfq, target_ms)
+    assert got_fifo["attainment"] < 0.95, (got_fifo, target_ms)
+    # and not marginally: the flood delays FIFO interactive TTFT past
+    # the target at p95
+    assert got_fifo["latency_ms"]["p95"] > target_ms
+
+
+# ---------------------------------------------------------------------------
+# 3. autoscaler: burn breach scales up, sustained idle drains down
+# ---------------------------------------------------------------------------
+
+def test_autoscale_up_on_burn_then_idle_scale_down_with_drain(
+        tmp_path):
+    rng = np.random.RandomState(5)
+    prompts = [rng.randint(2, 500, 20).astype(np.int32)
+               for _ in range(6)]
+    # impossible engine-side targets: every request violates, so the
+    # 30 s burn window stays breached for the whole test
+    slo = SLOConfig(ttft_ms=1e-4, e2e_ms=1e-4, objective=0.5,
+                    windows_s=(30.0,), dump_on_breach=False)
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                             burn_threshold=1.0, queue_high=1e9,
+                             sustain_s=2.0, idle_s=2.0,
+                             up_cooldown_s=0.0, down_cooldown_s=0.0)
+    fleet = _fleet("t_scale", num_replicas=1, slo=slo,
+                   autoscale=policy)
+
+    async def main():
+        outs = [await fleet(p) for p in prompts[:4]]
+
+        # breach observed but not yet sustained: no action
+        assert await fleet.autoscale_step(now=100.0) is None
+        assert await fleet.autoscale_step(now=101.0) is None
+        # past the sustain window: scale up
+        act = await fleet.autoscale_step(now=102.5)
+        assert act == {"action": "up", "reason": "burn_rate",
+                       "signal": act["signal"], "n_replicas": 2}
+        assert act["signal"] > 1.0
+        assert fleet.num_replicas == 2
+
+        # the new replica serves traffic (so its drain is non-trivial)
+        outs += [await fleet(p) for p in prompts[4:]]
+
+        # the burn window never clears inside this test, so swap in a
+        # burn-blind policy to exercise the idle path deterministically
+        fleet.autoscale_policy = AutoscalePolicy(
+            min_replicas=1, max_replicas=2, burn_threshold=1e9,
+            queue_high=1e9, sustain_s=2.0, idle_s=2.0,
+            up_cooldown_s=0.0, down_cooldown_s=0.0)
+        assert await fleet.autoscale_step(now=110.0) is None
+        act = await fleet.autoscale_step(now=112.5)
+        assert act is not None and act["action"] == "down"
+        assert act["reason"] == "idle" and act["n_replicas"] == 1
+        # graceful drain: nothing in flight, every KV block freed
+        assert act["drain"]["ok"] is True
+        assert act["drain"]["blocks_in_use"] == 0
+        assert fleet.num_replicas == 1
+        # at the floor: no further scale-down
+        assert await fleet.autoscale_step(now=120.0) is None
+
+        # the shrunk fleet still serves (no lost capacity)
+        outs.append(await fleet(prompts[0]))
+        return outs
+
+    try:
+        outs = asyncio.run(main())
+        # no lost requests anywhere in the episode
+        assert len(outs) == len(prompts) + 1
+        assert all(isinstance(o, np.ndarray) for o in outs)
+
+        # every decision lands in the flight-recorder dump
+        fleet.telemetry.flightrec.dump_dir = str(tmp_path)
+        dump = fleet.telemetry.flightrec.dump(reason="test/autoscale")
+        doc = load_dump(dump)
+        counts = doc["counts_by_kind"]
+        assert counts.get("route", 0) == len(outs)
+        assert counts.get("scale_up") == 1
+        assert counts.get("scale_down") == 1
+        assert counts.get("drain") == 1
+        ups = [e for e in doc["events"] if e["kind"] == "scale_up"]
+        assert ups[0]["reason"] == "burn_rate" \
+            and ups[0]["n_after"] == 2
+        downs = [e for e in doc["events"] if e["kind"] == "scale_down"]
+        assert downs[0]["reason"] == "idle" and downs[0]["replica"]
+        drains = [e for e in doc["events"] if e["kind"] == "drain"]
+        assert drains[0]["ok"] and drains[0]["blocks_in_use"] == 0
+        # the postmortem CLI renders the routing table from this dump
+        text = "\n".join(report_lines(doc))
+        assert "routing table (route events by replica):" in text
+        assert "last scale-ups:" in text
+        assert "last drains:" in text
+    finally:
+        fleet.shutdown()
