@@ -109,6 +109,11 @@ def parse_args(argv=None):
                     help="do not append this run's metric lines to "
                          "BENCH_HISTORY.jsonl "
                          "(ray_tpu/tools/perfledger)")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="append a roofline-attribution JSON line "
+                         "(ray_tpu/tools/autopilot attribute over the "
+                         "programs this run registered) after the "
+                         "metric lines")
     return ap.parse_args(argv)
 
 
@@ -122,11 +127,28 @@ def emit(record) -> None:
     _EMITTED.append(record)
 
 
+def _maybe_autopilot(args) -> None:
+    """`--autopilot`: one extra JSON line attributing the programs this
+    run registered (compute-bound vs HBM-bound vs the device ridge,
+    ranked by headroom-weighted time share).  Emitted through emit() so
+    it rides into the ledger with the metric lines.  Best-effort."""
+    if not getattr(args, "autopilot", False):
+        return
+    try:
+        from ray_tpu.tools.autopilot import attribute_registry
+
+        emit({"autopilot": attribute_registry()})
+    except Exception as e:  # noqa: BLE001 - attribution is best-effort
+        sys.stderr.write(f"bench: autopilot attribution failed: "
+                         f"{e!r}\n")
+
+
 def _ledger_append(args) -> None:
     """Persist this run's JSON lines into BENCH_HISTORY.jsonl so the
     bench trajectory survives the terminal (perfledger check/report
     read it back).  Best-effort: a ledger failure never breaks the
     bench contract of always printing its lines."""
+    _maybe_autopilot(args)
     if getattr(args, "no_ledger", False) or not _EMITTED:
         return
     try:
@@ -230,18 +252,13 @@ def _mesh_context(mesh):
 
 
 def peak_flops_per_chip() -> float:
-    import jax
+    """Dense bf16 peak FLOPs/s per chip — single source of truth is
+    the perf observatory's table (the lazy import keeps module load
+    free of jax so ensure_backend() can pin the platform first)."""
+    from ray_tpu._private.device_stats import \
+        peak_flops_per_chip as _peak
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = {  # dense bf16 peak, per chip
-        "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
-        "v5p": 459e12, "v4": 275e12, "v6 lite": 918e12, "v6e": 918e12,
-        "cpu": 1e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    return _peak()
 
 
 def time_config(batch, seq=1024, n_steps=20, preset="gpt2", mesh="data",
@@ -351,6 +368,15 @@ def time_config(batch, seq=1024, n_steps=20, preset="gpt2", mesh="data",
             params, opt_state, loss = step(params, opt_state, data)
         final_loss = float(loss)
         dt = time.perf_counter() - t0
+        # book the steady-state window into the observatory: the loop
+        # above dispatches async and only the float(loss) fence is a
+        # real sync, so per-step walltime is dt/n_steps, not the
+        # un-fenced dispatch intervals.  Without this bench.train_step
+        # records compiles but zero invokes, and the autopilot has no
+        # time_share to attribute on train sweeps.
+        reg = get_registry()
+        for _ in range(n_steps):
+            reg.record_invoke("bench.train_step", dt / max(1, n_steps))
 
     n_params = gpt2_param_count(cfg)
     tok_s_chip = batch * seq * n_steps / dt / max(1, n_chips)

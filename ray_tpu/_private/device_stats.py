@@ -44,13 +44,25 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
 
-#: dense bf16 peak FLOPs/s per chip by device kind (same table as
-#: bench.py's peak_flops_per_chip — duplicated here because library
-#: code cannot import the repo-root bench harness)
+#: dense bf16 peak FLOPs/s per chip by device kind — the SINGLE source
+#: of truth for the whole repo: bench.py's peak_flops_per_chip wraps
+#: this module's lookup (it used to carry a duplicate table), and the
+#: autopilot roofline attribution classifies against it.
 _PEAK_FLOPS_TABLE = {
     "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
     "v5p": 459e12, "v4": 275e12, "v6 lite": 918e12, "v6e": 918e12,
     "cpu": 1e12,
+}
+
+#: HBM bandwidth bytes/s per chip by device kind (public spec sheets).
+#: peak_flops / hbm_bw is the roofline RIDGE POINT in FLOPs/byte: a
+#: program whose arithmetic intensity sits below it is bandwidth-bound
+#: no matter how well the MXU is fed — the autopilot's compute-bound
+#: vs HBM-bound attribution hinges on this table.
+_PEAK_HBM_BW_TABLE = {
+    "v5 lite": 819e9, "v5litepod": 819e9, "v5e": 819e9,
+    "v5p": 2765e9, "v4": 1228e9, "v6 lite": 1640e9, "v6e": 1640e9,
+    "cpu": 100e9,
 }
 
 #: runtime program names the observatory hooks register under.  The
@@ -146,6 +158,52 @@ def peak_flops_per_chip(device: Any = None) -> float:
         if key in kind:
             return val
     return 197e12
+
+
+def peak_hbm_bytes_per_sec(device: Any = None) -> float:
+    """HBM bandwidth bytes/s for one chip of the running backend
+    (same fallback policy as :func:`peak_flops_per_chip`: the v5e
+    figure for unknown TPU kinds, the CPU entry without a backend)."""
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:  # noqa: BLE001 - no backend yet
+        return _PEAK_HBM_BW_TABLE["cpu"]
+    for key, val in _PEAK_HBM_BW_TABLE.items():
+        if key in kind:
+            return val
+    return _PEAK_HBM_BW_TABLE["v5e"]
+
+
+def device_roofline(device: Any = None) -> Dict[str, Any]:
+    """The roofline constants every attribution consumer needs, in one
+    JSON-able block: peak FLOPs/s, HBM bytes/s, and their ratio — the
+    ridge point in FLOPs/byte.  Embedded in ``engine_stats()`` (so a
+    dashboard dump of a REMOTE engine carries the remote device's
+    ridge, not the reader's) and used directly by
+    ``ray_tpu.tools.autopilot``."""
+    backend = kind = None
+    try:
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        backend = getattr(device, "platform", None)
+        kind = getattr(device, "device_kind", None)
+    except Exception:  # noqa: BLE001 - no backend yet
+        device = None
+    flops = peak_flops_per_chip(device)
+    bw = peak_hbm_bytes_per_sec(device)
+    return {
+        "backend": backend,
+        "device_kind": kind,
+        "peak_flops_per_chip": flops,
+        "peak_hbm_bytes_per_sec": bw,
+        "ridge_flops_per_byte": round(flops / bw, 1),
+    }
 
 
 def _signature(args: tuple, kwargs: dict) -> tuple:

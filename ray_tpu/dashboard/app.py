@@ -17,6 +17,42 @@ from typing import Optional
 
 DASHBOARD_NAME = "RAYTPU_DASHBOARD"
 
+
+def _merged_programs():
+    """Fleet-wide program view: every live deployment's engine_stats()
+    "programs" block merged over this process's own (mostly empty)
+    registry — on a name collision the busiest replica view wins.
+    Shared by /api/perf/programs and /api/perf/autopilot.  Returns
+    (programs, per_deployment_blocks, devices)."""
+    from ray_tpu._private import device_stats as ds
+
+    devices = ds.device_memory_stats()
+    programs = ds.get_registry().snapshot(
+        n_devices=max(1, len(devices)))
+    per_dep = {}
+    try:
+        from ray_tpu.serve import api as serve_api
+
+        for name in serve_api.status():
+            try:
+                stats = serve_api.engine_stats(name, timeout=15)
+            except Exception:  # noqa: BLE001 - no stats
+                continue
+            blocks = stats.get("programs")
+            if not isinstance(blocks, dict):
+                continue
+            per_dep[name] = blocks
+            for prog, blk in blocks.items():
+                cur = programs.get(prog)
+                if (cur is None or blk.get(
+                        "compile_events", 0) >= cur.get(
+                        "compile_events", 0)):
+                    programs[prog] = blk
+    except Exception:  # noqa: BLE001 - serve not running
+        pass
+    return programs, per_dep, devices
+
+
 class DashboardActor:
     def __init__(self, host: str = "127.0.0.1", port: int = 8265):
         self.host = host
@@ -297,31 +333,7 @@ class DashboardActor:
             def _collect():
                 from ray_tpu._private import device_stats as ds
 
-                devices = ds.device_memory_stats()
-                programs = ds.get_registry().snapshot(
-                    n_devices=max(1, len(devices)))
-                per_dep = {}
-                try:
-                    from ray_tpu.serve import api as serve_api
-
-                    for name in serve_api.status():
-                        try:
-                            stats = serve_api.engine_stats(name,
-                                                           timeout=15)
-                        except Exception:  # noqa: BLE001 - no stats
-                            continue
-                        blocks = stats.get("programs")
-                        if not isinstance(blocks, dict):
-                            continue
-                        per_dep[name] = blocks
-                        for prog, blk in blocks.items():
-                            cur = programs.get(prog)
-                            if (cur is None or blk.get(
-                                    "compile_events", 0) >= cur.get(
-                                    "compile_events", 0)):
-                                programs[prog] = blk
-                except Exception:  # noqa: BLE001 - serve not running
-                    pass
+                programs, per_dep, devices = _merged_programs()
                 return {
                     "programs": programs,
                     "deployments": per_dep,
@@ -333,6 +345,34 @@ class DashboardActor:
                 await loop.run_in_executor(None, _collect))
 
         app.router.add_get("/api/perf/programs", perf_programs)
+
+        # Autopilot (ray_tpu/tools/autopilot): the same merged program
+        # view pushed through roofline attribution (which program is
+        # the bottleneck, compute- vs HBM-bound) plus the ledger
+        # verdict summary and the next planned sweep — the closed
+        # tuning loop's state as one JSON document.
+        async def perf_autopilot(req):
+            budget = int(req.query.get("budget", 8))
+
+            def _collect():
+                from ray_tpu.tools.autopilot import (attribution,
+                                                     verdict)
+
+                programs, per_dep, _ = _merged_programs()
+                att = attribution.attribute(programs)
+                try:
+                    v = verdict.build_verdict(budget=budget,
+                                              attribution=att)
+                except Exception as e:  # noqa: BLE001 - no ledger
+                    v = {"error": f"{type(e).__name__}: {e}"[:300],
+                         "attribution": att}
+                v["deployments"] = sorted(per_dep)
+                return v
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/perf/autopilot", perf_autopilot)
 
         # On-demand profiler capture (util/state.py profile_device):
         # POST {"logdir": ..., "seconds": 1.0} traces this process for

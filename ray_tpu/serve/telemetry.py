@@ -48,6 +48,24 @@ _STEP_BOUNDS_MS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
 _metrics_lock = threading.Lock()
 _metrics: Optional[Dict[str, Any]] = None
 
+_roofline_cache: Optional[Dict[str, Any]] = None
+
+
+def _device_roofline() -> Optional[Dict[str, Any]]:
+    """This process's roofline constants (peak FLOPs, HBM bandwidth,
+    ridge point), cached after first success — engine_stats() is called
+    per scrape and the constants cannot change under a live backend.
+    None when the lookup itself fails (stats must never raise)."""
+    global _roofline_cache
+    if _roofline_cache is None:
+        try:
+            from ray_tpu._private.device_stats import device_roofline
+
+            _roofline_cache = device_roofline()
+        except Exception:  # noqa: BLE001 - stats are best-effort
+            return None
+    return dict(_roofline_cache)
+
 
 def _engine_metrics() -> Dict[str, Any]:
     """Process-wide metric singletons (one registration per name no
@@ -573,6 +591,10 @@ class EngineTelemetry:
             "slo": (self.slo.snapshot() if self.slo is not None
                     else None),
             "flightrec": self.flightrec.stats(),
+            # round-13: the roofline constants of THIS engine's device,
+            # so a dashboard attributing a remote engine's programs
+            # classifies against the remote ridge, not the reader's
+            "device": _device_roofline(),
         }
 
     def export_timeline(self, filename: Optional[str] = None

@@ -1,0 +1,25 @@
+"""Closed-loop perf autopilot over the observatory + ledger.
+
+observatory → **attribute** → **plan** → sweep → ledger → **verdict**
+(and the verdict's report embeds the next plan, closing the loop).
+Three stages behind ``python -m ray_tpu.tools.autopilot``; see
+docs/observability.md#autopilot for the loop diagram and
+docs/static-analysis.md for the lint rules that pin this package to
+the program catalogs.
+"""
+
+from ray_tpu.tools.autopilot.attribution import (PROGRAM_KNOBS,
+                                                 attribute,
+                                                 attribute_registry,
+                                                 classify)
+from ray_tpu.tools.autopilot.planner import (CANDIDATES,
+                                             mirror_variant, plan)
+from ray_tpu.tools.autopilot.verdict import (build_verdict,
+                                             render_markdown,
+                                             write_reports)
+
+__all__ = [
+    "PROGRAM_KNOBS", "attribute", "attribute_registry", "classify",
+    "CANDIDATES", "mirror_variant", "plan",
+    "build_verdict", "render_markdown", "write_reports",
+]

@@ -1,0 +1,217 @@
+"""Stage 1 of the autopilot loop: roofline attribution.
+
+The perf observatory (``_private/device_stats.py``) already records,
+per named program, the compiler's own FLOP count, bytes accessed, and
+steady-state invoke walltimes.  This module turns that wall of gauges
+into ONE statement: which program is the bottleneck, which side of the
+roofline it sits on, and which knobs move it.
+
+* **classification** — arithmetic intensity (FLOPs/byte from
+  ``cost_analysis``) against the device ridge point
+  (``peak_flops / hbm_bandwidth``): below the ridge the MXU starves on
+  HBM no matter how well it is fed (*hbm-bound*), above it the program
+  is *compute-bound* and MFU headroom is the whole story.
+* **ranking** — headroom-weighted time share: a program that eats 70%
+  of the walltime at 90% of its roofline ceiling is LESS interesting
+  than one eating 25% at a third of its ceiling.  ``score =
+  time_share * headroom`` ranks them; the top entry is named as *the*
+  bottleneck.
+* **knobs** — ``PROGRAM_KNOBS`` maps every runtime program the
+  observatory registers to the sweep-able knobs that move it, which is
+  what the planner (stage 2) grids over.  The graftcheck
+  ``autopilot-attribution`` rule pins this catalog to the static
+  ProgramSpec catalog, mirroring the PR-8 ``observatory-mapping``
+  rule, so a new hot-path program cannot ship without an attribution
+  entry.
+
+Inputs are snapshot dicts — ``ProgramRegistry.snapshot()``,
+``engine_stats()["programs"]``, or a dashboard ``/api/perf/programs``
+dump — so attribution runs equally on the live process and on a canned
+JSON file from a tunnel session.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import device_stats as _ds
+
+#: runtime program name -> the sweep-able knobs that move it (the
+#: planner's vocabulary).  Keys must stay a subset of
+#: ``device_stats.KNOWN_PROGRAMS`` and must cover every
+#: ``STATIC_PROGRAM_MAP`` target — both enforced by graftcheck's
+#: ``autopilot-attribution`` rule, so the static auditor's hot-path
+#: catalog, the runtime observatory, and this attribution table cannot
+#: drift apart.
+PROGRAM_KNOBS: Dict[str, Tuple[str, ...]] = {
+    "train.step": ("batch", "remat_policy", "ce_impl",
+                   "flash_resident"),
+    "bench.train_step": ("batch", "remat_policy", "ce_impl",
+                         "flash_resident"),
+    "serve.prefill": ("prefill_bucket", "batch", "flash_resident"),
+    "serve.paged_prefill": ("prefill_bucket", "block_size",
+                            "flash_resident"),
+    "serve.decode": ("batch", "kv_layout", "block_size",
+                     "flash_resident"),
+    "serve.spec_verify": ("spec_k", "spec_draft", "kv_layout"),
+    "serve.spec_draft": ("spec_k", "spec_draft"),
+    "serve.sharded_prefill": ("tensor", "prefill_bucket", "batch"),
+    "serve.sharded_paged_prefill": ("tensor", "prefill_bucket",
+                                    "block_size"),
+    "serve.sharded_decode": ("tensor", "batch", "kv_layout",
+                             "block_size"),
+    "serve.sharded_spec_verify": ("tensor", "spec_k", "spec_draft"),
+    "serve.sharded_spec_draft": ("tensor", "spec_k", "spec_draft"),
+}
+
+
+def classify(intensity: Optional[float],
+             ridge: float) -> str:
+    """``compute-bound`` / ``hbm-bound`` by arithmetic intensity vs the
+    ridge point; ``unmeasured`` when the cost harvest never landed
+    (no AOT compile on this backend, or ``RAYTPU_DEVICE_STATS_COST=0``)."""
+    if not isinstance(intensity, (int, float)):
+        return "unmeasured"
+    return "compute-bound" if intensity >= ridge else "hbm-bound"
+
+
+def _busy_ms(block: Dict[str, Any]) -> float:
+    """Approximate walltime spent in a program's steady state: mean
+    invoke over the recent window times total invokes.  Programs that
+    only ever compiled contribute zero — they cannot be the
+    steady-state bottleneck."""
+    invoke = block.get("invoke_ms") or {}
+    mean = invoke.get("mean")
+    invokes = block.get("invokes") or 0
+    if not isinstance(mean, (int, float)) or not invokes:
+        return 0.0
+    return float(mean) * int(invokes)
+
+
+def _headroom(block: Dict[str, Any], cls: str,
+              device: Dict[str, Any]) -> Optional[float]:
+    """Distance from the program's own roofline ceiling, in [0, 1].
+
+    Compute-bound: ``1 - mfu`` (the ceiling is the peak-FLOPs line).
+    HBM-bound: ``1 - achieved_bytes_per_sec / peak_bw`` (the ceiling
+    is the bandwidth line — a bandwidth-saturated program has no
+    headroom even at terrible MFU).  None when the inputs to either
+    ratio are missing."""
+    invoke = block.get("invoke_ms") or {}
+    mean_ms = invoke.get("mean")
+    if cls == "compute-bound":
+        mfu = block.get("mfu")
+        if isinstance(mfu, (int, float)):
+            return round(min(1.0, max(0.0, 1.0 - float(mfu))), 4)
+        return None
+    if cls == "hbm-bound":
+        nbytes = block.get("bytes_accessed")
+        bw = device.get("peak_hbm_bytes_per_sec")
+        if (isinstance(nbytes, (int, float))
+                and isinstance(mean_ms, (int, float)) and mean_ms > 0
+                and isinstance(bw, (int, float)) and bw > 0):
+            util = float(nbytes) / (float(mean_ms) / 1e3) / float(bw)
+            return round(min(1.0, max(0.0, 1.0 - util)), 4)
+        return None
+    return None
+
+
+def attribute(programs: Dict[str, Dict[str, Any]],
+              device: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
+    """Attribute a programs snapshot against the device roofline.
+
+    ``programs`` is any ``{name: block}`` snapshot the observatory
+    emits; ``device`` is a :func:`device_stats.device_roofline` block
+    (taken from the snapshot's origin when attributing a remote dump;
+    defaults to this process's devices).  Returns::
+
+        {"device": {...roofline...},
+         "programs": {name: {"class", "arithmetic_intensity", "mfu",
+                             "time_share", "headroom", "score",
+                             "busy_ms", "recompile_storm", "knobs"}},
+         "ranked": [names, best-score first],
+         "bottleneck": name | None,
+         "summary": one-sentence statement}
+    """
+    if device is None:
+        device = _ds.device_roofline()
+    ridge = float(device.get("ridge_flops_per_byte") or 1.0)
+    busy = {name: _busy_ms(block)
+            for name, block in programs.items()}
+    total_ms = sum(busy.values())
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, block in programs.items():
+        intensity = block.get("arithmetic_intensity")
+        cls = classify(intensity, ridge)
+        share = (busy[name] / total_ms) if total_ms > 0 else 0.0
+        headroom = _headroom(block, cls, device)
+        # unmeasured headroom is treated as full headroom for ranking:
+        # "we do not even know" is a reason to look, not to skip
+        score = share * (1.0 if headroom is None else headroom)
+        out[name] = {
+            "class": cls,
+            "arithmetic_intensity": intensity,
+            "ridge_flops_per_byte": ridge,
+            "mfu": block.get("mfu"),
+            "busy_ms": round(busy[name], 3),
+            "time_share": round(share, 4),
+            "headroom": headroom,
+            "score": round(score, 4),
+            "invokes": block.get("invokes"),
+            "recompile_storm": bool(block.get("recompile_storm")),
+            "knobs": list(PROGRAM_KNOBS.get(name, ())),
+        }
+    ranked = sorted(out, key=lambda n: (-out[n]["score"], n))
+    bottleneck = next((n for n in ranked if out[n]["score"] > 0), None)
+    if bottleneck is not None:
+        b = out[bottleneck]
+        knobs = "/".join(b["knobs"]) or "(no catalogued knobs)"
+        summary = (
+            f"bottleneck: {bottleneck} ({b['class']}, "
+            f"{b['time_share']:.0%} of program walltime, headroom "
+            f"{'unknown' if b['headroom'] is None else b['headroom']})"
+            f" — sweep {knobs}")
+    elif programs:
+        summary = ("no steady-state invokes recorded — programs "
+                   "compiled but never ran; nothing to attribute")
+    else:
+        summary = "no programs registered"
+    return {"device": device, "programs": out, "ranked": ranked,
+            "bottleneck": bottleneck, "summary": summary}
+
+
+def attribute_registry() -> Dict[str, Any]:
+    """Attribute this process's live ``ProgramRegistry`` (the
+    ``bench.py --autopilot`` / dashboard path)."""
+    devices = _ds.device_memory_stats()
+    snapshot = _ds.get_registry().snapshot(
+        n_devices=max(1, len(devices)))
+    return attribute(snapshot)
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    """Human rendering of one attribution report."""
+    dev = report["device"]
+    lines = [
+        f"device: {dev.get('device_kind') or dev.get('backend') or '?'}"
+        f"  peak {dev['peak_flops_per_chip']:.3g} FLOP/s, "
+        f"{dev['peak_hbm_bytes_per_sec']:.3g} B/s, "
+        f"ridge {dev['ridge_flops_per_byte']} FLOP/B",
+        "",
+    ]
+    for name in report["ranked"]:
+        p = report["programs"][name]
+        ai = p["arithmetic_intensity"]
+        lines.append(
+            f"  {name:<28s} {p['class']:<14s} "
+            f"AI={'-' if ai is None else format(ai, '.1f'):<8s} "
+            f"share={p['time_share']:<7.2%} "
+            f"headroom={'-' if p['headroom'] is None else p['headroom']}"
+            f" score={p['score']}")
+    lines += ["", report["summary"]]
+    return "\n".join(lines)
+
+
+__all__: List[str] = ["PROGRAM_KNOBS", "attribute",
+                      "attribute_registry", "classify", "render_text"]

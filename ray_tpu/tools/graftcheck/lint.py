@@ -16,15 +16,17 @@ Rule ids:
 
 * ``blocking-call-in-async`` — ``.block_until_ready()``,
   ``np.asarray(...)``, sync ``ray.get``/``ray_tpu.get``, and
-  ``time.sleep`` inside ``async def`` bodies under ``ray_tpu/serve/``:
-  each blocks the event loop (and usually the decode engine) on a
-  device or cluster round-trip.  Deliberate host fences carry a
-  disable comment naming the reason.
+  ``time.sleep`` inside ``async def`` bodies under ``ray_tpu/serve/``
+  or ``ray_tpu/tools/autopilot/`` (the dashboard calls the autopilot
+  from its event loop): each blocks the event loop (and usually the
+  decode engine) on a device or cluster round-trip.  Deliberate host
+  fences carry a disable comment naming the reason.
 * ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``,
-  ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py`` or
+  ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py``,
   ``serve/router.py`` (the fleet router timestamps routing/autoscale
   decisions and measures drain deadlines — interval math like the
-  rest):
+  rest), or anywhere under ``ray_tpu/tools/autopilot/`` (verdicts must
+  be reproducible from ledger contents alone):
   telemetry takes an injectable ``now`` (tests drive deterministic
   clocks) and intervals must use the monotonic ``perf_counter`` —
   the flight-recorder journal and SLO burn-rate windows are interval
@@ -49,6 +51,11 @@ Rule ids:
   mapping must target a KNOWN_PROGRAMS name): the static auditor's
   catalog of hot-path programs and the runtime perf observatory's must
   not drift apart.
+* ``autopilot-attribution`` — every runtime program name
+  ``STATIC_PROGRAM_MAP`` targets must have a knob entry in
+  ``tools/autopilot/attribution.py``'s ``PROGRAM_KNOBS`` (and every
+  knob entry must name a KNOWN_PROGRAMS program): the tuning loop
+  cannot name a bottleneck it has no catalogued way to move.
 """
 
 from __future__ import annotations
@@ -85,7 +92,9 @@ def _call_label(func: ast.AST) -> str:
 # ---------------------------------------------------------------------------
 
 def _blocking_calls_in_async(tree: ast.AST, rel: str) -> List[Violation]:
-    if not rel.replace("\\", "/").startswith("ray_tpu/serve/"):
+    rel_posix = rel.replace("\\", "/")
+    if not (rel_posix.startswith("ray_tpu/serve/")
+            or rel_posix.startswith("ray_tpu/tools/autopilot/")):
         return []
     out: List[Violation] = []
 
@@ -128,7 +137,8 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
             or rel_posix.endswith("util/tracing.py")
             or rel_posix.endswith("_private/flightrec.py")
             or rel_posix.endswith("serve/slo.py")
-            or rel_posix.endswith("serve/router.py")):
+            or rel_posix.endswith("serve/router.py")
+            or rel_posix.startswith("ray_tpu/tools/autopilot/")):
         return []
     out: List[Violation] = []
     for node in ast.walk(tree):
@@ -398,6 +408,42 @@ def _observatory_mapping() -> List[Violation]:
     return out
 
 
+def _autopilot_attribution() -> List[Violation]:
+    """Every runtime program the observatory can register must have an
+    autopilot knob entry (PROGRAM_KNOBS), and every knob entry must
+    name a real runtime program — otherwise the tuning loop's
+    'attribute' stage silently reports a bottleneck with no catalogued
+    way to move it (or grids over a program that can never appear).
+    Mirrors the observatory-mapping rule one layer up."""
+    ap_file = "ray_tpu/tools/autopilot/attribution.py"
+    try:
+        from ray_tpu._private.device_stats import (KNOWN_PROGRAMS,
+                                                   STATIC_PROGRAM_MAP)
+        from ray_tpu.tools.autopilot.attribution import PROGRAM_KNOBS
+    except Exception as e:  # noqa: BLE001 - import failure IS the finding
+        return [Violation(
+            "autopilot-attribution",
+            f"autopilot attribution catalog unavailable: "
+            f"{type(e).__name__}: {e}", file=ap_file)]
+    out: List[Violation] = []
+    for spec, runtime in STATIC_PROGRAM_MAP.items():
+        if runtime not in PROGRAM_KNOBS:
+            out.append(Violation(
+                "autopilot-attribution",
+                f"runtime program '{runtime}' (ProgramSpec '{spec}') "
+                f"has no PROGRAM_KNOBS entry — the autopilot can name "
+                f"it as the bottleneck but catalogs no knob to move it",
+                file=ap_file))
+    for runtime in PROGRAM_KNOBS:
+        if runtime not in KNOWN_PROGRAMS:
+            out.append(Violation(
+                "autopilot-attribution",
+                f"PROGRAM_KNOBS entry '{runtime}' is not a "
+                f"KNOWN_PROGRAMS runtime name — stale knob catalog for "
+                f"a removed/renamed program", file=ap_file))
+    return out
+
+
 def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
     """Lint every package file under ``root`` plus the repo-level
     checks.  Returns (violations, stats) where stats carries
@@ -420,6 +466,7 @@ def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
     violations.extend(_pallas_interpret_tests(root))
     violations.extend(_kernel_exports())
     violations.extend(_observatory_mapping())
+    violations.extend(_autopilot_attribution())
     stats = {"files": n_files, "suppressed": n_suppressed,
              "metric_names": metric_names_seen}
     return violations, stats

@@ -10,6 +10,7 @@ repo root, and turns the accumulated trajectory into CI-style verdicts:
     python -m ray_tpu.tools.perfledger ingest BENCH_r0*.json
     python -m ray_tpu.tools.perfledger check            # exit 1 on regress
     python -m ray_tpu.tools.perfledger report           # markdown trends
+    python -m ray_tpu.tools.perfledger publish latest   # arm the baseline
 
 ``bench.py`` and ``sweep_tpu.py`` append automatically (``--no-ledger``
 opts out), so every future TPU session grows the trajectory instead of
@@ -18,6 +19,8 @@ losing it.
 Ledger entries are one JSON object per line::
 
     {"recorded_at": ..., "source": "bench"|"sweep"|"ingest",
+     "provenance": {"git_sha", "jax_version", "backend",
+                    "device_kind", "hostname"},
      "record": {...original bench/sweep record...},
      "metrics": {name: {"value": v, "unit": u,
                         "higher_is_better": bool}}}
@@ -171,20 +174,73 @@ def parse_text(text: str) -> List[Dict[str, Any]]:
     return records
 
 
+_provenance_cache: Optional[Dict[str, Any]] = None
+
+
+def provenance() -> Dict[str, Any]:
+    """Where/what produced a ledger record: git SHA, jax version,
+    backend + device kind, hostname.  Stamped on every entry at
+    ``append_records`` time so cross-session BENCH_HISTORY series are
+    honestly comparable — the autopilot's staleness logic keys off the
+    SHA, and its CPU-vs-TPU gating off the backend.  Every field is
+    best-effort ``None``; backend/device are only read when jax is
+    ALREADY imported (ingesting a log must not drag a backend up just
+    to stamp it).  Cached per process."""
+    global _provenance_cache
+    if _provenance_cache is not None:
+        return dict(_provenance_cache)
+    import socket
+    import subprocess
+
+    out: Dict[str, Any] = {"git_sha": None, "jax_version": None,
+                           "backend": None, "device_kind": None,
+                           "hostname": None}
+    try:
+        r = subprocess.run(["git", "-C", repo_root(), "rev-parse",
+                            "--short", "HEAD"],
+                           capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            out["git_sha"] = r.stdout.strip() or None
+    except Exception:  # noqa: BLE001 - no git / not a checkout
+        pass
+    try:
+        import importlib.metadata as _md
+
+        out["jax_version"] = _md.version("jax")
+    except Exception:  # noqa: BLE001 - jax not installed
+        pass
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            out["backend"] = jax.default_backend()
+            out["device_kind"] = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - backend init failed
+            pass
+    try:
+        out["hostname"] = socket.gethostname()
+    except Exception:  # noqa: BLE001
+        pass
+    _provenance_cache = dict(out)
+    return out
+
+
 def append_records(records: Iterable[Dict[str, Any]], source: str,
                    path: Optional[str] = None) -> int:
-    """Append each record (with its flattened metric series) as one
-    ledger line; returns how many lines landed.  Records with no
-    numeric series (audit summaries, failures) are kept too — they
-    document the trajectory — but contribute nothing to ``check``."""
+    """Append each record (with its flattened metric series and the
+    process provenance stamp) as one ledger line; returns how many
+    lines landed.  Records with no numeric series (audit summaries,
+    failures) are kept too — they document the trajectory — but
+    contribute nothing to ``check``."""
     path = history_path(path)
     stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    prov = provenance()
     n = 0
     with open(path, "a") as f:
         for rec in records:
             if not isinstance(rec, dict):
                 continue
             entry = {"recorded_at": stamp, "source": source,
+                     "provenance": prov,
                      "record": rec, "metrics": extract_metrics(rec)}
             f.write(json.dumps(entry, sort_keys=True) + "\n")
             n += 1
@@ -347,6 +403,90 @@ def report(history: Optional[str] = None,
 
 
 # ---------------------------------------------------------------------------
+# publish
+# ---------------------------------------------------------------------------
+
+def entry_backend(entry: Dict[str, Any]) -> Optional[str]:
+    """Best available backend label for one ledger entry: the
+    provenance stamp when present (post round-12 entries), else the
+    bench record's own ``detail.backend``."""
+    prov = entry.get("provenance") or {}
+    if prov.get("backend"):
+        return str(prov["backend"])
+    rec = entry.get("record") or {}
+    detail = rec.get("detail") if isinstance(rec, dict) else None
+    if isinstance(detail, dict) and detail.get("backend"):
+        return str(detail["backend"])
+    return None
+
+
+def publish(selector: str = "latest",
+            history: Optional[str] = None,
+            baseline: Optional[str] = None,
+            allow_cpu: bool = False,
+            dry_run: bool = False) -> Dict[str, Any]:
+    """Promote one ledger entry's metrics into BASELINE.json's
+    ``published`` table — the act that arms the baseline gate ``check``
+    has been skipping while the table sat empty.
+
+    ``selector`` is a 0-based history index or ``latest`` (the newest
+    entry that carries metrics).  CPU-backend entries are refused
+    unless ``allow_cpu`` — a laptop smoke number must never become the
+    bar TPU sessions are graded against.  ``dry_run`` computes the
+    diff without writing.  Returns ``{entry, backend, diff, written}``;
+    raises ValueError on a bad selector or a refused publish."""
+    entries = load_history(history)
+    with_metrics = [(i, e) for i, e in enumerate(entries)
+                    if e.get("metrics")]
+    if not with_metrics:
+        raise ValueError("ledger has no entries with metrics")
+    if selector == "latest":
+        idx, entry = with_metrics[-1]
+    else:
+        idx = int(selector)
+        if not 0 <= idx < len(entries):
+            raise ValueError(f"history index {idx} out of range "
+                             f"(0..{len(entries) - 1})")
+        entry = entries[idx]
+        if not entry.get("metrics"):
+            raise ValueError(f"history entry {idx} carries no metrics "
+                             f"(source={entry.get('source')!r})")
+    backend = entry_backend(entry)
+    if backend == "cpu" and not allow_cpu:
+        raise ValueError(
+            f"history entry {idx} was measured on the CPU backend — "
+            f"refusing to publish a smoke number as the baseline "
+            f"(pass --allow-cpu to override)")
+    bpath = baseline_path(baseline)
+    try:
+        with open(bpath) as f:
+            data = json.load(f)
+    except Exception:  # noqa: BLE001 - missing/invalid baseline file
+        data = {}
+    published = dict(data.get("published") or {})
+    diff: Dict[str, Any] = {}
+    for name, m in sorted(entry["metrics"].items()):
+        if not isinstance(m, dict) or not isinstance(
+                m.get("value"), (int, float)):
+            continue
+        new = float(m["value"])
+        old = published.get(name)
+        if old != new:
+            diff[name] = {"old": old, "new": new}
+        published[name] = new
+    if not dry_run:
+        data["published"] = published
+        tmp = bpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, bpath)
+    return {"entry": idx, "backend": backend, "diff": diff,
+            "published": published, "written": not dry_run,
+            "baseline_path": bpath}
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -379,6 +519,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                        default=DEFAULT_TOLERANCE)
     p_rep.add_argument("--out", default="",
                        help="write the report here as well as stdout")
+    p_pub = sub.add_parser(
+        "publish",
+        help="promote one entry's metrics into BASELINE.json's "
+             "'published' table (arms the baseline gate)")
+    p_pub.add_argument("selector", nargs="?", default="latest",
+                       help="0-based history index, or 'latest' "
+                            "(newest entry with metrics)")
+    p_pub.add_argument("--baseline", default=None)
+    p_pub.add_argument("--allow-cpu", action="store_true",
+                       help="publish even a CPU-backend record "
+                            "(refused by default: a smoke number must "
+                            "not become the TPU bar)")
+    p_pub.add_argument("--dry-run", action="store_true",
+                       help="print the diff without writing "
+                            "BASELINE.json")
     args = ap.parse_args(argv)
 
     if args.cmd == "ingest":
@@ -403,6 +558,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = check(args.history, args.baseline, args.tolerance)
         print(json.dumps(result, indent=1, sort_keys=True))
         return 0 if result["ok"] else 1
+
+    if args.cmd == "publish":
+        try:
+            res = publish(args.selector, history=args.history,
+                          baseline=args.baseline,
+                          allow_cpu=args.allow_cpu,
+                          dry_run=args.dry_run)
+        except ValueError as e:
+            print(f"perfledger: publish refused: {e}", file=sys.stderr)
+            return 2
+        verb = "would publish" if args.dry_run else "published"
+        print(f"perfledger: {verb} entry {res['entry']} "
+              f"(backend={res['backend']}) -> {res['baseline_path']}")
+        for name, d in sorted(res["diff"].items()):
+            print(f"  {name}: {_fmt(d['old'])} -> {_fmt(d['new'])}")
+        if not res["diff"]:
+            print("  (no changes — already published)")
+        return 0
 
     text = report(args.history, args.baseline, args.tolerance)
     print(text)

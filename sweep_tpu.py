@@ -64,7 +64,11 @@ line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
 clean JSONL stream).  The first record is the graftcheck static-audit
 summary for the current tree (docs/static-analysis.md) so sweep
 numbers are traceable to a tree whose hot-path invariants held; pass
---no-audit to skip it.  Failures get a distinct tag — in particular the
+--no-audit to skip it.  Pass --autopilot to append one final record
+attributing every program the sweep registered against the device
+roofline (ray_tpu/tools/autopilot — the closed tuning loop's
+"attribute" stage), so the ledger carries WHY alongside the numbers.
+Failures get a distinct tag — in particular the
 known compile-helper HTTP 500 tunnel failure is tagged
 "compile_helper_500" — so sweeps that straddle the failure boundary
 remain analyzable after the fact.
@@ -159,7 +163,14 @@ def _run_traffic_variant(max_slots, kw, out):
                "prefix_len": spec.prefix_len,
                "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
                "tensor": n_chips, "spec_k": spec_k,
-               "preset": run_kw["preset"], "overrides": kw}
+               "preset": run_kw["preset"],
+               # block_size/prefill_bucket are popped into run_kw above,
+               # which used to leave them out of the variant identity —
+               # a block-size A/B hashed into ONE ledger series and
+               # compared 16 against 64 as if they were the same config
+               "block_size": run_kw["kv_block_size"],
+               "prefill_bucket": run_kw["prefill_bucket"],
+               "overrides": kw}
     try:
         rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
                           max_slots=max_slots, mesh=mesh,
@@ -298,13 +309,31 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
     return rec
 
 
+def _autopilot_record():
+    """One SWEEPJSON record attributing every program this sweep
+    registered (compute- vs HBM-bound against the device ridge, ranked
+    by headroom-weighted time share) — ``--autopilot`` appends it after
+    the variant records so the attribution rides into the ledger with
+    the numbers it explains.  Never raises."""
+    try:
+        from ray_tpu.tools.autopilot import attribute_registry
+
+        return {"autopilot": attribute_registry()}
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        return {"autopilot": {"error": f"{type(e).__name__}: "
+                              f"{str(e)[:200]}"}}
+
+
 def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
-              audit=False, ledger=True, ledger_path=None):
+              audit=False, ledger=True, ledger_path=None,
+              autopilot=False):
     """Run each [batch_per_chip, overrides] variant; returns the list of
     result records that were also emitted as SWEEPJSON lines.  With
     ``audit=True`` the first record is the graftcheck summary for the
     current tree (``python sweep_tpu.py`` turns this on; pass
-    --no-audit to skip).  Unless ``ledger=False`` (--no-ledger), every
+    --no-audit to skip).  With ``autopilot=True`` (--autopilot) the
+    LAST record is the roofline attribution of every program the sweep
+    registered.  Unless ``ledger=False`` (--no-ledger), every
     record is also appended to BENCH_HISTORY.jsonl through
     ray_tpu/tools/perfledger so the sweep trajectory outlives the
     terminal — SWEEPJSON lines used to evaporate with the scrollback."""
@@ -451,6 +480,10 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
                    "error": f"{type(e).__name__}: {str(e)[:300]}"}
         print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
         records.append(rec)
+    if autopilot:
+        rec = _autopilot_record()
+        print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+        records.append(rec)
     if ledger and records:
         try:
             from ray_tpu.tools import perfledger
@@ -470,10 +503,11 @@ if __name__ == "__main__":
     import jax
 
     argv = [a for a in sys.argv[1:]
-            if a not in ("--no-audit", "--no-ledger")]
+            if a not in ("--no-audit", "--no-ledger", "--autopilot")]
     n_chips = len(jax.devices())
     configs = json.loads(argv[0]) if argv else [
         [32, {}],
     ]
     run_sweep(configs, n_chips, audit="--no-audit" not in sys.argv,
-              ledger="--no-ledger" not in sys.argv)
+              ledger="--no-ledger" not in sys.argv,
+              autopilot="--autopilot" in sys.argv)

@@ -30,6 +30,7 @@ import pytest
 from ray_tpu.tools import graftcheck as gc
 from ray_tpu.tools.graftcheck.jaxpr_audit import ProgramSpec, audit_program
 from ray_tpu.tools.graftcheck.lint import (KERNEL_EXPORTS,
+                                           _autopilot_attribution,
                                            _observatory_mapping,
                                            lint_repo, lint_source,
                                            pallas_modules)
@@ -143,6 +144,31 @@ def test_observatory_mapping_planted_violations(monkeypatch):
     monkeypatch.setattr(ds, "STATIC_PROGRAM_MAP", stale)
     msgs = [v.message for v in _observatory_mapping()]
     assert any("matches no" in m for m in msgs)
+
+
+def test_autopilot_attribution_clean():
+    # round 12: the autopilot's knob catalog must cover every runtime
+    # program the static map targets
+    assert _autopilot_attribution() == []
+
+
+def test_autopilot_attribution_planted_violations(monkeypatch):
+    from ray_tpu.tools.autopilot import attribution as ap
+
+    # a runtime program the static map targets with no knob entry
+    missing = dict(ap.PROGRAM_KNOBS)
+    del missing["train.step"]
+    monkeypatch.setattr(ap, "PROGRAM_KNOBS", missing)
+    viols = _autopilot_attribution()
+    assert {v.rule for v in viols} == {"autopilot-attribution"}
+    assert any("'train.step'" in v.message for v in viols)
+
+    # a knob entry for a program the runtime never registers
+    bogus = dict(ap.PROGRAM_KNOBS)
+    bogus["serve.bogus"] = ("spec_k",)
+    monkeypatch.setattr(ap, "PROGRAM_KNOBS", bogus)
+    msgs = [v.message for v in _autopilot_attribution()]
+    assert any("not a KNOWN_PROGRAMS" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------
@@ -471,6 +497,39 @@ def test_lint_fleet_router_in_both_rule_scopes():
     """)
     kept, _ = lint_source(block, "ray_tpu/serve/router.py")
     assert [v.rule for v in kept] == ["blocking-call-in-async"]
+
+
+def test_lint_autopilot_in_both_rule_scopes():
+    # round 12: the dashboard calls the autopilot from its event loop
+    # and verdicts promised ledger-reproducibility — both the
+    # monotonic-clock and no-blocking-in-async invariants extend over
+    # ray_tpu/tools/autopilot/
+    wall = textwrap.dedent("""\
+        import time
+
+        def stamp_plan():
+            return time.time()
+    """)
+    kept, _ = lint_source(wall, "ray_tpu/tools/autopilot/planner.py")
+    assert [v.rule for v in kept] == ["wallclock-in-telemetry"]
+    kept, _ = lint_source(wall.replace("time.time()",
+                                       "time.perf_counter()"),
+                          "ray_tpu/tools/autopilot/planner.py")
+    assert not kept
+    block = textwrap.dedent("""\
+        import numpy as np
+
+        async def collect(snapshot):
+            return np.asarray(snapshot)
+    """)
+    kept, _ = lint_source(block,
+                          "ray_tpu/tools/autopilot/attribution.py")
+    assert [v.rule for v in kept] == ["blocking-call-in-async"]
+    # sibling tools stay out of both scopes
+    kept, _ = lint_source(wall, "ray_tpu/tools/graftcheck/fixture.py")
+    assert not kept
+    kept, _ = lint_source(block, "ray_tpu/tools/graftcheck/fixture.py")
+    assert not kept
 
 
 def test_lint_mutable_global_positive():
