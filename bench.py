@@ -819,6 +819,30 @@ def main_traffic(args, on_tpu: bool) -> None:
             "value": rep["spec_accept_rate"], "unit": "ratio",
             "vs_baseline": None,
             "detail": dict(detail, rounds=rep.get("spec_rounds"))})
+    _emit_anatomy(base, rep, detail)
+
+
+def _emit_anatomy(base: str, rep: dict, detail: dict) -> None:
+    """Tracebus per-token anatomy lines shared by --traffic solo and
+    --replicas N: inter-token latency percentiles plus the p99
+    TTFT-side critical-path total (its decomposition — router wait /
+    queue wait / requeue / prefill — rides in detail)."""
+    for q in ("p50", "p99"):
+        v = rep.get(f"itl_ms_{q}")
+        if isinstance(v, (int, float)):
+            emit({
+                "metric": f"{base}_itl_ms_{q}",
+                "value": v, "unit": "ms", "vs_baseline": None,
+                "detail": dict(detail,
+                               tpot_ms=(rep.get("latency_anatomy")
+                                        or {}).get("tpot_ms"))})
+    cp = rep.get("ttft_critical_path") or {}
+    if isinstance(cp.get("total_p99_ms"), (int, float)):
+        emit({
+            "metric": f"{base}_ttft_critical_path",
+            "value": cp["total_p99_ms"], "unit": "ms",
+            "vs_baseline": None,
+            "detail": dict(detail, critical_path=cp)})
 
 
 def main_traffic_fleet(args, on_tpu: bool) -> None:
@@ -902,6 +926,7 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
             "detail": dict(detail,
                            tenant_report=rep["tenants"].get(
                                name.split("_", 1)[0]))})
+    _emit_anatomy(base, rep, detail)
 
 
 def main(args=None):

@@ -321,6 +321,65 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/fleet", serve_fleet)
 
+        # Tracebus (ray_tpu/tools/tracebus.py): one request's causal
+        # span tree — router.route → engine.queue/kv.reserve →
+        # engine.prefill (+ matched device program dispatch) →
+        # engine.decode — by trace id (full or prefix) or engine-local
+        # id.  Fleets are scanned first (their find_request carries
+        # the replica name); then every serve deployment exposing
+        # request_trace.
+        async def serve_trace(req):
+            rid = req.match_info["request_id"]
+
+            def _collect():
+                from ray_tpu.serve.router import fleet_registry
+                from ray_tpu.tools import tracebus
+
+                snap = None
+                for fleet in fleet_registry().values():
+                    try:
+                        snap = fleet.find_request(rid)
+                    except Exception:  # noqa: BLE001
+                        snap = None
+                    if snap is not None:
+                        break
+                if snap is None:
+                    import ray_tpu
+                    from ray_tpu.serve import api as serve_api
+
+                    try:
+                        deployments = serve_api.status()
+                    except Exception:  # noqa: BLE001
+                        deployments = {}
+                    for name in deployments:
+                        try:
+                            handle = serve_api.get_deployment_handle(
+                                name)
+                            snap = ray_tpu.get(
+                                handle.method("request_trace")
+                                .remote(rid), timeout=15)
+                        except Exception:  # noqa: BLE001
+                            snap = None
+                        if snap is not None:
+                            snap.setdefault("replica", name)
+                            break
+                if snap is None:
+                    return None
+                spans = tracebus.attach_device_spans(
+                    tracebus.build_request_spans(snap), snap,
+                    tracebus._device_programs())
+                return dict(snap, spans=spans)
+
+            data = await loop.run_in_executor(None, _collect)
+            if data is None:
+                return web.json_response(
+                    {"error": f"request {rid!r} not found"},
+                    status=404)
+            return web.json_response(data)
+
+        app.router.add_get("/api/serve/trace/{request_id}",
+                           serve_trace)
+
         # Perf observatory (_private/device_stats.py): per-program
         # compiled cost model / recompile watchdog / live MFU, plus
         # per-chip allocator stats — the device-side complement of
@@ -359,7 +418,23 @@ class DashboardActor:
                                                      verdict)
 
                 programs, per_dep, _ = _merged_programs()
-                att = attribution.attribute(programs)
+                # request-side evidence: the tracebus p99 critical
+                # path over every live fleet's retained requests
+                req_ev = None
+                try:
+                    from ray_tpu.serve.router import fleet_registry
+                    from ray_tpu.tools import tracebus
+
+                    reqs = []
+                    for fleet in fleet_registry().values():
+                        reqs.extend(fleet.trace_records())
+                    if reqs:
+                        req_ev = tracebus.request_evidence(
+                            {"requests": reqs})
+                except Exception:  # noqa: BLE001 - evidence optional
+                    req_ev = None
+                att = attribution.attribute(
+                    programs, request_anatomy=req_ev)
                 try:
                     v = verdict.build_verdict(budget=budget,
                                               attribution=att)

@@ -89,6 +89,28 @@ class BlockPager:
         #: reserve / evict / free / COW decisions journal themselves
         #: so a postmortem can replay pool pressure around an anomaly
         self._recorder = recorder
+        #: (request_id, trace_id) the engine sets around one
+        #: admission's reservation window, so the kv_* journal events
+        #: carry the request/trace id a postmortem filters by
+        self._req_ctx: Tuple[Optional[int], Optional[str]] = (None,
+                                                              None)
+
+    def set_request(self, request_id: Optional[int],
+                    trace_id: Optional[str] = None) -> None:
+        """Scope subsequent recorder events to one request — the
+        engine brackets each admission's pager calls with
+        ``set_request(rec_id, trace_id)`` / ``set_request(None)``.
+        Purely journal tagging; allocation behavior is unchanged."""
+        self._req_ctx = (request_id, trace_id)
+
+    def _ctx_tag(self) -> Dict[str, object]:
+        req, trace = self._req_ctx
+        if req is None:
+            return {}
+        tag: Dict[str, object] = {"req": req}
+        if trace is not None:
+            tag["trace"] = trace
+        return tag
 
     # -- capacity ------------------------------------------------------
 
@@ -134,7 +156,8 @@ class BlockPager:
         if count > self.available:
             if self._recorder is not None and count:
                 self._recorder.record("kv_exhausted", need=count,
-                                      available=self.available)
+                                      available=self.available,
+                                      **self._ctx_tag())
             return None
         out: List[int] = []
         evicted = 0
@@ -151,7 +174,8 @@ class BlockPager:
         if self._recorder is not None and count:
             self._recorder.record("kv_reserve", blocks=count,
                                   evicted=evicted,
-                                  free=len(self._free))
+                                  free=len(self._free),
+                                  **self._ctx_tag())
         return out
 
     def release(self, block_ids: Sequence[int]) -> None:
@@ -176,7 +200,8 @@ class BlockPager:
         if self._recorder is not None and freed:
             self._recorder.record("kv_free", blocks=freed,
                                   free=len(self._free),
-                                  cached=len(self._cached))
+                                  cached=len(self._cached),
+                                  **self._ctx_tag())
 
     # -- prefix cache --------------------------------------------------
 
@@ -250,7 +275,7 @@ class BlockPager:
         self.cow_copies += 1
         if self._recorder is not None:
             self._recorder.record("kv_cow", src=block_id,
-                                  fork=fresh[0])
+                                  fork=fresh[0], **self._ctx_tag())
         return fresh[0], block_id
 
     def prefix_keys(self) -> List[Tuple[int, ...]]:

@@ -804,9 +804,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             import jax
             import jax.numpy as jnp
 
+            import time as _time
+
             pager = self._pager
             n = int(arr.shape[0])
             tokens = arr.tolist()
+            ctx = rec.get("ctx")
+            pager.set_request(rec["id"],
+                              ctx.trace_id if ctx is not None else None)
+            t_kv0 = _time.perf_counter()
             # spec decode: reserve k blocks' worth of verify-overshoot
             # headroom so rejected draft K/V writes land in blocks this
             # row owns, never one the pager re-hands out
@@ -818,9 +824,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             alloc = pager.allocate(need - len(matched))
             if alloc is None:
                 pager.release(matched)
-                self._telemetry.flightrec.record(
-                    "requeue", req=rec["id"], need=need,
-                    reason="pool_exhausted")
+                pager.set_request(None)
+                self._telemetry.record_requeue(
+                    rec, need=need, reason="pool_exhausted")
                 self._queue.push_front((arr, rec, sp), fut)
                 return False
             blocks = matched + alloc
@@ -831,9 +837,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     new_blk, src = pager.ensure_private(blocks[wb])
                 except MemoryError:
                     pager.release(blocks)
-                    self._telemetry.flightrec.record(
-                        "requeue", req=rec["id"], need=need,
-                        reason="cow_exhausted")
+                    pager.set_request(None)
+                    self._telemetry.record_requeue(
+                        rec, need=need, reason="cow_exhausted")
                     self._queue.push_front((arr, rec, sp), fut)
                     return False
                 if src is not None:
@@ -841,6 +847,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     self._cache = self._copy_block(
                         self._cache, np.int32(src), np.int32(new_blk))
                     self._telemetry.record_cow()
+            pager.set_request(None)
+            self._telemetry.record_kv_reserve(
+                rec, t_kv0, _time.perf_counter(), blocks=len(blocks),
+                hit_blocks=len(matched))
             self._telemetry.record_prefix_reuse(
                 len(matched), pager.blocks_needed(n, 0) - len(matched))
             n_tail = n - prefix_len
@@ -939,11 +949,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             checks all k+1 positions, accepted tokens are emitted and
             the caches advance by exactly the kept count.  Returns the
             number of tokens emitted (for step telemetry)."""
+            import time as _time
+
             import jax
             import jax.numpy as jnp
 
             from ray_tpu.models.decode_common import ngram_propose
 
+            t_round = _time.perf_counter()
             kd = spec_decode.k
             qprobs = None
             if self._draft_params is not None:
@@ -985,21 +998,30 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             # plain engine's np.asarray(toks))
             out_toks = np.asarray(out_toks)
             n_acc = np.asarray(n_acc)
+            t_done = _time.perf_counter()
+            round_dur = t_done - t_round
             total = 0
             for i, st in enumerate(self._slots):
                 if st is None:
                     continue
                 n = int(n_acc[i])
                 self._telemetry.record_spec(st["rec"], proposed=kd,
-                                            accepted=n)
+                                            accepted=n,
+                                            dur_s=round_dur)
                 finished = False
+                emitted = 0
                 for t in out_toks[i, :n + 1]:
                     st["out"].append(int(t))
                     total += 1
+                    emitted += 1
                     if len(st["out"]) >= max_new_tokens \
                             or self._hit_stop(st["out"]):
                         finished = True
                         break
+                # one dispatch emitted `emitted` tokens for this row —
+                # they share the round-end timestamp in the ITL trail
+                self._telemetry.record_token(st["rec"], n=emitted,
+                                             now=t_done)
                 # the correction token is always the row's new `cur`
                 # (it has no K/V yet — exactly a fresh sampled token)
                 self._cur[i] = out_toks[i, n]
@@ -1054,8 +1076,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         # it)
                         # graftcheck: disable=blocking-call-in-async
                         toks = np.asarray(toks)
+                    t_wave = _time.perf_counter()
                     self._telemetry.record_step(
-                        n_active, _time.perf_counter() - t_step)
+                        n_active, t_wave - t_step, now=t_wave)
                     if self._telemetry.slo is not None:
                         # throttled burn-rate watchdog: breach / storm
                         # transitions postmortem-dump the flight record
@@ -1064,6 +1087,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         if st is None:
                             continue
                         st["out"].append(int(toks[i]))
+                        self._telemetry.record_token(st["rec"],
+                                                     now=t_wave)
                         self._cur[i] = toks[i]
                         if len(st["out"]) >= max_new_tokens \
                                 or self._hit_stop(st["out"]):
@@ -1099,13 +1124,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 await asyncio.sleep(0)
 
         async def _call_continuous(self, prompt, sampling=None, *,
-                                   tenant=None, enqueue_ts=None):
-            """`tenant` / `enqueue_ts` are the fleet-router hooks
-            (serve/router.py): the router backdates `enqueue_ts` to the
-            instant the request entered ITS queue, so this engine's
-            telemetry charges router wait to the request's TTFT/e2e
-            series, and `tenant` tags the record for per-class SLO
-            slicing.  Direct callers omit both."""
+                                   tenant=None, enqueue_ts=None,
+                                   trace=None):
+            """`tenant` / `enqueue_ts` / `trace` are the fleet-router
+            hooks (serve/router.py): the router backdates `enqueue_ts`
+            to the instant the request entered ITS queue, so this
+            engine's telemetry charges router wait to the request's
+            TTFT/e2e series, `tenant` tags the record for per-class
+            SLO slicing, and `trace` is the tracebus TraceContext born
+            at router submit (a fresh engine-origin context is minted
+            when absent).  Direct callers omit all three."""
             import asyncio
 
             sp = None
@@ -1139,7 +1167,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 if shed is not None:
                     rec = self._telemetry.record_enqueue(
                         int(arr.shape[0]), now=enqueue_ts,
-                        tenant=tenant)
+                        tenant=tenant, ctx=trace)
                     self._telemetry.record_reject(
                         rec, reason=f"load shed: {shed}",
                         label=f"shed_{shed}")
@@ -1147,7 +1175,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         f"request shed ({shed}): engine over SLO "
                         f"with {len(self._queue)} queued")
             rec = self._telemetry.record_enqueue(
-                int(arr.shape[0]), now=enqueue_ts, tenant=tenant)
+                int(arr.shape[0]), now=enqueue_ts, tenant=tenant,
+                ctx=trace)
             fut = self._queue.put((arr, rec, sp))
             self._wake.set()
             return await fut
@@ -1204,6 +1233,26 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             occupancy lanes, engine-step lane); writes `path` when
             given and returns the event list."""
             return self._telemetry.export_timeline(path)
+
+        # -- tracebus surface (tools/tracebus.py collects these) -----
+
+        def trace_records(self):
+            """Tracebus request snapshots (hop timestamps, token
+            trail, router spans) for every retained request."""
+            return self._telemetry.trace_records()
+
+        def request_trace(self, request_id):
+            """One request's tracebus snapshot by trace id (or
+            engine-local id); None when unknown to this replica —
+            `handle.method("request_trace").remote(rid)` or GET
+            /api/serve/trace/<rid>."""
+            return self._telemetry.find_request(request_id)
+
+        def anatomy_samples(self, tenant=None):
+            """Raw latency-anatomy samples (ITL gaps, TPOT,
+            critical-path components) — fleet_stats pools these
+            across replicas before summarizing."""
+            return self._telemetry.anatomy_samples(tenant=tenant)
 
         def metrics_snapshot(self):
             """This replica's serve_* metric dumps (histogram buckets

@@ -59,7 +59,9 @@ import numpy as np
 
 from ray_tpu._private import telemetry as _core
 from ray_tpu.serve.slo import worst_burn_rate
-from ray_tpu.serve.telemetry import EngineTelemetry
+from ray_tpu.serve.telemetry import (EngineTelemetry, TraceContext,
+                                     _tracebus_enabled, latency_anatomy,
+                                     merge_anatomy_samples)
 
 __all__ = ["TenantClass", "DEFAULT_TENANT", "FairQueue",
            "AutoscalePolicy", "LLMRouter", "LLMFleet",
@@ -275,9 +277,14 @@ class LLMRouter:
             raise RuntimeError("no live replicas to route to")
         arr = self._normalize(prompt)
         t_submit = time.perf_counter()
+        # the request's causal identity for the tracebus, born HERE —
+        # threaded to the engine alongside enqueue_ts so router wait,
+        # engine queue wait, and device work stitch on one clock
+        ctx = (TraceContext(origin="router")
+               if _tracebus_enabled() else None)
         fut = asyncio.get_running_loop().create_future()
         item = (arr, tenant, sampling, t_submit, fut,
-                next(self._ids))
+                next(self._ids), ctx)
         if self._wfq is not None:
             self._wfq.push(item, tenant)
         else:
@@ -326,25 +333,35 @@ class LLMRouter:
                 item = self._wfq.pop()
             else:
                 item = self._fifo.popleft()
-            arr, tenant, sampling, t_submit, fut, rid = item
+            arr, tenant, sampling, t_submit, fut, rid, ctx = item
             tokens = tuple(int(t) for t in arr)
             rep, policy, matched = self._pick(tokens, cands)
             self.routed_by_policy[policy] += 1
+            if ctx is not None:
+                # the router hop: submit → dispatch, with the routing
+                # decision as span attributes
+                ctx.span("router.route", t_submit,
+                         time.perf_counter(), replica=rep.name,
+                         policy=policy, tenant=tenant,
+                         matched_blocks=matched, router_req=rid)
             self.telemetry.record_route(
                 req=rid, replica=rep.name, policy=policy,
                 tenant=tenant, matched_blocks=matched,
-                outstanding=rep.inflight)
+                outstanding=rep.inflight,
+                **({"trace": ctx.trace_id} if ctx is not None else {}))
             rep.inflight += 1
             rep.routed += 1
             asyncio.get_running_loop().create_task(
                 self._dispatch(rep, arr, tenant, sampling, t_submit,
-                               fut))
+                               fut, ctx))
 
     async def _dispatch(self, rep: ReplicaHandle, arr, tenant,
-                        sampling, t_submit: float, fut) -> None:
+                        sampling, t_submit: float, fut,
+                        ctx=None) -> None:
         try:
             out = await rep.inst(arr, sampling=sampling,
-                                 tenant=tenant, enqueue_ts=t_submit)
+                                 tenant=tenant, enqueue_ts=t_submit,
+                                 trace=ctx)
             if not fut.done():
                 fut.set_result(out)
         except Exception as e:  # noqa: BLE001 - surface to caller
@@ -609,7 +626,57 @@ class LLMFleet:
             "tenants": self.tenant_report(),
             "replicas": replicas,
             "flightrec": self.telemetry.flightrec.stats(),
+            "latency_anatomy": self.latency_anatomy(),
         }
+
+    # -- tracebus (tools/tracebus.py collects these) -------------------
+
+    def anatomy_samples(self, tenant: Optional[str] = None
+                        ) -> Dict[str, Any]:
+        """Raw latency-anatomy samples pooled over every replica (live
+        and retired) — fleet percentiles come from the union of
+        per-request samples, never from averaged summaries."""
+        parts = []
+        for rep in self._replicas + self._retired:
+            fn = getattr(rep.inst, "anatomy_samples", None)
+            if fn is not None:
+                parts.append(fn(tenant=tenant))
+        return merge_anatomy_samples(parts)
+
+    def latency_anatomy(self) -> Dict[str, Any]:
+        """Fleet-wide ITL/TPOT percentiles + critical-path
+        decomposition, overall and per tenant (fleet_stats block)."""
+        samples = self.anatomy_samples()
+        by_tenant = {
+            t: latency_anatomy(self.anatomy_samples(tenant=t))
+            for t in samples["tenants"]}
+        return dict(latency_anatomy(samples), by_tenant=by_tenant)
+
+    def trace_records(self) -> List[Dict[str, Any]]:
+        """Tracebus request snapshots from every replica (replica
+        lane name attached)."""
+        out: List[Dict[str, Any]] = []
+        for rep in self._replicas + self._retired:
+            fn = getattr(rep.inst, "trace_records", None)
+            if fn is None:
+                continue
+            for snap in fn():
+                snap["replica"] = rep.name
+                out.append(snap)
+        return out
+
+    def find_request(self, request_id) -> Optional[Dict[str, Any]]:
+        """Locate one request across replicas by trace id (or
+        engine-local id); None when no replica knows it."""
+        for rep in self._replicas + self._retired:
+            fn = getattr(rep.inst, "request_trace", None)
+            if fn is None:
+                continue
+            snap = fn(request_id)
+            if snap is not None:
+                snap["replica"] = rep.name
+                return snap
+        return None
 
     def shutdown(self) -> None:
         """Stop every engine (live and retired) and deregister."""

@@ -30,8 +30,10 @@ from __future__ import annotations
 
 import collections
 import itertools
+import os
 import threading
 import time
+import uuid
 from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
@@ -168,6 +170,203 @@ def _engine_metrics() -> Dict[str, Any]:
         return _metrics
 
 
+def _tracebus_enabled() -> bool:
+    """Tracebus bookkeeping (TraceContext + per-token timestamps) is
+    always-on unless ``RAYTPU_TRACEBUS=0`` — same opt-out contract as
+    the flight recorder, and guarded by the same <5% overhead test."""
+    return os.environ.get("RAYTPU_TRACEBUS", "1") != "0"
+
+
+class TraceContext:
+    """Causal identity of one request across router → engine → device.
+
+    Born at ``LLMRouter.submit`` (or at engine enqueue for a request
+    that never crossed a router) and threaded alongside the existing
+    ``enqueue_ts`` backdating path, so every component that touches the
+    request can stamp spans onto one object.  All timestamps are on the
+    process monotonic clock (``time.perf_counter``) — the same domain
+    as telemetry, flightrec, and the device observatory, which is what
+    lets the tracebus collector merge all three onto a single timeline.
+
+    Span ids are ``"<trace_id>:<n>"`` with ``:0`` reserved for the
+    implicit request-root span, so parent/child stitching needs no
+    shared counter beyond the context itself (requests are pumped from
+    a single event loop; the int bump is not contended)."""
+
+    __slots__ = ("trace_id", "origin", "spans", "_n")
+
+    def __init__(self, origin: str = "engine",
+                 trace_id: Optional[str] = None):
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.origin = origin  # "router" | "engine"
+        self.spans: List[Dict[str, Any]] = []
+        self._n = 0
+
+    @property
+    def root_id(self) -> str:
+        return f"{self.trace_id}:0"
+
+    def span(self, name: str, start: float, end: float,
+             parent: Optional[str] = None, **attrs: Any) -> str:
+        self._n += 1
+        sid = f"{self.trace_id}:{self._n}"
+        self.spans.append({
+            "name": name, "span_id": sid,
+            "parent_id": parent or self.root_id,
+            "start": float(start), "end": float(end), "attrs": attrs,
+        })
+        return sid
+
+
+#: critical-path components; together with ``e2e_ms`` these are the
+#: keys of every decomposition dict, and the components sum to
+#: ``e2e_ms`` exactly (modulo float rounding) by construction.
+CRITICAL_PATH_COMPONENTS = (
+    "router_wait_ms", "queue_wait_ms", "requeue_ms", "prefill_ms",
+    "inter_token_ms", "spec_rollback_ms")
+
+
+def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Decompose one completed request's e2e latency:
+
+        e2e = router_wait + queue_wait + requeue + prefill
+              + inter_token + spec_rollback
+
+    * router_wait — submit → engine enqueue (0 without a router);
+    * queue_wait  — engine enqueue → admit, minus time spent requeued;
+    * requeue     — first KV-exhaustion requeue → eventual admit;
+    * prefill     — admit → first token;
+    * inter_token — Σ inter-token gaps (first token → finish), minus
+      the estimated rollback share below;
+    * spec_rollback — decode time attributed to rejected draft
+      positions in speculative verify rounds.
+
+    Timestamps are clamped into the [enqueue, finish] window so a
+    record driven by a synthetic test clock degrades to zeros instead
+    of negative components.  None for incomplete/failed records."""
+    if rec.get("finish") is None or rec.get("status") != "ok":
+        return None
+    if rec.get("admit") is None or rec.get("first_token") is None:
+        return None
+    enq, fin = rec["enqueue"], rec["finish"]
+    e2e = max(0.0, fin - enq)
+    t_eng = rec.get("engine_enqueue")
+    t_eng = enq if t_eng is None else min(max(t_eng, enq), fin)
+    admit = min(max(rec["admit"], t_eng), fin)
+    first = min(max(rec["first_token"], admit), fin)
+    router_wait = t_eng - enq
+    wait = admit - t_eng
+    requeue = 0.0
+    rq_ts = rec.get("requeue_ts")
+    if rq_ts is not None:
+        requeue = min(max(0.0, admit - rq_ts), wait)
+    queue_wait = wait - requeue
+    prefill = first - admit
+    decode = fin - first
+    rollback = min(max(0.0, float(rec.get("spec_rollback_s") or 0.0)),
+                   decode)
+    ms = 1e3
+    return {
+        "e2e_ms": round(e2e * ms, 4),
+        "router_wait_ms": round(router_wait * ms, 4),
+        "queue_wait_ms": round(queue_wait * ms, 4),
+        "requeue_ms": round(requeue * ms, 4),
+        "prefill_ms": round(prefill * ms, 4),
+        "inter_token_ms": round((decode - rollback) * ms, 4),
+        "spec_rollback_ms": round(rollback * ms, 4),
+    }
+
+
+def _token_gaps_ms(rec: Dict[str, Any]) -> List[float]:
+    """Inter-token gaps (ms) from the per-token timestamp trail.
+    Tokens emitted by one spec-verify dispatch share a timestamp, so
+    their intra-round gaps are 0 — the single-dispatch reality."""
+    ts = rec.get("token_ts")
+    if not ts or len(ts) < 2:
+        return []
+    return [(b - a) * 1e3 for a, b in zip(ts, ts[1:])]
+
+
+def request_snapshot(rec: Dict[str, Any],
+                     deployment: Optional[str] = None
+                     ) -> Dict[str, Any]:
+    """Plain JSON-able view of one lifecycle record for the tracebus
+    collector: hop timestamps, the token trail, router-side spans from
+    the TraceContext, and the derived critical-path decomposition."""
+    ctx = rec.get("ctx")
+    kv = rec.get("kv_reserve")
+    return {
+        "request": (ctx.trace_id if ctx is not None
+                    else f"req{rec['id']}"),
+        "trace_id": ctx.trace_id if ctx is not None else None,
+        "origin": ctx.origin if ctx is not None else "engine",
+        "id": rec["id"],
+        "deployment": deployment,
+        "tenant": rec.get("tenant"),
+        "status": rec.get("status"),
+        "prompt_len": rec.get("prompt_len"),
+        "tokens": rec.get("tokens", 0),
+        "bucket": rec.get("bucket"),
+        "slot": rec.get("slot"),
+        "enqueue": rec.get("enqueue"),
+        "engine_enqueue": rec.get("engine_enqueue"),
+        "admit": rec.get("admit"),
+        "first_token": rec.get("first_token"),
+        "finish": rec.get("finish"),
+        "token_ts": (list(rec["token_ts"])
+                     if rec.get("token_ts") else None),
+        "requeues": rec.get("requeues", 0),
+        "requeue_ts": rec.get("requeue_ts"),
+        "spec_rounds": rec.get("spec_rounds", 0),
+        "spec_proposed": rec.get("spec_proposed", 0),
+        "spec_accepted": rec.get("spec_accepted", 0),
+        "spec_rollback_s": rec.get("spec_rollback_s", 0.0),
+        "kv_reserve": list(kv) if kv is not None else None,
+        "spans": ([dict(s) for s in ctx.spans]
+                  if ctx is not None else []),
+        "critical_path": critical_path(rec),
+        "itl_ms": _token_gaps_ms(rec),
+    }
+
+
+def empty_anatomy_samples() -> Dict[str, Any]:
+    return {"itl_ms": [], "tpot_ms": [],
+            "critical_path": {k: [] for k in
+                              ("e2e_ms",) + CRITICAL_PATH_COMPONENTS},
+            "tenants": []}
+
+
+def merge_anatomy_samples(parts: List[Dict[str, Any]]
+                          ) -> Dict[str, Any]:
+    """Pool raw latency-anatomy samples across engines (fleet_stats
+    aggregates replicas this way so fleet percentiles are computed
+    over the union, not averaged per-replica summaries)."""
+    out = empty_anatomy_samples()
+    tenants: set = set()
+    for p in parts:
+        if not p:
+            continue
+        out["itl_ms"].extend(p.get("itl_ms", ()))
+        out["tpot_ms"].extend(p.get("tpot_ms", ()))
+        for k, vals in p.get("critical_path", {}).items():
+            out["critical_path"].setdefault(k, []).extend(vals)
+        tenants.update(p.get("tenants", ()))
+    out["tenants"] = sorted(tenants)
+    return out
+
+
+def latency_anatomy(samples: Dict[str, Any]) -> Dict[str, Any]:
+    """Summarize raw anatomy samples into the stable
+    ``engine_stats()["latency_anatomy"]`` shape (sans by_tenant)."""
+    return {
+        "requests": len(samples["critical_path"]["e2e_ms"]),
+        "itl_ms": _core.summarize(samples["itl_ms"]),
+        "tpot_ms": _core.summarize(samples["tpot_ms"]),
+        "critical_path": {k: _core.summarize(v) for k, v
+                          in samples["critical_path"].items()},
+    }
+
+
 class EngineTelemetry:
     """Lifecycle recorder for one engine (deployment replica or bench
     harness).  All methods take an optional ``now`` (seconds, from
@@ -215,27 +414,53 @@ class EngineTelemetry:
     def _now(self, now: Optional[float]) -> float:
         return time.perf_counter() if now is None else now
 
+    @staticmethod
+    def _trace_tag(rec: Dict[str, Any]) -> Dict[str, str]:
+        """Flightrec field tagging the event with the request's trace
+        id, when one is in scope — lets postmortems follow a single
+        request across the journal ({} keeps untraced events lean)."""
+        ctx = rec.get("ctx")
+        return {"trace": ctx.trace_id} if ctx is not None else {}
+
     # -- lifecycle ---------------------------------------------------------
 
     def record_enqueue(self, prompt_len: int,
                        now: Optional[float] = None,
-                       tenant: Optional[str] = None) -> Dict[str, Any]:
+                       tenant: Optional[str] = None,
+                       ctx: Optional[TraceContext] = None,
+                       engine_now: Optional[float] = None
+                       ) -> Dict[str, Any]:
         """`tenant` tags the record for per-tenant SLO slicing (fleet
         router traffic classes); `now` may be BACKDATED to the instant
         the request entered the fleet router, so TTFT/e2e/queue-wait
         series charge router queueing to the request — the fleet-level
-        latency a client actually observed, not just engine wait."""
+        latency a client actually observed, not just engine wait.
+        `ctx` is the TraceContext born at router submit (a fresh
+        engine-origin one is minted here when absent and the tracebus
+        is enabled); `engine_now` is the instant the ENGINE saw the
+        request, kept separate from the backdated `now` so the
+        critical-path decomposition can split router wait from engine
+        queue wait."""
+        backdated = now is not None
         now = self._now(now)
+        t_eng = self._now(engine_now) if backdated else now
+        if ctx is None and _tracebus_enabled():
+            ctx = TraceContext(origin="engine")
         rec: Dict[str, Any] = {
             "id": next(self._ids), "prompt_len": int(prompt_len),
-            "enqueue": now, "admit": None, "first_token": None,
-            "finish": None, "slot": None, "bucket": None, "tokens": 0,
+            "enqueue": now, "engine_enqueue": t_eng, "admit": None,
+            "first_token": None, "finish": None, "slot": None,
+            "bucket": None, "tokens": 0,
             "spec_proposed": 0, "spec_accepted": 0,
+            "spec_rounds": 0, "spec_rollback_s": 0.0,
+            "requeues": 0, "requeue_ts": None, "kv_reserve": None,
+            "token_ts": [] if ctx is not None else None,
             "status": "queued", "trace": None, "tenant": tenant,
+            "ctx": ctx,
         }
         if tracing.is_enabled():
             rec["trace"] = tracing.record_span(
-                f"serve {self.deployment}.request")
+                f"serve {self.deployment}.request", start=now)
         with self._lock:
             self._counts["enqueued"] += 1
             self._queue_depth += 1
@@ -262,7 +487,8 @@ class EngineTelemetry:
         self.flightrec.record(
             "admit", ts=now, req=rec["id"], slot=int(slot),
             bucket=int(bucket),
-            wait_ms=round((now - rec["enqueue"]) * 1e3, 3))
+            wait_ms=round((now - rec["enqueue"]) * 1e3, 3),
+            **self._trace_tag(rec))
         if first_seen:
             # a never-seen padded prompt shape means one fresh XLA
             # compile of the prefill program for this bucket
@@ -296,11 +522,30 @@ class EngineTelemetry:
         now = self._now(now)
         rec["first_token"] = now
         rec["tokens"] = max(1, rec["tokens"])
+        if rec.get("token_ts") is not None:
+            rec["token_ts"].append(now)
         self._m["ttft"].observe(
             (now - rec["enqueue"]) * 1e3, tags=self._tags)
         self.flightrec.record(
             "first_token", ts=now, req=rec["id"],
-            ttft_ms=round((now - rec["enqueue"]) * 1e3, 3))
+            ttft_ms=round((now - rec["enqueue"]) * 1e3, 3),
+            **self._trace_tag(rec))
+
+    def record_token(self, rec: Dict[str, Any], n: int = 1,
+                     now: Optional[float] = None) -> None:
+        """Stamp `n` decode tokens for one request at one instant (a
+        spec-verify dispatch emits several tokens in one device round
+        trip, so they legitimately share a timestamp).  The trail
+        feeds per-request ITL/TPOT and the inter-token leg of the
+        critical path; a no-op when the tracebus is disabled."""
+        ts = rec.get("token_ts")
+        if ts is None:
+            return
+        now = self._now(now)
+        if n == 1:
+            ts.append(now)
+        else:
+            ts.extend([now] * int(n))
 
     def record_step(self, n_active: int, dur_s: float,
                     now: Optional[float] = None,
@@ -332,16 +577,25 @@ class EngineTelemetry:
             dur_ms=round(dur_s * 1e3, 3), tokens=n_tokens)
 
     def record_spec(self, rec: Dict[str, Any], proposed: int,
-                    accepted: int) -> None:
+                    accepted: int,
+                    dur_s: Optional[float] = None) -> None:
         """One speculative verify round for one request: the draft
         proposed `proposed` tokens, the target accepted `accepted` of
         them (0 <= accepted <= proposed; the +1 correction/bonus token
         every round also emits is counted by record_step, not here).
         Feeds the per-request acceptance-rate percentiles in
-        engine_stats()["spec"] and the serve_spec_* counters."""
+        engine_stats()["spec"] and the serve_spec_* counters.  `dur_s`
+        is the round's host walltime; the rejected-position share of
+        it accumulates as the request's spec_rollback critical-path
+        leg (rejected / (k+1) of the dispatch bought nothing)."""
         proposed, accepted = int(proposed), int(accepted)
         rec["spec_proposed"] += proposed
         rec["spec_accepted"] += accepted
+        rec["spec_rounds"] = rec.get("spec_rounds", 0) + 1
+        if dur_s and proposed > accepted:
+            rec["spec_rollback_s"] = (
+                rec.get("spec_rollback_s", 0.0)
+                + float(dur_s) * (proposed - accepted) / (proposed + 1))
         with self._lock:
             self._spec["proposed"] += proposed
             self._spec["accepted"] += accepted
@@ -350,7 +604,32 @@ class EngineTelemetry:
         self._m["spec_accepted"].inc(accepted, tags=self._tags)
         self._m["spec_rounds"].inc(tags=self._tags)
         self.flightrec.record("spec_round", req=rec["id"],
-                              proposed=proposed, accepted=accepted)
+                              proposed=proposed, accepted=accepted,
+                              **self._trace_tag(rec))
+
+    def record_requeue(self, rec: Dict[str, Any], need: int = 0,
+                       reason: str = "pool_exhausted",
+                       now: Optional[float] = None) -> None:
+        """Admission bounced the request back to the queue head (KV
+        pool or COW exhaustion).  First bounce stamps `requeue_ts` so
+        the critical path can charge the exhaustion stall separately
+        from ordinary queue wait."""
+        now = self._now(now)
+        rec["requeues"] = rec.get("requeues", 0) + 1
+        if rec.get("requeue_ts") is None:
+            rec["requeue_ts"] = now
+        self.flightrec.record(
+            "requeue", ts=now, req=rec["id"], need=int(need),
+            reason=reason, **self._trace_tag(rec))
+
+    def record_kv_reserve(self, rec: Dict[str, Any], start: float,
+                          end: float, blocks: int = 0,
+                          hit_blocks: int = 0) -> None:
+        """The BlockPager reservation window for one admission
+        (prefix match + allocate + COW), kept on the record so the
+        tracebus can render it as its own span inside queue wait."""
+        rec["kv_reserve"] = (float(start), float(end), int(blocks),
+                             int(hit_blocks))
 
     def record_finish(self, rec: Dict[str, Any],
                       n_tokens: Optional[int] = None,
@@ -367,11 +646,16 @@ class EngineTelemetry:
         self.flightrec.record(
             "finish", ts=now, req=rec["id"], slot=rec["slot"],
             tokens=rec["tokens"],
-            latency_ms=round((now - rec["enqueue"]) * 1e3, 3))
+            latency_ms=round((now - rec["enqueue"]) * 1e3, 3),
+            **self._trace_tag(rec))
         if rec["trace"] is not None:
             trace_id, span_id = rec["trace"]
+            start = (rec["admit"] if rec["admit"] is not None
+                     else rec["enqueue"])
             tracing.record_span(f"engine {self.deployment}.generate",
-                                trace_id=trace_id, parent_id=span_id)
+                                trace_id=trace_id, parent_id=span_id,
+                                start=start,
+                                duration=max(0.0, now - start))
 
     def record_reject(self, rec: Dict[str, Any], reason: str = "",
                       now: Optional[float] = None,
@@ -390,7 +674,8 @@ class EngineTelemetry:
         self._m["rejected"].inc(tags=dict(self._tags, reason=label))
         self.flightrec.record(
             "shed" if label.startswith("shed") else "reject",
-            req=rec["id"], label=label, reason=reason[:120])
+            req=rec["id"], label=label, reason=reason[:120],
+            **self._trace_tag(rec))
 
     # -- paged KV cache (serve/kv_pager.py feeds these) --------------------
 
@@ -421,17 +706,20 @@ class EngineTelemetry:
                      tenant: Optional[str] = None,
                      matched_blocks: int = 0,
                      outstanding: int = 0,
-                     now: Optional[float] = None) -> None:
+                     now: Optional[float] = None,
+                     trace: Optional[str] = None) -> None:
         """One routing decision: request `req` dispatched to `replica`
         under `policy` ("prefix_affinity" | "p2c" | "round_robin"),
         having matched `matched_blocks` resident prefix blocks there.
         `outstanding` is the replica's in-flight count at dispatch —
-        the load the power-of-two-choices fallback compared."""
+        the load the power-of-two-choices fallback compared.  `trace`
+        is the request's tracebus id when one is in scope."""
         self.flightrec.record(
             "route", ts=now, req=int(req), replica=str(replica),
             policy=str(policy), tenant=tenant,
             matched_blocks=int(matched_blocks),
-            outstanding=int(outstanding))
+            outstanding=int(outstanding),
+            **({"trace": trace} if trace is not None else {}))
 
     def record_scale(self, direction: str, n_before: int, n_after: int,
                      reason: str, signal: float = 0.0,
@@ -467,7 +755,8 @@ class EngineTelemetry:
         self._retire(rec, "errors")
         self._m["errors"].inc(tags=self._tags)
         self.flightrec.record("error", req=rec["id"],
-                              error=error[:200])
+                              error=error[:200],
+                              **self._trace_tag(rec))
 
     def _retire(self, rec: Dict[str, Any], count_key: str) -> None:
         with self._lock:
@@ -508,6 +797,58 @@ class EngineTelemetry:
                     (r["finish"], (r["finish"] - r["enqueue"]) * 1e3))
         return out
 
+    def anatomy_samples(self, tenant: Optional[str] = None
+                        ) -> Dict[str, Any]:
+        """Raw latency-anatomy samples over retired records: pooled
+        inter-token gaps, per-request TPOT, and the critical-path
+        decomposition per component — the un-summarized stream that
+        fleet_stats pools across replicas before taking percentiles."""
+        with self._lock:
+            recs = list(self._done)
+        if tenant is not None:
+            recs = [r for r in recs if r.get("tenant") == tenant]
+        out = empty_anatomy_samples()
+        tenants: set = set()
+        for r in recs:
+            if r.get("tenant"):
+                tenants.add(r["tenant"])
+            out["itl_ms"].extend(_token_gaps_ms(r))
+            cp = critical_path(r)
+            if cp is not None:
+                for k, v in cp.items():
+                    out["critical_path"][k].append(v)
+            if (r.get("status") == "ok" and r.get("finish") is not None
+                    and r.get("first_token") is not None
+                    and r.get("tokens", 0) > 1):
+                out["tpot_ms"].append(
+                    (r["finish"] - r["first_token"]) * 1e3
+                    / (r["tokens"] - 1))
+        out["tenants"] = sorted(tenants)
+        return out
+
+    def trace_records(self) -> List[Dict[str, Any]]:
+        """Tracebus view of every retained request (retired + live) as
+        plain dicts — what the fleet collector merges."""
+        with self._lock:
+            recs = list(self._done) + list(self._active.values())
+        return [request_snapshot(r, self.deployment) for r in recs]
+
+    def find_request(self, request_id: Any) -> Optional[Dict[str, Any]]:
+        """Locate one request by trace id (full or unambiguous prefix)
+        or by engine-local integer id; None when unknown here."""
+        rid = str(request_id)
+        with self._lock:
+            recs = list(self._done) + list(self._active.values())
+        for r in recs:
+            ctx = r.get("ctx")
+            if ctx is not None and (ctx.trace_id == rid
+                                    or (len(rid) >= 6
+                                        and ctx.trace_id.startswith(rid))):
+                return request_snapshot(r, self.deployment)
+            if str(r["id"]) == rid:
+                return request_snapshot(r, self.deployment)
+        return None
+
     def engine_stats(self) -> Dict[str, Any]:
         """Snapshot of everything ``bench``/dashboards ask the engine:
         percentiles over retained records, counters, throughput, and
@@ -535,6 +876,9 @@ class EngineTelemetry:
         lat = [(r["finish"] - r["enqueue"]) * 1e3 for r in recs
                if r["finish"] is not None and r["status"] == "ok"]
         inter = [d * 1e3 for _, d, _ in steps]
+        anatomy = self.anatomy_samples()
+        by_tenant = {t: latency_anatomy(self.anatomy_samples(tenant=t))
+                     for t in anatomy["tenants"]}
         if steps:
             window = (steps[-1][0] - steps[0][0] + steps[0][1])
             win_tokens = sum(n for _, _, n in steps)
@@ -585,6 +929,12 @@ class EngineTelemetry:
                     [r["spec_accepted"] / r["spec_proposed"]
                      for r in recs if r.get("spec_proposed", 0)]),
             },
+            # round-14: per-token latency anatomy — ITL/TPOT
+            # percentiles and the critical-path decomposition
+            # (e2e = router_wait + queue_wait + requeue + prefill +
+            # inter_token + spec_rollback), overall and per tenant
+            "latency_anatomy": dict(latency_anatomy(anatomy),
+                                    by_tenant=by_tenant),
             # round-12: SLO burn rates (None until the deployment
             # configures an SLOConfig — key presence is the contract)
             # and the flight recorder's ring occupancy/drop counters

@@ -248,7 +248,8 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 latency_slo_ms: Optional[float] = None,
                 admission_policy=None, slo=None, spec_decode=None,
                 mesh=None,
-                config_overrides: Optional[Dict[str, Any]] = None
+                config_overrides: Optional[Dict[str, Any]] = None,
+                trace_dump: Optional[str] = None
                 ) -> Dict[str, Any]:
     """One synthetic-traffic run against a fresh in-process engine
     (no serve cluster: the deployment class is instantiated directly,
@@ -289,6 +290,11 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                                  time_scale=time_scale,
                                  latency_slo_ms=latency_slo_ms)
             report["engine"] = inst.engine_stats()
+            if trace_dump:  # tracebus snapshot, pre-shutdown
+                from ray_tpu.tools import tracebus
+
+                tracebus.write_dump(tracebus.collect(inst),
+                                    trace_dump)
         finally:
             inst.shutdown_engine()
         return report
@@ -312,7 +318,32 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         sp = eng.get("spec") or {}
         report["spec_accept_rate"] = sp.get("accept_rate")
         report["spec_rounds"] = sp.get("rounds")
+    _flatten_anatomy(report, eng.get("latency_anatomy"))
     return report
+
+
+#: TTFT-side legs of the tracebus critical path (everything before the
+#: first token; the decode-side legs are inter_token + spec_rollback)
+_TTFT_COMPONENTS = ("router_wait_ms", "queue_wait_ms", "requeue_ms",
+                    "prefill_ms")
+
+
+def _flatten_anatomy(report: Dict[str, Any],
+                     anatomy: Optional[Dict[str, Any]]) -> None:
+    """Lift the headline tracebus numbers out of a latency_anatomy
+    block into top-level report fields (itl_ms_p50/p99 +
+    ttft_critical_path) for SWEEPJSON consumers."""
+    anatomy = anatomy or {}
+    report["latency_anatomy"] = anatomy
+    itl = anatomy.get("itl_ms") or {}
+    report["itl_ms_p50"] = itl.get("p50")
+    report["itl_ms_p99"] = itl.get("p99")
+    cp = anatomy.get("critical_path") or {}
+    ttft: Dict[str, Any] = {k: (cp.get(k) or {}).get("p99")
+                            for k in _TTFT_COMPONENTS}
+    vals = [v for v in ttft.values() if v is not None]
+    ttft["total_p99_ms"] = round(sum(vals), 3) if vals else None
+    report["ttft_critical_path"] = ttft
 
 
 async def drive_fleet(fleet, requests: List[TrafficRequest], *,
@@ -366,7 +397,8 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
                       routing: str = "prefix", wfq: bool = True,
                       autoscale=None, slo=None, admission_policy=None,
                       mesh=None,
-                      config_overrides: Optional[Dict[str, Any]] = None
+                      config_overrides: Optional[Dict[str, Any]] = None,
+                      trace_dump: Optional[str] = None
                       ) -> Dict[str, Any]:
     """One multi-tenant traffic run against a fresh in-process fleet
     (``build_llm_fleet``): N paged continuous engines behind the
@@ -395,6 +427,11 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
             report = await drive_fleet(fleet, requests,
                                        time_scale=time_scale)
             report["fleet"] = fleet.fleet_stats()
+            if trace_dump:  # tracebus snapshot, pre-shutdown
+                from ray_tpu.tools import tracebus
+
+                tracebus.write_dump(tracebus.collect(fleet),
+                                    trace_dump)
         finally:
             fleet.shutdown()
         return report
@@ -413,4 +450,5 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
         for obj, o in blk["objectives"].items():
             flat[f"{tname}_{obj}_slo_attainment"] = o["attainment"]
     report["tenant_slo_attainment"] = flat
+    _flatten_anatomy(report, report["fleet"].get("latency_anatomy"))
     return report

@@ -117,14 +117,21 @@ def _headroom(block: Dict[str, Any], cls: str,
 
 
 def attribute(programs: Dict[str, Dict[str, Any]],
-              device: Optional[Dict[str, Any]] = None
+              device: Optional[Dict[str, Any]] = None,
+              request_anatomy: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Attribute a programs snapshot against the device roofline.
 
     ``programs`` is any ``{name: block}`` snapshot the observatory
     emits; ``device`` is a :func:`device_stats.device_roofline` block
     (taken from the snapshot's origin when attributing a remote dump;
-    defaults to this process's devices).  Returns::
+    defaults to this process's devices).  ``request_anatomy`` is an
+    optional tracebus ``request_evidence()`` block (tools/tracebus.py)
+    — the p99 per-request critical-path decomposition — which names
+    the dominant *lifecycle* leg (queue wait, prefill, inter-token
+    gaps, ...) to complement the roofline's program-granularity view:
+    a device bottleneck only matters if the request tail is actually
+    spent on device.  Returns::
 
         {"device": {...roofline...},
          "programs": {name: {"class", "arithmetic_intensity", "mfu",
@@ -132,6 +139,7 @@ def attribute(programs: Dict[str, Dict[str, Any]],
                              "busy_ms", "recompile_storm", "knobs"}},
          "ranked": [names, best-score first],
          "bottleneck": name | None,
+         "request_anatomy": evidence block | None,
          "summary": one-sentence statement}
     """
     if device is None:
@@ -177,8 +185,19 @@ def attribute(programs: Dict[str, Dict[str, Any]],
                    "compiled but never ran; nothing to attribute")
     else:
         summary = "no programs registered"
+    if request_anatomy and request_anatomy.get("dominant_component"):
+        dom = request_anatomy["dominant_component"]
+        pct = request_anatomy.get("percentile", 99)
+        comps = (request_anatomy.get("overall") or {}).get(
+            "components") or {}
+        val = comps.get(dom)
+        summary += (
+            f"; request p{pct:g} tail dominated by {dom}"
+            + (f" ({val:.1f} ms)" if isinstance(val, (int, float))
+               else ""))
     return {"device": device, "programs": out, "ranked": ranked,
-            "bottleneck": bottleneck, "summary": summary}
+            "bottleneck": bottleneck,
+            "request_anatomy": request_anatomy, "summary": summary}
 
 
 def attribute_registry() -> Dict[str, Any]:
@@ -209,6 +228,19 @@ def render_text(report: Dict[str, Any]) -> str:
             f"share={p['time_share']:<7.2%} "
             f"headroom={'-' if p['headroom'] is None else p['headroom']}"
             f" score={p['score']}")
+    anatomy = report.get("request_anatomy")
+    if anatomy and anatomy.get("overall", {}).get("requests"):
+        over = anatomy["overall"]
+        comps = over["components"]
+        pct = anatomy.get("percentile", 99)
+        parts = " ".join(
+            f"{k.replace('_ms', '')}={comps[k]:.1f}"
+            for k in sorted(comps) if k != "e2e_ms"
+            and isinstance(comps.get(k), (int, float)))
+        lines += ["", f"  request p{pct:g} critical path "
+                      f"({over['requests']} reqs, "
+                      f"e2e {comps.get('e2e_ms') or 0.0:.1f} ms): "
+                      f"{parts}"]
     lines += ["", report["summary"]]
     return "\n".join(lines)
 

@@ -55,11 +55,23 @@ def filter_events(events: List[Dict[str, Any]], *,
                   kinds: Optional[List[str]] = None,
                   since: Optional[float] = None,
                   until: Optional[float] = None,
-                  last: Optional[int] = None) -> List[Dict[str, Any]]:
+                  last: Optional[int] = None,
+                  request: Optional[str] = None
+                  ) -> List[Dict[str, Any]]:
+    """`request` follows ONE request through the journal: events whose
+    ``req`` equals it (engine-local id) or whose ``trace`` matches it
+    (tracebus id, full or prefix — serve/telemetry.py tags lifecycle
+    and kv_* events with the trace in scope)."""
     out = events
     if kinds:
         want = set(kinds)
         out = [e for e in out if e.get("kind") in want]
+    if request is not None:
+        rid = str(request)
+        out = [e for e in out
+               if str(e.get("req")) == rid
+               or (isinstance(e.get("trace"), str)
+                   and e["trace"].startswith(rid))]
     if since is not None:
         out = [e for e in out if e.get("t_s", 0.0) >= since]
     if until is not None:
@@ -245,6 +257,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="relative seconds (t_s) lower bound")
     p.add_argument("--until", type=float, default=None,
                    help="relative seconds (t_s) upper bound")
+    p.add_argument("--request", default=None,
+                   help="follow one request: engine-local id (req "
+                        "field) or tracebus trace id / prefix")
 
     p = sub.add_parser("trace",
                        help="chrome-trace instant-event lane")
@@ -274,7 +289,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         kinds = args.kind.split(",") if args.kind else None
         for e in filter_events(doc["events"], kinds=kinds,
                                since=args.since, until=args.until,
-                               last=args.last):
+                               last=args.last, request=args.request):
             print(json.dumps(e, sort_keys=True))
         return 0
     if args.cmd == "trace":

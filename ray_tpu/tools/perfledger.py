@@ -67,6 +67,9 @@ _SWEEP_FIELDS = (
     "interactive_ttft_slo_attainment",
     "interactive_e2e_slo_attainment",
     "batch_ttft_slo_attainment", "batch_e2e_slo_attainment",
+    # tracebus per-token anatomy (itl = inter-token latency, ms →
+    # lower is better via the _ms suffix; no override applies)
+    "itl_ms_p50", "itl_ms_p99",
 )
 
 #: substrings marking a metric where SMALLER is better

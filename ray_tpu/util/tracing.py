@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -29,12 +30,19 @@ _memory_spans: Optional[Any] = None
 
 @dataclasses.dataclass
 class SpanRecord:
-    """Fallback span (surface-compatible with the bits tests read)."""
+    """Fallback span (surface-compatible with the bits tests read).
+
+    ``start`` is on the process monotonic clock (``time.perf_counter``)
+    — the same domain as telemetry, flightrec, and the device
+    observatory — so fallback spans can render into a shared timeline.
+    ``duration`` is 0.0 for point spans recorded without an end."""
 
     name: str
     trace_id: str
     span_id: str
     parent_id: Optional[str]
+    start: float = 0.0
+    duration: float = 0.0
 
 
 _fallback_spans: List[SpanRecord] = []
@@ -105,12 +113,17 @@ def reset_tracing() -> None:
 
 
 def record_span(name: str, trace_id: Optional[str] = None,
-                parent_id: Optional[str] = None):
+                parent_id: Optional[str] = None,
+                start: Optional[float] = None,
+                duration: float = 0.0):
     """Record one standalone span event and return its identity as a
     ``(trace_id, span_id)`` pair (None when tracing is off).  The serve
     engine telemetry uses this to link a request's root span to the
     engine-side work span: pass the returned pair back as
-    ``trace_id``/``parent_id`` to record a child."""
+    ``trace_id``/``parent_id`` to record a child.  ``start``/``duration``
+    (monotonic seconds) stamp the fallback record so it can render into
+    a timeline; under the OTel backend the span carries its own clock
+    and the hints are ignored."""
     if not _enabled:
         return None
     if _mode == "otel":
@@ -122,7 +135,8 @@ def record_span(name: str, trace_id: Optional[str] = None,
         return (format(ctx.trace_id, "032x"),
                 format(ctx.span_id, "016x"))
     tid = trace_id or uuid.uuid4().hex
-    return (tid, _record(name, tid, parent_id))
+    return (tid, _record(name, tid, parent_id,
+                         start=start, duration=duration))
 
 
 def recorded_spans() -> List[Any]:
@@ -132,11 +146,16 @@ def recorded_spans() -> List[Any]:
         return list(_fallback_spans)
 
 
-def _record(name: str, trace_id: str, parent_id: Optional[str]) -> str:
+def _record(name: str, trace_id: str, parent_id: Optional[str],
+            start: Optional[float] = None,
+            duration: float = 0.0) -> str:
     span_id = uuid.uuid4().hex[:16]
+    if start is None:
+        start = time.perf_counter()
     with _fallback_lock:
         _fallback_spans.append(
-            SpanRecord(name, trace_id, span_id, parent_id))
+            SpanRecord(name, trace_id, span_id, parent_id,
+                       start, duration))
         if len(_fallback_spans) > 10_000:
             del _fallback_spans[:5_000]
     return span_id

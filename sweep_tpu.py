@@ -194,6 +194,9 @@ def _run_traffic_variant(max_slots, kw, out):
                "e2e_slo_attainment":
                    (slo_rep.get("e2e") or {}).get("attainment"),
                "spec_accept_rate": rep.get("spec_accept_rate"),
+               "itl_ms_p50": rep.get("itl_ms_p50"),
+               "itl_ms_p99": rep.get("itl_ms_p99"),
+               "ttft_critical_path": rep.get("ttft_critical_path"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],
@@ -289,6 +292,9 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
         rec = {"sweep": variant,
                "router_prefix_hit_rate":
                    rep["router_prefix_hit_rate"],
+               "itl_ms_p50": rep.get("itl_ms_p50"),
+               "itl_ms_p99": rep.get("itl_ms_p99"),
+               "ttft_critical_path": rep.get("ttft_critical_path"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],

@@ -30,7 +30,17 @@ TOP_KEYS = {
     "max_active_slots", "max_slots", "prefill_buckets",
     "prefill_compiles", "program_compiles", "rejections_by_reason",
     "kv_cache", "spec", "slo", "flightrec", "programs",
+    "latency_anatomy",
 }
+
+ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "critical_path",
+                "by_tenant"}
+
+CRITICAL_PATH_KEYS = {"e2e_ms", "router_wait_ms", "queue_wait_ms",
+                      "requeue_ms", "prefill_ms", "inter_token_ms",
+                      "spec_rollback_ms"}
+
+SUMMARY_KEYS = {"count", "mean", "p50", "p95", "p99", "max"}
 
 SPEC_KEYS = {"proposed", "accepted", "rejected", "rounds",
              "accept_rate", "accept_rate_per_request"}
@@ -127,6 +137,26 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
                                 "burn_rate"}
     assert blk["breached"] is False      # targets are unreachable-slow
     assert blk["breaches"] == 0 and blk["dumps"] == []
+
+    # tracebus latency anatomy: ITL/TPOT percentiles + the
+    # critical-path decomposition, same shape across the whole matrix
+    anatomy = stats["latency_anatomy"]
+    assert set(anatomy) == ANATOMY_KEYS
+    assert anatomy["requests"] == 2  # both requests finished ok
+    assert set(anatomy["itl_ms"]) == SUMMARY_KEYS
+    assert set(anatomy["tpot_ms"]) == SUMMARY_KEYS
+    assert set(anatomy["critical_path"]) == CRITICAL_PATH_KEYS
+    for comp in anatomy["critical_path"].values():
+        assert set(comp) == SUMMARY_KEYS
+    # 3 new tokens per request -> inter-token gaps were recorded
+    assert anatomy["itl_ms"]["count"] > 0
+    # components sum to e2e (the invariant critical-path attribution
+    # rests on), checked at the mean since summaries are per-component
+    cp = anatomy["critical_path"]
+    comp_sum = sum(cp[k]["mean"] for k in CRITICAL_PATH_KEYS
+                   if k != "e2e_ms")
+    assert comp_sum == pytest.approx(cp["e2e_ms"]["mean"], rel=0.05)
+    assert anatomy["by_tenant"] == {}  # no tenant tags in this run
 
     # flight recorder: always on by default, journaling this run
     fr = stats["flightrec"]

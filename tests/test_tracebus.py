@@ -1,0 +1,393 @@
+"""Request tracebus: causal span trees, critical-path attribution,
+and the merged fleet timeline.
+
+The acceptance test is the headline: a 2-replica ``run_traffic_fleet``
+run exports ONE merged chrome trace in which a named request's spans
+stitch router → replica engine → device program via parent ids on a
+single monotonic clock, and the ``critical-path --percentile 99``
+decomposition sums to within 5% of that request's measured e2e.
+Unit tests pin the decomposition invariant (components sum to e2e
+exactly, garbage clocks clamp to zero), the span-tree parenting, the
+flightrec ``--request`` follow filter, the perfledger metric
+direction for the new ITL series, the graftcheck scope extensions
+over tools/tracebus.py, and the <5% hot-path overhead guard.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.serve import telemetry as T  # noqa: E402
+from ray_tpu.serve.llm import build_llm_deployment  # noqa: E402
+from ray_tpu.tools import tracebus as TB  # noqa: E402
+from ray_tpu.util import tracing  # noqa: E402
+
+_OVR = {"dtype": jnp.float32, "use_flash": False, "remat": False}
+
+
+def _prompts(n, lo=8, hi=14, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(2, 50, size=rng.randint(lo, hi))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _synthetic_rec():
+    """One deterministically-clocked request record driven through
+    every telemetry hop (requeue, kv reserve, spec round, tokens)."""
+    tel = T.EngineTelemetry("dep0")
+    ctx = T.TraceContext(origin="router")
+    ctx.span("router.route", 0.5, 1.0, replica="dep0", policy="wfq",
+             tenant="a", matched_blocks=0, router_req=7)
+    rec = tel.record_enqueue(12, now=1.0, tenant="a", ctx=ctx,
+                             engine_now=1.2)
+    tel.record_requeue(rec, need=3, reason="pool_exhausted", now=1.3)
+    tel.record_kv_reserve(rec, 1.35, 1.4, blocks=4, hit_blocks=1)
+    tel.record_admit(rec, bucket=16, slot=0, now=1.5)
+    tel.record_first_token(rec, now=2.0)
+    tel.record_token(rec, now=2.1)
+    tel.record_token(rec, n=2, now=2.3)
+    tel.record_spec(rec, proposed=4, accepted=2, dur_s=0.2)
+    tel.record_finish(rec, n_tokens=5, now=2.5)
+    return tel, rec
+
+
+# ---------------------------------------------------------------------------
+# critical-path decomposition
+# ---------------------------------------------------------------------------
+
+def test_critical_path_components_sum_to_e2e_exactly():
+    _tel, rec = _synthetic_rec()
+    cp = T.critical_path(rec)
+    assert cp["e2e_ms"] == pytest.approx(1500.0)
+    assert cp["router_wait_ms"] == pytest.approx(200.0)
+    assert cp["requeue_ms"] == pytest.approx(200.0)
+    assert cp["prefill_ms"] == pytest.approx(500.0)
+    assert cp["spec_rollback_ms"] == pytest.approx(80.0)
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+
+
+def test_critical_path_clamps_garbage_clocks():
+    """Deterministic tests inject tiny fake clocks while engine_enqueue
+    may come from the real perf_counter; the decomposition must clamp
+    to [enqueue, finish] and never go negative."""
+    tel = T.EngineTelemetry("d")
+    rec = tel.record_enqueue(8, now=5.0)
+    rec["engine_enqueue"] = 1e6          # wildly out of window
+    tel.record_admit(rec, bucket=16, slot=0, now=5.5)
+    tel.record_first_token(rec, now=6.0)
+    tel.record_finish(rec, n_tokens=2, now=6.5)
+    cp = T.critical_path(rec)
+    assert all(v >= 0.0 for v in cp.values())
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+    # unfinished / rejected records have no decomposition
+    assert T.critical_path(tel.record_enqueue(8, now=1.0)) is None
+
+
+def test_tracebus_opt_out(monkeypatch):
+    monkeypatch.setenv("RAYTPU_TRACEBUS", "0")
+    tel = T.EngineTelemetry("d")
+    rec = tel.record_enqueue(8, now=1.0)
+    assert rec["ctx"] is None and rec["token_ts"] is None
+    tel.record_token(rec, now=2.0)       # must be a no-op, not a crash
+    assert rec["token_ts"] is None
+
+
+# ---------------------------------------------------------------------------
+# span trees
+# ---------------------------------------------------------------------------
+
+def test_span_tree_parent_ids_and_device_stitch():
+    _tel, rec = _synthetic_rec()
+    snap = T.request_snapshot(rec, deployment="dep0")
+    snap["replica"] = "dep0"
+    programs = {"invokes": {"serve.prefill_b16": [[1.95, 0.3]]},
+                "compiles": {}}
+    spans = TB.attach_device_spans(
+        TB.build_request_spans(snap), snap, programs)
+    by_id = {s["span_id"]: s for s in spans}
+    names = {s["name"] for s in spans}
+    assert {"router.route", "engine.queue", "engine.requeue",
+            "kv.reserve", "engine.prefill",
+            "engine.decode"} <= names
+    root = next(s for s in spans if s["parent_id"] is None)
+    # router span recorded live on the TraceContext parents to root
+    route = next(s for s in spans if s["name"] == "router.route")
+    assert route["parent_id"] == root["span_id"]
+    # requeue + kv reserve nest under the queue span
+    queue = next(s for s in spans if s["name"] == "engine.queue")
+    for child in ("engine.requeue", "kv.reserve"):
+        s = next(x for x in spans if x["name"] == child)
+        assert s["parent_id"] == queue["span_id"]
+    # device program invoke parents under engine.prefill: the full
+    # router -> engine -> device chain
+    dev = next(s for s in spans if s["name"].startswith("device "))
+    prefill = by_id[dev["parent_id"]]
+    assert prefill["name"] == "engine.prefill"
+    assert by_id[prefill["parent_id"]] is root
+    # every span is a window on one clock inside the request
+    for s in spans:
+        assert s["end"] >= s["start"] >= 0.0
+
+
+def test_fallback_span_record_carries_start_duration():
+    tracing.enable_tracing()
+    t0 = time.perf_counter()
+    tracing.record_span("probe")
+    tracing.record_span("window", start=12.5, duration=0.25)
+    probe, window = tracing.recorded_spans()[-2:]
+    assert probe.start >= t0 and probe.duration == 0.0
+    assert window.start == 12.5 and window.duration == 0.25
+
+
+# ---------------------------------------------------------------------------
+# flightrec request follow + perfledger direction
+# ---------------------------------------------------------------------------
+
+def test_flightrec_filter_by_request():
+    from ray_tpu.tools.flightrec import filter_events
+
+    events = [
+        {"kind": "admit", "req": 0, "trace": "abcdef0123456789"},
+        {"kind": "admit", "req": 1, "trace": "fedcba9876543210"},
+        {"kind": "step", "dur_ms": 1.0},
+        {"kind": "requeue", "req": 0, "trace": "abcdef0123456789"},
+    ]
+    got = filter_events(events, request="abcdef01")
+    assert [e["kind"] for e in got] == ["admit", "requeue"]
+    assert filter_events(events, request="1")[0]["req"] == 1
+    assert filter_events(events, request="nope") == []
+
+
+def test_perfledger_itl_direction_and_fields():
+    """'itl_ms_*' must trend lower-is-better: the _HIGHER_OVERRIDES
+    substring match ('slo_attainment'/'accept_rate') must not catch
+    it, and the _ms suffix must."""
+    from ray_tpu.tools.perfledger import (_SWEEP_FIELDS,
+                                          higher_is_better)
+
+    assert "itl_ms_p50" in _SWEEP_FIELDS
+    assert "itl_ms_p99" in _SWEEP_FIELDS
+    assert higher_is_better("itl_ms_p50") is False
+    assert higher_is_better("itl_ms_p99") is False
+    assert higher_is_better("gpt2_traffic_itl_ms_p99") is False
+    assert higher_is_better("gpt2_traffic_ttft_critical_path") is False
+    # the overrides still win where they should
+    assert higher_is_better("interactive_ttft_slo_attainment") is True
+
+
+# ---------------------------------------------------------------------------
+# graftcheck scopes over tools/tracebus.py
+# ---------------------------------------------------------------------------
+
+def test_graftcheck_wallclock_scope_covers_tracebus():
+    from ray_tpu.tools.graftcheck.lint import lint_source
+
+    src = ("import time\n"
+           "def collect():\n"
+           "    return time.time()\n")
+    kept, _ = lint_source(src, "ray_tpu/tools/tracebus.py")
+    assert [v.rule for v in kept] == ["wallclock-in-telemetry"]
+    # same source outside the scope stays clean
+    kept, _ = lint_source(src, "ray_tpu/tools/unrelated.py")
+    assert kept == []
+
+
+def test_graftcheck_blocking_async_scope_covers_tracebus():
+    from ray_tpu.tools.graftcheck.lint import lint_source
+
+    src = ("import time\n"
+           "async def pump():\n"
+           "    time.sleep(1)\n")
+    kept, _ = lint_source(src, "ray_tpu/tools/tracebus.py")
+    assert [v.rule for v in kept] == ["blocking-call-in-async"]
+    kept, _ = lint_source(src, "ray_tpu/tools/unrelated.py")
+    assert kept == []
+
+
+def test_tracebus_module_passes_its_own_lint():
+    from ray_tpu.tools.graftcheck.lint import lint_source
+
+    with open(TB.__file__) as f:
+        kept, _ = lint_source(f.read(), "ray_tpu/tools/tracebus.py")
+    assert kept == [], [str(v) for v in kept]
+
+
+# ---------------------------------------------------------------------------
+# fleet acceptance: merged trace + CLI + <=5% decomposition
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet_dump(tmp_path_factory):
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    tenants = (
+        TenantSpec("interactive", rate_share=1.0,
+                   slo_class="interactive", prefix_groups=(0,)),
+        TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                   prefix_groups=(1,)))
+    spec = TrafficSpec(num_requests=8, seed=0, rate_rps=100.0,
+                       num_prefix_groups=2, prefix_len=32,
+                       p_shared=0.75, tail_len_mean=6.0,
+                       tail_len_max=16, vocab=500, tenants=tenants)
+    path = str(tmp_path_factory.mktemp("tracebus") / "dump.json")
+    rep = run_traffic_fleet(
+        spec, num_replicas=2, family="gpt2", preset="nano",
+        kv_block_size=16, max_slots=2, max_new_tokens=4,
+        prefill_bucket=16, time_scale=0.0,
+        config_overrides={"dtype": jnp.float32, "use_flash": False},
+        trace_dump=path)
+    return rep, path
+
+
+def test_fleet_report_carries_anatomy(fleet_dump):
+    rep, _ = fleet_dump
+    assert rep["completed"] > 0
+    assert isinstance(rep["itl_ms_p50"], (int, float))
+    assert isinstance(rep["itl_ms_p99"], (int, float))
+    assert rep["itl_ms_p50"] <= rep["itl_ms_p99"]
+    cp = rep["ttft_critical_path"]
+    assert isinstance(cp["total_p99_ms"], (int, float))
+    assert cp["total_p99_ms"] >= 0.0
+    assert rep["fleet"]["latency_anatomy"]["requests"] > 0
+
+
+def test_fleet_dump_stitches_router_engine_device(fleet_dump):
+    _rep, path = fleet_dump
+    doc = TB.load_dump(path)
+    reqs = [r for r in doc["requests"] if r.get("critical_path")]
+    assert reqs, "no completed requests in the dump"
+    # requests landed on two replica lanes
+    assert len({r["replica"] for r in doc["requests"]}) == 2
+    # router journal + one journal per replica merged onto one clock
+    assert "router" in doc["flightrec"]
+    assert len(doc["flightrec"]) >= 3
+    stitched = 0
+    for req in reqs:
+        spans = TB.attach_device_spans(
+            TB.build_request_spans(req), req, doc["programs"])
+        by_id = {s["span_id"]: s for s in spans}
+        route = next((s for s in spans
+                      if s["name"] == "router.route"), None)
+        assert route is not None, req["request"]
+        root = by_id[route["parent_id"]]
+        assert root["parent_id"] is None
+        prefill = next(s for s in spans
+                       if s["name"] == "engine.prefill")
+        assert by_id[prefill["parent_id"]] is root
+        dev = next((s for s in spans
+                    if s["name"].startswith("device ")), None)
+        if dev is not None:
+            assert by_id[dev["parent_id"]] is prefill
+            stitched += 1
+    # at least one named request carries the full
+    # router -> engine -> device chain
+    assert stitched > 0
+
+
+def test_fleet_dump_critical_path_within_5pct(fleet_dump):
+    _rep, path = fleet_dump
+    doc = TB.load_dump(path)
+    table = TB.critical_path_table(doc, 99.0)
+    assert table["requests"] > 0
+    ex = table["exemplar"]["critical_path"]
+    comp_sum = sum(ex[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert abs(comp_sum - ex["e2e_ms"]) <= 0.05 * ex["e2e_ms"]
+    # per-tenant slicing stays well-formed
+    for tenant in ("interactive", "batch"):
+        tt = TB.critical_path_table(doc, 99.0, tenant=tenant)
+        assert tt["tenant"] == tenant
+
+
+def test_fleet_dump_cli_subcommands(fleet_dump, tmp_path, capsys):
+    _rep, path = fleet_dump
+    doc = TB.load_dump(path)
+    rid = next(r["request"] for r in doc["requests"]
+               if r.get("critical_path"))
+
+    assert TB.main(["report", path]) == 0
+    assert "critical path p99" in capsys.readouterr().out
+
+    assert TB.main(["trace", path, rid[:8]]) == 0
+    out = capsys.readouterr().out
+    assert "engine.prefill" in out and "router.route" in out
+
+    assert TB.main(["critical-path", path,
+                    "--percentile", "99"]) == 0
+    assert "prefill_ms" in capsys.readouterr().out
+
+    trace_out = str(tmp_path / "merged_trace.json")
+    assert TB.main(["export", path, "-o", trace_out]) == 0
+    capsys.readouterr()
+    with open(trace_out) as f:
+        events = json.load(f)
+    # one merged timeline: router pid 0 + a lane per replica, spans
+    # carrying their causal ids into the export
+    lanes = {e["args"]["name"] for e in events
+             if e.get("name") == "process_name"}
+    assert sum(1 for name in lanes
+               if name.startswith("replica ")) == 2
+    assert any(name.startswith("router") for name in lanes)
+    spans = [e for e in events if e.get("ph") == "X"
+             and e.get("cat") == "tracebus"]
+    assert any(e["args"].get("parent_id") for e in spans)
+
+    # unknown request id -> nonzero exit, not a traceback
+    assert TB.main(["trace", path, "veryunknown"]) == 1
+
+
+def test_unreadable_dump_exits_2(tmp_path, capsys):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    assert TB.main(["report", str(bad)]) == 2
+    assert TB.main(["report", str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# hot-path overhead guard (mirrors the flightrec guard)
+# ---------------------------------------------------------------------------
+
+def test_tracebus_overhead_under_5pct(monkeypatch):
+    """Per-token stamping + context threading must be cheap enough to
+    leave on: min-of-repeats decode-loop wall time with tracebus on
+    stays within 5% of RAYTPU_TRACEBUS=0."""
+    dep = build_llm_deployment(
+        "gpt2", "nano", scheduler="continuous", kv_layout="paged",
+        kv_block_size=16, prefill_bucket=16, max_slots=2,
+        max_new_tokens=8, temperature=0.0, config_overrides=_OVR)
+    prompts = _prompts(4)
+
+    def drive():
+        async def main():
+            inst = dep.func_or_class()
+            try:
+                await asyncio.gather(*[inst(p) for p in prompts])
+            finally:
+                inst.shutdown_engine()
+
+        asyncio.run(main())
+
+    def best(n=5):
+        def run_once():
+            t0 = time.perf_counter()
+            drive()
+            return time.perf_counter() - t0
+
+        return min(run_once() for _ in range(n))
+
+    drive()                            # compile warmup (shared cache)
+    monkeypatch.setenv("RAYTPU_TRACEBUS", "0")
+    off = best()
+    monkeypatch.setenv("RAYTPU_TRACEBUS", "1")
+    on = best()
+    assert on <= off * 1.05, (on, off)
