@@ -82,6 +82,14 @@ def parse_args(argv=None):
                          "TARGET's family/preset/seed — acceptance "
                          "~1.0, isolates the dispatch-amortization "
                          "ceiling), 'ngram', or '<family>:<preset>'")
+    ap.add_argument("--train", action="store_true",
+                    help="benchmark through the trainwatch loop "
+                         "(train/goodput.py) instead of the raw AOT "
+                         "harness: build_train_step(health=True) driven "
+                         "by a data-wait-probed batch iterator; emits "
+                         "train_goodput and train_data_wait_ms_p50/p99 "
+                         "JSON lines with the full step anatomy in "
+                         "detail")
     ap.add_argument("--traffic", action="store_true",
                     help="benchmark the continuous serve engine under "
                          "synthetic shared-prefix Poisson traffic "
@@ -929,6 +937,99 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
     _emit_anatomy(base, rep, detail)
 
 
+def main_train_watch(args, on_tpu: bool) -> None:
+    """--train: the trainwatch goodput bench.  Where the default path
+    times a raw AOT loop (time_config), this drives the instrumented
+    flagship path — ``jax_utils.build_train_step(health=True)`` fed by
+    a data-wait-probed batch iterator — and reports what trainwatch
+    measured: the rolling goodput ratio (productive device time over
+    loop wall, compiles and stalls excluded) and the input-stall
+    percentiles, with the full step anatomy in detail.  Health mode
+    fences every step, so the device leg is real device time, not
+    dispatch time."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    from ray_tpu.models import (gpt2_config, gpt2_init,
+                                gpt2_logical_axes, gpt2_loss)
+    from ray_tpu.train import goodput as gp
+    from ray_tpu.train.jax_trainer import jax_utils
+    from ray_tpu.train.telemetry import train_stats
+
+    preset = args.preset or ("gpt2" if on_tpu else "tiny")
+    seq = 1024 if on_tpu else 128
+    n_chips = len(jax.devices())
+    if args.chips:
+        n_chips = min(n_chips, args.chips)
+    batch = args.batch or ((8 * n_chips) if on_tpu else 2)
+    n_steps = args.steps or (20 if on_tpu else 3)
+    overrides = {} if on_tpu else {"use_flash": False}
+    cfg = gpt2_config(preset, max_seq=seq, **overrides)
+
+    mesh, axes = None, None
+    if n_chips > 1:
+        from ray_tpu.parallel import MeshSpec, make_mesh
+
+        mesh = make_mesh(MeshSpec(data=-1),
+                         devices=list(jax.devices())[:n_chips])
+        axes = gpt2_logical_axes(cfg)
+
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    import contextlib
+
+    trainer = "bench_train"
+    with (_mesh_context(mesh) if mesh is not None
+          else contextlib.nullcontext()):
+        if mesh is not None:
+            from ray_tpu.parallel.sharding import shard_params
+
+            params = shard_params(params, axes, mesh)
+        opt_state = tx.init(params)
+        step = jax_utils.build_train_step(
+            lambda p, b: gpt2_loss(p, b, cfg), tx, mesh=mesh,
+            logical_axes=axes, health=True, telemetry_name=trainer)
+
+        rng = np.random.RandomState(0)
+
+        def batches():
+            while True:
+                yield {"tokens": rng.randint(
+                    0, cfg.vocab_size,
+                    size=(batch, seq + 1)).astype(np.int32)}
+
+        it = gp.watch_data(batches(), trainer=trainer)
+        loss = None
+        for _ in range(n_steps + 1):   # +1: the first step compiles
+            data = next(it)
+            params, opt_state, loss, _health = step(params, opt_state,
+                                                    data)
+    stats = train_stats(trainer)
+    anatomy = stats["anatomy"]
+    detail = {
+        "chips": n_chips, "batch": batch, "seq": seq,
+        "preset": preset, "steps": stats["goodput"]["steps"],
+        "goodput": stats["goodput"],
+        "anatomy_mean_ms": {k: (anatomy[k] or {}).get("mean")
+                            for k in anatomy},
+        "anomalies": stats["health"]["anomalies"],
+        "loss": round(float(loss), 3) if loss is not None else None,
+        "backend": jax.default_backend(),
+        "tpu_error": TPU_ERROR,
+    }
+    emit({"metric": "train_goodput",
+          "value": stats["goodput"]["ratio"], "unit": "ratio",
+          "vs_baseline": None, "detail": detail})
+    dw = anatomy["data_wait_ms"]
+    for q in ("p50", "p99"):
+        emit({"metric": f"train_data_wait_ms_{q}", "value": dw[q],
+              "unit": "ms", "vs_baseline": None,
+              "detail": {"count": dw["count"], "preset": preset,
+                         "backend": jax.default_backend()}})
+
+
 def main(args=None):
     args = args or parse_args()
     if args.chips:
@@ -957,6 +1058,9 @@ def main(args=None):
         return _ledger_append(args)
     if args.traffic:
         main_traffic(args, jax.default_backend() == "tpu")
+        return _ledger_append(args)
+    if args.train:
+        main_train_watch(args, jax.default_backend() == "tpu")
         return _ledger_append(args)
     if args.mesh == "tensor":
         raise SystemExit("--mesh tensor is a serve layout; combine it "

@@ -321,6 +321,29 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/fleet", serve_fleet)
 
+        # Trainwatch (train/telemetry.py + train/goodput.py): one
+        # train_stats() snapshot per trainer that has stepped in THIS
+        # process — step-time percentiles plus the anatomy / goodput /
+        # health / checkpoint blocks, keyed by trainer name.
+        async def train_stats_view(_req):
+            def _collect():
+                from ray_tpu.train.goodput import registered_trainers
+                from ray_tpu.train.telemetry import train_stats
+
+                out = {}
+                for name in registered_trainers():
+                    try:
+                        out[name] = train_stats(name)
+                    except Exception as e:  # noqa: BLE001
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/train/stats", train_stats_view)
+
         # Tracebus (ray_tpu/tools/tracebus.py): one request's causal
         # span tree — router.route → engine.queue/kv.reserve →
         # engine.prefill (+ matched device program dispatch) →

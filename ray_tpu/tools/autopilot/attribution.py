@@ -118,7 +118,8 @@ def _headroom(block: Dict[str, Any], cls: str,
 
 def attribute(programs: Dict[str, Dict[str, Any]],
               device: Optional[Dict[str, Any]] = None,
-              request_anatomy: Optional[Dict[str, Any]] = None
+              request_anatomy: Optional[Dict[str, Any]] = None,
+              train_anatomy: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Attribute a programs snapshot against the device roofline.
 
@@ -131,7 +132,12 @@ def attribute(programs: Dict[str, Dict[str, Any]],
     the dominant *lifecycle* leg (queue wait, prefill, inter-token
     gaps, ...) to complement the roofline's program-granularity view:
     a device bottleneck only matters if the request tail is actually
-    spent on device.  Returns::
+    spent on device.  ``train_anatomy`` is the trainwatch view — a
+    ``train_stats()``-shaped dict (or just its ``anatomy``/``goodput``
+    blocks, train/goodput.py): when ``data_wait`` dominates the step
+    anatomy the summary cites *input-bound* — sweeping device knobs
+    cannot move a loop that is starving on its batch iterator.
+    Returns::
 
         {"device": {...roofline...},
          "programs": {name: {"class", "arithmetic_intensity", "mfu",
@@ -195,9 +201,28 @@ def attribute(programs: Dict[str, Dict[str, Any]],
             f"; request p{pct:g} tail dominated by {dom}"
             + (f" ({val:.1f} ms)" if isinstance(val, (int, float))
                else ""))
+    if train_anatomy:
+        from ray_tpu.train.goodput import dominant_component
+
+        anatomy = train_anatomy.get("anatomy") or train_anatomy
+        dom = dominant_component(anatomy)
+        if dom is not None:
+            mean = (anatomy.get(dom) or {}).get("mean")
+            ratio = (train_anatomy.get("goodput") or {}).get("ratio")
+            gp = (f", goodput {ratio}" if isinstance(
+                ratio, (int, float)) else "")
+            if dom == "data_wait_ms":
+                summary += (
+                    f"; training is input-bound: data_wait dominates "
+                    f"step anatomy ({mean:.1f} ms mean{gp}) — feed "
+                    f"the loop before sweeping device knobs")
+            else:
+                summary += (f"; train step anatomy dominated by "
+                            f"{dom} ({mean:.1f} ms mean{gp})")
     return {"device": device, "programs": out, "ranked": ranked,
             "bottleneck": bottleneck,
-            "request_anatomy": request_anatomy, "summary": summary}
+            "request_anatomy": request_anatomy,
+            "train_anatomy": train_anatomy, "summary": summary}
 
 
 def attribute_registry() -> Dict[str, Any]:

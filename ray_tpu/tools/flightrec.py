@@ -7,8 +7,11 @@ postmortem files the SLO watchdog / engine crash handler write
 * ``report``    — human summary: trigger, event counts by kind, drop
   counter, step-duration percentiles, recent sheds/errors, the
   breaching objective's burn rates when the dump carries an SLO
-  context, and — on fleet dumps (serve/router.py) — the per-replica
-  routing table plus the last scale-up/scale-down/drain decisions.
+  context, — on fleet dumps (serve/router.py) — the per-replica
+  routing table plus the last scale-up/scale-down/drain decisions,
+  and — on trainwatch dumps (train/goodput.py) — the train lanes:
+  step wall percentiles, the anomaly table (step index + trigger
+  metric), recent checkpoint events, and the watchdog's metric trail.
   Exits 0 on a readable dump — scripts gate on it.
 * ``events``    — the journal itself, filtered (``--kind``,
   ``--last``, ``--since/--until`` seconds) and printed one JSON
@@ -102,7 +105,46 @@ def report_lines(doc: Dict[str, Any]) -> List[str]:
         s = summarize(steps)
         lines.append(f"step dur_ms: n={s['count']} mean={s['mean']} "
                      f"p50={s['p50']} p95={s['p95']} max={s['max']}")
+    # train lanes (trainwatch dumps, train/goodput.py): step metric
+    # trail percentiles plus the anomaly table the watchdog journaled
+    tsteps = [e for e in events if e.get("kind") == "train_step"]
+    if tsteps:
+        walls = [e["wall_ms"] for e in tsteps if "wall_ms" in e]
+        losses = [e["loss"] for e in tsteps
+                  if isinstance(e.get("loss"), (int, float))]
+        line = f"train steps: n={len(tsteps)}"
+        if walls:
+            s = summarize(walls)
+            line += (f"  wall_ms p50={s['p50']} p95={s['p95']} "
+                     f"max={s['max']}")
+        if losses:
+            line += f"  last_loss={losses[-1]}"
+        lines.append(line)
+    anomalies = [e for e in events if e.get("kind") == "train_anomaly"]
+    if anomalies:
+        lines.append("train anomalies (step  metric  value  reason):")
+        for e in anomalies[-10:]:
+            lines.append(f"  {e.get('step')}  {e.get('metric')}  "
+                         f"{e.get('value')}  {e.get('reason')}")
+    for label, kind in (("checkpoint saves", "ckpt_save"),
+                        ("checkpoint restores", "ckpt_restore")):
+        tail = filter_events(events, kinds=[kind], last=3)
+        if tail:
+            lines.append(f"last {label}:")
+            for e in tail:
+                lines.append("  " + json.dumps(e, sort_keys=True))
     ctx = doc.get("context") or {}
+    if ctx.get("trainer"):
+        lines.append(
+            f"train anomaly: trainer={ctx['trainer']}  "
+            f"step={ctx.get('step')}  reason={ctx.get('reason')}  "
+            f"{ctx.get('metric')}={ctx.get('value')}")
+        trail = ctx.get("trail") or []
+        if trail:
+            lines.append("metric trail (last "
+                         f"{len(trail)} steps):")
+            for t in trail[-8:]:
+                lines.append("  " + json.dumps(t, sort_keys=True))
     slo = ctx.get("slo")
     if isinstance(slo, dict):
         objective = ctx.get("objective")
@@ -207,7 +249,7 @@ def sweepjson_records(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
          "unit": "events", "detail": detail},
     ]
     for kind in ("shed", "error", "requeue", "kv_exhausted",
-                 "recompile_storm"):
+                 "recompile_storm", "train_anomaly"):
         if counts.get(kind):
             recs.append({"metric": f"flightrec_{kind}_events",
                          "value": counts[kind], "unit": "events",

@@ -25,8 +25,10 @@ Rule ids:
   ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py``,
   ``serve/router.py`` (the fleet router timestamps routing/autoscale
   decisions and measures drain deadlines — interval math like the
-  rest), or anywhere under ``ray_tpu/tools/autopilot/`` (verdicts must
-  be reproducible from ledger contents alone):
+  rest), ``train/goodput.py`` (the trainwatch anatomy promises legs
+  that sum exactly to the step wall — one wall-clock read breaks the
+  invariant), or anywhere under ``ray_tpu/tools/autopilot/``
+  (verdicts must be reproducible from ledger contents alone):
   telemetry takes an injectable ``now`` (tests drive deterministic
   clocks) and intervals must use the monotonic ``perf_counter`` —
   the flight-recorder journal and SLO burn-rate windows are interval
@@ -140,6 +142,7 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
             or rel_posix.endswith("serve/slo.py")
             or rel_posix.endswith("serve/router.py")
             or rel_posix.endswith("tools/tracebus.py")
+            or rel_posix.endswith("train/goodput.py")
             or rel_posix.startswith("ray_tpu/tools/autopilot/")):
         return []
     out: List[Violation] = []

@@ -70,14 +70,18 @@ _SWEEP_FIELDS = (
     # tracebus per-token anatomy (itl = inter-token latency, ms →
     # lower is better via the _ms suffix; no override applies)
     "itl_ms_p50", "itl_ms_p99",
+    # trainwatch (train/goodput.py): productive-device-time ratio
+    # (higher via the goodput override) + input-stall percentiles
+    "train_goodput", "train_data_wait_ms_p50", "train_data_wait_ms_p99",
 )
 
 #: substrings marking a metric where SMALLER is better
 _LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile")
 
 #: substrings that trump _LOWER_IS_BETTER: "ttft_slo_attainment"
-#: contains "ttft" but is a fraction where BIGGER is better
-_HIGHER_OVERRIDES = ("slo_attainment", "accept_rate")
+#: contains "ttft" but is a fraction where BIGGER is better, and
+#: "goodput" is a productive-time fraction regardless of neighbors
+_HIGHER_OVERRIDES = ("slo_attainment", "accept_rate", "goodput")
 
 
 def repo_root() -> str:

@@ -28,9 +28,18 @@ from ray_tpu.train.grad_accum import accumulated_train_step
 from ray_tpu.train.checkpointing import (latest_step, restore_sharded,
                                          save_sharded,
                                          sharded_checkpoint_to_air)
+from ray_tpu.train.goodput import (GoodputTracker, HealthWatchdog,
+                                   get_goodput_tracker,
+                                   get_health_watchdog,
+                                   get_train_recorder, watch_data,
+                                   worker_skew)
+from ray_tpu.train.telemetry import train_stats
 
 __all__ = [
     "accumulated_train_step",
+    "GoodputTracker", "HealthWatchdog", "get_goodput_tracker",
+    "get_health_watchdog", "get_train_recorder", "watch_data",
+    "worker_skew", "train_stats",
     "save_sharded", "restore_sharded", "latest_step",
     "sharded_checkpoint_to_air",
     "session", "Checkpoint", "ScalingConfig", "RunConfig", "FailureConfig",

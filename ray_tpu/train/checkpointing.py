@@ -16,6 +16,7 @@ and user code share one path.
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional
 
 __all__ = ["save_sharded", "restore_sharded", "latest_step",
@@ -32,20 +33,56 @@ def _step_dir(path: str, step: Optional[int]) -> str:
     return os.path.join(path, f"step_{step}") if step is not None else path
 
 
+def _tree_bytes(tree: Any) -> int:
+    """Total array bytes in a pytree (0 when leaves carry no nbytes)."""
+    try:
+        import jax
+
+        return int(sum(getattr(x, "nbytes", 0) or 0
+                       for x in jax.tree_util.tree_leaves(tree)))
+    except Exception:  # noqa: BLE001 - never fail a checkpoint on this
+        return 0
+
+
+def _record_ckpt(kind: str, dur_s: float, nbytes: int, target: str,
+                 step: Optional[int], trainer: str) -> None:
+    """Book one save/restore pause into trainwatch: the goodput
+    tracker (so checkpoint pauses show up in the goodput denominator
+    and the next step's ``checkpoint`` anatomy leg) plus a
+    ``ckpt_save``/``ckpt_restore`` flight-recorder journal event."""
+    try:
+        from ray_tpu.train.goodput import (get_goodput_tracker,
+                                           get_train_recorder)
+
+        get_goodput_tracker(trainer).record_checkpoint(
+            kind, dur_s, nbytes=nbytes, step=step)
+        get_train_recorder(trainer).record(
+            f"ckpt_{kind}", step=step,
+            dur_ms=round(dur_s * 1e3, 3), bytes=nbytes, path=target)
+    except Exception:  # noqa: BLE001 - observability must not raise
+        pass
+
+
 def save_sharded(params: Any, path: str, *,
-                 step: Optional[int] = None) -> str:
+                 step: Optional[int] = None,
+                 trainer: str = "default") -> str:
     """Write a (possibly mesh-sharded) pytree; each process writes only
-    its addressable shards.  Returns the checkpoint directory."""
+    its addressable shards.  Returns the checkpoint directory.  The
+    pause is timed and journaled under the named trainer's trainwatch
+    state (``train_stats(trainer)["checkpoint"]``)."""
     target = os.path.abspath(_step_dir(path, step))
     ckptr = _checkpointer()
+    t0 = time.perf_counter()
     ckptr.save(target, params, force=True)
     ckptr.wait_until_finished()
+    _record_ckpt("save", time.perf_counter() - t0,
+                 _tree_bytes(params), target, step, trainer)
     return target
 
 
 def restore_sharded(path: str, *, step: Optional[int] = None,
                     template: Any = None, mesh=None, axes: Any = None,
-                    rules=None) -> Any:
+                    rules=None, trainer: str = "default") -> Any:
     """Restore a pytree saved with save_sharded.
 
     Resharding: pass `mesh` + `axes` (the model's logical-axis pytree,
@@ -59,8 +96,11 @@ def restore_sharded(path: str, *, step: Optional[int] = None,
 
     target = os.path.abspath(_step_dir(path, step))
     ckptr = _checkpointer()
+    t0 = time.perf_counter()
     restored = (ckptr.restore(target, template)
                 if template is not None else ckptr.restore(target))
+    _record_ckpt("restore", time.perf_counter() - t0,
+                 _tree_bytes(restored), target, step, trainer)
     if mesh is None or axes is None:
         return restored
     from jax.sharding import NamedSharding

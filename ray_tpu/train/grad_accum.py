@@ -22,15 +22,27 @@ __all__ = ["accumulated_train_step"]
 
 
 def accumulated_train_step(loss_fn: Callable, tx, *,
-                           num_microbatches: int) -> Callable:
+                           num_microbatches: int,
+                           telemetry: bool = False,
+                           telemetry_name: str = "grad_accum",
+                           jit_kwargs=None) -> Callable:
     """Build `step(params, opt_state, batch) -> (params, opt_state,
     loss)` that averages gradients over `num_microbatches` slices of the
     leading batch axis before applying ONE optimizer update.
 
     loss_fn(params, microbatch) -> scalar loss.  Every leaf of `batch`
-    must have a leading axis divisible by num_microbatches.  The
-    returned step is NOT jitted — wrap it in jax.jit (with your
-    shardings) at the call site."""
+    must have a leading axis divisible by num_microbatches.  By
+    default the returned step is NOT jitted — wrap it in jax.jit (with
+    your shardings) at the call site.
+
+    telemetry=True closes the observability gap accumulated steps used
+    to have (they bypassed ``instrument_train_step`` entirely, so
+    their compiles and step times were invisible): the step is jitted
+    HERE (pass ``jit_kwargs`` for shardings/donation) and wrapped with
+    the same observatory + step-time + trainwatch anatomy stack as
+    ``build_train_step``, under the ``train.step`` program name — an
+    accumulated step IS the train step.  Read it back via
+    ``train_stats(telemetry_name)``."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -62,4 +74,20 @@ def accumulated_train_step(loss_fn: Callable, tx, *,
         updates, new_opt = tx.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), new_opt, lsum / n)
 
-    return step
+    if not telemetry:
+        return step
+
+    from ray_tpu._private.device_stats import get_registry
+    from ray_tpu.train.goodput import (get_goodput_tracker,
+                                       instrument_trainwatch)
+    from ray_tpu.train.telemetry import (get_train_telemetry,
+                                         instrument_train_step)
+
+    jitted = jax.jit(step, **(jit_kwargs or {}))
+    jitted = get_registry().instrument("train.step", jitted)
+    jitted = instrument_train_step(
+        jitted, telemetry=get_train_telemetry(telemetry_name))
+    wrapped = instrument_trainwatch(
+        jitted, tracker=get_goodput_tracker(telemetry_name))
+    wrapped._raw_step = step
+    return wrapped

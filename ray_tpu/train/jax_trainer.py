@@ -66,18 +66,31 @@ class jax_utils:
     def build_train_step(loss_fn, tx, mesh=None, logical_axes=None,
                          rules=None, donate: bool = True,
                          telemetry: bool = True,
-                         telemetry_name: str = "jax_trainer"):
+                         telemetry_name: str = "jax_trainer",
+                         health: bool = False):
         """jitted (params, opt_state, batch) -> (params, opt_state, loss)
         with optional sharding constraints from logical_axes.
 
         telemetry=True (default) wraps the step with host-side
-        step-time histograms, examples/sec gauges, and compile-event
+        step-time histograms, examples/sec gauges, compile-event
         counters (train/telemetry.py — perf_counter pairs only, no
-        added device syncs); read them back via
-        ``jax_utils.train_stats(telemetry_name)``."""
+        added device syncs) AND the trainwatch anatomy/goodput
+        recorder (train/goodput.py); read them back via
+        ``jax_utils.train_stats(telemetry_name)``.
+
+        health=True makes the step additionally return cheap device
+        scalars as a 4th output — ``{"loss", "grad_norm",
+        "nonfinite"}``, all computed INSIDE the jitted program (no
+        extra dispatch, no host transfer in the jaxpr) — and arms the
+        host-side watchdog: EWMA z-score spikes and NaN/inf trip a
+        ``train_anomaly`` journal event plus a flight-recorder
+        postmortem naming the step, trainer, and batch signature.
+        Reading the scalars fences each step (one small D2H), which
+        is what buys one-step detection latency."""
         import functools
 
         import jax
+        import jax.numpy as jnp
         import optax
 
         from ray_tpu.parallel import sharding
@@ -91,7 +104,18 @@ class jax_utils:
         def step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
             updates, opt_state = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state, loss
+            new_params = optax.apply_updates(params, updates)
+            if not health:
+                return new_params, opt_state, loss
+            nonfinite = functools.reduce(
+                jnp.add,
+                [jnp.sum(~jnp.isfinite(g)).astype(jnp.int32)
+                 for g in jax.tree_util.tree_leaves(grads)],
+                jnp.int32(0))
+            scalars = {"loss": loss,
+                       "grad_norm": optax.global_norm(grads),
+                       "nonfinite": nonfinite}
+            return new_params, opt_state, loss, scalars
 
         kw: Dict[str, Any] = {}
         if in_shardings is not None:
@@ -102,17 +126,28 @@ class jax_utils:
         if not telemetry:
             return jitted
         from ray_tpu._private.device_stats import get_registry
+        from ray_tpu.train.goodput import (get_goodput_tracker,
+                                           get_health_watchdog,
+                                           instrument_trainwatch)
         from ray_tpu.train.telemetry import (get_train_telemetry,
                                              instrument_train_step)
 
         # perf observatory first (compiled-cost harvest + recompile
-        # watchdog under "train.step"), host step-time telemetry on
-        # the outside — both are signature-keyed, neither adds a sync
+        # watchdog under "train.step"), host step-time telemetry next,
+        # trainwatch anatomy/health on the outside — all are
+        # signature-keyed; only health mode adds a (deliberate) sync
         n_dev = int(mesh.size) if mesh is not None else 1
         jitted = get_registry().instrument("train.step", jitted,
                                            n_devices=n_dev)
-        return instrument_train_step(
+        jitted = instrument_train_step(
             jitted, telemetry=get_train_telemetry(telemetry_name))
+        wrapped = instrument_trainwatch(
+            jitted,
+            tracker=get_goodput_tracker(telemetry_name),
+            watchdog=(get_health_watchdog(telemetry_name)
+                      if health else None))
+        wrapped._raw_step = step   # the jaxpr-guard hook (tests)
+        return wrapped
 
     @staticmethod
     def train_stats(name: str = "jax_trainer"):

@@ -118,9 +118,27 @@ def get_train_telemetry(name: str = "default") -> TrainTelemetry:
         return tel
 
 
+def telemetry_names() -> list:
+    """Trainer names with step telemetry in this process."""
+    with _telemetries_lock:
+        return sorted(_telemetries)
+
+
 def train_stats(name: str = "default") -> Dict[str, Any]:
-    """Snapshot for the named trainer (empty-shaped if never stepped)."""
-    return get_train_telemetry(name).stats()
+    """Snapshot for the named trainer (empty-shaped if never stepped).
+
+    Beyond the step-time block this carries the trainwatch view
+    (train/goodput.py): ``anatomy`` (per-step wall decomposed into
+    data_wait/h2d/dispatch/device_compute/compile/checkpoint, legs
+    summing exactly to the wall), ``goodput`` (rolling productive
+    device time over loop wall), ``health`` (watchdog EWMA state and
+    anomaly dumps), ``checkpoint`` (save/restore counters), and
+    ``flightrec`` (the trainer's journal occupancy)."""
+    from ray_tpu.train.goodput import trainwatch_blocks
+
+    out = get_train_telemetry(name).stats()
+    out.update(trainwatch_blocks(name))
+    return out
 
 
 def _batch_signature(batch: Any) -> tuple:

@@ -532,6 +532,28 @@ def test_lint_autopilot_in_both_rule_scopes():
     assert not kept
 
 
+def test_lint_wallclock_covers_trainwatch():
+    # round 14: the trainwatch anatomy promises legs that sum exactly
+    # to the step wall on ONE clock — a planted time.time() in
+    # train/goodput.py breaks that invariant and must flag
+    src = textwrap.dedent("""\
+        import time
+
+        def record_step(call_s):
+            return time.time()
+    """)
+    kept, _ = lint_source(src, "ray_tpu/train/goodput.py")
+    assert [v.rule for v in kept] == ["wallclock-in-telemetry"]
+    kept, _ = lint_source(src.replace("time.time()",
+                                      "time.perf_counter()"),
+                          "ray_tpu/train/goodput.py")
+    assert not kept
+    # train-package neighbours stay out of scope (telemetry.py is
+    # covered by the */telemetry.py glob, grad_accum.py is not timed)
+    kept, _ = lint_source(src, "ray_tpu/train/grad_accum.py")
+    assert not kept
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote
