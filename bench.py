@@ -108,6 +108,14 @@ def parse_args(argv=None):
                     choices=["dense", "paged"],
                     help="--traffic KV-cache layout (paged enables "
                          "prefix reuse; dense is the parity oracle)")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="--traffic chunked streaming prefill A/B: "
+                         "switch to the two-tenant long-prompt mixture "
+                         "and admit long prompts as N-token "
+                         "block-aligned chunks interleaved with decode "
+                         "waves (0 = same mixture, one-shot prefill — "
+                         "the A/B control; paged layout only); emits "
+                         "per-tenant ttft_ms_p99 lines")
     ap.add_argument("--profile", default="",
                     help="capture an XLA device trace of the timed "
                          "region into this directory "
@@ -249,14 +257,13 @@ def ensure_backend() -> None:
 
 
 def _mesh_context(mesh):
-    """``jax.set_mesh`` appeared in newer jax; older versions use the
-    Mesh object itself as the context manager.  The harness only needs
-    the mesh resource env active around the jitted step, so either
-    spelling works."""
-    import jax
+    """Version-portable mesh context — the shim now lives in
+    parallel/mesh.py (``mesh_context``) so bench and the rllib
+    algorithms share one spelling; this alias keeps the harness's
+    call sites stable."""
+    from ray_tpu.parallel import mesh_context
 
-    set_mesh = getattr(jax, "set_mesh", None)
-    return set_mesh(mesh) if set_mesh is not None else mesh
+    return mesh_context(mesh)
 
 
 def peak_flops_per_chip() -> float:
@@ -744,6 +751,24 @@ def main_traffic(args, on_tpu: bool) -> None:
                   latency_slo_ms=60000.0, time_scale=0.0,
                   config_overrides={"dtype": jnp.float32,
                                     "use_flash": False})
+    if args.prefill_chunk is not None:
+        import dataclasses
+
+        from ray_tpu.serve.traffic import TenantSpec
+
+        # the chunked-prefill A/B workload: an interactive tenant with
+        # the spec's short Poisson tails plus a batch tenant flooding
+        # with fixed long prompts (prompt fits max_seq: prefix + long
+        # tail + max_new).  --prefill-chunk 0 runs the SAME mixture
+        # one-shot, so the two runs A/B on identical traffic.
+        base += "_long"
+        spec = dataclasses.replace(spec, tenants=(
+            TenantSpec("interactive", rate_share=3.0,
+                       slo_class="interactive"),
+            TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                       prompt_len=640 if on_tpu else 80),
+        ))
+        kw["prefill_chunk_tokens"] = args.prefill_chunk or None
     mesh, n_chips = (decode_mesh(args.chips or 1)
                      if args.mesh == "tensor" else (None, 1))
     if mesh is not None:
@@ -784,6 +809,9 @@ def main_traffic(args, on_tpu: bool) -> None:
               "ttft_ms": eng["ttft_ms"],
               "kv_cache": eng.get("kv_cache"),
               "rejections_by_reason": eng["rejections_by_reason"]}
+    if args.prefill_chunk is not None:
+        detail["prefill_chunk_tokens"] = args.prefill_chunk or None
+        detail["prefill_chunks"] = rep.get("prefill_chunks")
     if spec_cfg is not None:
         # spec counters join every traffic record so ledger series
         # cover spec+traffic runs, not just --decode --spec-k
@@ -827,6 +855,15 @@ def main_traffic(args, on_tpu: bool) -> None:
             "value": rep["spec_accept_rate"], "unit": "ratio",
             "vs_baseline": None,
             "detail": dict(detail, rounds=rep.get("spec_rounds"))})
+    # per-tenant TTFT p99 — the chunked-prefill headline: interactive
+    # TTFT under the long-prompt flood, A/B-able across chunk sizes
+    for tname in ("interactive", "batch"):
+        v = rep.get(f"{tname}_ttft_ms_p99")
+        if isinstance(v, (int, float)):
+            emit({
+                "metric": f"{base}_{tname}_ttft_ms_p99",
+                "value": v, "unit": "ms", "vs_baseline": None,
+                "detail": detail})
     _emit_anatomy(base, rep, detail)
 
 

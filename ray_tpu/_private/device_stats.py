@@ -94,6 +94,10 @@ STATIC_PROGRAM_MAP: Dict[str, str] = {
     "gpt2_paged_decode_step": "serve.decode",
     "gpt2_sharded_decode_step": "serve.sharded_decode",
     "gpt2_spec_verify_step": "serve.spec_verify",
+    # chunked streaming prefill reuses the paged_prefill program (one
+    # invoke per chunk), so the static spec maps to the same runtime
+    # name — the observatory sees N invokes per chunked admission
+    "gpt2_chunked_prefill": "serve.paged_prefill",
 }
 
 _metrics_lock = threading.Lock()

@@ -181,6 +181,20 @@ def active_mesh():
     return None
 
 
+def mesh_context(mesh):
+    """Version-portable ``with mesh active:`` context manager.
+
+    ``jax.set_mesh`` appeared in newer jax; older versions use the
+    Mesh object itself as the context manager.  Callers only need the
+    mesh resource env active around their jitted steps, so either
+    spelling works — every ``with jax.set_mesh(mesh):`` site in the
+    repo (rllib algorithms, bench harness) routes through here so the
+    version shim has one home."""
+    jax, _ = _import_jax()
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
+
+
 def local_mesh(spec: Optional[MeshSpec] = None):
     """Mesh over this process's addressable devices only."""
     jax, _ = _import_jax()

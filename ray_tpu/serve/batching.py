@@ -22,7 +22,41 @@ from __future__ import annotations
 
 import asyncio
 import functools
+from dataclasses import dataclass
 from typing import Any, Callable, List, Optional
+
+
+@dataclass
+class ChunkCursor:
+    """Progress cursor for chunked streaming prefill (serve/llm.py):
+    a queued long prompt is admitted once but filled over several
+    block-aligned ``paged_prefill`` calls interleaved with decode
+    waves, and the engine's slot record carries this cursor between
+    waves.  ``filled`` counts prompt tokens already resident in KV
+    blocks (including any reused prefix), so the next chunk's program
+    call gets ``prefix_len == filled``."""
+
+    total: int          # prompt length in tokens
+    chunk_tokens: int   # scheduler budget per prefill turn
+    filled: int = 0     # tokens already written to KV blocks
+    chunks_done: int = 0
+
+    @property
+    def remaining(self) -> int:
+        return self.total - self.filled
+
+    @property
+    def done(self) -> bool:
+        return self.filled >= self.total
+
+    def next_chunk(self) -> int:
+        """Token count for the next prefill call (last one may be
+        short)."""
+        return min(self.chunk_tokens, self.remaining)
+
+    def advance(self, n: int) -> None:
+        self.filled += n
+        self.chunks_done += 1
 
 
 class _BatchQueue:

@@ -82,6 +82,12 @@ class BlockPager:
         self.prefix_misses = 0    # blocks that had to be prefilled
         self.cow_copies = 0
         self.evictions = 0
+        #: chunked streaming prefill (round 15): fill events the
+        #: engine reports as it writes reserved blocks chunk by chunk.
+        #: partial_fills counts intermediate chunks (row parked after),
+        #: fill_tokens the prompt tokens ingested through fills.
+        self.partial_fills = 0
+        self.fill_tokens = 0
         #: total keys handed out by prefix_keys() — how much affinity
         #: metadata this pager has published to routers
         self.prefix_keys_exported = 0
@@ -203,6 +209,24 @@ class BlockPager:
                                   cached=len(self._cached),
                                   **self._ctx_tag())
 
+    def note_fill(self, tokens: int, partial: bool = False) -> None:
+        """Journal one prefill chunk writing `tokens` token slots into
+        this pager's reserved blocks (chunked streaming prefill —
+        serve/llm.py calls this per chunk).  `partial=True` marks an
+        intermediate chunk: the row still has unfilled tail blocks and
+        is parked until its next chunk window.  Pure accounting — the
+        blocks were allocated at admission and ownership is unchanged;
+        the counters surface in stats() and the `kv_fill` journal
+        event lets a postmortem replay how a long prompt's blocks
+        filled between decode waves."""
+        self.fill_tokens += int(tokens)
+        if partial:
+            self.partial_fills += 1
+        if self._recorder is not None:
+            self._recorder.record("kv_fill", tokens=int(tokens),
+                                  partial=bool(partial),
+                                  **self._ctx_tag())
+
     # -- prefix cache --------------------------------------------------
 
     def match_prefix(self, tokens: Sequence[int]
@@ -315,6 +339,8 @@ class BlockPager:
             if total else 0.0,
             "cow_copies": self.cow_copies,
             "evictions": self.evictions,
+            "partial_fills": self.partial_fills,
+            "fill_tokens": self.fill_tokens,
             "prefix_keys_resident": len(self._index),
             "prefix_keys_exported": self.prefix_keys_exported,
         }

@@ -37,7 +37,8 @@ import numpy as np
 
 from ray_tpu.models.decode_common import SamplingParams
 from ray_tpu.serve.api import deployment
-from ray_tpu.serve.batching import OverloadedError, RequestQueue
+from ray_tpu.serve.batching import (ChunkCursor, OverloadedError,
+                                    RequestQueue)
 from ray_tpu.serve.batching import batch as _batch
 from ray_tpu.serve.telemetry import EngineTelemetry
 
@@ -289,6 +290,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          kv_layout: str = "dense",
                          kv_block_size: int = 16,
                          kv_num_blocks: Optional[int] = None,
+                         prefill_chunk_tokens: Optional[int] = None,
                          admission_policy=None,
                          slo=None,
                          mesh=None,
@@ -311,6 +313,20 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     shared write boundaries).  kv_block_size sets the block token
     granularity; kv_num_blocks the pool size (default: enough for
     every slot plus one sequence of prefix-cache headroom).
+    prefill_chunk_tokens: chunked streaming prefill (paged layout
+    only; dense keeps one-shot prefill as the bit-exactness oracle).
+    A prompt whose unmatched tail exceeds N tokens is admitted as a
+    sequence of block-aligned prefill chunks interleaved with decode
+    waves — the engine loop alternates `decode wave → at most one
+    chunk of pending prefill → decode wave`, with round-robin
+    fairness over chunking slots so one huge prompt cannot consume
+    consecutive chunk windows.  Each chunk is a call to the existing
+    paged_prefill program with prefix_len = tokens already filled
+    (prior chunks are literally resident prefix blocks), so chunked
+    output is bit-identical to one-shot prefill by construction and
+    the program compiles once per prefill_bucket-padded chunk shape.
+    Must be a positive multiple of kv_block_size.  None (default)
+    keeps one-shot prefill.
     admission_policy: a serve.batching.AdmissionPolicy closing the
     telemetry loop — requests are load-shed with OverloadedError when
     its queue-depth / queue-wait / TTFT gates trip.
@@ -363,6 +379,21 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
         raise ValueError("kv_layout='paged' requires "
                          "scheduler='continuous' (the block pager "
                          "lives in the continuous engine)")
+    if prefill_chunk_tokens is not None:
+        if kv_layout != "paged":
+            raise ValueError(
+                "prefill_chunk_tokens requires kv_layout='paged' "
+                "(chunks fill KV blocks incrementally through "
+                "paged_prefill; dense keeps one-shot prefill as the "
+                "bit-exactness oracle)")
+        if prefill_chunk_tokens < 1 \
+                or prefill_chunk_tokens % kv_block_size:
+            raise ValueError(
+                f"prefill_chunk_tokens={prefill_chunk_tokens} must be "
+                f"a positive multiple of kv_block_size="
+                f"{kv_block_size} (chunks must end on block "
+                "boundaries so prior chunks are resident prefix "
+                "blocks)")
     if mesh is not None and scheduler != "continuous":
         raise ValueError("mesh-sharded serving requires "
                          "scheduler='continuous' (the batch scheduler "
@@ -579,6 +610,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._engine_task = None
             self._default_sp = default_sp
             self._samplers = {}     # SamplingParams -> jitted sampler
+            # chunked streaming prefill (round 15): round-robin cursor
+            # over slots mid-prefill, plus a constant key for the
+            # discarded samples of intermediate chunks (the engine RNG
+            # splits once per admission, at the FINAL chunk — the same
+            # stream a one-shot admission sees)
+            self._chunk_rr = 0
+            self._dummy_key = None
+            if prefill_chunk_tokens is not None:
+                import jax as _jax
+                self._dummy_key = _jax.random.PRNGKey(0)
 
             # spec decode: resolve the verify program and (model
             # drafts) the draft family's fns/config/params/cache pool
@@ -854,14 +895,36 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._telemetry.record_prefix_reuse(
                 len(matched), pager.blocks_needed(n, 0) - len(matched))
             n_tail = n - prefix_len
+            row_bt = np.zeros((self.cfg.max_seq // kv_block_size,),
+                              np.int32)
+            row_bt[:len(blocks)] = blocks
+            if prefill_chunk_tokens is not None \
+                    and n_tail > prefill_chunk_tokens:
+                # chunked streaming admission: blocks are reserved
+                # (and COW-forked) exactly as the one-shot path above,
+                # but the prefill itself runs as block-sized chunks
+                # from the engine loop (_prefill_chunk_step) so decode
+                # waves interleave with a long prompt instead of
+                # stalling behind one giant dispatch
+                t_pad = -(-prefill_chunk_tokens // prefill_bucket) \
+                    * prefill_bucket
+                self._telemetry.record_admit(rec, slot, t_pad)
+                self._slots[slot] = {
+                    "state": "prefill", "prompt": arr, "out": [],
+                    "fut": fut, "rec": rec, "sp": sp, "blocks": blocks,
+                    "row_bt": row_bt,
+                    "cursor": ChunkCursor(
+                        total=n, chunk_tokens=prefill_chunk_tokens,
+                        filled=prefix_len)}
+                if spec_decode is not None:
+                    self._spec_rej[slot] = 0
+                self._telemetry.record_kv_stats(pager.stats())
+                return True
             t_pad = -(-n_tail // prefill_bucket) * prefill_bucket
             t_pad = max(n_tail, min(t_pad, self.cfg.max_seq))
             self._telemetry.record_admit(rec, slot, t_pad)
             tail_toks = np.zeros((1, t_pad), np.int32)
             tail_toks[0, t_pad - n_tail:] = arr[prefix_len:]
-            row_bt = np.zeros((self.cfg.max_seq // kv_block_size,),
-                              np.int32)
-            row_bt[:len(blocks)] = blocks
             self._rng, k = jax.random.split(self._rng)
             if sp is not None:
                 logits, self._cache = self._fns.paged_prefill_raw(
@@ -905,6 +968,106 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._pager.release(blocks)
             self._telemetry.record_kv_stats(self._pager.stats())
 
+        def _prefill_chunk_step(self, candidates) -> None:
+            """Run AT MOST ONE chunk of pending prefill — the engine
+            loop alternates `decode wave → one chunk → decode wave`.
+            Fairness is round-robin over the slots mid-prefill
+            (`candidates`), so one 32k prompt cannot consume
+            consecutive chunk windows while another long prompt waits.
+
+            Each chunk is the existing paged_prefill program with
+            prefix_len = tokens already filled — prior chunks are
+            literally resident prefix blocks — so the chunked result
+            is bit-identical to one-shot prefill by construction, and
+            the program compiles once per prefill_bucket-padded chunk
+            shape.  Between chunks the row is PARKED (null block
+            table): decode waves scatter-write masked garbage into
+            every row at its pos, and those writes must land in the
+            null block, never in this row's half-filled real blocks;
+            the next chunk re-installs row_bt/pos/start absolutely."""
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+
+            # next candidate strictly after the cursor, cyclically
+            i = min(candidates,
+                    key=lambda s: ((s - self._chunk_rr) % max_slots)
+                    or max_slots)
+            self._chunk_rr = i
+            st = self._slots[i]
+            arr = st["prompt"]
+            n = int(arr.shape[0])
+            cur = st["cursor"]
+            filled = cur.filled
+            c = cur.next_chunk()
+            last = filled + c >= n
+            t_pad = -(-c // prefill_bucket) * prefill_bucket
+            t_pad = max(c, min(t_pad, self.cfg.max_seq))
+            chunk_toks = np.zeros((1, t_pad), np.int32)
+            chunk_toks[0, t_pad - c:] = arr[filled:filled + c]
+            t0 = _time.perf_counter()
+            if last:
+                self._rng, k = jax.random.split(self._rng)
+            else:
+                # intermediate chunks discard their sample, so the
+                # fused program runs under a constant key — the
+                # engine RNG stream stays identical to a one-shot
+                # admission (exactly one split, at the final chunk)
+                k = self._dummy_key
+            first = None
+            if st["sp"] is not None:
+                logits, self._cache = self._fns.paged_prefill_raw(
+                    self.params, self._cache, jnp.asarray(chunk_toks),
+                    jnp.asarray(st["row_bt"]), np.int32(filled),
+                    np.int32(c), np.int32(i))
+                if last:
+                    tok = self._sampler_for(st["sp"])(logits, k)
+                    first = int(np.asarray(tok)[0])
+                else:
+                    # host fence so the chunk window is real device
+                    # time, mirroring the one-shot path's int()
+                    np.asarray(logits[0, 0])
+            else:
+                tok, self._cache = self._paged_prefill(
+                    self.params, self._cache, jnp.asarray(chunk_toks),
+                    jnp.asarray(st["row_bt"]), np.int32(filled),
+                    np.int32(c), np.int32(i), k)
+                # the chunk's host fence (the one-shot path's int());
+                # intermediate chunks discard the value
+                first = int(np.asarray(tok)[0])
+            t1 = _time.perf_counter()
+            cur.advance(c)
+            self._telemetry.record_prefill_chunk(
+                st["rec"], t0, t1, tokens=c, bucket=t_pad, last=last)
+            # journal the fill under this request's id/trace, same
+            # bracketing idiom as the admission reservation window
+            ctx = st["rec"].get("ctx")
+            self._pager.set_request(
+                st["rec"]["id"],
+                ctx.trace_id if ctx is not None else None)
+            self._pager.note_fill(c, partial=not last)
+            self._pager.set_request(None)
+            if not last:
+                self._cache = self._clear_row(self._cache, np.int32(i))
+                return
+            rec, fut, blocks = st["rec"], st["fut"], st["blocks"]
+            self._telemetry.record_first_token(rec)
+            self._pager.register_prefix(arr.tolist(), blocks)
+            if max_new_tokens <= 1 or self._hit_stop([first]):
+                self._telemetry.record_finish(rec, n_tokens=1)
+                if not fut.done():
+                    fut.set_result(np.concatenate(
+                        [arr, np.asarray([first], np.int32)]))
+                self._slots[i] = None
+                self._retire_paged_row(i, blocks)
+                return
+            self._cur[i] = first
+            st["state"] = "decode"
+            st["out"] = [first]
+            self._draft_admit(i, arr)
+            self._telemetry.record_kv_stats(self._pager.stats())
+
         def _finish_slot(self, i, st) -> None:
             """Retire a finished slot NOW — the freed slot (and its
             paged blocks) is admissible in the same engine wave."""
@@ -932,7 +1095,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             toks = np.zeros((max_slots,), np.int32)
             groups: Dict[Any, list] = {}
             for i, st in enumerate(self._slots):
-                if st is None:
+                if st is None or st.get("state") == "prefill":
                     continue
                 groups.setdefault(st["sp"] or self._default_sp,
                                   []).append(i)
@@ -979,7 +1142,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # history: zero extra weights, zero extra dispatches
                 drafts = np.zeros((max_slots, kd), np.int32)
                 for i, st in enumerate(self._slots):
-                    if st is None:
+                    if st is None or st.get("state") == "prefill":
                         continue
                     drafts[i] = ngram_propose(
                         st["prompt"].tolist() + st["out"], kd,
@@ -1002,7 +1165,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             round_dur = t_done - t_round
             total = 0
             for i, st in enumerate(self._slots):
-                if st is None:
+                if st is None or st.get("state") == "prefill":
+                    # mid-prefill rows are parked (null block table):
+                    # the pool-wide verify dispatch covers them but
+                    # their outputs are discarded
                     continue
                 n = int(n_acc[i])
                 self._telemetry.record_spec(st["rec"], proposed=kd,
@@ -1032,8 +1198,12 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
         async def _engine(self):
             """The scheduler loop: admit → one pooled decode step (or
-            one speculative draft+verify round) → retire finished
-            slots → yield (so new requests enqueue mid-generation)."""
+            one speculative draft+verify round) over the decoding
+            slots → retire finished slots → at most ONE chunk of
+            pending chunked prefill → yield (so new requests enqueue
+            mid-generation).  The decode-wave/chunk alternation is the
+            chunked-prefill scheduler: a long prompt costs the other
+            slots one chunk window per wave, never a full prefill."""
             import asyncio
             import time as _time
 
@@ -1043,56 +1213,64 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             while True:
                 try:
                     self._admit_pending()
+                    prefilling = [
+                        i for i, s in enumerate(self._slots)
+                        if s is not None
+                        and s.get("state") == "prefill"]
                     n_active = sum(s is not None for s in self._slots)
                     if not n_active:
                         self._wake.clear()
                         if not len(self._queue):
                             await self._wake.wait()
                         continue
+                    n_decode = n_active - len(prefilling)
                     # step walltime: dispatch + the np.asarray host
                     # fence the engine already performs — perf_counter
                     # pairs only, no extra device sync
-                    t_step = _time.perf_counter()
-                    if spec_decode is not None:
+                    if n_decode and spec_decode is not None:
+                        t_step = _time.perf_counter()
                         n_tokens = self._spec_round()
                         self._telemetry.record_step(
-                            n_active,
+                            n_decode,
                             _time.perf_counter() - t_step,
                             n_tokens=n_tokens)
-                        if self._telemetry.slo is not None:
-                            self._telemetry.slo.check()
-                        await asyncio.sleep(0)
-                        continue
-                    self._rng, k = jax.random.split(self._rng)
-                    if any(st is not None and st["sp"] is not None
-                           for st in self._slots):
-                        toks = self._mixed_step(k)
-                    else:
-                        toks, self._cache = self._pool_step(
-                            self.params, self._cache,
-                            jnp.asarray(self._cur), k)
-                        # the engine's one deliberate per-step host
-                        # fence (documented above; telemetry brackets
-                        # it)
-                        # graftcheck: disable=blocking-call-in-async
-                        toks = np.asarray(toks)
-                    t_wave = _time.perf_counter()
-                    self._telemetry.record_step(
-                        n_active, t_wave - t_step, now=t_wave)
+                    elif n_decode:
+                        t_step = _time.perf_counter()
+                        self._rng, k = jax.random.split(self._rng)
+                        if any(st is not None
+                               and st.get("state") != "prefill"
+                               and st["sp"] is not None
+                               for st in self._slots):
+                            toks = self._mixed_step(k)
+                        else:
+                            toks, self._cache = self._pool_step(
+                                self.params, self._cache,
+                                jnp.asarray(self._cur), k)
+                            # the engine's one deliberate per-step
+                            # host fence (documented above; telemetry
+                            # brackets it)
+                            # graftcheck: disable=blocking-call-in-async
+                            toks = np.asarray(toks)
+                        t_wave = _time.perf_counter()
+                        self._telemetry.record_step(
+                            n_decode, t_wave - t_step, now=t_wave)
+                        for i, st in enumerate(self._slots):
+                            if st is None \
+                                    or st.get("state") == "prefill":
+                                continue
+                            st["out"].append(int(toks[i]))
+                            self._telemetry.record_token(st["rec"],
+                                                         now=t_wave)
+                            self._cur[i] = toks[i]
+                            if len(st["out"]) >= max_new_tokens \
+                                    or self._hit_stop(st["out"]):
+                                self._finish_slot(i, st)
                     if self._telemetry.slo is not None:
                         # throttled burn-rate watchdog: breach / storm
                         # transitions postmortem-dump the flight record
                         self._telemetry.slo.check()
-                    for i, st in enumerate(self._slots):
-                        if st is None:
-                            continue
-                        st["out"].append(int(toks[i]))
-                        self._telemetry.record_token(st["rec"],
-                                                     now=t_wave)
-                        self._cur[i] = toks[i]
-                        if len(st["out"]) >= max_new_tokens \
-                                or self._hit_stop(st["out"]):
-                            self._finish_slot(i, st)
+                    if prefilling:
+                        self._prefill_chunk_step(prefilling)
                 except Exception as e:  # noqa: BLE001 - fail loudly
                     # crash postmortem: the journal around the failure
                     # is exactly what the flight recorder exists for —

@@ -598,12 +598,20 @@ class LLMFleet:
         autoscaler state, per-replica engine summaries, and the
         fleet-wide prefix hit rate (pooled over replicas)."""
         hits = misses = 0
+        chunks = {"requests": 0, "chunks": 0, "tokens": 0,
+                  "max_chunks_per_request": 0}
         replicas = {}
         for rep in self._replicas + self._retired:
             st = rep.engine_stats()
             kv = st.get("kv_cache") or {}
             hits += int(kv.get("prefix_block_hits", 0))
             misses += int(kv.get("prefix_block_misses", 0))
+            pc = st.get("prefill_chunks") or {}
+            for k in ("requests", "chunks", "tokens"):
+                chunks[k] += int(pc.get(k, 0))
+            chunks["max_chunks_per_request"] = max(
+                chunks["max_chunks_per_request"],
+                int(pc.get("max_chunks_per_request", 0)))
             replicas[rep.name] = {
                 "draining": rep.draining,
                 "retired": rep in self._retired,
@@ -623,6 +631,7 @@ class LLMFleet:
             "signals": self._signals(),
             "prefix_hit_rate": round(hits / total, 4) if total
             else 0.0,
+            "prefill_chunks": chunks,
             "tenants": self.tenant_report(),
             "replicas": replicas,
             "flightrec": self.telemetry.flightrec.stats(),

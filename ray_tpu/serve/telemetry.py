@@ -223,19 +223,23 @@ class TraceContext:
 #: ``e2e_ms`` exactly (modulo float rounding) by construction.
 CRITICAL_PATH_COMPONENTS = (
     "router_wait_ms", "queue_wait_ms", "requeue_ms", "prefill_ms",
-    "inter_token_ms", "spec_rollback_ms")
+    "prefill_wait_ms", "inter_token_ms", "spec_rollback_ms")
 
 
 def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Decompose one completed request's e2e latency:
 
         e2e = router_wait + queue_wait + requeue + prefill
-              + inter_token + spec_rollback
+              + prefill_wait + inter_token + spec_rollback
 
     * router_wait — submit → engine enqueue (0 without a router);
     * queue_wait  — engine enqueue → admit, minus time spent requeued;
     * requeue     — first KV-exhaustion requeue → eventual admit;
-    * prefill     — admit → first token;
+    * prefill     — admit → first token, or for chunked-prefill
+      admissions the SUM of the per-chunk dispatch windows;
+    * prefill_wait — the rest of admit → first token: time a chunked
+      prefill spent parked between chunks while decode waves ran
+      (exactly 0 for one-shot prefill);
     * inter_token — Σ inter-token gaps (first token → finish), minus
       the estimated rollback share below;
     * spec_rollback — decode time attributed to rejected draft
@@ -261,7 +265,21 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     if rq_ts is not None:
         requeue = min(max(0.0, admit - rq_ts), wait)
     queue_wait = wait - requeue
-    prefill = first - admit
+    window = first - admit
+    chunks = rec.get("prefill_chunks")
+    if chunks:
+        # chunked prefill: the prefill leg is the sum of the chunk
+        # dispatch windows (clamped into [admit, first] so synthetic
+        # clocks degrade gracefully); the residual of admit → first is
+        # the parked time between chunks — decode waves ran there, so
+        # it must not be billed as prefill compute
+        prefill = min(window, sum(
+            max(0.0, min(float(c[1]), first) - max(float(c[0]), admit))
+            for c in chunks))
+        prefill_wait = window - prefill
+    else:
+        prefill = window
+        prefill_wait = 0.0
     decode = fin - first
     rollback = min(max(0.0, float(rec.get("spec_rollback_s") or 0.0)),
                    decode)
@@ -272,6 +290,7 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         "queue_wait_ms": round(queue_wait * ms, 4),
         "requeue_ms": round(requeue * ms, 4),
         "prefill_ms": round(prefill * ms, 4),
+        "prefill_wait_ms": round(prefill_wait * ms, 4),
         "inter_token_ms": round((decode - rollback) * ms, 4),
         "spec_rollback_ms": round(rollback * ms, 4),
     }
@@ -322,6 +341,8 @@ def request_snapshot(rec: Dict[str, Any],
         "spec_accepted": rec.get("spec_accepted", 0),
         "spec_rollback_s": rec.get("spec_rollback_s", 0.0),
         "kv_reserve": list(kv) if kv is not None else None,
+        "prefill_chunks": ([list(c) for c in rec["prefill_chunks"]]
+                           if rec.get("prefill_chunks") else None),
         "spans": ([dict(s) for s in ctx.spans]
                   if ctx is not None else []),
         "critical_path": critical_path(rec),
@@ -330,7 +351,7 @@ def request_snapshot(rec: Dict[str, Any],
 
 
 def empty_anatomy_samples() -> Dict[str, Any]:
-    return {"itl_ms": [], "tpot_ms": [],
+    return {"itl_ms": [], "tpot_ms": [], "ttft_ms": [],
             "critical_path": {k: [] for k in
                               ("e2e_ms",) + CRITICAL_PATH_COMPONENTS},
             "tenants": []}
@@ -348,6 +369,7 @@ def merge_anatomy_samples(parts: List[Dict[str, Any]]
             continue
         out["itl_ms"].extend(p.get("itl_ms", ()))
         out["tpot_ms"].extend(p.get("tpot_ms", ()))
+        out["ttft_ms"].extend(p.get("ttft_ms", ()))
         for k, vals in p.get("critical_path", {}).items():
             out["critical_path"].setdefault(k, []).extend(vals)
         tenants.update(p.get("tenants", ()))
@@ -362,6 +384,7 @@ def latency_anatomy(samples: Dict[str, Any]) -> Dict[str, Any]:
         "requests": len(samples["critical_path"]["e2e_ms"]),
         "itl_ms": _core.summarize(samples["itl_ms"]),
         "tpot_ms": _core.summarize(samples["tpot_ms"]),
+        "ttft_ms": _core.summarize(samples["ttft_ms"]),
         "critical_path": {k: _core.summarize(v) for k, v
                           in samples["critical_path"].items()},
     }
@@ -403,6 +426,10 @@ class EngineTelemetry:
         self._rejections_by_reason: Dict[str, int] = {}
         self._kv_stats: Optional[Dict[str, Any]] = None
         self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
+        #: chunked streaming prefill (round 15): admissions split into
+        #: block-sized chunks interleaved with decode waves
+        self._chunks = {"requests": 0, "chunks": 0, "tokens": 0,
+                        "max_chunks": 0}
         #: round-12 flight recorder: every lifecycle transition below
         #: also journals a compact decision event (one deque append)
         #: so postmortems can replay what the engine DID, not just its
@@ -454,6 +481,7 @@ class EngineTelemetry:
             "spec_proposed": 0, "spec_accepted": 0,
             "spec_rounds": 0, "spec_rollback_s": 0.0,
             "requeues": 0, "requeue_ts": None, "kv_reserve": None,
+            "prefill_chunks": None,
             "token_ts": [] if ctx is not None else None,
             "status": "queued", "trace": None, "tenant": tenant,
             "ctx": ctx,
@@ -630,6 +658,37 @@ class EngineTelemetry:
         tracebus can render it as its own span inside queue wait."""
         rec["kv_reserve"] = (float(start), float(end), int(blocks),
                              int(hit_blocks))
+
+    def record_prefill_chunk(self, rec: Dict[str, Any], start: float,
+                             end: float, tokens: int, bucket: int,
+                             last: bool = False) -> None:
+        """One chunk of a chunked (streaming) prefill: `tokens` prompt
+        tokens ingested through the paged_prefill program padded to
+        `bucket`, dispatched over [start, end] on the perf_counter
+        clock.  The windows accumulate on the record — critical_path()
+        bills their sum as the prefill leg and the parked remainder of
+        admit → first token as prefill_wait — and the final chunk
+        (``last=True``) is the one whose sample becomes the first
+        token.  One-shot admissions never call this, so their records
+        (and the decomposition) are unchanged."""
+        chunks = rec.get("prefill_chunks")
+        if chunks is None:
+            chunks = rec["prefill_chunks"] = []
+            with self._lock:
+                self._chunks["requests"] += 1
+        chunks.append((float(start), float(end), int(tokens),
+                       int(bucket)))
+        with self._lock:
+            self._chunks["chunks"] += 1
+            self._chunks["tokens"] += int(tokens)
+            self._chunks["max_chunks"] = max(
+                self._chunks["max_chunks"], len(chunks))
+        self.flightrec.record(
+            "prefill_chunk", ts=end, req=rec["id"],
+            chunk=len(chunks) - 1, tokens=int(tokens),
+            bucket=int(bucket), last=bool(last),
+            dur_ms=round((end - start) * 1e3, 3),
+            **self._trace_tag(rec))
 
     def record_finish(self, rec: Dict[str, Any],
                       n_tokens: Optional[int] = None,
@@ -813,6 +872,9 @@ class EngineTelemetry:
             if r.get("tenant"):
                 tenants.add(r["tenant"])
             out["itl_ms"].extend(_token_gaps_ms(r))
+            if r.get("first_token") is not None:
+                out["ttft_ms"].append(
+                    (r["first_token"] - r["enqueue"]) * 1e3)
             cp = critical_path(r)
             if cp is not None:
                 for k, v in cp.items():
@@ -869,6 +931,7 @@ class EngineTelemetry:
             kv_stats = (dict(self._kv_stats)
                         if self._kv_stats is not None else None)
             spec = dict(self._spec)
+            chunks = dict(self._chunks)
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
                 if r["first_token"] is not None]
         qwait = [(r["admit"] - r["enqueue"]) * 1e3 for r in recs
@@ -928,6 +991,15 @@ class EngineTelemetry:
                 "accept_rate_per_request": _core.summarize(
                     [r["spec_accepted"] / r["spec_proposed"]
                      for r in recs if r.get("spec_proposed", 0)]),
+            },
+            # round-15: chunked streaming prefill — long prompts
+            # admitted as block-sized chunks interleaved with decode
+            # waves (all zeros when prefill_chunk_tokens is unset)
+            "prefill_chunks": {
+                "requests": chunks["requests"],
+                "chunks": chunks["chunks"],
+                "tokens": chunks["tokens"],
+                "max_chunks_per_request": chunks["max_chunks"],
             },
             # round-14: per-token latency anatomy — ITL/TPOT
             # percentiles and the critical-path decomposition

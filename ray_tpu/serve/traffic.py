@@ -52,7 +52,14 @@ class TenantSpec:
     via `weight`); `prefix_groups` restricts the tenant to a subset of
     the spec's shared-prefix pool (its own "system prompts" — empty =
     the whole pool); `ttft_slo_ms` / `e2e_slo_ms` are the per-tenant
-    latency targets scored by ``LLMFleet.tenant_report()``."""
+    latency targets scored by ``LLMFleet.tenant_report()``.
+
+    `prompt_len` makes this a long-prompt tenant: its requests draw a
+    fixed `prompt_len`-token user turn instead of the spec's Poisson
+    tail (total prompt = shared prefix + prompt_len when the request
+    extends a prefix) — the batch-floods-interactive mixture the
+    chunked-prefill A/B needs.  When unset (every legacy spec) the
+    draw order is untouched, so the RNG stream stays bit-identical."""
 
     name: str
     rate_share: float = 1.0
@@ -62,11 +69,15 @@ class TenantSpec:
     e2e_slo_ms: Optional[float] = None
     objective: float = 0.95
     weight: Optional[float] = None
+    prompt_len: Optional[int] = None
 
     def __post_init__(self):
         if self.rate_share <= 0:
             raise ValueError(f"tenant {self.name!r}: rate_share must "
                              "be > 0")
+        if self.prompt_len is not None and self.prompt_len < 1:
+            raise ValueError(f"tenant {self.name!r}: prompt_len must "
+                             "be >= 1 when set")
         if self.slo_class not in _CLASS_WEIGHTS:
             raise ValueError(
                 f"tenant {self.name!r}: slo_class must be one of "
@@ -167,16 +178,22 @@ class TrafficGenerator:
             shares = np.cumsum(shares / shares.sum())
         out: List[TrafficRequest] = []
         for i in range(spec.num_requests):
-            tenant, pool = "", None
+            tenant, pool, plen = "", None, None
             if shares is not None:
                 idx = min(int(np.searchsorted(shares, rng.rand())),
                           len(spec.tenants) - 1)
                 t = spec.tenants[idx]
                 tenant = t.name
                 pool = t.prefix_groups or None
+                plen = t.prompt_len
             tail_len = 1 + min(int(rng.poisson(
                 max(spec.tail_len_mean - 1.0, 0.0))),
                 spec.tail_len_max - 1)
+            if plen is not None:
+                # long-prompt tenant: the Poisson draw above still
+                # happens (keeps the stream aligned with prompt_len
+                # unset), only the drawn size changes
+                tail_len = plen
             tail = rng.randint(2, spec.vocab,
                                size=tail_len).astype(np.int32)
             if spec.num_prefix_groups > 0 \
@@ -216,7 +233,12 @@ async def drive(instance, requests: List[TrafficRequest], *,
             await asyncio.sleep(delay)
         start = time.perf_counter()
         try:
-            await instance(req.prompt)
+            # the tenant tag rides into engine telemetry so per-class
+            # anatomy (TTFT p99 by tenant) works without a fleet router
+            if req.tenant:
+                await instance(req.prompt, tenant=req.tenant)
+            else:
+                await instance(req.prompt)
         except OverloadedError:
             return {"shed": True, "latency_ms": None}
         return {"shed": False,
@@ -244,6 +266,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 preset: str = "nano", kv_layout: str = "paged",
                 kv_block_size: int = 16, max_slots: int = 4,
                 max_new_tokens: int = 8, prefill_bucket: int = 16,
+                prefill_chunk_tokens: Optional[int] = None,
                 time_scale: float = 0.0,
                 latency_slo_ms: Optional[float] = None,
                 admission_policy=None, slo=None, spec_decode=None,
@@ -268,7 +291,13 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     ``report["engine"]["slo"]``.  `spec_decode` (a SpecConfig) runs
     the traffic through the speculative engine; accept-rate/rounds
     then ride in ``report["spec_accept_rate"]``/``["spec_rounds"]`` so
-    ledger series cover spec+traffic runs."""
+    ledger series cover spec+traffic runs.
+
+    `prefill_chunk_tokens` enables chunked streaming prefill (paged
+    layout only — see build_llm_deployment); the report then carries
+    the engine's ``prefill_chunks`` counter block and per-tenant
+    ``{tenant}_ttft_ms_p99`` fields so sweeps can A/B the chunk size
+    against interactive-tenant TTFT."""
     import asyncio
 
     from ray_tpu.serve.llm import build_llm_deployment
@@ -278,6 +307,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         max_new_tokens=max_new_tokens, temperature=0.0,
         prefill_bucket=prefill_bucket, kv_layout=kv_layout,
         kv_block_size=kv_block_size,
+        prefill_chunk_tokens=prefill_chunk_tokens,
         admission_policy=admission_policy, slo=slo,
         spec_decode=spec_decode, mesh=mesh,
         config_overrides=config_overrides)
@@ -318,14 +348,24 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         sp = eng.get("spec") or {}
         report["spec_accept_rate"] = sp.get("accept_rate")
         report["spec_rounds"] = sp.get("rounds")
+    report["prefill_chunk_tokens"] = prefill_chunk_tokens
+    if eng.get("prefill_chunks"):
+        report["prefill_chunks"] = eng["prefill_chunks"]
     _flatten_anatomy(report, eng.get("latency_anatomy"))
+    # per-tenant TTFT percentiles, flattened for SWEEPJSON consumers
+    # ({tenant}_ttft_ms_p99 — the chunked-prefill headline metric)
+    by_tenant = (eng.get("latency_anatomy") or {}).get(
+        "by_tenant") or {}
+    for tname, blk in by_tenant.items():
+        ttft = blk.get("ttft_ms") or {}
+        report[f"{tname}_ttft_ms_p99"] = ttft.get("p99")
     return report
 
 
 #: TTFT-side legs of the tracebus critical path (everything before the
 #: first token; the decode-side legs are inter_token + spec_rollback)
 _TTFT_COMPONENTS = ("router_wait_ms", "queue_wait_ms", "requeue_ms",
-                    "prefill_ms")
+                    "prefill_ms", "prefill_wait_ms")
 
 
 def _flatten_anatomy(report: Dict[str, Any],
@@ -451,4 +491,9 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
             flat[f"{tname}_{obj}_slo_attainment"] = o["attainment"]
     report["tenant_slo_attainment"] = flat
     _flatten_anatomy(report, report["fleet"].get("latency_anatomy"))
+    by_tenant = (report["fleet"].get("latency_anatomy") or {}).get(
+        "by_tenant") or {}
+    for tname, blk in by_tenant.items():
+        ttft = blk.get("ttft_ms") or {}
+        report[f"{tname}_ttft_ms_p99"] = ttft.get("p99")
     return report

@@ -36,6 +36,7 @@ _B, _T = 2, 64           # train batch: 128 tokens (> d_model=64)
 _PB, _PT0 = 4, 64        # prefill batch: T0 != n_layer so no aliasing
 _CE_N, _CE_D, _CE_V, _CE_VALID = 128, 64, 512, 500
 _NANO_VOCAB = 512        # padded_vocab of the nano presets
+_CHUNK_T = 32            # chunked-prefill tail bucket (< max_seq=128)
 
 _MiB = 2 ** 20
 
@@ -231,6 +232,43 @@ def _build_gpt2_spec_verify_step():
             (params, cache, block, key))
 
 
+def _build_gpt2_chunked_prefill():
+    """One chunk of streaming prefill (round 14): the serve engine's
+    chunked admission runs the SAME ``paged_prefill`` program once per
+    chunk with ``prefix_len`` = tokens already filled, so the audited
+    shape is a chunk-sized tail bucket (Tt=32) against a warm pool
+    with one resident prefix block.  The invariants that make
+    chunking's TTFT story real: the forward must never scan over the
+    FULL sequence length (the chunk's cost must be O(chunk), not
+    O(max_seq) — that is the whole head-of-line-blocking fix), and
+    peak HBM must stay at pool + chunk-sized temps (a dense
+    re-materialization of the pool per chunk would multiply the
+    engine's hottest loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_init
+    from ray_tpu.models.gpt2_decode import init_paged_cache, paged_prefill
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    bs = 16
+    per_row = cfg.max_seq // bs
+    cache = init_paged_cache(cfg, _PB, num_blocks=1 + _PB * per_row,
+                             block_size=bs)
+    cache["block_tables"] = 1 + jnp.arange(
+        _PB * per_row, dtype=jnp.int32).reshape(_PB, per_row)
+    row_bt = 1 + jnp.arange(per_row, dtype=jnp.int32)
+    toks = jnp.zeros((1, _CHUNK_T), jnp.int32)
+    # prefix_len=16: one already-resident block (the previous chunk);
+    # n_tail == bucket (full chunk); dynamic scalars as in the engine
+    return (lambda p, c, t, bt, pl, nt, s: paged_prefill(
+        p, c, t, cfg, row_bt=bt, prefix_len=pl, n_tail=nt, slot=s),
+        (params, cache, toks, row_bt, jnp.int32(16),
+         jnp.int32(_CHUNK_T), jnp.int32(0)))
+
+
 def _ce_inputs():
     import jax
     import jax.numpy as jnp
@@ -344,6 +382,21 @@ def default_programs() -> List[ProgramSpec]:
             donate_argnums=(1,),
             # same pool sizing as the paged decode step plus the tiny
             # (B, k+1, V) verify logits and accept-fold temps
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_chunked_prefill",
+            build=_build_gpt2_chunked_prefill,
+            # full-sequence logits must never appear: the chunk emits
+            # one row of logits (and intermediate chunks discard it)
+            forbid_logits=(128, _NANO_VOCAB),        # max_seq rows
+            # the chunk forward must be O(chunk): no scan of length
+            # max_seq (a per-position pool walk would re-introduce the
+            # head-of-line stall chunking exists to remove)
+            forbid_scan_lengths=(128,),
+            allow_f32_matmul=True,
+            # pool (same sizing as the paged decode step) + (Tt, ...)
+            # chunk temps; a dense pool re-materialization per chunk
+            # blows through this
             hbm_budget_bytes=6 * _MiB),
         ProgramSpec(
             name="fused_ce_fwd",

@@ -70,6 +70,10 @@ _SWEEP_FIELDS = (
     # tracebus per-token anatomy (itl = inter-token latency, ms →
     # lower is better via the _ms suffix; no override applies)
     "itl_ms_p50", "itl_ms_p99",
+    # chunked-prefill A/B (round 14): per-tenant TTFT p99 under the
+    # long-prompt mixture — "ttft"/"_ms" mark these lower-is-better
+    # (unlike the *_ttft_slo_attainment fractions above)
+    "interactive_ttft_ms_p99", "batch_ttft_ms_p99",
     # trainwatch (train/goodput.py): productive-device-time ratio
     # (higher via the goodput override) + input-stall percentiles
     "train_goodput", "train_data_wait_ms_p50", "train_data_wait_ms_p99",

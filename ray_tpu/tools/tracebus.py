@@ -214,8 +214,18 @@ def build_request_spans(req: Dict[str, Any]) -> List[Dict[str, Any]]:
                  blocks=kv[2] if len(kv) > 2 else None,
                  hit_blocks=kv[3] if len(kv) > 3 else None)
     if admit is not None and first is not None:
-        emit("engine.prefill", admit, first,
-             bucket=req.get("bucket"), slot=req.get("slot"))
+        chunks = req.get("prefill_chunks")
+        if chunks:
+            # chunked streaming prefill: one child span per chunk so
+            # the timeline shows decode waves in the gaps between them
+            for ci, c in enumerate(chunks):
+                emit("engine.prefill", c[0], c[1],
+                     chunk=ci, n_chunks=len(chunks),
+                     tokens=int(c[2]), bucket=int(c[3]),
+                     slot=req.get("slot"))
+        else:
+            emit("engine.prefill", admit, first,
+                 bucket=req.get("bucket"), slot=req.get("slot"))
     if first is not None and finish is not None:
         emit("engine.decode", first, finish,
              tokens=req.get("tokens"),
@@ -235,11 +245,16 @@ def attach_device_spans(spans: List[Dict[str, Any]],
     whose window ends closest to the request's first token inside the
     prefill window IS this request's device work.  Decode dispatches
     are pooled across slots and stay on the shared device lane."""
-    prefill = next((s for s in spans
-                    if s["name"] == "engine.prefill"), None)
-    if prefill is None:
+    prefills = [s for s in spans if s["name"] == "engine.prefill"]
+    if not prefills:
         return spans
-    lo, hi = prefill["start"], prefill["end"] + 1e-4
+    # chunked prefill emits several engine.prefill spans; the search
+    # window covers all of them and the matched dispatch parents under
+    # the chunk whose window contains it (falling back to the last
+    # chunk, whose dispatch produced the first token).
+    lo = min(s["start"] for s in prefills)
+    hi = max(s["end"] for s in prefills) + 1e-4
+    last = prefills[-1]
     best = None
     for kind_key, kind in (("invokes", "invoke"),
                            ("compiles", "compile")):
@@ -248,16 +263,19 @@ def attach_device_spans(spans: List[Dict[str, Any]],
                 continue
             for ts, dur in evs:
                 if lo <= ts <= hi:
-                    gap = abs(prefill["end"] - ts)
+                    gap = abs(last["end"] - ts)
                     if best is None or gap < best[0]:
                         best = (gap, name, ts, dur, kind)
     if best is not None:
         _gap, name, ts, dur, kind = best
+        parent = next(
+            (s for s in prefills
+             if s["start"] <= ts <= s["end"] + 1e-4), last)
         spans.append({
             "name": f"device {name}",
             "span_id": f"{_tid(req)}:dev",
-            "parent_id": prefill["span_id"],
-            "start": max(lo, ts - dur), "end": ts,
+            "parent_id": parent["span_id"],
+            "start": max(parent["start"], ts - dur), "end": ts,
             "attrs": {"program": name, "kind": kind,
                       "dur_ms": round(dur * 1e3, 3)},
         })

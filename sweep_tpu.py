@@ -116,7 +116,8 @@ def _run_traffic_variant(max_slots, kw, out):
     from ray_tpu.serve.batching import AdmissionPolicy
     from ray_tpu.serve.llm import SpecConfig
     from ray_tpu.serve.slo import SLOConfig
-    from ray_tpu.serve.traffic import TrafficSpec, run_traffic
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic)
 
     kv_layout = kw.pop("kv_layout", "paged")
     tensor = kw.pop("tensor", 1)
@@ -124,6 +125,21 @@ def _run_traffic_variant(max_slots, kw, out):
     spec_draft = kw.pop("spec_draft", "aligned")
     ttft_slo_ms = kw.pop("ttft_slo_ms", None)
     e2e_slo_ms = kw.pop("e2e_slo_ms", None)
+    # chunked streaming prefill A/B: `long_prompt_len` switches to the
+    # two-tenant long-prompt mixture (interactive short tails + batch
+    # tenant flooding with fixed long prompts); `prefill_chunk` is the
+    # chunk size (None/0 = one-shot — the control arm on the SAME
+    # seeded traffic)
+    prefill_chunk = kw.pop("prefill_chunk", None) or None
+    long_prompt_len = kw.pop("long_prompt_len", None)
+    tenants = ()
+    if long_prompt_len:
+        tenants = (
+            TenantSpec("interactive", rate_share=3.0,
+                       slo_class="interactive"),
+            TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                       prompt_len=long_prompt_len),
+        )
     mesh, n_chips = decode_mesh(tensor)
     spec = TrafficSpec(
         num_requests=kw.pop("requests", 64),
@@ -134,12 +150,14 @@ def _run_traffic_variant(max_slots, kw, out):
         p_shared=kw.pop("p_shared", 0.75),
         tail_len_mean=kw.pop("tail_len_mean", 32.0),
         tail_len_max=kw.pop("tail_len_max", 128),
-        vocab=kw.pop("vocab", 50000))
+        vocab=kw.pop("vocab", 50000),
+        tenants=tenants)
     run_kw = {
         "preset": kw.pop("preset", "gpt2"),
         "kv_block_size": kw.pop("block_size", 16),
         "max_new_tokens": kw.pop("new_tokens", 64),
         "prefill_bucket": kw.pop("prefill_bucket", 128),
+        "prefill_chunk_tokens": prefill_chunk,
         "time_scale": kw.pop("time_scale", 1.0),
         "latency_slo_ms": kw.pop("latency_slo_ms", 20000.0),
     }
@@ -170,6 +188,10 @@ def _run_traffic_variant(max_slots, kw, out):
                # compared 16 against 64 as if they were the same config
                "block_size": run_kw["kv_block_size"],
                "prefill_bucket": run_kw["prefill_bucket"],
+               # chunk size is variant identity: a chunk-size A/B must
+               # never hash into one ledger series
+               "prefill_chunk_tokens": prefill_chunk,
+               "long_prompt_len": long_prompt_len,
                "overrides": kw}
     try:
         rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
@@ -197,6 +219,11 @@ def _run_traffic_variant(max_slots, kw, out):
                "itl_ms_p50": rep.get("itl_ms_p50"),
                "itl_ms_p99": rep.get("itl_ms_p99"),
                "ttft_critical_path": rep.get("ttft_critical_path"),
+               # per-tenant TTFT p99, top-level so perfledger lifts
+               # them (None outside the long-prompt mixture)
+               "interactive_ttft_ms_p99":
+                   rep.get("interactive_ttft_ms_p99"),
+               "batch_ttft_ms_p99": rep.get("batch_ttft_ms_p99"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],
@@ -207,6 +234,7 @@ def _run_traffic_variant(max_slots, kw, out):
                    "ttft_p50_ms": (eng["ttft_ms"] or {}).get("p50"),
                    "ttft_p95_ms": (eng["ttft_ms"] or {}).get("p95"),
                    "kv_cache": eng.get("kv_cache"),
+                   "prefill_chunks": eng.get("prefill_chunks"),
                    "rejections_by_reason":
                        eng["rejections_by_reason"]}}
     except Exception as e:  # noqa: BLE001 - sweep must survive

@@ -30,15 +30,18 @@ TOP_KEYS = {
     "max_active_slots", "max_slots", "prefill_buckets",
     "prefill_compiles", "program_compiles", "rejections_by_reason",
     "kv_cache", "spec", "slo", "flightrec", "programs",
-    "latency_anatomy",
+    "latency_anatomy", "prefill_chunks",
 }
 
-ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "critical_path",
-                "by_tenant"}
+ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "ttft_ms",
+                "critical_path", "by_tenant"}
 
 CRITICAL_PATH_KEYS = {"e2e_ms", "router_wait_ms", "queue_wait_ms",
-                      "requeue_ms", "prefill_ms", "inter_token_ms",
-                      "spec_rollback_ms"}
+                      "requeue_ms", "prefill_ms", "prefill_wait_ms",
+                      "inter_token_ms", "spec_rollback_ms"}
+
+PREFILL_CHUNK_KEYS = {"requests", "chunks", "tokens",
+                      "max_chunks_per_request"}
 
 SUMMARY_KEYS = {"count", "mean", "p50", "p95", "p99", "max"}
 
@@ -145,6 +148,8 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
     assert anatomy["requests"] == 2  # both requests finished ok
     assert set(anatomy["itl_ms"]) == SUMMARY_KEYS
     assert set(anatomy["tpot_ms"]) == SUMMARY_KEYS
+    assert set(anatomy["ttft_ms"]) == SUMMARY_KEYS
+    assert anatomy["ttft_ms"]["count"] == 2
     assert set(anatomy["critical_path"]) == CRITICAL_PATH_KEYS
     for comp in anatomy["critical_path"].values():
         assert set(comp) == SUMMARY_KEYS
@@ -157,6 +162,12 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
                    if k != "e2e_ms")
     assert comp_sum == pytest.approx(cp["e2e_ms"]["mean"], rel=0.05)
     assert anatomy["by_tenant"] == {}  # no tenant tags in this run
+
+    # chunked-prefill counter block: always present, all-zero when
+    # chunking is off (as here — short prompts, no chunk knob)
+    assert set(stats["prefill_chunks"]) == PREFILL_CHUNK_KEYS
+    assert stats["prefill_chunks"]["requests"] == 0
+    assert stats["prefill_chunks"]["chunks"] == 0
 
     # flight recorder: always on by default, journaling this run
     fr = stats["flightrec"]

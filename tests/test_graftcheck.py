@@ -60,7 +60,7 @@ def test_repo_audit_covers_canonical_programs(repo_report):
     assert {"gpt2_train_step", "llama_train_step",
             "gpt2_prefill_ragged", "llama_prefill_ragged",
             "gpt2_decode_step", "gpt2_sharded_decode_step",
-            "gpt2_spec_verify_step",
+            "gpt2_spec_verify_step", "gpt2_chunked_prefill",
             "fused_ce_fwd", "fused_ce_bwd"} <= audited
     for name, info in repo_report["programs"].items():
         assert "error" not in info, f"{name} failed to trace: {info}"
@@ -297,6 +297,46 @@ def test_planted_spec_verify_full_logits_detected():
                         donate_argnums=spec.donate_argnums,
                         allow_f32_matmul=True))
     assert "logits-buffer" in _rules(vs)
+
+
+def test_planted_chunked_prefill_full_sequence_detected():
+    """The chunked-prefill ProgramSpec pins the whole point of
+    chunking: each chunk program touches only its own tail, never a
+    full-sequence buffer.  A variant that materializes the
+    (max_seq, V) logits class or scans the full 128-step sequence
+    must trip the rule under the real spec's own constraints."""
+    from ray_tpu.tools.graftcheck.programs import default_programs
+
+    spec = next(s for s in default_programs()
+                if s.name == "gpt2_chunked_prefill")
+    assert spec.forbid_logits == (128, 512)
+    assert spec.forbid_scan_lengths == (128,)
+    assert spec.hbm_budget_bytes > 0
+    fn, args = spec.build()
+
+    def bad_logits(p, c, t, bt, pl, nt, s):
+        logits, cache = fn(p, c, t, bt, pl, nt, s)
+        full = jnp.zeros(spec.forbid_logits, jnp.float32)  # planted
+        return logits + jnp.sum(full), cache
+
+    vs, _ = audit_program(
+        ProgramSpec(name="planted", build=lambda: (bad_logits, args),
+                    forbid_logits=spec.forbid_logits,
+                    allow_f32_matmul=True))
+    assert "logits-buffer" in _rules(vs)
+
+    def bad_scan(p, c, t, bt, pl, nt, s):
+        logits, cache = fn(p, c, t, bt, pl, nt, s)
+        acc, _ys = jax.lax.scan(lambda carry, x: (carry + x, x),
+                                jnp.zeros(()),
+                                jnp.zeros((128,)))  # planted full seq
+        return logits + acc, cache
+
+    vs, _ = audit_program(
+        ProgramSpec(name="planted", build=lambda: (bad_scan, args),
+                    forbid_scan_lengths=spec.forbid_scan_lengths,
+                    allow_f32_matmul=True))
+    assert "t0-scan" in _rules(vs)
 
 
 def test_peak_estimate_counts_live_buffers():

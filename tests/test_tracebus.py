@@ -92,6 +92,59 @@ def test_critical_path_clamps_garbage_clocks():
     assert T.critical_path(tel.record_enqueue(8, now=1.0)) is None
 
 
+def _synthetic_chunked_rec():
+    """The same deterministic clocks as ``_synthetic_rec`` but the
+    prefill leg lands as two chunk dispatch windows (1.5-1.6 and
+    1.8-1.9) with a parked decode-wave gap between them."""
+    tel = T.EngineTelemetry("dep0")
+    rec = tel.record_enqueue(96, now=1.0, tenant="a",
+                             ctx=T.TraceContext(origin="router"),
+                             engine_now=1.2)
+    tel.record_requeue(rec, need=3, reason="pool_exhausted", now=1.3)
+    tel.record_admit(rec, bucket=32, slot=0, now=1.5)
+    tel.record_prefill_chunk(rec, 1.5, 1.6, tokens=32, bucket=32)
+    tel.record_prefill_chunk(rec, 1.8, 1.9, tokens=32, bucket=32,
+                             last=True)
+    tel.record_first_token(rec, now=2.0)
+    tel.record_token(rec, n=2, now=2.3)
+    tel.record_finish(rec, n_tokens=3, now=2.5)
+    return tel, rec
+
+
+def test_critical_path_chunked_prefill_exact_sum():
+    """Chunked prefill splits the admit -> first-token window into
+    prefill (the summed chunk windows) and prefill_wait (the parked
+    remainder where decode waves ran) — and the decomposition still
+    sums to e2e exactly."""
+    _tel, rec = _synthetic_chunked_rec()
+    cp = T.critical_path(rec)
+    assert cp["e2e_ms"] == pytest.approx(1500.0)
+    assert cp["prefill_ms"] == pytest.approx(200.0)
+    assert cp["prefill_wait_ms"] == pytest.approx(300.0)
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+
+
+def test_critical_path_chunk_windows_clamp_to_first_token():
+    """A chunk window leaking past the first-token stamp (scheduler
+    jitter) is clamped into [admit, first]: prefill never exceeds the
+    window and the exact-sum invariant holds."""
+    tel = T.EngineTelemetry("d")
+    rec = tel.record_enqueue(64, now=1.0, engine_now=1.0)
+    tel.record_admit(rec, bucket=32, slot=0, now=1.5)
+    tel.record_prefill_chunk(rec, 1.4, 1.7, tokens=32, bucket=32)
+    tel.record_prefill_chunk(rec, 1.9, 2.2, tokens=32, bucket=32,
+                             last=True)
+    tel.record_first_token(rec, now=2.0)
+    tel.record_finish(rec, n_tokens=2, now=2.5)
+    cp = T.critical_path(rec)
+    # (1.5..1.7) + (1.9..2.0) after clamping -> 300 ms of 500
+    assert cp["prefill_ms"] == pytest.approx(300.0)
+    assert cp["prefill_wait_ms"] == pytest.approx(200.0)
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+
+
 def test_tracebus_opt_out(monkeypatch):
     monkeypatch.setenv("RAYTPU_TRACEBUS", "0")
     tel = T.EngineTelemetry("d")
@@ -136,6 +189,43 @@ def test_span_tree_parent_ids_and_device_stitch():
     # every span is a window on one clock inside the request
     for s in spans:
         assert s["end"] >= s["start"] >= 0.0
+
+
+def test_chunked_span_tree_one_prefill_span_per_chunk():
+    """Chunked records emit one engine.prefill span per chunk (with
+    chunk ordinals) and the matched device dispatch parents under the
+    chunk whose window contains it."""
+    _tel, rec = _synthetic_chunked_rec()
+    snap = T.request_snapshot(rec, deployment="dep0")
+    programs = {"invokes": {"serve.paged_prefill": [[1.85, 0.04]]},
+                "compiles": {}}
+    spans = TB.attach_device_spans(
+        TB.build_request_spans(snap), snap, programs)
+    pf = [s for s in spans if s["name"] == "engine.prefill"]
+    assert len(pf) == 2
+    assert [s["attrs"]["chunk"] for s in pf] == [0, 1]
+    assert all(s["attrs"]["n_chunks"] == 2 for s in pf)
+    assert [s["attrs"]["tokens"] for s in pf] == [32, 32]
+    assert (pf[0]["start"], pf[0]["end"]) == (1.5, 1.6)
+    assert (pf[1]["start"], pf[1]["end"]) == (1.8, 1.9)
+    # the invoke at t=1.85 sits inside chunk 1's window
+    dev = next(s for s in spans if s["name"].startswith("device "))
+    assert dev["parent_id"] == pf[1]["span_id"]
+
+
+def test_chunked_device_stitch_falls_back_to_last_chunk():
+    """A dispatch timestamped in the parked gap between chunks (clock
+    skew) still parents under the last chunk — the one whose sample
+    became the first token — rather than dangling."""
+    _tel, rec = _synthetic_chunked_rec()
+    snap = T.request_snapshot(rec, deployment="dep0")
+    programs = {"invokes": {"serve.paged_prefill": [[1.7, 0.05]]},
+                "compiles": {}}
+    spans = TB.attach_device_spans(
+        TB.build_request_spans(snap), snap, programs)
+    pf = [s for s in spans if s["name"] == "engine.prefill"]
+    dev = next(s for s in spans if s["name"].startswith("device "))
+    assert dev["parent_id"] == pf[-1]["span_id"]
 
 
 def test_fallback_span_record_carries_start_duration():
