@@ -865,6 +865,23 @@ def main_traffic(args, on_tpu: bool) -> None:
                 "value": v, "unit": "ms", "vs_baseline": None,
                 "detail": detail})
     _emit_anatomy(base, rep, detail)
+    _emit_kvscope(base, rep, detail)
+
+
+def _emit_kvscope(base: str, rep: dict, detail: dict) -> None:
+    """kvscope headlines shared by --traffic solo and --replicas N:
+    KV pool pressure (p95 occupancy over the run's engine waves) and
+    cache-thrash waste (fraction of prefilled tokens that re-filled
+    previously-resident prefixes).  Both lower-is-better in the
+    ledger."""
+    for field, unit in (("kv_occupancy_p95", "fraction"),
+                        ("reprefill_waste_frac", "fraction")):
+        v = rep.get(field)
+        if isinstance(v, (int, float)):
+            emit({
+                "metric": f"{base}_{field}",
+                "value": v, "unit": unit, "vs_baseline": None,
+                "detail": detail})
 
 
 def _emit_anatomy(base: str, rep: dict, detail: dict) -> None:
@@ -972,6 +989,7 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
                            tenant_report=rep["tenants"].get(
                                name.split("_", 1)[0]))})
     _emit_anatomy(base, rep, detail)
+    _emit_kvscope(base, rep, detail)
 
 
 def main_train_watch(args, on_tpu: bool) -> None:

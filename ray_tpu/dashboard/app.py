@@ -299,6 +299,37 @@ class DashboardActor:
 
         app.router.add_get("/api/serve/slo", serve_slo)
 
+        # kvscope (serve/kvscope.py): each deployment's "kv_scope"
+        # block — KV pool occupancy ring, eviction forensics, HBM
+        # ledger — without the heavyweight rest.  The dump feeds
+        # `python -m ray_tpu.tools.kvscope report/timeline/export`
+        # directly.
+        async def serve_kvscope(_req):
+            def _collect():
+                from ray_tpu.serve import api as serve_api
+
+                out = {}
+                try:
+                    deployments = serve_api.status()
+                except Exception:  # noqa: BLE001 - serve not running
+                    return out
+                for name in deployments:
+                    try:
+                        stats = serve_api.engine_stats(name,
+                                                       timeout=15)
+                        out[name] = {
+                            "kv_scope": stats.get("kv_scope"),
+                        }
+                    except Exception as e:  # noqa: BLE001 - no stats
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/serve/kvscope", serve_kvscope)
+
         # Fleet control plane (serve/router.py): every live
         # build_llm_fleet() in this process — routing policy mix,
         # pooled prefix hit rate, per-tenant SLO attainment, and the
@@ -456,8 +487,21 @@ class DashboardActor:
                             {"requests": reqs})
                 except Exception:  # noqa: BLE001 - evidence optional
                     req_ev = None
+                # memory-side evidence: the pooled kvscope block of
+                # any live fleet (cache-thrash waste attribution)
+                kv_ev = None
+                try:
+                    from ray_tpu.serve.router import fleet_registry
+
+                    for fleet in fleet_registry().values():
+                        ks = fleet.fleet_stats().get("kv_scope")
+                        if ks and ks.get("reprefill_waste_frac"):
+                            kv_ev = ks
+                            break
+                except Exception:  # noqa: BLE001 - evidence optional
+                    kv_ev = None
                 att = attribution.attribute(
-                    programs, request_anatomy=req_ev)
+                    programs, request_anatomy=req_ev, kv_scope=kv_ev)
                 try:
                     v = verdict.build_verdict(budget=budget,
                                               attribution=att)

@@ -167,26 +167,44 @@ class AdmissionPolicy:
         backlog exists — admitted requests are already waiting longer
         than the SLO, so new ones cannot meet it; or
       * observed p95 TTFT exceeds ``ttft_slo_ms`` while a backlog
-        exists.
+        exists; or
+      * the kvscope HBM ledger's ``min_headroom_bytes`` (worst chip:
+        bytes_limit − max(live allocator bytes, KV pool + audited
+        program peak)) has fallen below ``min_headroom_bytes`` —
+        admitting more work risks a device OOM, which no amount of
+        queueing recovers from.
 
     The percentile gates only fire with a backlog (``queue_depth >
     0``): an idle engine with bad historical percentiles must accept
-    work, or it could shed forever on stale history.  ``None`` for any
-    threshold disables that gate; the default policy (all None except
-    a generous queue bound) never sheds in small test runs."""
+    work, or it could shed forever on stale history.  The headroom
+    gate fires regardless of backlog — exhausted HBM does not heal by
+    admitting the request that would exhaust it — but is inert when
+    the ledger reports no measurable headroom (CPU backends, dense
+    engines).  ``None`` for any threshold disables that gate; the
+    default policy (all None except a generous queue bound) never
+    sheds in small test runs."""
 
     def __init__(self, *, max_queue_depth: Optional[int] = None,
                  queue_wait_slo_ms: Optional[float] = None,
-                 ttft_slo_ms: Optional[float] = None):
+                 ttft_slo_ms: Optional[float] = None,
+                 min_headroom_bytes: Optional[int] = None):
         self.max_queue_depth = max_queue_depth
         self.queue_wait_slo_ms = queue_wait_slo_ms
         self.ttft_slo_ms = ttft_slo_ms
+        self.min_headroom_bytes = min_headroom_bytes
 
     def decide(self, stats, queue_depth: int) -> Optional[str]:
         """None = admit; otherwise the shed reason (metric label)."""
         if self.max_queue_depth is not None \
                 and queue_depth >= self.max_queue_depth:
             return "queue_full"
+        if self.min_headroom_bytes is not None:
+            ledger = (stats.get("kv_scope") or {}).get("hbm_ledger") \
+                or {}
+            headroom = ledger.get("min_headroom_bytes")
+            if headroom is not None \
+                    and headroom < self.min_headroom_bytes:
+                return "hbm_headroom"
         if queue_depth > 0:
             qw = (stats.get("queue_wait_ms") or {}).get("p95")
             if self.queue_wait_slo_ms is not None and qw is not None \
@@ -201,7 +219,8 @@ class AdmissionPolicy:
     def describe(self) -> dict:
         return {"max_queue_depth": self.max_queue_depth,
                 "queue_wait_slo_ms": self.queue_wait_slo_ms,
-                "ttft_slo_ms": self.ttft_slo_ms}
+                "ttft_slo_ms": self.ttft_slo_ms,
+                "min_headroom_bytes": self.min_headroom_bytes}
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,

@@ -33,7 +33,14 @@ from __future__ import annotations
 import collections
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.serve.kvscope import KVScope
+
 __all__ = ["BlockPager"]
+
+#: journal events tag evicted/re-registered keys by their first few
+#: tokens (enough to eyeball which prefix churned) plus the full
+#: length — full keys would bloat the bounded flightrec ring
+_KEY_PREFIX_TOKENS = 8
 
 
 class BlockPager:
@@ -95,28 +102,43 @@ class BlockPager:
         #: reserve / evict / free / COW decisions journal themselves
         #: so a postmortem can replay pool pressure around an anomaly
         self._recorder = recorder
-        #: (request_id, trace_id) the engine sets around one
+        #: (request_id, trace_id, tenant) the engine sets around one
         #: admission's reservation window, so the kv_* journal events
-        #: carry the request/trace id a postmortem filters by
-        self._req_ctx: Tuple[Optional[int], Optional[str]] = (None,
-                                                              None)
+        #: carry the request/trace/tenant a postmortem filters by and
+        #: kvscope can attribute blocks + re-prefill waste per tenant
+        self._req_ctx: Tuple[Optional[int], Optional[str],
+                             Optional[str]] = (None, None, None)
+        #: kvscope (serve/kvscope.py): occupancy ring + eviction
+        #: forensics + re-prefill waste ledger over this pool
+        self.scope = KVScope(self.num_blocks, self.block_size)
 
     def set_request(self, request_id: Optional[int],
-                    trace_id: Optional[str] = None) -> None:
+                    trace_id: Optional[str] = None,
+                    tenant: Optional[str] = None) -> None:
         """Scope subsequent recorder events to one request — the
         engine brackets each admission's pager calls with
-        ``set_request(rec_id, trace_id)`` / ``set_request(None)``.
-        Purely journal tagging; allocation behavior is unchanged."""
-        self._req_ctx = (request_id, trace_id)
+        ``set_request(rec_id, trace_id, tenant)`` / ``set_request(None)``.
+        Purely journal/attribution tagging; allocation behavior is
+        unchanged."""
+        self._req_ctx = (request_id, trace_id, tenant)
 
     def _ctx_tag(self) -> Dict[str, object]:
-        req, trace = self._req_ctx
+        req, trace, tenant = self._req_ctx
         if req is None:
             return {}
         tag: Dict[str, object] = {"req": req}
         if trace is not None:
             tag["trace"] = trace
+        if tenant:
+            tag["tenant"] = tenant
         return tag
+
+    def _key_tag(self, key: Optional[Tuple[int, ...]]
+                 ) -> Dict[str, object]:
+        if key is None:
+            return {}
+        return {"key_prefix": list(key[:_KEY_PREFIX_TOKENS]),
+                "key_len": len(key)}
 
     # -- capacity ------------------------------------------------------
 
@@ -170,13 +192,29 @@ class BlockPager:
         for _ in range(count):
             if not self._free:
                 blk, _ = self._cached.popitem(last=False)  # LRU
+                # forensics: capture the content key BEFORE the index
+                # drops it — the kv_evict journal event and the
+                # kvscope re-prefill ledger both need to know WHAT
+                # was lost, not just that a block was reclaimed
+                key = self._block_key.get(blk)
+                owner = self.scope.note_evict(key)
                 self._deregister(blk)
                 self.evictions += 1
                 evicted += 1
                 self._free.append(blk)
+                if self._recorder is not None:
+                    # "tenant" names the VICTIM's owner (what was
+                    # lost); req/trace still identify the evicting
+                    # admission via the request context
+                    tag = dict(self._ctx_tag(), **self._key_tag(key))
+                    if owner:
+                        tag["tenant"] = owner
+                    self._recorder.record("kv_evict", block=blk,
+                                          **tag)
             blk = self._free.pop()
             self._ref[blk] = 1
             out.append(blk)
+        self.scope.note_alloc(out, self._req_ctx[2])
         if self._recorder is not None and count:
             self._recorder.record("kv_reserve", blocks=count,
                                   evicted=evicted,
@@ -197,6 +235,7 @@ class BlockPager:
             if ref < 0:
                 raise ValueError(f"release of unallocated block {blk}")
             del self._ref[blk]
+            self.scope.note_block_released(blk)
             if blk in self._block_key:
                 self._cached[blk] = None       # most-recently used
                 self._cached.move_to_end(blk)
@@ -256,17 +295,25 @@ class BlockPager:
                 self._ref[blk] = 1
             else:
                 self._ref[blk] += 1
+        self.scope.note_alloc(matched, self._req_ctx[2])
         self.prefix_hits += len(matched)
         self.prefix_misses += self.blocks_needed(n, 0) - len(matched)
         return prefix_len, matched
 
     def register_prefix(self, tokens: Sequence[int],
-                        block_ids: Sequence[int]) -> None:
+                        block_ids: Sequence[int]) -> int:
         """Index every FULL prompt block of `tokens` (block i holds
         K/V for tokens[i*bs:(i+1)*bs]) so later prompts can match it.
         First writer wins: keys already indexed keep their canonical
-        block (the duplicate block simply stays unregistered)."""
+        block (the duplicate block simply stays unregistered).
+
+        Returns the re-prefill waste tokens kvscope booked — the sum
+        over registered keys that were previously evicted (content
+        the pool already held once and had to re-fill from scratch).
+        """
         tokens = tuple(int(t) for t in tokens)
+        tenant = self._req_ctx[2]
+        waste = 0
         for i in range(len(tokens) // self.block_size):
             key = tokens[:(i + 1) * self.block_size]
             blk = block_ids[i]
@@ -274,6 +321,14 @@ class BlockPager:
                 continue
             self._index[key] = blk
             self._block_key[blk] = key
+            booked = self.scope.note_register(key, tenant)
+            if booked:
+                waste += booked
+                if self._recorder is not None:
+                    self._recorder.record(
+                        "kv_reprefill", block=blk, tokens=booked,
+                        **dict(self._ctx_tag(), **self._key_tag(key)))
+        return waste
 
     def ensure_private(self, block_id: int
                        ) -> Tuple[int, Optional[int]]:
@@ -298,8 +353,12 @@ class BlockPager:
         self.release([block_id])       # our ref moves to the fork
         self.cow_copies += 1
         if self._recorder is not None:
-            self._recorder.record("kv_cow", src=block_id,
-                                  fork=fresh[0], **self._ctx_tag())
+            # forensics: the forked block's content key (when it is a
+            # registered prefix boundary) names WHICH prefix diverged
+            self._recorder.record(
+                "kv_cow", src=block_id, fork=fresh[0],
+                **dict(self._ctx_tag(),
+                       **self._key_tag(self._block_key.get(block_id))))
         return fresh[0], block_id
 
     def prefix_keys(self) -> List[Tuple[int, ...]]:
@@ -324,6 +383,25 @@ class BlockPager:
             self._index.pop(key, None)
 
     # -- introspection -------------------------------------------------
+
+    def sample_occupancy(self) -> None:
+        """Append one kvscope occupancy snapshot — the engine calls
+        this once per wave, so the ring replays pool pressure at
+        scheduling granularity without journaling every allocation."""
+        self.scope.sample(self._free, len(self._cached))
+
+    def kv_scope_stats(self) -> Dict[str, object]:
+        """The occupancy/forensics half of ``engine_stats()``'s
+        ``kv_scope`` block.  ``prefill_tokens`` (the waste-fraction
+        denominator) counts prefilled blocks in token units — the
+        same block-granular unit the waste ledger books — so
+        ``reprefill_waste_frac`` is exactly 'fraction of prefilled
+        blocks that re-filled previously-resident content'.  The HBM
+        ledger is composed by the deployment, which owns the device
+        view."""
+        return self.scope.stats(
+            free=len(self._free), cached=len(self._cached),
+            prefill_tokens=self.prefix_misses * self.block_size)
 
     def stats(self) -> Dict[str, float]:
         total = self.prefix_hits + self.prefix_misses

@@ -852,8 +852,10 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             tokens = arr.tolist()
             ctx = rec.get("ctx")
             pager.set_request(rec["id"],
-                              ctx.trace_id if ctx is not None else None)
+                              ctx.trace_id if ctx is not None else None,
+                              tenant=rec.get("tenant"))
             t_kv0 = _time.perf_counter()
+            ev0 = pager.evictions
             # spec decode: reserve k blocks' worth of verify-overshoot
             # headroom so rejected draft K/V writes land in blocks this
             # row owns, never one the pager re-hands out
@@ -891,7 +893,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             pager.set_request(None)
             self._telemetry.record_kv_reserve(
                 rec, t_kv0, _time.perf_counter(), blocks=len(blocks),
-                hit_blocks=len(matched))
+                hit_blocks=len(matched),
+                evicted=pager.evictions - ev0)
             self._telemetry.record_prefix_reuse(
                 len(matched), pager.blocks_needed(n, 0) - len(matched))
             n_tail = n - prefix_len
@@ -942,8 +945,18 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             first = int(np.asarray(tok)[0])
             self._telemetry.record_first_token(rec)
             # the prompt's full blocks now hold exactly its K/V —
-            # index them so later prompts can skip this work
-            pager.register_prefix(tokens, blocks)
+            # index them so later prompts can skip this work.
+            # Re-bracketed in the request context: registration is
+            # where kvscope books re-prefill waste (a previously
+            # evicted key coming back), and the booking must carry
+            # this request's tenant/trace
+            pager.set_request(rec["id"],
+                              ctx.trace_id if ctx is not None else None,
+                              tenant=rec.get("tenant"))
+            waste = pager.register_prefix(tokens, blocks)
+            pager.set_request(None)
+            if waste:
+                self._telemetry.note_kv_waste(rec, waste)
             if max_new_tokens <= 1 or self._hit_stop([first]):
                 self._telemetry.record_finish(rec, n_tokens=1)
                 if not fut.done():
@@ -1045,7 +1058,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             ctx = st["rec"].get("ctx")
             self._pager.set_request(
                 st["rec"]["id"],
-                ctx.trace_id if ctx is not None else None)
+                ctx.trace_id if ctx is not None else None,
+                tenant=st["rec"].get("tenant"))
             self._pager.note_fill(c, partial=not last)
             self._pager.set_request(None)
             if not last:
@@ -1053,7 +1067,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 return
             rec, fut, blocks = st["rec"], st["fut"], st["blocks"]
             self._telemetry.record_first_token(rec)
-            self._pager.register_prefix(arr.tolist(), blocks)
+            # registration under the request context: kvscope books
+            # re-prefill waste (previously-evicted keys returning)
+            # against this request's tenant
+            self._pager.set_request(
+                rec["id"], ctx.trace_id if ctx is not None else None,
+                tenant=rec.get("tenant"))
+            waste = self._pager.register_prefix(arr.tolist(), blocks)
+            self._pager.set_request(None)
+            if waste:
+                self._telemetry.note_kv_waste(rec, waste)
             if max_new_tokens <= 1 or self._hit_stop([first]):
                 self._telemetry.record_finish(rec, n_tokens=1)
                 if not fut.done():
@@ -1269,6 +1292,11 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         # throttled burn-rate watchdog: breach / storm
                         # transitions postmortem-dump the flight record
                         self._telemetry.slo.check()
+                    if self._pager is not None:
+                        # kvscope occupancy ring: one pool snapshot
+                        # per wave (host counters only, no device
+                        # sync) — the timeline a postmortem replays
+                        self._pager.sample_occupancy()
                     if prefilling:
                         self._prefill_chunk_step(prefilling)
                 except Exception as e:  # noqa: BLE001 - fail loudly
@@ -1339,7 +1367,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             if admission_policy is not None:
                 # the control loop: telemetry percentiles feed the
                 # shed decision BEFORE the request costs the engine
-                # anything
+                # anything.  The HBM-headroom gate needs a FRESH
+                # ledger (engine_stats serves the last composed one):
+                # refresh only when that gate is armed — the device
+                # allocator query stays off the default admit path
+                if getattr(admission_policy, "min_headroom_bytes",
+                           None) is not None \
+                        and getattr(self, "_pager", None) is not None:
+                    self._telemetry.record_kv_scope(
+                        self._compose_kv_scope())
                 shed = admission_policy.decide(
                     self._telemetry.engine_stats(), len(self._queue))
                 if shed is not None:
@@ -1370,6 +1406,35 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
         # -- telemetry surface (works for both schedulers) -----------
 
+        def _compose_kv_scope(self):
+            """The full engine_stats()["kv_scope"] block: the pager's
+            occupancy/forensics half plus the unified HBM ledger
+            (pool bytes + live allocator view + graftcheck's audited
+            per-program peak budget → headroom_bytes per chip).  The
+            budget term is cached after the first lookup — graftcheck
+            import cost is paid once per deployment."""
+            from ray_tpu._private.device_stats import \
+                device_memory_stats
+            from ray_tpu.serve.kvscope import (
+                hbm_ledger, serve_program_budget_bytes)
+
+            pager = self._pager
+            block = pager.kv_scope_stats()
+            budget = getattr(self, "_kvscope_budget", None)
+            if budget is None:
+                budget = serve_program_budget_bytes()
+                self._kvscope_budget = budget
+            mesh = getattr(self, "mesh", None)
+            devices = (list(mesh.devices.flat)
+                       if mesh is not None else None)
+            pool_per_chip = (pager.bytes_per_block * pager.num_blocks
+                             // pager.tensor_shards)
+            block["hbm_ledger"] = hbm_ledger(
+                pool_bytes_per_chip=pool_per_chip,
+                device_stats=device_memory_stats(devices),
+                program_budget_bytes=budget)
+            return block
+
         def engine_stats(self):
             """p50/p95/p99 TTFT + queue wait, throughput, slot
             utilization, request counts, rejections by reason, and
@@ -1379,6 +1444,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             pager = getattr(self, "_pager", None)
             if pager is not None:
                 self._telemetry.record_kv_stats(pager.stats())
+                self._telemetry.record_kv_scope(
+                    self._compose_kv_scope())
             stats = self._telemetry.engine_stats()
             if admission_policy is not None:
                 stats["admission_policy"] = admission_policy.describe()

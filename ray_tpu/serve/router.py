@@ -600,6 +600,15 @@ class LLMFleet:
         hits = misses = 0
         chunks = {"requests": 0, "chunks": 0, "tokens": 0,
                   "max_chunks_per_request": 0}
+        # kvscope pooling: waste counters SUM over replicas (each
+        # replica's pager thrashes independently), occupancy reports
+        # per-replica ratios plus the fleet max/mean — a fleet-wide
+        # average would hide one replica's pool running hot
+        scope = {"reprefill_waste_tokens": 0, "reprefill_events": 0,
+                 "keys_evicted": 0, "prefill_tokens": 0}
+        waste_by_tenant: Dict[str, int] = {}
+        occ_by_replica: Dict[str, float] = {}
+        occ_p95s: List[float] = []
         replicas = {}
         for rep in self._replicas + self._retired:
             st = rep.engine_stats()
@@ -612,6 +621,17 @@ class LLMFleet:
             chunks["max_chunks_per_request"] = max(
                 chunks["max_chunks_per_request"],
                 int(pc.get("max_chunks_per_request", 0)))
+            ks = st.get("kv_scope") or {}
+            forensics = ks.get("forensics") or {}
+            for k in scope:
+                scope[k] += int(forensics.get(k, 0))
+            for t, v in (forensics.get("waste_by_tenant")
+                         or {}).items():
+                waste_by_tenant[t] = waste_by_tenant.get(t, 0) + int(v)
+            occ = ks.get("occupancy") or {}
+            occ_by_replica[rep.name] = float(
+                occ.get("occupancy_ratio", 0.0))
+            occ_p95s.append(float(occ.get("occupancy_p95", 0.0)))
             replicas[rep.name] = {
                 "draining": rep.draining,
                 "retired": rep in self._retired,
@@ -623,6 +643,21 @@ class LLMFleet:
                 if st.get("slo") else None,
             }
         total = hits + misses
+        occ_vals = list(occ_by_replica.values())
+        kv_scope = dict(
+            scope,
+            reprefill_waste_frac=round(
+                scope["reprefill_waste_tokens"]
+                / scope["prefill_tokens"], 4)
+            if scope["prefill_tokens"] else 0.0,
+            waste_by_tenant=waste_by_tenant,
+            occupancy_by_replica=occ_by_replica,
+            occupancy_max=max(occ_vals) if occ_vals else 0.0,
+            occupancy_mean=round(sum(occ_vals) / len(occ_vals), 4)
+            if occ_vals else 0.0,
+            # worst replica's ring p95 — the fleet headline occupancy
+            # number (an average would hide one pool running hot)
+            occupancy_p95=max(occ_p95s) if occ_p95s else 0.0)
         return {
             "name": self.name,
             "num_replicas": self.num_replicas,
@@ -632,6 +667,7 @@ class LLMFleet:
             "prefix_hit_rate": round(hits / total, 4) if total
             else 0.0,
             "prefill_chunks": chunks,
+            "kv_scope": kv_scope,
             "tenants": self.tenant_report(),
             "replicas": replicas,
             "flightrec": self.telemetry.flightrec.stats(),

@@ -38,6 +38,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
 from ray_tpu._private.flightrec import FlightRecorder
+from ray_tpu.serve.kvscope import empty_kv_scope as _empty_kv_scope
 from ray_tpu.util import tracing
 
 #: ms boundaries for request-level latencies (TTFT, queue wait, total)
@@ -166,6 +167,22 @@ def _engine_metrics() -> Dict[str, Any]:
                     "serve_spec_rounds_total",
                     "speculative propose+verify rounds (one target "
                     "dispatch each)", tag_keys=tags),
+                "kv_occupancy": Gauge(
+                    "serve_kv_occupancy_ratio",
+                    "fraction of the usable KV pool (null block "
+                    "excluded) held in-use or parked in the LRU "
+                    "cache", tag_keys=tags),
+                "kv_fragmentation": Gauge(
+                    "serve_kv_fragmentation",
+                    "largest-contiguous-free-run deficit of the KV "
+                    "pool (0 = one contiguous run, ->1 = shattered)",
+                    tag_keys=tags),
+                "kv_reprefill_waste": Counter(
+                    "serve_kv_reprefill_waste_tokens_total",
+                    "prompt tokens re-prefilled into blocks whose "
+                    "content key was previously resident and evicted "
+                    "(what a host-RAM KV tier would have saved)",
+                    tag_keys=tags),
             }
         return _metrics
 
@@ -425,6 +442,13 @@ class EngineTelemetry:
         self._program_compiles: Dict[str, int] = {}
         self._rejections_by_reason: Dict[str, int] = {}
         self._kv_stats: Optional[Dict[str, Any]] = None
+        #: kvscope block (serve/kvscope.py) the deployment composes —
+        #: occupancy ring + eviction forensics + HBM ledger; the
+        #: waste counter below tracks how much of the cumulative
+        #: reprefill_waste_tokens has already been pushed to the
+        #: Prometheus counter (counters take deltas, stats are totals)
+        self._kv_scope: Optional[Dict[str, Any]] = None
+        self._kv_waste_reported = 0
         self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
         #: chunked streaming prefill (round 15): admissions split into
         #: block-sized chunks interleaved with decode waves
@@ -652,12 +676,27 @@ class EngineTelemetry:
 
     def record_kv_reserve(self, rec: Dict[str, Any], start: float,
                           end: float, blocks: int = 0,
-                          hit_blocks: int = 0) -> None:
+                          hit_blocks: int = 0, evicted: int = 0,
+                          reprefill_waste_tokens: int = 0) -> None:
         """The BlockPager reservation window for one admission
         (prefix match + allocate + COW), kept on the record so the
-        tracebus can render it as its own span inside queue wait."""
+        tracebus can render it as its own span inside queue wait.
+        `evicted` counts resident prefixes this reservation pushed
+        out; `reprefill_waste_tokens` (patched post-prefill via
+        `note_kv_waste` — registration happens after the window)
+        counts tokens this admission re-filled that were previously
+        resident, so a trace can show WHO thrashed the cache."""
         rec["kv_reserve"] = (float(start), float(end), int(blocks),
-                             int(hit_blocks))
+                             int(hit_blocks), int(evicted),
+                             int(reprefill_waste_tokens))
+
+    def note_kv_waste(self, rec: Dict[str, Any], tokens: int) -> None:
+        """Patch the re-prefill waste this admission booked onto its
+        kv_reserve tuple — known only at `register_prefix` time, after
+        the reservation window closed."""
+        kv = rec.get("kv_reserve")
+        if kv is not None and tokens:
+            rec["kv_reserve"] = kv[:5] + (int(tokens),)
 
     def record_prefill_chunk(self, rec: Dict[str, Any], start: float,
                              end: float, tokens: int, bucket: int,
@@ -758,6 +797,27 @@ class EngineTelemetry:
             self._kv_stats = dict(stats)
         self._m["kv_blocks_in_use"].set(
             int(stats.get("blocks_in_use", 0)), tags=self._tags)
+
+    def record_kv_scope(self, block: Dict[str, Any]) -> None:
+        """Latest composed kvscope block (occupancy + forensics + HBM
+        ledger, see serve/kvscope.py) — mirrored into
+        engine_stats()["kv_scope"] and the kvscope gauges; the waste
+        Prometheus counter advances by the delta since the last push
+        (stats carry totals, counters take increments)."""
+        occ = block.get("occupancy") or {}
+        forensics = block.get("forensics") or {}
+        with self._lock:
+            self._kv_scope = block
+            waste = int(forensics.get("reprefill_waste_tokens", 0))
+            delta = waste - self._kv_waste_reported
+            if delta > 0:
+                self._kv_waste_reported = waste
+        self._m["kv_occupancy"].set(
+            float(occ.get("occupancy_ratio", 0.0)), tags=self._tags)
+        self._m["kv_fragmentation"].set(
+            float(occ.get("fragmentation", 0.0)), tags=self._tags)
+        if delta > 0:
+            self._m["kv_reprefill_waste"].inc(delta, tags=self._tags)
 
     # -- fleet control plane (serve/router.py journals through here) -------
 
@@ -930,6 +990,7 @@ class EngineTelemetry:
             rejections = dict(self._rejections_by_reason)
             kv_stats = (dict(self._kv_stats)
                         if self._kv_stats is not None else None)
+            kv_scope = self._kv_scope
             spec = dict(self._spec)
             chunks = dict(self._chunks)
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
@@ -977,6 +1038,11 @@ class EngineTelemetry:
             # keys — the "requests" dict shape is a stable contract)
             "rejections_by_reason": rejections,
             "kv_cache": kv_stats,
+            # round-16: kvscope — occupancy ring + eviction forensics
+            # + unified HBM ledger (stable empty-shaped block on
+            # dense engines, which have no pager to observe)
+            "kv_scope": (kv_scope if kv_scope is not None
+                         else _empty_kv_scope()),
             # round-11: speculative decoding — engine totals plus
             # per-request acceptance-rate percentiles (requests that
             # saw at least one verify round)

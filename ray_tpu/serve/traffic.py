@@ -59,7 +59,17 @@ class TenantSpec:
     tail (total prompt = shared prefix + prompt_len when the request
     extends a prefix) — the batch-floods-interactive mixture the
     chunked-prefill A/B needs.  When unset (every legacy spec) the
-    draw order is untouched, so the RNG stream stays bit-identical."""
+    draw order is untouched, so the RNG stream stays bit-identical.
+
+    `prefix_pool` makes this a cache-churn tenant: its shared-prefix
+    requests rotate round-robin through a private pool of N distinct
+    `prefix_len`-token prefixes (drawn from a SEPARATE seeded stream)
+    instead of the spec's `num_prefix_groups` — size N past what the
+    pager's LRU pool can park and every rotation lap re-prefills
+    evicted content, the reproducible thrash kvscope's re-prefill
+    waste accounting is tested against.  Mutually exclusive with
+    `prefix_groups`; when unset (every legacy spec) the main RNG
+    stream stays bit-identical."""
 
     name: str
     rate_share: float = 1.0
@@ -70,6 +80,7 @@ class TenantSpec:
     objective: float = 0.95
     weight: Optional[float] = None
     prompt_len: Optional[int] = None
+    prefix_pool: Optional[int] = None
 
     def __post_init__(self):
         if self.rate_share <= 0:
@@ -78,6 +89,16 @@ class TenantSpec:
         if self.prompt_len is not None and self.prompt_len < 1:
             raise ValueError(f"tenant {self.name!r}: prompt_len must "
                              "be >= 1 when set")
+        if self.prefix_pool is not None:
+            if self.prefix_pool < 1:
+                raise ValueError(
+                    f"tenant {self.name!r}: prefix_pool must be >= 1 "
+                    "when set")
+            if self.prefix_groups:
+                raise ValueError(
+                    f"tenant {self.name!r}: prefix_pool and "
+                    "prefix_groups are mutually exclusive (a churn "
+                    "tenant rotates its own private prefixes)")
         if self.slo_class not in _CLASS_WEIGHTS:
             raise ValueError(
                 f"tenant {self.name!r}: slo_class must be one of "
@@ -165,6 +186,17 @@ class TrafficGenerator:
             self._rng.randint(2, spec.vocab, size=spec.prefix_len)
             .astype(np.int32)
             for _ in range(spec.num_prefix_groups)]
+        # churn tenants (prefix_pool=N): each gets a private pool of N
+        # prefixes from its own seeded stream, so the main RNG stream
+        # above (and therefore every legacy draw) is untouched
+        self.tenant_pools: Dict[str, List[np.ndarray]] = {}
+        for i, t in enumerate(spec.tenants):
+            if t.prefix_pool is None:
+                continue
+            pool_rng = np.random.RandomState(spec.seed + 7919 * (i + 1))
+            self.tenant_pools[t.name] = [
+                pool_rng.randint(2, spec.vocab, size=spec.prefix_len)
+                .astype(np.int32) for _ in range(t.prefix_pool)]
 
     def requests(self) -> List[TrafficRequest]:
         spec, rng = self.spec, self._rng
@@ -177,8 +209,11 @@ class TrafficGenerator:
                               dtype=np.float64)
             shares = np.cumsum(shares / shares.sum())
         out: List[TrafficRequest] = []
+        #: per-tenant round-robin cursor over its churn pool — a local
+        #: so repeated requests() calls replay identically
+        pool_rr: Dict[str, int] = {}
         for i in range(spec.num_requests):
-            tenant, pool, plen = "", None, None
+            tenant, pool, plen, churn = "", None, None, None
             if shares is not None:
                 idx = min(int(np.searchsorted(shares, rng.rand())),
                           len(spec.tenants) - 1)
@@ -186,6 +221,7 @@ class TrafficGenerator:
                 tenant = t.name
                 pool = t.prefix_groups or None
                 plen = t.prompt_len
+                churn = self.tenant_pools.get(t.name)
             tail_len = 1 + min(int(rng.poisson(
                 max(spec.tail_len_mean - 1.0, 0.0))),
                 spec.tail_len_max - 1)
@@ -198,11 +234,26 @@ class TrafficGenerator:
                                size=tail_len).astype(np.int32)
             if spec.num_prefix_groups > 0 \
                     and rng.rand() < spec.p_shared:
-                if pool is not None:
+                if churn is not None:
+                    # churn tenant: the group draw below still happens
+                    # (keeps the stream aligned for co-tenants), but
+                    # the prefix comes from the tenant's private pool,
+                    # rotated round-robin so a bounded pager pool is
+                    # forced through deterministic LRU eviction laps
+                    rng.randint(spec.num_prefix_groups)
+                    p_idx = pool_rr.get(tenant, 0)
+                    pool_rr[tenant] = p_idx + 1
+                    group = -2 - (p_idx % len(churn))
+                    prompt = np.concatenate(
+                        [churn[p_idx % len(churn)], tail])
+                elif pool is not None:
                     group = int(pool[rng.randint(len(pool))])
+                    prompt = np.concatenate([self.prefixes[group],
+                                             tail])
                 else:
                     group = int(rng.randint(spec.num_prefix_groups))
-                prompt = np.concatenate([self.prefixes[group], tail])
+                    prompt = np.concatenate([self.prefixes[group],
+                                             tail])
             else:
                 group, prompt = -1, tail
             out.append(TrafficRequest(float(arrivals[i]), prompt,
@@ -264,7 +315,9 @@ async def drive(instance, requests: List[TrafficRequest], *,
 
 def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 preset: str = "nano", kv_layout: str = "paged",
-                kv_block_size: int = 16, max_slots: int = 4,
+                kv_block_size: int = 16,
+                kv_num_blocks: Optional[int] = None,
+                max_slots: int = 4,
                 max_new_tokens: int = 8, prefill_bucket: int = 16,
                 prefill_chunk_tokens: Optional[int] = None,
                 time_scale: float = 0.0,
@@ -306,7 +359,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         family, preset, scheduler="continuous", max_slots=max_slots,
         max_new_tokens=max_new_tokens, temperature=0.0,
         prefill_bucket=prefill_bucket, kv_layout=kv_layout,
-        kv_block_size=kv_block_size,
+        kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
         prefill_chunk_tokens=prefill_chunk_tokens,
         admission_policy=admission_policy, slo=slo,
         spec_decode=spec_decode, mesh=mesh,
@@ -335,6 +388,15 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     eng = report["engine"]
     kv = eng.get("kv_cache") or {}
     report["prefix_hit_rate"] = kv.get("prefix_hit_rate", 0.0)
+    # kvscope headlines: cache pressure (occupancy) and cache-thrash
+    # waste (fraction of prefilled tokens that re-filled previously
+    # resident prefixes), flattened for SWEEPJSON consumers
+    scope_blk = eng.get("kv_scope") or {}
+    report["kv_occupancy_p95"] = \
+        (scope_blk.get("occupancy") or {}).get("occupancy_p95", 0.0)
+    report["reprefill_waste_frac"] = \
+        (scope_blk.get("forensics") or {}).get(
+            "reprefill_waste_frac", 0.0)
     # engine-side SLO: per-objective attainment (TTFT + e2e + queue
     # wait as configured), flattened for SWEEPJSON consumers
     slo_block = eng.get("slo")
@@ -483,6 +545,12 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
     report["wfq"] = wfq
     report["router_prefix_hit_rate"] = \
         report["fleet"]["prefix_hit_rate"]
+    # fleet-pooled kvscope headlines (see fleet_stats()["kv_scope"])
+    fleet_scope = report["fleet"].get("kv_scope") or {}
+    report["kv_occupancy_p95"] = \
+        fleet_scope.get("occupancy_p95", 0.0)
+    report["reprefill_waste_frac"] = \
+        fleet_scope.get("reprefill_waste_frac", 0.0)
     report["tenants"] = report["fleet"]["tenants"]
     #: flattened for SWEEPJSON consumers: {tenant}_{obj}_slo_attainment
     flat: Dict[str, Any] = {}

@@ -28,13 +28,14 @@ from ray_tpu.tools.autopilot import attribution, planner, verdict
 def _load_snapshot(path: str) -> Dict[str, Any]:
     """A canned snapshot file: either a bare ``{name: block}`` programs
     dict, or an ``engine_stats()`` / dashboard dump carrying
-    ``programs`` (and optionally ``device``) keys."""
+    ``programs`` (and optionally ``device`` and ``kv_scope``) keys."""
     with open(path) as f:
         obj = json.load(f)
     if isinstance(obj.get("programs"), dict):
         return {"programs": obj["programs"],
-                "device": obj.get("device")}
-    return {"programs": obj, "device": None}
+                "device": obj.get("device"),
+                "kv_scope": obj.get("kv_scope")}
+    return {"programs": obj, "device": None, "kv_scope": None}
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -100,7 +101,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.snapshot:
             snap = _load_snapshot(args.snapshot)
             report = attribution.attribute(snap["programs"],
-                                           device=snap["device"])
+                                           device=snap["device"],
+                                           kv_scope=snap["kv_scope"])
         else:
             report = attribution.attribute_registry()
         if args.format == "json":
@@ -114,7 +116,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.snapshot:
             snap = _load_snapshot(args.snapshot)
             att = attribution.attribute(snap["programs"],
-                                        device=snap["device"])
+                                        device=snap["device"],
+                                        kv_scope=snap["kv_scope"])
         p = planner.plan(args.history, args.baseline,
                          budget=args.budget, attribution=att,
                          include_fresh=args.include_fresh)

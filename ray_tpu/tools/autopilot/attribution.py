@@ -116,10 +116,17 @@ def _headroom(block: Dict[str, Any], cls: str,
     return None
 
 
+#: re-prefill waste fraction above which the serving bottleneck is
+#: called cache thrash: the KV pool is evicting prefixes it re-fills,
+#: so prefill compute is going to content the pool already held
+CACHE_THRASH_WASTE_FRAC = 0.15
+
+
 def attribute(programs: Dict[str, Dict[str, Any]],
               device: Optional[Dict[str, Any]] = None,
               request_anatomy: Optional[Dict[str, Any]] = None,
-              train_anatomy: Optional[Dict[str, Any]] = None
+              train_anatomy: Optional[Dict[str, Any]] = None,
+              kv_scope: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Attribute a programs snapshot against the device roofline.
 
@@ -137,7 +144,13 @@ def attribute(programs: Dict[str, Dict[str, Any]],
     blocks, train/goodput.py): when ``data_wait`` dominates the step
     anatomy the summary cites *input-bound* — sweeping device knobs
     cannot move a loop that is starving on its batch iterator.
-    Returns::
+    ``kv_scope`` is the kvscope block (``engine_stats()["kv_scope"]``
+    or the fleet-pooled variant): when the re-prefill waste fraction
+    crosses :data:`CACHE_THRASH_WASTE_FRAC` the summary names the
+    serving loop *cache-thrash-bound* — a meaningful share of prefill
+    compute is re-filling prefixes the pool already held and evicted,
+    so the lever is pool size (or a host-RAM KV tier), not program
+    knobs.  Returns::
 
         {"device": {...roofline...},
          "programs": {name: {"class", "arithmetic_intensity", "mfu",
@@ -219,10 +232,23 @@ def attribute(programs: Dict[str, Dict[str, Any]],
             else:
                 summary += (f"; train step anatomy dominated by "
                             f"{dom} ({mean:.1f} ms mean{gp})")
+    if kv_scope:
+        # engine shape nests the waste under "forensics"; the
+        # fleet-pooled block (router fleet_stats) is flat
+        fx = kv_scope.get("forensics") or kv_scope
+        frac = fx.get("reprefill_waste_frac") or 0.0
+        if frac >= CACHE_THRASH_WASTE_FRAC:
+            summary += (
+                f"; serving is cache-thrash-bound: {frac:.0%} of "
+                f"prefill tokens re-filled previously-resident "
+                f"prefixes ({fx.get('reprefill_waste_tokens', 0)} "
+                f"tokens) — grow the KV pool before sweeping "
+                f"program knobs")
     return {"device": device, "programs": out, "ranked": ranked,
             "bottleneck": bottleneck,
             "request_anatomy": request_anatomy,
-            "train_anatomy": train_anatomy, "summary": summary}
+            "train_anatomy": train_anatomy, "kv_scope": kv_scope,
+            "summary": summary}
 
 
 def attribute_registry() -> Dict[str, Any]:
@@ -270,5 +296,6 @@ def render_text(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
-__all__: List[str] = ["PROGRAM_KNOBS", "attribute",
-                      "attribute_registry", "classify", "render_text"]
+__all__: List[str] = ["CACHE_THRASH_WASTE_FRAC", "PROGRAM_KNOBS",
+                      "attribute", "attribute_registry", "classify",
+                      "render_text"]

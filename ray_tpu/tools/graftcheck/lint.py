@@ -141,7 +141,9 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
             or rel_posix.endswith("_private/flightrec.py")
             or rel_posix.endswith("serve/slo.py")
             or rel_posix.endswith("serve/router.py")
+            or rel_posix.endswith("serve/kvscope.py")
             or rel_posix.endswith("tools/tracebus.py")
+            or rel_posix.endswith("tools/kvscope.py")
             or rel_posix.endswith("train/goodput.py")
             or rel_posix.startswith("ray_tpu/tools/autopilot/")):
         return []

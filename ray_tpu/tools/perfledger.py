@@ -77,10 +77,15 @@ _SWEEP_FIELDS = (
     # trainwatch (train/goodput.py): productive-device-time ratio
     # (higher via the goodput override) + input-stall percentiles
     "train_goodput", "train_data_wait_ms_p50", "train_data_wait_ms_p99",
+    # kvscope (serve/kvscope.py): KV pool pressure + cache-thrash
+    # waste — both fractions where SMALLER is better ("occupancy" /
+    # "waste" below; no higher-is-better override contains either)
+    "kv_occupancy_p95", "reprefill_waste_frac",
 )
 
 #: substrings marking a metric where SMALLER is better
-_LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile")
+_LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile",
+                    "occupancy", "waste")
 
 #: substrings that trump _LOWER_IS_BETTER: "ttft_slo_attainment"
 #: contains "ttft" but is a fraction where BIGGER is better, and

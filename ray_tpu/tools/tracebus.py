@@ -212,7 +212,10 @@ def build_request_spans(req: Dict[str, Any]) -> List[Dict[str, Any]]:
         if kv:
             emit("kv.reserve", kv[0], kv[1], parent=queue_id,
                  blocks=kv[2] if len(kv) > 2 else None,
-                 hit_blocks=kv[3] if len(kv) > 3 else None)
+                 hit_blocks=kv[3] if len(kv) > 3 else None,
+                 evicted=kv[4] if len(kv) > 4 else None,
+                 reprefill_waste_tokens=kv[5] if len(kv) > 5
+                 else None)
     if admit is not None and first is not None:
         chunks = req.get("prefill_chunks")
         if chunks:

@@ -224,6 +224,11 @@ def _run_traffic_variant(max_slots, kw, out):
                "interactive_ttft_ms_p99":
                    rep.get("interactive_ttft_ms_p99"),
                "batch_ttft_ms_p99": rep.get("batch_ttft_ms_p99"),
+               # kvscope headlines, top-level for perfledger
+               # (lower-is-better: pool pressure + cache thrash)
+               "kv_occupancy_p95": rep.get("kv_occupancy_p95"),
+               "reprefill_waste_frac":
+                   rep.get("reprefill_waste_frac"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],
@@ -323,6 +328,11 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
                "itl_ms_p50": rep.get("itl_ms_p50"),
                "itl_ms_p99": rep.get("itl_ms_p99"),
                "ttft_critical_path": rep.get("ttft_critical_path"),
+               # fleet-pooled kvscope headlines, top-level for
+               # perfledger (lower-is-better)
+               "kv_occupancy_p95": rep.get("kv_occupancy_p95"),
+               "reprefill_waste_frac":
+                   rep.get("reprefill_waste_frac"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],

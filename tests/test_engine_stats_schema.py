@@ -29,9 +29,21 @@ TOP_KEYS = {
     "tokens_generated", "tokens_per_sec", "slot_utilization",
     "max_active_slots", "max_slots", "prefill_buckets",
     "prefill_compiles", "program_compiles", "rejections_by_reason",
-    "kv_cache", "spec", "slo", "flightrec", "programs",
+    "kv_cache", "kv_scope", "spec", "slo", "flightrec", "programs",
     "latency_anatomy", "prefill_chunks",
 }
+
+KV_SCOPE_KEYS = {"enabled", "occupancy", "forensics",
+                 "blocks_by_tenant", "hbm_ledger"}
+
+KV_OCCUPANCY_KEYS = {"ring_capacity", "samples", "last",
+                     "occupancy_ratio", "occupancy_p95",
+                     "fragmentation", "ring"}
+
+KV_FORENSICS_KEYS = {"keys_evicted", "keys_tracked", "keys_forgotten",
+                     "reprefill_events", "reprefill_waste_tokens",
+                     "reprefill_waste_frac", "prefill_tokens",
+                     "waste_by_tenant", "top_keys"}
 
 ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "ttft_ms",
                 "critical_path", "by_tenant"}
@@ -117,6 +129,28 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
         assert "prefix_hit_rate" in stats["kv_cache"]
     else:
         assert stats["kv_cache"] is None
+
+    # kv_scope: same shape for both layouts — paged engines report the
+    # live kvscope block (occupancy ring sampled per wave, HBM
+    # ledger), dense engines the stable zero-shaped block, so
+    # dashboards and the kvscope CLI never branch on layout
+    ks = stats["kv_scope"]
+    assert set(ks) == KV_SCOPE_KEYS
+    assert set(ks["occupancy"]) == KV_OCCUPANCY_KEYS
+    assert set(ks["forensics"]) == KV_FORENSICS_KEYS
+    assert set(ks["hbm_ledger"]) == {"per_chip", "min_headroom_bytes"}
+    if kv_layout == "paged":
+        assert ks["enabled"] is True
+        assert ks["occupancy"]["samples"] > 0
+        assert len(ks["occupancy"]["ring"]) == \
+            ks["occupancy"]["samples"]
+        assert len(ks["hbm_ledger"]["per_chip"]) >= 1
+        for chip in ks["hbm_ledger"]["per_chip"]:
+            assert chip["kv_pool_bytes"] > 0
+    else:
+        assert ks["enabled"] is False
+        assert ks["occupancy"]["samples"] == 0
+        assert ks["hbm_ledger"]["per_chip"] == []
 
     # spec block always present; counters move iff spec decoding ran
     assert set(stats["spec"]) == SPEC_KEYS

@@ -317,6 +317,71 @@ def test_engine_crash_writes_postmortem(tmp_path, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# kv journal event shapes (kvscope forensics contract)
+# ---------------------------------------------------------------------------
+
+def test_kv_journal_events_carry_key_and_tenant():
+    """Eviction/COW/re-prefill journal events must name WHAT was lost
+    — the content key (first tokens + length) and the owning tenant —
+    or eviction forensics cannot attribute cache thrash.  Regression
+    guard on the event shapes postmortem tooling filters by."""
+    from ray_tpu.serve.kv_pager import BlockPager
+
+    rec = FlightRecorder("pager", enabled=True)
+    bs = 4
+    pager = BlockPager(num_blocks=5, block_size=bs, max_seq=16,
+                       recorder=rec)
+
+    # tenant A registers one prefix block, parks it in the LRU pool
+    key_a = tuple(range(10, 10 + bs))
+    pager.set_request(1, "trace-a", tenant="alpha")
+    blocks = pager.allocate(1)
+    assert pager.register_prefix(list(key_a), blocks) == 0
+    pager.release(blocks)
+    pager.set_request(None)
+
+    # tenant B floods the pool: A's parked block is evicted
+    pager.set_request(2, "trace-b", tenant="beta")
+    flood = pager.allocate(4)
+    assert pager.evictions == 1
+    pager.release(flood)
+    pager.set_request(None)
+
+    ev = {e["kind"]: e for e in rec.snapshot()}
+    evict = ev["kv_evict"]
+    # the victim's owner, not the evictor, is named as tenant; the
+    # evicting admission stays identifiable via req/trace
+    assert evict["tenant"] == "alpha"
+    assert evict["req"] == 2 and evict["trace"] == "trace-b"
+    assert evict["key_prefix"] == list(key_a)[:8]
+    assert evict["key_len"] == bs
+
+    # A re-registers the same content: a kv_reprefill event books the
+    # waste against the re-filling tenant with the same key tag
+    pager.set_request(3, "trace-a2", tenant="alpha")
+    blocks = pager.allocate(1)
+    assert pager.register_prefix(list(key_a), blocks) == bs
+    ev = {e["kind"]: e for e in rec.snapshot()}
+    rp = ev["kv_reprefill"]
+    assert rp["tokens"] == bs and rp["tenant"] == "alpha"
+    assert rp["key_prefix"] == list(key_a)[:8]
+    assert rp["key_len"] == bs
+
+    # COW fork of the registered block carries the diverging key
+    pager.release(blocks)
+    _plen, matched = pager.match_prefix(list(key_a) + [99])
+    assert matched
+    fresh, src = pager.ensure_private(matched[0])
+    assert src == matched[0]
+    ev = {e["kind"]: e for e in rec.snapshot()}
+    cow = ev["kv_cow"]
+    assert cow["key_prefix"] == list(key_a)[:8]
+    assert cow["key_len"] == bs
+    assert cow["tenant"] == "alpha"
+    pager.set_request(None)
+
+
+# ---------------------------------------------------------------------------
 # hot-path overhead guard
 # ---------------------------------------------------------------------------
 

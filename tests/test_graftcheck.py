@@ -594,6 +594,41 @@ def test_lint_wallclock_covers_trainwatch():
     assert not kept
 
 
+def test_lint_wallclock_covers_kvscope():
+    # round 16: the kvscope occupancy ring promised perf_counter
+    # timestamps (wall-clock steps would corrupt the timeline around
+    # NTP slews) — a planted time.time() in either the host-side core
+    # or the CLI must flag
+    src = textwrap.dedent("""\
+        import time
+
+        def sample(free):
+            return time.time()
+    """)
+    for rel in ("ray_tpu/serve/kvscope.py",
+                "ray_tpu/tools/kvscope.py"):
+        kept, _ = lint_source(src, rel)
+        assert [v.rule for v in kept] == ["wallclock-in-telemetry"], rel
+        kept, _ = lint_source(src.replace("time.time()",
+                                          "time.perf_counter()"), rel)
+        assert not kept, rel
+    # the pager itself stays OUT of scope (allocation is not timed)
+    kept, _ = lint_source(src, "ray_tpu/serve/kv_pager.py")
+    assert not kept
+
+
+def test_lint_kvscope_sources_clean():
+    # kvscope lints itself clean under the full rule set
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("ray_tpu/serve/kvscope.py",
+                "ray_tpu/tools/kvscope.py"):
+        with open(os.path.join(repo, rel)) as f:
+            kept, _ = lint_source(f.read(), rel)
+        assert not kept, [str(v) for v in kept]
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote
