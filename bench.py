@@ -116,6 +116,14 @@ def parse_args(argv=None):
                          "waves (0 = same mixture, one-shot prefill — "
                          "the A/B control; paged layout only); emits "
                          "per-tenant ttft_ms_p99 lines")
+    ap.add_argument("--kv-host-tier-bytes", type=int, default=None,
+                    help="--traffic tiered host-RAM KV cache A/B: give "
+                         "the engine's BlockPager a host tier of this "
+                         "byte budget so LRU-evicted prefix blocks "
+                         "re-admit via H2D copy instead of re-prefill "
+                         "(serve/kv_tier.py; paged layout only; omit "
+                         "for the tier-off control); emits "
+                         "kv_tier_hit_rate lines")
     ap.add_argument("--profile", default="",
                     help="capture an XLA device trace of the timed "
                          "region into this directory "
@@ -769,6 +777,9 @@ def main_traffic(args, on_tpu: bool) -> None:
                        prompt_len=640 if on_tpu else 80),
         ))
         kw["prefill_chunk_tokens"] = args.prefill_chunk or None
+    if args.kv_host_tier_bytes:
+        base += "_tier"
+        kw["kv_host_tier_bytes"] = args.kv_host_tier_bytes
     mesh, n_chips = (decode_mesh(args.chips or 1)
                      if args.mesh == "tensor" else (None, 1))
     if mesh is not None:
@@ -812,6 +823,9 @@ def main_traffic(args, on_tpu: bool) -> None:
     if args.prefill_chunk is not None:
         detail["prefill_chunk_tokens"] = args.prefill_chunk or None
         detail["prefill_chunks"] = rep.get("prefill_chunks")
+    if args.kv_host_tier_bytes:
+        detail["kv_host_tier_bytes"] = args.kv_host_tier_bytes
+        detail["kv_tier"] = eng.get("kv_tier")
     if spec_cfg is not None:
         # spec counters join every traffic record so ledger series
         # cover spec+traffic runs, not just --decode --spec-k
@@ -873,9 +887,12 @@ def _emit_kvscope(base: str, rep: dict, detail: dict) -> None:
     KV pool pressure (p95 occupancy over the run's engine waves) and
     cache-thrash waste (fraction of prefilled tokens that re-filled
     previously-resident prefixes).  Both lower-is-better in the
-    ledger."""
+    ledger; the host-tier hit rate (fraction of second-chance probes
+    the tier absorbed) is higher-is-better and reads 0.0 when no tier
+    was configured, so tier-on/off runs stay A/B-able."""
     for field, unit in (("kv_occupancy_p95", "fraction"),
-                        ("reprefill_waste_frac", "fraction")):
+                        ("reprefill_waste_frac", "fraction"),
+                        ("kv_tier_hit_rate", "fraction")):
         v = rep.get(field)
         if isinstance(v, (int, float)):
             emit({
@@ -960,6 +977,9 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
                   time_scale=0.0,
                   config_overrides={"dtype": jnp.float32,
                                     "use_flash": False})
+    if args.kv_host_tier_bytes:
+        base += "_tier"
+        kw["kv_host_tier_bytes"] = args.kv_host_tier_bytes
     rep = run_traffic_fleet(
         spec, num_replicas=args.replicas, family="gpt2",
         preset=preset, kv_block_size=16,
@@ -975,6 +995,9 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
               "latency_ms_by_tenant": rep["latency_ms_by_tenant"],
               "routed_by_policy":
                   fleet["router"]["routed_by_policy"]}
+    if args.kv_host_tier_bytes:
+        detail["kv_host_tier_bytes"] = args.kv_host_tier_bytes
+        detail["kv_tier"] = fleet.get("kv_tier")
     emit({
         "metric": f"{base}_router_prefix_hit_rate",
         "value": rep["router_prefix_hit_rate"], "unit": "fraction",

@@ -319,6 +319,7 @@ class DashboardActor:
                                                        timeout=15)
                         out[name] = {
                             "kv_scope": stats.get("kv_scope"),
+                            "kv_tier": stats.get("kv_tier"),
                         }
                     except Exception as e:  # noqa: BLE001 - no stats
                         out[name] = {
@@ -489,19 +490,28 @@ class DashboardActor:
                     req_ev = None
                 # memory-side evidence: the pooled kvscope block of
                 # any live fleet (cache-thrash waste attribution)
+                # plus its host-tier block (churn-absorption credit)
                 kv_ev = None
+                tier_ev = None
                 try:
                     from ray_tpu.serve.router import fleet_registry
 
                     for fleet in fleet_registry().values():
-                        ks = fleet.fleet_stats().get("kv_scope")
-                        if ks and ks.get("reprefill_waste_frac"):
+                        fs = fleet.fleet_stats()
+                        ks = fs.get("kv_scope")
+                        kt = fs.get("kv_tier")
+                        if ks and (ks.get("reprefill_waste_frac")
+                                   or (kt or {}).get("tokens_restored")):
                             kv_ev = ks
+                            if kt and kt.get("enabled"):
+                                tier_ev = kt
                             break
                 except Exception:  # noqa: BLE001 - evidence optional
                     kv_ev = None
+                    tier_ev = None
                 att = attribution.attribute(
-                    programs, request_anatomy=req_ev, kv_scope=kv_ev)
+                    programs, request_anatomy=req_ev, kv_scope=kv_ev,
+                    kv_tier=tier_ev)
                 try:
                     v = verdict.build_verdict(budget=budget,
                                               attribution=att)

@@ -6,6 +6,7 @@ from ray_tpu.serve.api import (Deployment, delete, deployment,
 from ray_tpu.serve.batching import (AdmissionPolicy, OverloadedError,
                                     batch)
 from ray_tpu.serve.kv_pager import BlockPager
+from ray_tpu.serve.kv_tier import HostKVTier
 from ray_tpu.serve.llm import (SamplingParams, SpecConfig,
                                build_llm_deployment)
 from ray_tpu.serve.handle import DeploymentHandle
@@ -25,7 +26,8 @@ __all__ = ["deployment", "Deployment", "run", "delete", "shutdown",
            "start_http_proxy", "batch", "status", "engine_stats",
            "ServeApplicationSchema", "DeploymentSchema",
            "apply_config", "build_llm_deployment", "AdmissionPolicy",
-           "OverloadedError", "BlockPager", "TrafficSpec",
+           "OverloadedError", "BlockPager", "HostKVTier",
+           "TrafficSpec",
            "TrafficGenerator", "run_traffic", "SamplingParams",
            "SpecConfig", "SLOConfig", "worst_burn_rate",
            "TenantSpec", "TenantClass", "AutoscalePolicy",

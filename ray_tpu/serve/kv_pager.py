@@ -31,8 +31,9 @@ pool is a static-shape jit argument, never reallocated.
 from __future__ import annotations
 
 import collections
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.serve.kv_tier import HostKVTier
 from ray_tpu.serve.kvscope import KVScope
 
 __all__ = ["BlockPager"]
@@ -55,7 +56,8 @@ class BlockPager:
 
     def __init__(self, num_blocks: int, block_size: int, max_seq: int,
                  *, bytes_per_block: int = 0, tensor_shards: int = 1,
-                 recorder=None):
+                 recorder=None,
+                 host_tier: Optional[HostKVTier] = None):
         if max_seq % block_size:
             raise ValueError(f"max_seq={max_seq} must be a multiple of "
                              f"block_size={block_size}")
@@ -111,6 +113,22 @@ class BlockPager:
         #: kvscope (serve/kvscope.py): occupancy ring + eviction
         #: forensics + re-prefill waste ledger over this pool
         self.scope = KVScope(self.num_blocks, self.block_size)
+        #: tiered host-RAM KV cache (serve/kv_tier.py): evicted
+        #: registered blocks spill device→host instead of vanishing,
+        #: and `tier_lookup` gives HBM prefix misses a second chance.
+        #: The pager still never touches device memory — the engine
+        #: registers a block-saver callback (`set_block_saver`) that
+        #: gathers a block's K/V rows to host at spill time.
+        self.tier = host_tier
+        self._block_saver: Optional[Callable[[int], Tuple]] = None
+
+    def set_block_saver(self, fn: Callable[[int], Tuple]) -> None:
+        """Register the engine's D2H gather: ``fn(block_id) ->
+        (k_rows, v_rows)`` host arrays for one block across all
+        layers.  Required before eviction can spill into the host
+        tier; without it (or without a tier) eviction keeps its
+        original discard semantics."""
+        self._block_saver = fn
 
     def set_request(self, request_id: Optional[int],
                     trace_id: Optional[str] = None,
@@ -198,6 +216,19 @@ class BlockPager:
                 # was lost, not just that a block was reclaimed
                 key = self._block_key.get(blk)
                 owner = self.scope.note_evict(key)
+                # tiered host-RAM KV cache: before the block id is
+                # recycled, spill its K/V rows device→host so a later
+                # admission can restore the prefix via H2D copy
+                # instead of re-prefilling it (serve/kv_tier.py)
+                spilled = 0
+                if self.tier is not None and key is not None \
+                        and self._block_saver is not None:
+                    # resident key → the gather would copy identical
+                    # bytes (content addressing); LRU-touch instead
+                    spilled = self.tier.refresh(key)
+                    if not spilled:
+                        k_rows, v_rows = self._block_saver(blk)
+                        spilled = self.tier.put(key, k_rows, v_rows)
                 self._deregister(blk)
                 self.evictions += 1
                 evicted += 1
@@ -209,6 +240,8 @@ class BlockPager:
                     tag = dict(self._ctx_tag(), **self._key_tag(key))
                     if owner:
                         tag["tenant"] = owner
+                    if spilled:
+                        tag["tier_bytes"] = spilled
                     self._recorder.record("kv_evict", block=blk,
                                           **tag)
             blk = self._free.pop()
@@ -299,6 +332,67 @@ class BlockPager:
         self.prefix_hits += len(matched)
         self.prefix_misses += self.blocks_needed(n, 0) - len(matched)
         return prefix_len, matched
+
+    def tier_lookup(self, tokens: Sequence[int], matched: int
+                    ) -> List[Tuple[Tuple[int, ...], Dict]]:
+        """Second-chance prefix lookup against the host tier: walk
+        the full-block keys of `tokens` past the first `matched` HBM
+        blocks and collect consecutive tier entries, stopping at the
+        first miss (same chain discipline as `match_prefix` — a gap
+        cannot be skipped, the prefill must be contiguous).  The walk
+        is capped where `match_prefix` caps: a reusable block must
+        end at or before token ``len(tokens) - 1``, so the tail
+        prefill still ingests at least one token.
+
+        Returns ``[(key, entry), ...]`` — probes count into the
+        tier's hit/miss stats; entries stay resident (the tier is a
+        cache).  The caller allocates fresh blocks, H2D-installs each
+        entry, then calls `note_tier_restore` to index them.  Empty
+        when no tier is attached."""
+        if self.tier is None:
+            return []
+        tokens = tuple(int(t) for t in tokens)
+        n = len(tokens)
+        out: List[Tuple[Tuple[int, ...], Dict]] = []
+        for i in range(int(matched), max(n - 1, 0) // self.block_size):
+            entry = self.tier.take(tokens[:(i + 1) * self.block_size])
+            if entry is None:
+                break
+            out.append((tokens[:(i + 1) * self.block_size], entry))
+        return out
+
+    def note_tier_restore(self, pairs: Sequence[Tuple[Tuple[int, ...],
+                                                      Dict]],
+                          block_ids: Sequence[int]) -> int:
+        """The engine H2D-installed `pairs` (from `tier_lookup`) into
+        freshly-allocated `block_ids` — index them as resident prefix
+        blocks.  Unlike `register_prefix`, this books NO re-prefill
+        waste: the content came back via copy, not recompute — scope
+        forensics record the saved work as ``tier_hits`` /
+        ``tokens_restored`` instead, and each block journals a
+        ``kv_fetch`` event naming key/tenant/bytes.  The restored
+        blocks count as prefix HITS (served from cache, just a slower
+        tier), so ``prefill_tokens`` — the waste-frac denominator —
+        keeps meaning 'tokens actually prefilled'.  Returns the token
+        slots restored."""
+        tenant = self._req_ctx[2]
+        restored = 0
+        for (key, entry), blk in zip(pairs, block_ids):
+            self._index[key] = blk
+            self._block_key[blk] = key
+            self.scope.note_tier_hit(key, tenant)
+            restored += self.block_size
+            if self._recorder is not None:
+                self._recorder.record(
+                    "kv_fetch", block=blk, tokens=self.block_size,
+                    bytes=int(entry.get("bytes", 0)),
+                    **dict(self._ctx_tag(), **self._key_tag(key)))
+        nblocks = len(pairs)
+        self.prefix_hits += nblocks
+        self.prefix_misses -= nblocks
+        if self.tier is not None:
+            self.tier.note_restored(restored)
+        return restored
 
     def register_prefix(self, tokens: Sequence[int],
                         block_ids: Sequence[int]) -> int:

@@ -14,9 +14,12 @@ concerns, all pure host bookkeeping hanging off `BlockPager`
     content-addressed token tuples, so an evicted key that later
     re-registers is the SAME prefix being re-filled from scratch.
     Each such re-registration books ``block_size`` tokens of
-    `reprefill_waste_tokens` — exactly the tokens a host-RAM KV tier
-    (ROADMAP item 2) would have saved — broken down per key and per
-    tenant;
+    `reprefill_waste_tokens` — exactly the tokens the host-RAM KV
+    tier (serve/kv_tier.py) saves — broken down per key and per
+    tenant.  A key the tier restores instead (``note_tier_hit``)
+    books ``tier_hits``/``tokens_restored`` waste-AVOIDED, never
+    waste: the forensics split residual churn cost from churn the
+    tier absorbed;
   * **unified HBM ledger** — one per-chip table merging the pager's
     pool bytes, jax `device_memory_stats()`, and graftcheck's
     per-program peak budgets into a single ``headroom_bytes`` that an
@@ -94,6 +97,11 @@ class KVScope:
         self.keys_forgotten = 0
         self.reprefill_events = 0
         self.reprefill_waste_tokens = 0
+        #: host-tier second chances (serve/kv_tier.py): keys restored
+        #: via H2D copy instead of re-prefill — waste AVOIDED, kept
+        #: beside the residual waste so the split is visible
+        self.tier_hits = 0
+        self.tokens_restored = 0
         self._waste_by_tenant: Dict[str, int] = {}
         self._waste_by_key: Dict[Tuple[int, ...], int] = {}
 
@@ -188,6 +196,22 @@ class KVScope:
                 self._waste_by_key.get(key, 0) + waste
         return waste
 
+    def note_tier_hit(self, key: Tuple[int, ...],
+                      tenant: Optional[str]) -> None:
+        """One prefix key was restored from the host KV tier
+        (H2D copy) instead of being re-prefilled.  Consumes the
+        evicted-ledger entry WITHOUT booking waste — the later
+        ``note_register`` of the same key (the pager re-indexes the
+        restored block) must book zero ``reprefill_waste_tokens`` —
+        and records the avoided work as ``tokens_restored``."""
+        if not self.enabled:
+            return
+        self.tier_hits += 1
+        self.tokens_restored += self.block_size
+        self._key_tenant[key] = tenant
+        if key in self._evicted:
+            del self._evicted[key]
+
     def note_evict(self, key: Optional[Tuple[int, ...]]
                    ) -> Optional[str]:
         """One registered block was LRU-evicted.  Moves the key into
@@ -247,6 +271,8 @@ class KVScope:
                     round(waste / prefill_tokens, 4)
                     if prefill_tokens else 0.0,
                 "prefill_tokens": int(prefill_tokens),
+                "tier_hits": self.tier_hits,
+                "tokens_restored": self.tokens_restored,
                 "waste_by_tenant": dict(self._waste_by_tenant),
                 "top_keys": [
                     {"key_prefix": list(k[:8]), "key_len": len(k),
@@ -283,6 +309,8 @@ def empty_kv_scope() -> Dict[str, object]:
             "reprefill_waste_tokens": 0,
             "reprefill_waste_frac": 0.0,
             "prefill_tokens": 0,
+            "tier_hits": 0,
+            "tokens_restored": 0,
             "waste_by_tenant": {},
             "top_keys": [],
         },

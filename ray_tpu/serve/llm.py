@@ -217,6 +217,35 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         out["pos"] = cache["pos"].at[slot].set(0)
         return out
 
+    def install_blocks(cache, blk_ids, k_stack, v_stack):
+        # tiered host-RAM KV cache (serve/kv_tier.py): splice a whole
+        # restored chain back into the pool in ONE dispatch — blk_ids
+        # is a fixed-length (max_seq // block_size) id vector and the
+        # stacks are (N, L, block_size, H, head_dim) rows, so every
+        # restore shares one compiled program regardless of chain
+        # length.  Padding entries target the null block (id 0) with
+        # zero rows: block 0 is the masked write-sink idle rows
+        # already scribble into, so the pad write is harmless by the
+        # same contract.  The pool is donated — a restore must never
+        # copy a multi-GB pool just to overwrite a few blocks.  On a
+        # sharded pool the committed cache shardings re-distribute
+        # the replicated host rows, mirroring how admit() lands rows.
+        out = dict(cache)
+        out["k"] = cache["k"].at[:, blk_ids].set(
+            k_stack.swapaxes(0, 1))
+        out["v"] = cache["v"].at[:, blk_ids].set(
+            v_stack.swapaxes(0, 1))
+        return out
+
+    def save_block(cache, blk):
+        # spill companion to install_blocks: one fused program slices
+        # a block's K and V rows out of the pool together, so an
+        # eviction costs a single dispatch + one D2H transfer pair
+        # instead of two eager slice ops (the spill path runs once per
+        # eviction — at small block counts that is hundreds of times a
+        # run, and per-op overhead is the whole cost on host backends)
+        return cache["k"][:, blk], cache["v"][:, blk]
+
     # perf observatory: the heavy programs report compiles / compiler
     # cost model / invoke walltimes to the process-wide registry under
     # stable names (sharded engines get their own so single- and
@@ -268,6 +297,8 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         pool_logits=jax.jit(pool_logits),
         admit=jax.jit(admit), copy_block=jax.jit(copy_block),
         clear_row=jax.jit(clear_row),
+        install_blocks=jax.jit(install_blocks, donate_argnums=(0,)),
+        save_block=jax.jit(save_block),
         spec_verify=spec_verify, draft_propose=draft_propose,
         draft_prefill=draft_prefill)
     _JIT_CACHE[key] = fns
@@ -291,6 +322,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          kv_block_size: int = 16,
                          kv_num_blocks: Optional[int] = None,
                          prefill_chunk_tokens: Optional[int] = None,
+                         kv_host_tier_bytes: Optional[int] = None,
                          admission_policy=None,
                          slo=None,
                          mesh=None,
@@ -327,6 +359,19 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     the program compiles once per prefill_bucket-padded chunk shape.
     Must be a positive multiple of kv_block_size.  None (default)
     keeps one-shot prefill.
+    kv_host_tier_bytes: tiered host-RAM KV cache (paged layout only;
+    serve/kv_tier.py).  When set, a prefix block the pager's LRU
+    eviction claims is spilled device→host into a byte-budgeted
+    LRU store under its content-addressed key, and an admission whose
+    HBM prefix match falls short probes that store second-chance: a
+    hit re-installs the block via one H2D copy + block-table splice
+    and bumps prefix_len so paged_prefill skips those tokens — the
+    effective prefix cache grows beyond HBM and re-admitted prefixes
+    cost a copy instead of a re-prefill (outputs stay bit-identical
+    to the dense oracle; the restore rows ARE the rows prefill would
+    write).  Surfaced as engine_stats()["kv_tier"], tracebus
+    `kv.fetch` spans, and the `kv_fetch_ms` critical-path component.
+    None (default) keeps plain discard-on-evict.
     admission_policy: a serve.batching.AdmissionPolicy closing the
     telemetry loop — requests are load-shed with OverloadedError when
     its queue-depth / queue-wait / TTFT gates trip.
@@ -394,6 +439,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 f"{kv_block_size} (chunks must end on block "
                 "boundaries so prior chunks are resident prefix "
                 "blocks)")
+    if kv_host_tier_bytes is not None:
+        if kv_layout != "paged":
+            raise ValueError(
+                "kv_host_tier_bytes requires kv_layout='paged' (the "
+                "host tier spills and restores the pager's KV "
+                "blocks; dense rows are never evicted)")
+        if int(kv_host_tier_bytes) <= 0:
+            raise ValueError(
+                f"kv_host_tier_bytes={kv_host_tier_bytes} must be a "
+                "positive byte budget")
     if mesh is not None and scheduler != "continuous":
         raise ValueError("mesh-sharded serving requires "
                          "scheduler='continuous' (the batch scheduler "
@@ -574,6 +629,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
         def _init_continuous(self, prefill_fn, step_fn, init_cache_fn,
                              init_paged_fn, paged_prefill_fn):
+            import jax
             import jax.numpy as jnp
 
             cfg = self.cfg
@@ -591,15 +647,26 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                                    * self._kv_heads(cfg)
                                    * cfg.head_dim
                                    * jnp.dtype(cfg.dtype).itemsize)
+                # tiered host-RAM KV cache: evicted prefix blocks
+                # spill device→host and re-admit via H2D copy instead
+                # of re-prefill (serve/kv_tier.py)
+                host_tier = None
+                if kv_host_tier_bytes is not None:
+                    from ray_tpu.serve.kv_tier import HostKVTier
+
+                    host_tier = HostKVTier(kv_host_tier_bytes)
                 self._pager = BlockPager(
                     n_blocks, kv_block_size, cfg.max_seq,
                     bytes_per_block=bytes_per_block,
                     tensor_shards=self._kv_shards(),
-                    recorder=self._telemetry.flightrec)
+                    recorder=self._telemetry.flightrec,
+                    host_tier=host_tier)
                 self._cache = init_paged_fn(cfg, max_slots,
                                             num_blocks=n_blocks,
                                             block_size=kv_block_size,
                                             mesh=self.mesh)
+                if host_tier is not None:
+                    self._pager.set_block_saver(self._tier_save)
             else:
                 self._cache = init_cache_fn(cfg, max_slots,
                                             mesh=self.mesh)
@@ -689,6 +756,26 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
              self._admit, self._copy_block, self._clear_row) = (
                 fns.prefill, fns.paged_prefill, fns.pool_step,
                 fns.admit, fns.copy_block, fns.clear_row)
+            if self._pager is not None and self._pager.tier is not None:
+                # pre-compile the H2D splice program with an all-pad
+                # call (every id 0 → zero rows into the null write
+                # sink): restores share ONE fixed-shape program, so
+                # the first real tier restore pays a copy inside its
+                # kv_fetch window, not a compile
+                maxn = cfg.max_seq // kv_block_size
+                row_shape = (maxn,) + self._cache["k"][:, 0].shape
+                row_dtype = self._cache["k"].dtype
+                # persistent host staging buffers for the restore path
+                # (ids, k rows, v rows) — refilled in place per
+                # restore instead of re-allocating pad arrays
+                self._tier_stage = (np.zeros((maxn,), np.int32),
+                                    np.zeros(row_shape, row_dtype),
+                                    np.zeros(row_shape, row_dtype))
+                zr = jnp.zeros(row_shape, self._cache["k"].dtype)
+                self._cache = fns.install_blocks(
+                    self._cache, jnp.zeros((maxn,), jnp.int32),
+                    zr, zr)
+                jax.block_until_ready(self._cache["k"])
             # perf observatory: mirror process-wide program compile
             # events into this deployment's program-keyed recompile
             # counter (decode/sharded-decode shape churn visible, not
@@ -873,6 +960,46 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 self._queue.push_front((arr, rec, sp), fut)
                 return False
             blocks = matched + alloc
+            # tiered host-RAM KV cache: second-chance lookup — full
+            # blocks the HBM prefix match missed may survive in the
+            # host tier.  Restore each hit into a freshly-allocated
+            # block with one H2D install, then bump prefix_len so the
+            # tail prefill skips those tokens exactly as it does for
+            # HBM-resident prefixes (content-addressed keys make the
+            # restored rows the rows re-prefill would have written, so
+            # outputs stay bit-identical to the dense oracle).  Probed
+            # only after allocation succeeds — a requeued admission
+            # must not double-count tier probes.
+            pairs = pager.tier_lookup(tokens, len(matched))
+            if pairs:
+                t_f0 = _time.perf_counter()
+                # one padded dispatch for the whole chain (the
+                # program's shape is fixed at maxn, pre-compiled at
+                # init).  The id/stack staging buffers persist across
+                # restores: pad entries target the null write sink
+                # (block 0), whose content is garbage by contract, so
+                # stale rows left from an earlier restore need no
+                # re-zeroing.
+                ids, ek, ev = self._tier_stage
+                ids[:] = 0
+                ids[:len(pairs)] = alloc[:len(pairs)]
+                for i, (_, e) in enumerate(pairs):
+                    ek[i] = e["k"]
+                    ev[i] = e["v"]
+                self._cache = self._fns.install_blocks(
+                    self._cache, jnp.asarray(ids), jnp.asarray(ek),
+                    jnp.asarray(ev))
+                # fence so the h2d bucket times the transfer, not the
+                # dispatch (the trainwatch h2d discipline)
+                jax.block_until_ready(self._cache["k"])
+                t_f1 = _time.perf_counter()
+                pager.tier.note_h2d(t_f1 - t_f0)
+                restored = pager.note_tier_restore(pairs, alloc)
+                prefix_len += restored
+                self._telemetry.record_kv_fetch(
+                    rec, t_f0, t_f1, blocks=len(pairs),
+                    tokens=restored,
+                    bytes=sum(int(e["bytes"]) for _, e in pairs))
             wb = prefix_len // kv_block_size
             if wb < len(matched):
                 # the tail's first write lands inside a matched block
@@ -895,8 +1022,12 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 rec, t_kv0, _time.perf_counter(), blocks=len(blocks),
                 hit_blocks=len(matched),
                 evicted=pager.evictions - ev0)
+            # tier-restored blocks count as reuse hits (served from
+            # cache, just a slower tier), mirroring the pager's own
+            # hit/miss accounting in note_tier_restore
+            reused = len(matched) + len(pairs)
             self._telemetry.record_prefix_reuse(
-                len(matched), pager.blocks_needed(n, 0) - len(matched))
+                reused, pager.blocks_needed(n, 0) - reused)
             n_tail = n - prefix_len
             row_bt = np.zeros((self.cfg.max_seq // kv_block_size,),
                               np.int32)
@@ -971,6 +1102,27 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._draft_admit(slot, arr)
             self._telemetry.record_kv_stats(pager.stats())
             return True
+
+        def _tier_save(self, blk) -> tuple:
+            """The pager's block-saver callback (serve/kv_tier.py):
+            D2H gather of one pool block's K/V rows at eviction time.
+            One jitted save_block dispatch slices K and V together and
+            device_get pulls both to host in one transfer pair
+            (gathering shards on a mesh-sharded cache, so the stored
+            copy is always the full replicated block; the jitted
+            install_blocks program re-distributes it under the cache's
+            shardings on restore).  The copy is timed into the tier's
+            d2h bucket trainwatch-style — the tier itself never reads
+            a clock."""
+            import time as _time
+
+            import jax
+
+            t0 = _time.perf_counter()
+            k_rows, v_rows = jax.device_get(
+                self._fns.save_block(self._cache, np.int32(blk)))
+            self._pager.tier.note_d2h(_time.perf_counter() - t0)
+            return k_rows, v_rows
 
         def _retire_paged_row(self, slot, blocks) -> None:
             """Free a finished/errored row's blocks.  The row's table
@@ -1446,6 +1598,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 self._telemetry.record_kv_stats(pager.stats())
                 self._telemetry.record_kv_scope(
                     self._compose_kv_scope())
+                if pager.tier is not None:
+                    self._telemetry.record_kv_tier(
+                        pager.tier.stats())
             stats = self._telemetry.engine_stats()
             if admission_policy is not None:
                 stats["admission_policy"] = admission_policy.describe()

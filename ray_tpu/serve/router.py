@@ -605,7 +605,16 @@ class LLMFleet:
         # per-replica ratios plus the fleet max/mean — a fleet-wide
         # average would hide one replica's pool running hot
         scope = {"reprefill_waste_tokens": 0, "reprefill_events": 0,
-                 "keys_evicted": 0, "prefill_tokens": 0}
+                 "keys_evicted": 0, "prefill_tokens": 0,
+                 "tier_hits": 0, "tokens_restored": 0}
+        # host-tier pooling (serve/kv_tier.py): counters SUM over
+        # replicas (each replica spills/restores its own tier), the
+        # pooled hit rate is recomputed over the summed probes
+        tier = {"hits": 0, "misses": 0, "saves": 0, "evictions": 0,
+                "tokens_restored": 0, "bytes_resident": 0,
+                "bytes_budget": 0, "entries": 0, "h2d_ms": 0.0,
+                "d2h_ms": 0.0}
+        tier_enabled = False
         waste_by_tenant: Dict[str, int] = {}
         occ_by_replica: Dict[str, float] = {}
         occ_p95s: List[float] = []
@@ -632,6 +641,13 @@ class LLMFleet:
             occ_by_replica[rep.name] = float(
                 occ.get("occupancy_ratio", 0.0))
             occ_p95s.append(float(occ.get("occupancy_p95", 0.0)))
+            kt = st.get("kv_tier") or {}
+            if kt.get("enabled"):
+                tier_enabled = True
+            for k in tier:
+                tier[k] = round(tier[k] + (kt.get(k) or 0), 3) \
+                    if k.endswith("_ms") else tier[k] + int(kt.get(k)
+                                                           or 0)
             replicas[rep.name] = {
                 "draining": rep.draining,
                 "retired": rep in self._retired,
@@ -658,6 +674,11 @@ class LLMFleet:
             # worst replica's ring p95 — the fleet headline occupancy
             # number (an average would hide one pool running hot)
             occupancy_p95=max(occ_p95s) if occ_p95s else 0.0)
+        tier_probes = tier["hits"] + tier["misses"]
+        kv_tier = dict(
+            tier, enabled=tier_enabled,
+            hit_rate=round(tier["hits"] / tier_probes, 4)
+            if tier_probes else 0.0)
         return {
             "name": self.name,
             "num_replicas": self.num_replicas,
@@ -668,6 +689,7 @@ class LLMFleet:
             else 0.0,
             "prefill_chunks": chunks,
             "kv_scope": kv_scope,
+            "kv_tier": kv_tier,
             "tenants": self.tenant_report(),
             "replicas": replicas,
             "flightrec": self.telemetry.flightrec.stats(),

@@ -38,6 +38,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
 from ray_tpu._private.flightrec import FlightRecorder
+from ray_tpu.serve.kv_tier import empty_kv_tier as _empty_kv_tier
 from ray_tpu.serve.kvscope import empty_kv_scope as _empty_kv_scope
 from ray_tpu.util import tracing
 
@@ -181,7 +182,20 @@ def _engine_metrics() -> Dict[str, Any]:
                     "serve_kv_reprefill_waste_tokens_total",
                     "prompt tokens re-prefilled into blocks whose "
                     "content key was previously resident and evicted "
-                    "(what a host-RAM KV tier would have saved)",
+                    "(residual churn the host-RAM KV tier did not "
+                    "absorb)", tag_keys=tags),
+                "kv_tier_bytes": Gauge(
+                    "serve_kv_tier_bytes_resident",
+                    "bytes of evicted KV blocks resident in the "
+                    "host-RAM tier (serve/kv_tier.py)", tag_keys=tags),
+                "kv_tier_hit_rate": Gauge(
+                    "serve_kv_tier_hit_rate",
+                    "fraction of host-tier second-chance probes that "
+                    "restored a block via H2D copy", tag_keys=tags),
+                "kv_tier_restored": Counter(
+                    "serve_kv_tier_tokens_restored_total",
+                    "prompt tokens re-admitted from the host tier "
+                    "via H2D copy instead of re-prefill",
                     tag_keys=tags),
             }
         return _metrics
@@ -239,19 +253,23 @@ class TraceContext:
 #: keys of every decomposition dict, and the components sum to
 #: ``e2e_ms`` exactly (modulo float rounding) by construction.
 CRITICAL_PATH_COMPONENTS = (
-    "router_wait_ms", "queue_wait_ms", "requeue_ms", "prefill_ms",
-    "prefill_wait_ms", "inter_token_ms", "spec_rollback_ms")
+    "router_wait_ms", "queue_wait_ms", "requeue_ms", "kv_fetch_ms",
+    "prefill_ms", "prefill_wait_ms", "inter_token_ms",
+    "spec_rollback_ms")
 
 
 def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Decompose one completed request's e2e latency:
 
-        e2e = router_wait + queue_wait + requeue + prefill
+        e2e = router_wait + queue_wait + requeue + kv_fetch + prefill
               + prefill_wait + inter_token + spec_rollback
 
     * router_wait — submit → engine enqueue (0 without a router);
-    * queue_wait  — engine enqueue → admit, minus time spent requeued;
+    * queue_wait  — engine enqueue → admit, minus time spent requeued
+      and minus the kv_fetch window below;
     * requeue     — first KV-exhaustion requeue → eventual admit;
+    * kv_fetch    — H2D restore of host-tier KV blocks during this
+      admission (serve/kv_tier.py; exactly 0 without a tier hit);
     * prefill     — admit → first token, or for chunked-prefill
       admissions the SUM of the per-chunk dispatch windows;
     * prefill_wait — the rest of admit → first token: time a chunked
@@ -281,7 +299,16 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     rq_ts = rec.get("requeue_ts")
     if rq_ts is not None:
         requeue = min(max(0.0, admit - rq_ts), wait)
-    queue_wait = wait - requeue
+    # host-tier restore: the H2D window is carved out of the queue
+    # leg it ran inside (admission work before record_admit), clamped
+    # like every other component so synthetic clocks degrade to 0
+    kv_fetch = 0.0
+    kf = rec.get("kv_fetch")
+    if kf is not None:
+        kv_fetch = min(max(0.0, min(float(kf[1]), admit)
+                           - max(float(kf[0]), t_eng)),
+                       wait - requeue)
+    queue_wait = wait - requeue - kv_fetch
     window = first - admit
     chunks = rec.get("prefill_chunks")
     if chunks:
@@ -306,6 +333,7 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         "router_wait_ms": round(router_wait * ms, 4),
         "queue_wait_ms": round(queue_wait * ms, 4),
         "requeue_ms": round(requeue * ms, 4),
+        "kv_fetch_ms": round(kv_fetch * ms, 4),
         "prefill_ms": round(prefill * ms, 4),
         "prefill_wait_ms": round(prefill_wait * ms, 4),
         "inter_token_ms": round((decode - rollback) * ms, 4),
@@ -358,6 +386,8 @@ def request_snapshot(rec: Dict[str, Any],
         "spec_accepted": rec.get("spec_accepted", 0),
         "spec_rollback_s": rec.get("spec_rollback_s", 0.0),
         "kv_reserve": list(kv) if kv is not None else None,
+        "kv_fetch": (list(rec["kv_fetch"])
+                     if rec.get("kv_fetch") is not None else None),
         "prefill_chunks": ([list(c) for c in rec["prefill_chunks"]]
                            if rec.get("prefill_chunks") else None),
         "spans": ([dict(s) for s in ctx.spans]
@@ -449,6 +479,10 @@ class EngineTelemetry:
         #: Prometheus counter (counters take deltas, stats are totals)
         self._kv_scope: Optional[Dict[str, Any]] = None
         self._kv_waste_reported = 0
+        #: host-RAM KV tier block (serve/kv_tier.py) the deployment
+        #: pushes; same delta-tracking idiom for its restored counter
+        self._kv_tier: Optional[Dict[str, Any]] = None
+        self._kv_tier_restored_reported = 0
         self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
         #: chunked streaming prefill (round 15): admissions split into
         #: block-sized chunks interleaved with decode waves
@@ -505,7 +539,7 @@ class EngineTelemetry:
             "spec_proposed": 0, "spec_accepted": 0,
             "spec_rounds": 0, "spec_rollback_s": 0.0,
             "requeues": 0, "requeue_ts": None, "kv_reserve": None,
-            "prefill_chunks": None,
+            "kv_fetch": None, "prefill_chunks": None,
             "token_ts": [] if ctx is not None else None,
             "status": "queued", "trace": None, "tenant": tenant,
             "ctx": ctx,
@@ -698,6 +732,19 @@ class EngineTelemetry:
         if kv is not None and tokens:
             rec["kv_reserve"] = kv[:5] + (int(tokens),)
 
+    def record_kv_fetch(self, rec: Dict[str, Any], start: float,
+                        end: float, blocks: int = 0, tokens: int = 0,
+                        bytes: int = 0) -> None:
+        """The host-tier restore window of one admission
+        (serve/kv_tier.py): `blocks` evicted prefix blocks re-admitted
+        via H2D copy over [start, end] instead of being re-prefilled.
+        Kept on the record so critical_path() can carve the window
+        out of queue wait as the ``kv_fetch_ms`` component and the
+        tracebus can render a ``kv.fetch`` span; per-block journal
+        events (key/tenant/bytes) come from the pager itself."""
+        rec["kv_fetch"] = (float(start), float(end), int(blocks),
+                           int(tokens), int(bytes))
+
     def record_prefill_chunk(self, rec: Dict[str, Any], start: float,
                              end: float, tokens: int, bucket: int,
                              last: bool = False) -> None:
@@ -818,6 +865,25 @@ class EngineTelemetry:
             float(occ.get("fragmentation", 0.0)), tags=self._tags)
         if delta > 0:
             self._m["kv_reprefill_waste"].inc(delta, tags=self._tags)
+
+    def record_kv_tier(self, block: Dict[str, Any]) -> None:
+        """Latest HostKVTier.stats() block (serve/kv_tier.py) —
+        mirrored into engine_stats()["kv_tier"] and the tier gauges;
+        the tokens-restored Prometheus counter advances by the delta
+        since the last push (stats carry totals, counters take
+        increments)."""
+        with self._lock:
+            self._kv_tier = dict(block)
+            restored = int(block.get("tokens_restored", 0))
+            delta = restored - self._kv_tier_restored_reported
+            if delta > 0:
+                self._kv_tier_restored_reported = restored
+        self._m["kv_tier_bytes"].set(
+            int(block.get("bytes_resident", 0)), tags=self._tags)
+        self._m["kv_tier_hit_rate"].set(
+            float(block.get("hit_rate", 0.0)), tags=self._tags)
+        if delta > 0:
+            self._m["kv_tier_restored"].inc(delta, tags=self._tags)
 
     # -- fleet control plane (serve/router.py journals through here) -------
 
@@ -991,6 +1057,7 @@ class EngineTelemetry:
             kv_stats = (dict(self._kv_stats)
                         if self._kv_stats is not None else None)
             kv_scope = self._kv_scope
+            kv_tier = self._kv_tier
             spec = dict(self._spec)
             chunks = dict(self._chunks)
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
@@ -1043,6 +1110,11 @@ class EngineTelemetry:
             # dense engines, which have no pager to observe)
             "kv_scope": (kv_scope if kv_scope is not None
                          else _empty_kv_scope()),
+            # round-17: tiered host-RAM KV cache — spill/restore
+            # counters + engine-fed H2D/D2H cost (stable zero-shaped
+            # block when no tier is configured, dense included)
+            "kv_tier": (kv_tier if kv_tier is not None
+                        else _empty_kv_tier()),
             # round-11: speculative decoding — engine totals plus
             # per-request acceptance-rate percentiles (requests that
             # saw at least one verify round)

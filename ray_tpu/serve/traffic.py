@@ -320,6 +320,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
                 max_slots: int = 4,
                 max_new_tokens: int = 8, prefill_bucket: int = 16,
                 prefill_chunk_tokens: Optional[int] = None,
+                kv_host_tier_bytes: Optional[int] = None,
                 time_scale: float = 0.0,
                 latency_slo_ms: Optional[float] = None,
                 admission_policy=None, slo=None, spec_decode=None,
@@ -350,7 +351,12 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     layout only — see build_llm_deployment); the report then carries
     the engine's ``prefill_chunks`` counter block and per-tenant
     ``{tenant}_ttft_ms_p99`` fields so sweeps can A/B the chunk size
-    against interactive-tenant TTFT."""
+    against interactive-tenant TTFT.
+
+    `kv_host_tier_bytes` enables the tiered host-RAM KV cache (see
+    build_llm_deployment); ``report["kv_tier_hit_rate"]`` then rides
+    along so sweeps can A/B the tier budget against
+    ``reprefill_waste_frac`` on churn traffic."""
     import asyncio
 
     from ray_tpu.serve.llm import build_llm_deployment
@@ -361,6 +367,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
         prefill_bucket=prefill_bucket, kv_layout=kv_layout,
         kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
         prefill_chunk_tokens=prefill_chunk_tokens,
+        kv_host_tier_bytes=kv_host_tier_bytes,
         admission_policy=admission_policy, slo=slo,
         spec_decode=spec_decode, mesh=mesh,
         config_overrides=config_overrides)
@@ -397,6 +404,11 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
     report["reprefill_waste_frac"] = \
         (scope_blk.get("forensics") or {}).get(
             "reprefill_waste_frac", 0.0)
+    # host-tier headline: fraction of second-chance probes that
+    # restored a block via H2D instead of re-prefilling (0.0 when the
+    # tier is off — the field is always present for sweep identity)
+    report["kv_tier_hit_rate"] = \
+        (eng.get("kv_tier") or {}).get("hit_rate", 0.0)
     # engine-side SLO: per-objective attainment (TTFT + e2e + queue
     # wait as configured), flattened for SWEEPJSON consumers
     slo_block = eng.get("slo")
@@ -427,7 +439,7 @@ def run_traffic(spec: TrafficSpec, *, family: str = "gpt2",
 #: TTFT-side legs of the tracebus critical path (everything before the
 #: first token; the decode-side legs are inter_token + spec_rollback)
 _TTFT_COMPONENTS = ("router_wait_ms", "queue_wait_ms", "requeue_ms",
-                    "prefill_ms", "prefill_wait_ms")
+                    "kv_fetch_ms", "prefill_ms", "prefill_wait_ms")
 
 
 def _flatten_anatomy(report: Dict[str, Any],
@@ -492,7 +504,10 @@ async def drive_fleet(fleet, requests: List[TrafficRequest], *,
 
 def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
                       family: str = "gpt2", preset: str = "nano",
-                      kv_block_size: int = 16, max_slots: int = 4,
+                      kv_block_size: int = 16,
+                      kv_num_blocks: Optional[int] = None,
+                      kv_host_tier_bytes: Optional[int] = None,
+                      max_slots: int = 4,
                       max_new_tokens: int = 8,
                       prefill_bucket: int = 16,
                       time_scale: float = 0.0,
@@ -519,7 +534,8 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
         routing=routing, wfq=wfq, autoscale=autoscale,
         max_slots=max_slots, max_new_tokens=max_new_tokens,
         temperature=0.0, prefill_bucket=prefill_bucket,
-        kv_block_size=kv_block_size, slo=slo,
+        kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
+        kv_host_tier_bytes=kv_host_tier_bytes, slo=slo,
         admission_policy=admission_policy, mesh=mesh,
         config_overrides=config_overrides)
     requests = TrafficGenerator(spec).requests()
@@ -551,6 +567,9 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
         fleet_scope.get("occupancy_p95", 0.0)
     report["reprefill_waste_frac"] = \
         fleet_scope.get("reprefill_waste_frac", 0.0)
+    # fleet-pooled host-tier headline (see fleet_stats()["kv_tier"])
+    report["kv_tier_hit_rate"] = \
+        (report["fleet"].get("kv_tier") or {}).get("hit_rate", 0.0)
     report["tenants"] = report["fleet"]["tenants"]
     #: flattened for SWEEPJSON consumers: {tenant}_{obj}_slo_attainment
     flat: Dict[str, Any] = {}

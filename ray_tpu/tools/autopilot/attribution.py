@@ -126,7 +126,8 @@ def attribute(programs: Dict[str, Dict[str, Any]],
               device: Optional[Dict[str, Any]] = None,
               request_anatomy: Optional[Dict[str, Any]] = None,
               train_anatomy: Optional[Dict[str, Any]] = None,
-              kv_scope: Optional[Dict[str, Any]] = None
+              kv_scope: Optional[Dict[str, Any]] = None,
+              kv_tier: Optional[Dict[str, Any]] = None
               ) -> Dict[str, Any]:
     """Attribute a programs snapshot against the device roofline.
 
@@ -150,7 +151,14 @@ def attribute(programs: Dict[str, Dict[str, Any]],
     serving loop *cache-thrash-bound* — a meaningful share of prefill
     compute is re-filling prefixes the pool already held and evicted,
     so the lever is pool size (or a host-RAM KV tier), not program
-    knobs.  Returns::
+    knobs.  ``kv_tier`` is the host-tier block
+    (``engine_stats()["kv_tier"]`` or the fleet-pooled variant): when
+    the RESIDUAL waste is below threshold but the would-be waste —
+    counting tokens the tier re-admitted via H2D as churn that would
+    have been re-prefill without it — crosses it, the summary stops
+    calling the loop cache-thrash-bound and instead credits the tier
+    with absorbing the churn (the lever becomes tier budget, not pool
+    size).  Returns::
 
         {"device": {...roofline...},
          "programs": {name: {"class", "arithmetic_intensity", "mfu",
@@ -237,6 +245,11 @@ def attribute(programs: Dict[str, Dict[str, Any]],
         # fleet-pooled block (router fleet_stats) is flat
         fx = kv_scope.get("forensics") or kv_scope
         frac = fx.get("reprefill_waste_frac") or 0.0
+        # tokens the host tier re-admitted via H2D are churn that
+        # WOULD have been re-prefill waste without it — the tier block
+        # is authoritative, the kvscope forensics mirror is fallback
+        restored = int((kv_tier or {}).get("tokens_restored")
+                       or fx.get("tokens_restored") or 0)
         if frac >= CACHE_THRASH_WASTE_FRAC:
             summary += (
                 f"; serving is cache-thrash-bound: {frac:.0%} of "
@@ -244,11 +257,30 @@ def attribute(programs: Dict[str, Dict[str, Any]],
                 f"prefixes ({fx.get('reprefill_waste_tokens', 0)} "
                 f"tokens) — grow the KV pool before sweeping "
                 f"program knobs")
+            if restored:
+                summary += (
+                    f" (host KV tier restored {restored} tokens but "
+                    f"thrash persists — grow its byte budget too)")
+        elif restored:
+            prefill = float(fx.get("prefill_tokens") or 0)
+            waste = float(fx.get("reprefill_waste_tokens") or 0)
+            denom = prefill + restored
+            would_be = (waste + restored) / denom if denom > 0 else 0.0
+            if would_be >= CACHE_THRASH_WASTE_FRAC:
+                hit_rate = (kv_tier or {}).get("hit_rate")
+                hr = (f", tier hit rate {hit_rate:.0%}"
+                      if isinstance(hit_rate, (int, float)) else "")
+                summary += (
+                    f"; host KV tier is absorbing cache churn: "
+                    f"{restored} tokens re-admitted via H2D instead "
+                    f"of re-prefill (would-be waste {would_be:.0%} "
+                    f"vs {frac:.0%} residual{hr}) — pool churn is "
+                    f"handled, not a bottleneck")
     return {"device": device, "programs": out, "ranked": ranked,
             "bottleneck": bottleneck,
             "request_anatomy": request_anatomy,
             "train_anatomy": train_anatomy, "kv_scope": kv_scope,
-            "summary": summary}
+            "kv_tier": kv_tier, "summary": summary}
 
 
 def attribute_registry() -> Dict[str, Any]:

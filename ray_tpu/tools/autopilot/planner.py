@@ -194,7 +194,17 @@ def mirror_variant(batch: int,
                    "rate_rps": kw.pop("rate_rps", 32.0),
                    "preset": kw.pop("preset", "gpt2"),
                    "block_size": kw.pop("block_size", 16),
-                   "prefill_bucket": kw.pop("prefill_bucket", 128)}
+                   "prefill_bucket": kw.pop("prefill_bucket", 128),
+                   # identity keys sweep_tpu records so A/B arms never
+                   # hash into one ledger series — mirrored with the
+                   # same `or None` normalization (0 = off = default)
+                   "prefill_chunk_tokens":
+                       kw.pop("prefill_chunk", None) or None,
+                   "long_prompt_len": kw.pop("long_prompt_len", None),
+                   "kv_host_tier_bytes":
+                       kw.pop("kv_host_tier_bytes", None) or None,
+                   "kv_num_blocks":
+                       kw.pop("kv_num_blocks", None) or None}
         for consumed in ("spec_draft", "ttft_slo_ms", "e2e_slo_ms",
                          "seed", "prefix_groups", "tail_len_mean",
                          "tail_len_max", "vocab", "new_tokens",

@@ -23,6 +23,9 @@ Rule ids:
   fences carry a disable comment naming the reason.
 * ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``,
   ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py``,
+  ``serve/kv_tier.py`` (the host tier never reads a clock — the
+  engine feeds it measured H2D/D2H seconds via ``note_h2d`` /
+  ``note_d2h``, the trainwatch idiom),
   ``serve/router.py`` (the fleet router timestamps routing/autoscale
   decisions and measures drain deadlines — interval math like the
   rest), ``train/goodput.py`` (the trainwatch anatomy promises legs
@@ -142,6 +145,7 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
             or rel_posix.endswith("serve/slo.py")
             or rel_posix.endswith("serve/router.py")
             or rel_posix.endswith("serve/kvscope.py")
+            or rel_posix.endswith("serve/kv_tier.py")
             or rel_posix.endswith("tools/tracebus.py")
             or rel_posix.endswith("tools/kvscope.py")
             or rel_posix.endswith("train/goodput.py")

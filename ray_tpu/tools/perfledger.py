@@ -81,6 +81,10 @@ _SWEEP_FIELDS = (
     # waste — both fractions where SMALLER is better ("occupancy" /
     # "waste" below; no higher-is-better override contains either)
     "kv_occupancy_p95", "reprefill_waste_frac",
+    # tiered host-RAM KV cache (serve/kv_tier.py): fraction of
+    # second-chance probes the tier absorbed — higher is better via
+    # the "hit_rate" override below
+    "kv_tier_hit_rate",
 )
 
 #: substrings marking a metric where SMALLER is better
@@ -88,9 +92,11 @@ _LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile",
                     "occupancy", "waste")
 
 #: substrings that trump _LOWER_IS_BETTER: "ttft_slo_attainment"
-#: contains "ttft" but is a fraction where BIGGER is better, and
-#: "goodput" is a productive-time fraction regardless of neighbors
-_HIGHER_OVERRIDES = ("slo_attainment", "accept_rate", "goodput")
+#: contains "ttft" but is a fraction where BIGGER is better,
+#: "goodput" is a productive-time fraction regardless of neighbors,
+#: and "hit_rate" covers prefix/router/kv-tier cache hit fractions
+_HIGHER_OVERRIDES = ("slo_attainment", "accept_rate", "goodput",
+                     "hit_rate")
 
 
 def repo_root() -> str:

@@ -216,6 +216,15 @@ def build_request_spans(req: Dict[str, Any]) -> List[Dict[str, Any]]:
                  evicted=kv[4] if len(kv) > 4 else None,
                  reprefill_waste_tokens=kv[5] if len(kv) > 5
                  else None)
+        # host-tier restore (serve/kv_tier.py): evicted prefix blocks
+        # re-admitted via H2D copy during this admission — its own
+        # span inside queue wait, matching the kv_fetch_ms component
+        kf = req.get("kv_fetch")
+        if kf:
+            emit("kv.fetch", kf[0], kf[1], parent=queue_id,
+                 blocks=kf[2] if len(kf) > 2 else None,
+                 tokens=kf[3] if len(kf) > 3 else None,
+                 bytes=kf[4] if len(kf) > 4 else None)
     if admit is not None and first is not None:
         chunks = req.get("prefill_chunks")
         if chunks:

@@ -132,6 +132,11 @@ def _run_traffic_variant(max_slots, kw, out):
     # seeded traffic)
     prefill_chunk = kw.pop("prefill_chunk", None) or None
     long_prompt_len = kw.pop("long_prompt_len", None)
+    # tiered host-RAM KV cache A/B: byte budget for the pager's host
+    # tier (None/0 = tier off — the control arm); `kv_num_blocks`
+    # shrinks the HBM pool to force churn the tier can absorb
+    kv_host_tier_bytes = kw.pop("kv_host_tier_bytes", None) or None
+    kv_num_blocks = kw.pop("kv_num_blocks", None) or None
     tenants = ()
     if long_prompt_len:
         tenants = (
@@ -158,6 +163,8 @@ def _run_traffic_variant(max_slots, kw, out):
         "max_new_tokens": kw.pop("new_tokens", 64),
         "prefill_bucket": kw.pop("prefill_bucket", 128),
         "prefill_chunk_tokens": prefill_chunk,
+        "kv_num_blocks": kv_num_blocks,
+        "kv_host_tier_bytes": kv_host_tier_bytes,
         "time_scale": kw.pop("time_scale", 1.0),
         "latency_slo_ms": kw.pop("latency_slo_ms", 20000.0),
     }
@@ -192,6 +199,11 @@ def _run_traffic_variant(max_slots, kw, out):
                # never hash into one ledger series
                "prefill_chunk_tokens": prefill_chunk,
                "long_prompt_len": long_prompt_len,
+               # tier budget (and any pool shrink forcing churn) is
+               # variant identity: tier-on/off must never hash into
+               # one ledger series
+               "kv_host_tier_bytes": kv_host_tier_bytes,
+               "kv_num_blocks": kv_num_blocks,
                "overrides": kw}
     try:
         rep = run_traffic(spec, family="gpt2", kv_layout=kv_layout,
@@ -229,6 +241,8 @@ def _run_traffic_variant(max_slots, kw, out):
                "kv_occupancy_p95": rep.get("kv_occupancy_p95"),
                "reprefill_waste_frac":
                    rep.get("reprefill_waste_frac"),
+               # host-tier headline (higher-is-better; 0.0 tier-off)
+               "kv_tier_hit_rate": rep.get("kv_tier_hit_rate"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],
@@ -239,6 +253,7 @@ def _run_traffic_variant(max_slots, kw, out):
                    "ttft_p50_ms": (eng["ttft_ms"] or {}).get("p50"),
                    "ttft_p95_ms": (eng["ttft_ms"] or {}).get("p95"),
                    "kv_cache": eng.get("kv_cache"),
+                   "kv_tier": eng.get("kv_tier"),
                    "prefill_chunks": eng.get("prefill_chunks"),
                    "rejections_by_reason":
                        eng["rejections_by_reason"]}}
@@ -299,9 +314,15 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
         tail_len_max=kw.pop("tail_len_max", 128),
         vocab=kw.pop("vocab", 50000),
         tenants=tenants)
+    # tiered host-RAM KV cache A/B (per-replica tier; see
+    # _run_traffic_variant for the knob semantics)
+    kv_host_tier_bytes = kw.pop("kv_host_tier_bytes", None) or None
+    kv_num_blocks = kw.pop("kv_num_blocks", None) or None
     run_kw = {
         "preset": kw.pop("preset", "gpt2"),
         "kv_block_size": kw.pop("block_size", 16),
+        "kv_num_blocks": kv_num_blocks,
+        "kv_host_tier_bytes": kv_host_tier_bytes,
         "max_new_tokens": kw.pop("new_tokens", 64),
         "prefill_bucket": kw.pop("prefill_bucket", 128),
         "time_scale": kw.pop("time_scale", 1.0),
@@ -312,7 +333,10 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
                "requests": spec.num_requests,
                "prefix_len": spec.prefix_len,
                "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
-               "preset": run_kw["preset"], "overrides": kw}
+               "preset": run_kw["preset"],
+               "kv_host_tier_bytes": kv_host_tier_bytes,
+               "kv_num_blocks": kv_num_blocks,
+               "overrides": kw}
     try:
         rep = run_traffic_fleet(spec, num_replicas=replicas,
                                 family="gpt2", max_slots=max_slots,
@@ -333,6 +357,8 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
                "kv_occupancy_p95": rep.get("kv_occupancy_p95"),
                "reprefill_waste_frac":
                    rep.get("reprefill_waste_frac"),
+               # fleet-pooled host-tier headline (higher-is-better)
+               "kv_tier_hit_rate": rep.get("kv_tier_hit_rate"),
                "completed": rep["completed"], "shed": rep["shed"],
                "latency_p50_ms": rep["latency_ms"]["p50"],
                "latency_p95_ms": rep["latency_ms"]["p95"],

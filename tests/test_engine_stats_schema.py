@@ -29,8 +29,8 @@ TOP_KEYS = {
     "tokens_generated", "tokens_per_sec", "slot_utilization",
     "max_active_slots", "max_slots", "prefill_buckets",
     "prefill_compiles", "program_compiles", "rejections_by_reason",
-    "kv_cache", "kv_scope", "spec", "slo", "flightrec", "programs",
-    "latency_anatomy", "prefill_chunks",
+    "kv_cache", "kv_scope", "kv_tier", "spec", "slo", "flightrec",
+    "programs", "latency_anatomy", "prefill_chunks",
 }
 
 KV_SCOPE_KEYS = {"enabled", "occupancy", "forensics",
@@ -43,14 +43,20 @@ KV_OCCUPANCY_KEYS = {"ring_capacity", "samples", "last",
 KV_FORENSICS_KEYS = {"keys_evicted", "keys_tracked", "keys_forgotten",
                      "reprefill_events", "reprefill_waste_tokens",
                      "reprefill_waste_frac", "prefill_tokens",
+                     "tier_hits", "tokens_restored",
                      "waste_by_tenant", "top_keys"}
+
+KV_TIER_KEYS = {"enabled", "bytes_budget", "bytes_resident", "entries",
+                "hits", "misses", "hit_rate", "saves", "evictions",
+                "tokens_restored", "h2d_ms", "d2h_ms"}
 
 ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "ttft_ms",
                 "critical_path", "by_tenant"}
 
 CRITICAL_PATH_KEYS = {"e2e_ms", "router_wait_ms", "queue_wait_ms",
-                      "requeue_ms", "prefill_ms", "prefill_wait_ms",
-                      "inter_token_ms", "spec_rollback_ms"}
+                      "requeue_ms", "kv_fetch_ms", "prefill_ms",
+                      "prefill_wait_ms", "inter_token_ms",
+                      "spec_rollback_ms"}
 
 PREFILL_CHUNK_KEYS = {"requests", "chunks", "tokens",
                       "max_chunks_per_request"}
@@ -152,6 +158,17 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
         assert ks["occupancy"]["samples"] == 0
         assert ks["hbm_ledger"]["per_chip"] == []
 
+    # kv_tier: same shape regardless of layout — no host tier is
+    # configured anywhere in this matrix, so every engine (dense AND
+    # paged) reports the zero-shaped disabled block; dashboards never
+    # branch on whether a tier exists
+    kt = stats["kv_tier"]
+    assert set(kt) == KV_TIER_KEYS
+    assert kt["enabled"] is False
+    assert kt["hits"] == 0 and kt["misses"] == 0
+    assert kt["tokens_restored"] == 0
+    assert kt["bytes_resident"] == 0 and kt["entries"] == 0
+
     # spec block always present; counters move iff spec decoding ran
     assert set(stats["spec"]) == SPEC_KEYS
     if spec is not None:
@@ -222,3 +239,33 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
         assert stats["mesh"]["n_devices"] == 8
     else:
         assert "mesh" not in stats
+
+
+def test_engine_stats_kv_tier_enabled_shape():
+    """A paged engine WITH a host tier reports the identical key set,
+    just with ``enabled: True`` and a live byte budget — the golden
+    shape must not fork on configuration."""
+    slo = SLOConfig(ttft_ms=60_000.0, e2e_ms=120_000.0,
+                    queue_wait_ms=60_000.0)
+    dep = build_llm_deployment(
+        "gpt2", "nano", scheduler="continuous", kv_layout="paged",
+        kv_block_size=16, prefill_bucket=16, max_slots=2,
+        max_new_tokens=3, temperature=0.0, slo=slo,
+        kv_host_tier_bytes=1 << 20, config_overrides=_OVR)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, 50, size=rng.randint(8, 14))
+               .astype(np.int32) for _ in range(2)]
+
+    async def main():
+        inst = dep.func_or_class()
+        try:
+            await asyncio.gather(*[inst(p) for p in prompts])
+            return inst.engine_stats()
+        finally:
+            inst.shutdown_engine()
+
+    stats = asyncio.run(main())
+    kt = stats["kv_tier"]
+    assert set(kt) == KV_TIER_KEYS
+    assert kt["enabled"] is True
+    assert kt["bytes_budget"] == 1 << 20

@@ -629,6 +629,50 @@ def test_lint_kvscope_sources_clean():
         assert not kept, [str(v) for v in kept]
 
 
+def test_lint_wallclock_covers_kv_tier():
+    # round 17: the host KV tier never reads a clock — the engine
+    # feeds it measured H2D/D2H seconds (note_h2d/note_d2h) — so a
+    # planted time.time() inside serve/kv_tier.py must flag
+    src = textwrap.dedent("""\
+        import time
+
+        def put(key, rows):
+            return time.time()
+    """)
+    kept, _ = lint_source(src, "ray_tpu/serve/kv_tier.py")
+    assert [v.rule for v in kept] == ["wallclock-in-telemetry"]
+    kept, _ = lint_source(src.replace("time.time()",
+                                      "time.perf_counter()"),
+                          "ray_tpu/serve/kv_tier.py")
+    assert not kept
+
+
+def test_lint_blocking_call_covers_kv_tier():
+    # kv_tier.py lives under ray_tpu/serve/, so the async-path
+    # blocking-call scope already covers it: a planted D2H gather
+    # inside an async def must flag
+    src = textwrap.dedent("""\
+        import numpy as np
+
+        async def spill(cache, blk):
+            return np.asarray(cache[blk])
+    """)
+    kept, _ = lint_source(src, "ray_tpu/serve/kv_tier.py")
+    assert [v.rule for v in kept] == ["blocking-call-in-async"]
+
+
+def test_lint_kv_tier_source_clean():
+    # the shipped tier lints clean under the full rule set (both the
+    # wallclock and blocking-call scopes now include it)
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rel = "ray_tpu/serve/kv_tier.py"
+    with open(os.path.join(repo, rel)) as f:
+        kept, _ = lint_source(f.read(), rel)
+    assert not kept, [str(v) for v in kept]
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote

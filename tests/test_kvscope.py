@@ -365,6 +365,63 @@ def test_churn_journal_replay_matches_per_tenant_waste():
     assert sum(replayed.values()) == fx["reprefill_waste_tokens"]
 
 
+def test_churn_journal_replay_tier_round_trip_books_zero_waste():
+    """Same replay discipline with a host tier attached: every
+    ``kv_fetch`` event names content a prior ``kv_evict`` recorded as
+    lost, and an evict→fetch→register round-trip books ZERO re-prefill
+    waste — while a restored key stays resident, no ``kv_reprefill``
+    event may name it.  The forensics mirror must agree with the
+    journal exactly (tier_hits == fetch events, tokens_restored ==
+    their token sum)."""
+    from ray_tpu.serve.llm import build_llm_deployment
+    from ray_tpu.serve.traffic import drive
+
+    dep = build_llm_deployment(
+        "gpt2", "nano", scheduler="continuous", kv_layout="paged",
+        kv_block_size=16, kv_num_blocks=12, prefill_bucket=16,
+        max_slots=2, max_new_tokens=4, temperature=0.0,
+        kv_host_tier_bytes=1 << 26, config_overrides=_OVR)
+    requests = TrafficGenerator(_churn_spec()).requests()
+
+    async def main():
+        inst = dep.func_or_class()
+        try:
+            await drive(inst, requests, time_scale=0.0)
+            return (inst.engine_stats(),
+                    inst._telemetry.flightrec.snapshot())
+        finally:
+            inst.shutdown_engine()
+
+    stats, events = asyncio.run(main())
+    fx = stats["kv_scope"]["forensics"]
+    evicted = set()
+    restored_resident = set()
+    fetches = 0
+    fetched_tokens = 0
+    for e in events:
+        ident = (tuple(e.get("key_prefix") or ()), e.get("key_len"))
+        if e["kind"] == "kv_evict":
+            evicted.add(ident)
+            restored_resident.discard(ident)
+        elif e["kind"] == "kv_fetch":
+            # a fetch can only restore content a prior evict lost
+            assert ident in evicted, e
+            assert e["bytes"] > 0 and e["tokens"] == 16, e
+            restored_resident.add(ident)
+            fetches += 1
+            fetched_tokens += e["tokens"]
+        elif e["kind"] == "kv_reprefill":
+            # the round-trip invariant: registering a tier-restored
+            # key must never book waste
+            assert ident not in restored_resident, e
+    assert fetches > 0, "tier never restored — workload did not churn"
+    assert fx["tier_hits"] == fetches
+    assert fx["tokens_restored"] == fetched_tokens
+    kt = stats["kv_tier"]
+    assert kt["enabled"] and kt["hits"] == fetches
+    assert kt["tokens_restored"] == fetched_tokens
+
+
 # ---------------------------------------------------------------------------
 # autopilot attribution: cache-thrash clause
 # ---------------------------------------------------------------------------
