@@ -116,6 +116,23 @@ def parse_args(argv=None):
                          "waves (0 = same mixture, one-shot prefill — "
                          "the A/B control; paged layout only); emits "
                          "per-tenant ttft_ms_p99 lines")
+    ap.add_argument("--prefill-replicas", type=int, default=None,
+                    help="--traffic disaggregated serving A/B: build "
+                         "this many role='prefill' replicas alongside "
+                         "--decode-replicas role='decode' replicas "
+                         "(serve/router.py build_llm_fleet) with "
+                         "block-granular KV handoff between them; "
+                         "both flags required together; emits "
+                         "handoff_ms_p99 and per-role pool-occupancy "
+                         "lines")
+    ap.add_argument("--decode-replicas", type=int, default=None,
+                    help="--traffic disaggregated serving: decode-"
+                         "role replica count (see --prefill-replicas)")
+    ap.add_argument("--handoff-staged", action="store_true",
+                    help="--traffic disaggregated serving: force the "
+                         "D2H→H2D host-staging handoff hop (the "
+                         "cross-process path) instead of the same-"
+                         "process device fast path")
     ap.add_argument("--kv-host-tier-bytes", type=int, default=None,
                     help="--traffic tiered host-RAM KV cache A/B: give "
                          "the engine's BlockPager a host tier of this "
@@ -727,8 +744,10 @@ def main_traffic(args, on_tpu: bool) -> None:
     `{base}_{objective}_slo_attainment` lines; `--spec-k K` runs the
     traffic through the speculative engine and adds accept-rate
     lines.  No published baseline exists, so vs_baseline is null.
-    `--replicas N` (N>1) switches to the fleet path below."""
-    if args.replicas > 1:
+    `--replicas N` (N>1) switches to the fleet path below, as does
+    the disaggregated `--prefill-replicas/--decode-replicas` pair."""
+    if args.replicas > 1 or args.prefill_replicas \
+            or args.decode_replicas:
         return main_traffic_fleet(args, on_tpu)
     import jax
 
@@ -980,6 +999,12 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
     if args.kv_host_tier_bytes:
         base += "_tier"
         kw["kv_host_tier_bytes"] = args.kv_host_tier_bytes
+    disagg = bool(args.prefill_replicas or args.decode_replicas)
+    if disagg:
+        base += "_disagg"
+        kw["num_prefill_replicas"] = args.prefill_replicas
+        kw["num_decode_replicas"] = args.decode_replicas
+        kw["handoff_staged"] = args.handoff_staged
     rep = run_traffic_fleet(
         spec, num_replicas=args.replicas, family="gpt2",
         preset=preset, kv_block_size=16,
@@ -998,6 +1023,23 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
     if args.kv_host_tier_bytes:
         detail["kv_host_tier_bytes"] = args.kv_host_tier_bytes
         detail["kv_tier"] = fleet.get("kv_tier")
+    if disagg:
+        detail["num_prefill_replicas"] = args.prefill_replicas
+        detail["num_decode_replicas"] = args.decode_replicas
+        detail["handoff_staged"] = args.handoff_staged
+        detail["handoff"] = rep.get("handoff")
+        emit({
+            "metric": f"{base}_handoff_ms_p99",
+            "value": rep.get("handoff_ms_p99"), "unit": "ms",
+            "vs_baseline": None, "detail": detail})
+        for key in sorted(rep):
+            # {role}_kv_occupancy_{mean,p95} utilization lines
+            if key.endswith("_kv_occupancy_p95") \
+                    or key.endswith("_kv_occupancy_mean"):
+                emit({
+                    "metric": f"{base}_{key}",
+                    "value": rep[key], "unit": "fraction",
+                    "vs_baseline": None, "detail": detail})
     emit({
         "metric": f"{base}_router_prefix_hit_rate",
         "value": rep["router_prefix_hit_rate"], "unit": "fraction",

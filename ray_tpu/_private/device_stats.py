@@ -72,9 +72,12 @@ _PEAK_HBM_BW_TABLE = {
 KNOWN_PROGRAMS = frozenset({
     "serve.prefill", "serve.paged_prefill", "serve.decode",
     "serve.spec_verify", "serve.spec_draft",
+    "serve.kv_handoff_export", "serve.kv_handoff_install",
     "serve.sharded_prefill", "serve.sharded_paged_prefill",
     "serve.sharded_decode",
     "serve.sharded_spec_verify", "serve.sharded_spec_draft",
+    "serve.sharded_kv_handoff_export",
+    "serve.sharded_kv_handoff_install",
     "train.step",
     "bench.train_step",
 })
@@ -98,6 +101,11 @@ STATIC_PROGRAM_MAP: Dict[str, str] = {
     # invoke per chunk), so the static spec maps to the same runtime
     # name — the observatory sees N invokes per chunked admission
     "gpt2_chunked_prefill": "serve.paged_prefill",
+    # disaggregated prefill/decode handoff: the export gather on the
+    # prefill replica and the donated install splice on the decode
+    # replica (serve/llm.py kv_handoff_* programs)
+    "gpt2_kv_handoff_export": "serve.kv_handoff_export",
+    "gpt2_kv_handoff_install": "serve.kv_handoff_install",
 }
 
 _metrics_lock = threading.Lock()

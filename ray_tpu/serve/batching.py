@@ -59,6 +59,43 @@ class ChunkCursor:
         self.chunks_done += 1
 
 
+@dataclass
+class HandoffCursor:
+    """State of one disaggregated prefill→decode KV handoff
+    (serve/llm.py + serve/router.py two-stage dispatch): a prefill
+    replica that finishes a request's last chunk resolves its future
+    with this cursor instead of generated tokens, and the router
+    forwards it to the chosen decode replica, whose admission path
+    installs the exported block rows and resumes decoding at
+    ``first_token``.
+
+    ``k_rows``/``v_rows`` are the filled KV block rows gathered by the
+    prefill engine's ``kv_handoff_export`` program — jax device arrays
+    on the same-process fast path, host numpy after the D2H hop on the
+    staged path (``path`` records which).  ``meta`` carries the
+    prefill-side telemetry timing (enqueue/admit/first-token/chunk
+    windows) so the decode replica's record decomposes exactly like a
+    monolithic engine's, plus the new ``handoff_ms`` leg."""
+
+    prompt: Any                # np.int32 prompt token array
+    first_token: int           # sampled at the prefill replica's last chunk
+    n_tokens: int              # prompt tokens resident in the exported rows
+    n_blocks: int              # filled block rows exported (leading rows)
+    k_rows: Any = None         # stacked K rows, shape (maxn, L, bs, H, hd)
+    v_rows: Any = None         # stacked V rows, same shape
+    nbytes: int = 0            # payload footprint (both stacks)
+    path: str = "fast"         # "fast" device copy | "staged" D2H→H2D
+    t_export0: float = 0.0     # export dispatch start (prefill side)
+    t_export1: float = 0.0     # export fence end (prefill side)
+    installed: bool = False    # decode side flips this after the splice
+    meta: Any = None           # telemetry meta for record_enqueue_handoff
+    sampling: Any = None       # per-request SamplingParams override
+
+    @property
+    def done(self) -> bool:
+        return self.installed
+
+
 class _BatchQueue:
     def __init__(self, fn: Callable, max_batch_size: int,
                  batch_wait_timeout_s: float):

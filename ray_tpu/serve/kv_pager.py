@@ -424,6 +424,33 @@ class BlockPager:
                         **dict(self._ctx_tag(), **self._key_tag(key)))
         return waste
 
+    def note_handoff_import(self, tokens: Sequence[int],
+                            block_ids: Sequence[int]) -> None:
+        """Index the FULL prompt blocks a disaggregated handoff just
+        installed (serve/router.py two-stage dispatch): this decode
+        replica received the rows by device or staged copy from a
+        prefill replica, so unlike ``register_prefix`` nothing was
+        recomputed and no probe happened — NO re-prefill waste is
+        booked and the prefix hit/miss counters stay untouched.
+        First writer wins, exactly like ``register_prefix``: keys
+        already indexed keep their canonical block."""
+        tokens = tuple(int(t) for t in tokens)
+        tenant = self._req_ctx[2]
+        indexed = 0
+        for i in range(len(tokens) // self.block_size):
+            key = tokens[:(i + 1) * self.block_size]
+            blk = block_ids[i]
+            if key in self._index or blk in self._block_key:
+                continue
+            self._index[key] = blk
+            self._block_key[blk] = key
+            self.scope.note_handoff_import(key, tenant)
+            indexed += 1
+        if self._recorder is not None and indexed:
+            self._recorder.record(
+                "kv_handoff_import", blocks=indexed,
+                **self._ctx_tag())
+
     def ensure_private(self, block_id: int
                        ) -> Tuple[int, Optional[int]]:
         """Copy-on-write gate: called before a sequence writes into

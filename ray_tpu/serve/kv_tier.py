@@ -47,7 +47,24 @@ from __future__ import annotations
 import collections
 from typing import Dict, Optional, Tuple
 
-__all__ = ["HostKVTier", "empty_kv_tier"]
+import numpy as np
+
+__all__ = ["HostKVTier", "empty_kv_tier", "staging_buffers"]
+
+
+def staging_buffers(maxn: int, row_shape: Tuple[int, ...],
+                    dtype) -> Tuple[np.ndarray, np.ndarray,
+                                    np.ndarray]:
+    """Persistent host staging triple ``(ids, k_rows, v_rows)`` for
+    fixed-shape block-splice dispatches: the tier restore path and the
+    disaggregated handoff's staged D2H→H2D hop (serve/llm.py) both
+    refill these in place per transfer instead of re-allocating pad
+    arrays.  ``maxn`` is the id-vector length (max_seq // block_size)
+    and ``row_shape`` the stacked per-block row shape the engine's
+    install program expects."""
+    return (np.zeros((maxn,), np.int32),
+            np.zeros(row_shape, dtype),
+            np.zeros(row_shape, dtype))
 
 #: one stored block: per-layer K rows, per-layer V rows (host numpy,
 #: shape (n_layer, block_size, kv_heads, head_dim)), byte footprint

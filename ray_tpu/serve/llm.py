@@ -37,7 +37,8 @@ import numpy as np
 
 from ray_tpu.models.decode_common import SamplingParams
 from ray_tpu.serve.api import deployment
-from ray_tpu.serve.batching import (ChunkCursor, OverloadedError,
+from ray_tpu.serve.batching import (ChunkCursor, HandoffCursor,
+                                    OverloadedError,
                                     RequestQueue)
 from ray_tpu.serve.batching import batch as _batch
 from ray_tpu.serve.telemetry import EngineTelemetry
@@ -246,6 +247,40 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         # run, and per-op overhead is the whole cost on host backends)
         return cache["k"][:, blk], cache["v"][:, blk]
 
+    def kv_handoff_export(cache, blk_ids):
+        # disaggregated prefill→decode handoff (serve/router.py
+        # two-stage dispatch): gather a finished prefill's filled
+        # block rows out of the pool in ONE dispatch — the read twin
+        # of install_blocks, sharing its fixed-length id-vector shape
+        # so every handoff reuses one compiled program.  Pad entries
+        # (id 0) gather the null block's garbage rows; they install
+        # back into the null block on the decode side, so the pads
+        # are harmless end to end by the same write-sink contract.
+        return (cache["k"][:, blk_ids].swapaxes(0, 1),
+                cache["v"][:, blk_ids].swapaxes(0, 1))
+
+    def kv_handoff_install(cache, blk_ids, k_stack, v_stack, slot,
+                           row_bt, pos):
+        # decode-side handoff splice: land the exported rows AND
+        # point the receiving row's block table / pos / start at them
+        # in ONE donated dispatch, so the row is decode-ready the
+        # moment the program retires and the first decode step reads
+        # exactly the rows the prefill replica wrote (bit-identical
+        # to the monolithic engine by construction).  `pos` is the
+        # prompt length — the same value paged_prefill leaves behind
+        # (prefix_len + n_tail) — and start pins to 0 like every
+        # paged admission.
+        out = dict(cache)
+        out["k"] = cache["k"].at[:, blk_ids].set(
+            k_stack.swapaxes(0, 1))
+        out["v"] = cache["v"].at[:, blk_ids].set(
+            v_stack.swapaxes(0, 1))
+        out["block_tables"] = cache["block_tables"].at[slot].set(
+            row_bt)
+        out["pos"] = cache["pos"].at[slot].set(pos)
+        out["start"] = cache["start"].at[slot].set(0)
+        return out
+
     # perf observatory: the heavy programs report compiles / compiler
     # cost model / invoke walltimes to the process-wide registry under
     # stable names (sharded engines get their own so single- and
@@ -299,6 +334,12 @@ def _jitted_engine_fns(prefill_fn, step_fn, paged_prefill_fn, cfg,
         clear_row=jax.jit(clear_row),
         install_blocks=jax.jit(install_blocks, donate_argnums=(0,)),
         save_block=jax.jit(save_block),
+        kv_handoff_export=registry.instrument(
+            shard + "kv_handoff_export", jax.jit(kv_handoff_export),
+            n_dev),
+        kv_handoff_install=registry.instrument(
+            shard + "kv_handoff_install",
+            jax.jit(kv_handoff_install, donate_argnums=(0,)), n_dev),
         spec_verify=spec_verify, draft_propose=draft_propose,
         draft_prefill=draft_prefill)
     _JIT_CACHE[key] = fns
@@ -327,6 +368,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                          slo=None,
                          mesh=None,
                          spec_decode: Optional[SpecConfig] = None,
+                         role: str = "both",
+                         handoff_staged: bool = False,
                          config_overrides: Optional[Dict[str, Any]]
                          = None):
     """A serve Deployment generating continuations for int32
@@ -410,6 +453,25 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
     checks all k+1 positions, so at acceptance rate a the target runs
     ~1/(1 + a*k) dispatches per emitted token.  Greedy (temperature 0)
     spec output is bit-identical to the non-speculative engine.
+    role: disaggregated prefill/decode serving (round 18).  "both"
+    (default) is the monolithic engine.  "prefill" engines run the
+    admission + prefill machinery only and PARK at the handoff: when a
+    request's last chunk finishes, the filled KV block rows are
+    exported (one fixed-shape kv_handoff_export gather) and the
+    request's future resolves with a serve.batching.HandoffCursor
+    instead of tokens — the fleet router forwards it to a decode
+    replica.  "decode" engines accept those cursors through
+    ``admit_prefilled``: fresh blocks are allocated, the rows land via
+    one donated kv_handoff_install splice (block table + pos + start
+    set in the same dispatch), and decoding resumes at the prefill
+    replica's first token — bit-identical to the monolithic engine by
+    construction.  Both split roles require scheduler='continuous'
+    and kv_layout='paged'.
+    handoff_staged: force the staged D2H→H2D handoff hop (the general
+    cross-process path — export rows are pulled to host before the
+    decode-side install) even when prefill and decode replicas share
+    one process.  Default False keeps the same-process fast path,
+    where the exported rows stay device-resident end to end.
     checkpoint_path: pickled param pytree (matching the family's init
     layout); absent → fresh init from `seed` (tests/demos)."""
     if family not in ("gpt2", "llama"):
@@ -449,6 +511,25 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             raise ValueError(
                 f"kv_host_tier_bytes={kv_host_tier_bytes} must be a "
                 "positive byte budget")
+    if role not in ("both", "prefill", "decode"):
+        raise ValueError(f"unknown role {role!r} (expected 'both', "
+                         "'prefill', or 'decode')")
+    if role != "both":
+        if scheduler != "continuous":
+            raise ValueError(
+                f"role={role!r} requires scheduler='continuous' "
+                "(the handoff parks/admits through the slot-pool "
+                "engine loop)")
+        if kv_layout != "paged":
+            raise ValueError(
+                f"role={role!r} requires kv_layout='paged' (the "
+                "handoff moves block rows between pagers; dense rows "
+                "have no block-granular identity to hand off)")
+    if handoff_staged and role == "both":
+        raise ValueError(
+            "handoff_staged only applies to split roles "
+            "(role='prefill' exports through host staging; a "
+            "monolithic engine never hands off)")
     if mesh is not None and scheduler != "continuous":
         raise ValueError("mesh-sharded serving requires "
                          "scheduler='continuous' (the batch scheduler "
@@ -518,7 +599,11 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._telemetry = EngineTelemetry(
                 f"llm_{family}_{preset}",
                 max_slots=(max_slots if scheduler == "continuous"
-                           else max_batch_size))
+                           else max_batch_size),
+                role=role)
+            #: disaggregated serving role — the fleet router reads
+            #: this to type replicas ("prefill" | "decode" | "both")
+            self.role = role
             if scheduler == "batch":
                 self._generate = jax.jit(
                     lambda p, toks, k: gen_fn(
@@ -762,20 +847,47 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # sink): restores share ONE fixed-shape program, so
                 # the first real tier restore pays a copy inside its
                 # kv_fetch window, not a compile
+                from ray_tpu.serve.kv_tier import staging_buffers
+
                 maxn = cfg.max_seq // kv_block_size
                 row_shape = (maxn,) + self._cache["k"][:, 0].shape
                 row_dtype = self._cache["k"].dtype
                 # persistent host staging buffers for the restore path
                 # (ids, k rows, v rows) — refilled in place per
                 # restore instead of re-allocating pad arrays
-                self._tier_stage = (np.zeros((maxn,), np.int32),
-                                    np.zeros(row_shape, row_dtype),
-                                    np.zeros(row_shape, row_dtype))
+                self._tier_stage = staging_buffers(maxn, row_shape,
+                                                   row_dtype)
                 zr = jnp.zeros(row_shape, self._cache["k"].dtype)
                 self._cache = fns.install_blocks(
                     self._cache, jnp.zeros((maxn,), jnp.int32),
                     zr, zr)
                 jax.block_until_ready(self._cache["k"])
+            if self._pager is not None:
+                # handoff id staging buffer: role-split engines use it
+                # every handoff; a role="both" engine only if a caller
+                # feeds it packages via admit_prefilled directly
+                self._handoff_ids = np.zeros(
+                    (cfg.max_seq // kv_block_size,), np.int32)
+            if role != "both":
+                # disaggregated handoff: pre-compile this role's side
+                # of the block move with an all-pad call so the first
+                # real handoff pays a copy inside its handoff window,
+                # not an XLA compile (the tier-splice precompile
+                # discipline, applied to the new programs)
+                maxn = cfg.max_seq // kv_block_size
+                pad_ids = jnp.zeros((maxn,), jnp.int32)
+                if role == "prefill":
+                    k_rows, v_rows = fns.kv_handoff_export(
+                        self._cache, pad_ids)
+                    jax.block_until_ready(k_rows)
+                    del k_rows, v_rows
+                else:
+                    row_shape = (maxn,) + self._cache["k"][:, 0].shape
+                    zr = jnp.zeros(row_shape, self._cache["k"].dtype)
+                    self._cache = fns.kv_handoff_install(
+                        self._cache, pad_ids, zr, zr, np.int32(0),
+                        jnp.zeros((maxn,), jnp.int32), np.int32(0))
+                    jax.block_until_ready(self._cache["k"])
             # perf observatory: mirror process-wide program compile
             # events into this deployment's program-keyed recompile
             # counter (decode/sharded-decode shape churn visible, not
@@ -868,6 +980,13 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 if not free:
                     return
                 ((arr, rec, sp), fut), = self._queue.pop(1)
+                if isinstance(arr, HandoffCursor):
+                    # disaggregated handoff package from a prefill
+                    # replica — block-table splice, never a prefill
+                    if not self._admit_one_handoff(arr, rec, fut,
+                                                   free[0]):
+                        return      # pool exhausted — retry later
+                    continue
                 n = int(arr.shape[0])
                 if n == 0 or n + max_new_tokens > self.cfg.max_seq:
                     self._telemetry.record_reject(
@@ -1095,6 +1214,15 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         [arr, np.asarray([first], np.int32)]))
                 self._retire_paged_row(slot, blocks)
                 return True
+            if role == "prefill":
+                # disaggregated serving: the request's decode belongs
+                # to a decode replica — export the filled block rows,
+                # resolve the future with a HandoffCursor package, and
+                # free this replica's row/blocks (registered full
+                # blocks park in the LRU, keeping the prefix warm)
+                self._handoff_out(slot, arr, rec, sp, fut, blocks,
+                                  first)
+                return True
             self._cur[slot] = first
             self._slots[slot] = {"prompt": arr, "out": [first],
                                  "fut": fut, "rec": rec, "sp": sp,
@@ -1132,6 +1260,141 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             self._cache = self._clear_row(self._cache, np.int32(slot))
             self._pager.release(blocks)
             self._telemetry.record_kv_stats(self._pager.stats())
+
+        def _handoff_out(self, slot, arr, rec, sp, fut, blocks,
+                         first) -> None:
+            """Prefill-role park: export the request's filled block
+            rows and resolve its future with a `HandoffCursor` package
+            the router forwards to a decode replica.  The fast path
+            keeps the rows on device (same-process handoff is a
+            device-side gather the install splices straight back); the
+            staged path pulls them to host so the package can cross a
+            process/host boundary as a D2H→H2D hop.  Either way the
+            rows are the EXACT bytes prefill wrote — the decode-side
+            splice re-creates the monolithic engine's post-prefill
+            cache state bit-for-bit.  This replica's row and blocks
+            are freed immediately; registered full blocks park in the
+            pager LRU, so the prefix index stays warm for
+            prefix-affinity admissions."""
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+
+            n = int(arr.shape[0])
+            n_blk = -(-n // kv_block_size)
+            ids = self._handoff_ids
+            ids[:] = 0
+            ids[:n_blk] = blocks[:n_blk]
+            t0 = _time.perf_counter()
+            k_rows, v_rows = self._fns.kv_handoff_export(
+                self._cache, jnp.asarray(ids))
+            if handoff_staged:
+                k_rows, v_rows = jax.device_get((k_rows, v_rows))
+                path = "staged"
+            else:
+                # fence so the export window is real device time, not
+                # just the dispatch (the tier d2h discipline)
+                jax.block_until_ready(k_rows)
+                path = "fast"
+            t1 = _time.perf_counter()
+            nbytes = self._pager.bytes_per_block * n_blk
+            # the decode replica's telemetry record is pre-populated
+            # from this meta so the merged request anatomy keeps ONE
+            # unbroken clock: router enqueue → prefill → handoff →
+            # decode, with the critical path still summing to e2e
+            meta = {
+                "prompt_len": n,
+                "enqueue": rec["enqueue"],
+                "engine_enqueue": rec["engine_enqueue"],
+                "admit": rec["admit"],
+                "first_token": rec["first_token"],
+                "bucket": rec["bucket"],
+                "requeues": rec.get("requeues", 0),
+                "requeue_ts": rec.get("requeue_ts"),
+                "kv_reserve": rec.get("kv_reserve"),
+                "kv_fetch": rec.get("kv_fetch"),
+                "prefill_chunks": rec.get("prefill_chunks"),
+                "tenant": rec.get("tenant"),
+                "ctx": rec.get("ctx"),
+            }
+            pkg = HandoffCursor(
+                prompt=arr, first_token=int(first), n_tokens=n,
+                n_blocks=n_blk, k_rows=k_rows, v_rows=v_rows,
+                nbytes=nbytes, path=path, t_export0=t0, t_export1=t1,
+                meta=meta, sampling=sp)
+            self._telemetry.record_handoff_out(
+                rec, blocks=n_blk, nbytes=nbytes, path=path)
+            self._retire_paged_row(slot, blocks)
+            if not fut.done():
+                fut.set_result(pkg)
+
+        def _admit_one_handoff(self, pkg, rec, fut, slot) -> bool:
+            """Decode-role admission of a prefilled handoff package:
+            allocate a fresh block chain, splice the exported rows +
+            table/pos/start into this replica's pool in one donated
+            dispatch, and enter decode at the package's first token.
+            `pos = prompt_len`, `start = 0` — exactly the state
+            `paged_prefill` leaves — so the first decode step here is
+            bit-identical to the monolithic engine by construction.
+            Returns False when the pool cannot hold the chain yet
+            (package requeued at the head, admission pauses)."""
+            import time as _time
+
+            import jax
+            import jax.numpy as jnp
+
+            pager = self._pager
+            arr = pkg.prompt
+            n = int(pkg.n_tokens)
+            ctx = rec.get("ctx")
+            pager.set_request(rec["id"],
+                              ctx.trace_id if ctx is not None else None,
+                              tenant=rec.get("tenant"))
+            need = pager.blocks_needed(
+                n, max_new_tokens,
+                headroom=spec_decode.k if spec_decode is not None
+                else 0)
+            alloc = pager.allocate(need)
+            if alloc is None:
+                pager.set_request(None)
+                self._telemetry.record_requeue(
+                    rec, need=need, reason="handoff_pool_exhausted")
+                self._queue.push_front((pkg, rec, pkg.sampling), fut)
+                return False
+            n_blk = int(pkg.n_blocks)
+            ids = self._handoff_ids
+            ids[:] = 0
+            ids[:n_blk] = alloc[:n_blk]
+            row_bt = np.zeros((self.cfg.max_seq // kv_block_size,),
+                              np.int32)
+            row_bt[:need] = alloc
+            self._cache = self._fns.kv_handoff_install(
+                self._cache, jnp.asarray(ids),
+                jnp.asarray(pkg.k_rows), jnp.asarray(pkg.v_rows),
+                np.int32(slot), jnp.asarray(row_bt), np.int32(n))
+            # fence: the handoff window must time the transfer+splice,
+            # not the dispatch (the tier-restore h2d discipline)
+            jax.block_until_ready(self._cache["k"])
+            t_done = _time.perf_counter()
+            pkg.installed = True
+            # index the imported full blocks so later prompts sharing
+            # the prefix hit HERE — the router's prefix-affinity stage
+            # then skips prefill entirely for them
+            pager.note_handoff_import(arr.tolist(), alloc)
+            pager.set_request(None)
+            self._telemetry.record_kv_handoff(
+                rec, pkg.t_export0, t_done, blocks=n_blk,
+                nbytes=int(pkg.nbytes), path=pkg.path)
+            self._telemetry.record_admit_handoff(rec, slot)
+            first = int(pkg.first_token)
+            self._cur[slot] = first
+            self._slots[slot] = {"prompt": arr, "out": [first],
+                                 "fut": fut, "rec": rec,
+                                 "sp": pkg.sampling, "blocks": alloc}
+            self._draft_admit(slot, arr)
+            self._telemetry.record_kv_stats(pager.stats())
+            return True
 
         def _prefill_chunk_step(self, candidates) -> None:
             """Run AT MOST ONE chunk of pending prefill — the engine
@@ -1236,6 +1499,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         [arr, np.asarray([first], np.int32)]))
                 self._slots[i] = None
                 self._retire_paged_row(i, blocks)
+                return
+            if role == "prefill":
+                # chunked long prompts hand off too: the last chunk's
+                # filled rows move wholesale, so a 32k prompt never
+                # decodes on the prefill replica it streamed through
+                self._slots[i] = None
+                self._handoff_out(i, arr, rec, st["sp"], fut, blocks,
+                                  first)
                 return
             self._cur[i] = first
             st["state"] = "decode"
@@ -1544,6 +1815,44 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 int(arr.shape[0]), now=enqueue_ts, tenant=tenant,
                 ctx=trace)
             fut = self._queue.put((arr, rec, sp))
+            self._wake.set()
+            return await fut
+
+        async def admit_prefilled(self, pkg):
+            """Second-stage entry point for disaggregated serving: the
+            fleet router forwards a prefill replica's `HandoffCursor`
+            package here.  The package's telemetry meta seeds a record
+            that keeps the request's original enqueue/admit/TTFT
+            clock, so the merged anatomy spans both replicas with one
+            unbroken critical path.  Decode starts from the package's
+            first token after the block splice — no prefill runs on
+            this engine for the request."""
+            import asyncio
+
+            if role == "prefill":
+                raise ValueError(
+                    "admit_prefilled needs a decode-capable engine "
+                    "(role='decode' or 'both'); this replica is "
+                    "role='prefill'")
+            if self._pager is None:
+                raise ValueError(
+                    "admit_prefilled requires kv_layout='paged'")
+            if not isinstance(pkg, HandoffCursor):
+                raise ValueError(
+                    "admit_prefilled takes a HandoffCursor, got "
+                    f"{type(pkg).__name__}")
+            if pkg.sampling is not None and spec_decode is not None:
+                raise ValueError(
+                    "per-request sampling overrides are not "
+                    "supported with spec_decode (the verify program "
+                    "bakes in ONE sampling config)")
+            if self._wake is None:
+                self._wake = asyncio.Event()
+            if self._engine_task is None or self._engine_task.done():
+                self._engine_task = asyncio.get_running_loop(
+                ).create_task(self._engine())
+            rec = self._telemetry.record_enqueue_handoff(pkg.meta)
+            fut = self._queue.put((pkg, rec, pkg.sampling))
             self._wake.set()
             return await fut
 

@@ -58,6 +58,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ray_tpu._private import telemetry as _core
+from ray_tpu.serve.batching import HandoffCursor
 from ray_tpu.serve.slo import worst_burn_rate
 from ray_tpu.serve.telemetry import (EngineTelemetry, TraceContext,
                                      _tracebus_enabled, latency_anatomy,
@@ -176,16 +177,28 @@ class AutoscalePolicy:
 
 
 class ReplicaHandle:
-    """Router-side view of one engine replica: identity, outstanding
-    count, drain flag, and the latest prefix-key export."""
+    """Router-side view of one engine replica: identity, role,
+    outstanding count, drain flag, and the latest prefix-key
+    export."""
 
     def __init__(self, name: str, inst: Any):
         self.name = name
         self.inst = inst
+        #: "both" (monolithic), "prefill", or "decode" — read off the
+        #: engine so the router's two-stage scheduler and the fleet's
+        #: role-aware pooling never guess from names
+        self.role = str(getattr(inst, "role", "both"))
         self.inflight = 0
         self.routed = 0
         self.draining = False
         self._keys: frozenset = frozenset()
+
+    def free_blocks(self) -> int:
+        """Blocks this replica's pager could allocate right now — the
+        handoff target score (a decode replica must hold the whole
+        chain, so free-block headroom beats raw request count)."""
+        pager = getattr(self.inst, "_pager", None)
+        return int(pager.available) if pager is not None else 0
 
     def refresh_metadata(self) -> None:
         """Pull the replica's resident prefix keys (the BlockPager
@@ -246,7 +259,9 @@ class LLMRouter:
         self._ids = itertools.count()
         self.telemetry = telemetry or EngineTelemetry(name)
         self.routed_by_policy = {"prefix_affinity": 0, "p2c": 0,
-                                 "round_robin": 0}
+                                 "round_robin": 0, "disagg_prefill": 0}
+        #: completed second-stage moves (prefill → decode replica)
+        self.handoffs = 0
 
     # -- introspection -------------------------------------------------
 
@@ -294,11 +309,40 @@ class LLMRouter:
 
     # -- dispatch ------------------------------------------------------
 
-    def _candidates(self) -> List[ReplicaHandle]:
-        live = self.live_replicas
+    def _candidates(self, reps: Optional[List[ReplicaHandle]] = None
+                    ) -> List[ReplicaHandle]:
+        live = self.live_replicas if reps is None \
+            else [r for r in reps if not r.draining]
         if self._cap is None:
             return live
         return [r for r in live if r.inflight < self._cap]
+
+    @property
+    def disaggregated(self) -> bool:
+        return any(r.role == "prefill" for r in self.live_replicas)
+
+    def _pick_disagg(self, tokens: Tuple[int, ...],
+                     pre: List[ReplicaHandle],
+                     dec: List[ReplicaHandle]
+                     ) -> Tuple[ReplicaHandle, str, int]:
+        """Stage one of disaggregated routing.  Prefix affinity still
+        wins, and it wins BIGGER here: a decode replica already
+        holding the prompt's prefix blocks serves the request whole —
+        its paged prefill of the unmatched tail is exactly the work a
+        handoff would have shipped over, so the prefill fleet is
+        skipped entirely.  Otherwise the request admits to the
+        least-loaded prefill replica and rides the handoff path."""
+        if self.policy == "prefix":
+            best, best_match = None, 0
+            for rep in dec:
+                rep.refresh_metadata()
+                m = rep.prefix_match(tokens, self._block_size)
+                if m > best_match:
+                    best, best_match = rep, m
+            if best is not None:
+                return best, "prefix_affinity", best_match
+        rep = min(pre, key=lambda r: r.inflight)
+        return rep, "disagg_prefill", 0
 
     def _pick(self, tokens: Tuple[int, ...],
               cands: List[ReplicaHandle]
@@ -326,7 +370,18 @@ class LLMRouter:
         Synchronous and re-entrant-safe: called on submit, on every
         completion, and when the replica set changes."""
         while self.queue_depth() > 0:
-            cands = self._candidates()
+            live = self.live_replicas
+            pre = [r for r in live if r.role == "prefill"]
+            if pre:
+                # two-stage disaggregated dispatch gates on prefill
+                # capacity (the handoff target is chosen later, when
+                # the package exists and free-block counts are fresh)
+                cands = self._candidates(pre)
+                dec = [r for r in live
+                       if r.role in ("decode", "both")]
+            else:
+                cands = self._candidates()
+                dec = []
             if not cands:
                 return
             if self._wfq is not None:
@@ -335,7 +390,11 @@ class LLMRouter:
                 item = self._fifo.popleft()
             arr, tenant, sampling, t_submit, fut, rid, ctx = item
             tokens = tuple(int(t) for t in arr)
-            rep, policy, matched = self._pick(tokens, cands)
+            if pre:
+                rep, policy, matched = self._pick_disagg(
+                    tokens, cands, dec)
+            else:
+                rep, policy, matched = self._pick(tokens, cands)
             self.routed_by_policy[policy] += 1
             if ctx is not None:
                 # the router hop: submit → dispatch, with the routing
@@ -353,23 +412,69 @@ class LLMRouter:
             rep.routed += 1
             asyncio.get_running_loop().create_task(
                 self._dispatch(rep, arr, tenant, sampling, t_submit,
-                               fut, ctx))
+                               fut, ctx, rid))
+
+    def _pick_handoff_target(self) -> ReplicaHandle:
+        """Stage two: the decode replica to install a handoff package
+        on — most free pager blocks first (the install must hold the
+        request's WHOLE chain), outstanding slots break ties.  A
+        package may exceed the inflight cap: the request already won
+        its admission at stage one, and the decode engine's own
+        queue/requeue machinery absorbs any wait."""
+        dec = [r for r in self.live_replicas
+               if r.role in ("decode", "both")]
+        if not dec:
+            raise RuntimeError(
+                "no live decode replicas to hand off to")
+        under = [r for r in dec
+                 if self._cap is None or r.inflight < self._cap]
+        pool = under or dec
+        return max(pool, key=lambda r: (r.free_blocks(), -r.inflight))
+
+    async def _forward_handoff(self, pkg, tenant, ctx, rid: int):
+        rep = self._pick_handoff_target()
+        self.telemetry.record_route(
+            req=rid, replica=rep.name, policy="handoff",
+            tenant=tenant, matched_blocks=int(pkg.n_blocks),
+            outstanding=rep.inflight,
+            **({"trace": ctx.trace_id} if ctx is not None else {}))
+        rep.inflight += 1
+        rep.routed += 1
+        try:
+            out = await rep.inst.admit_prefilled(pkg)
+            self.handoffs += 1
+            return out
+        finally:
+            rep.inflight -= 1
+            self._pump()
 
     async def _dispatch(self, rep: ReplicaHandle, arr, tenant,
                         sampling, t_submit: float, fut,
-                        ctx=None) -> None:
+                        ctx=None, rid: int = -1) -> None:
+        released = False
         try:
             out = await rep.inst(arr, sampling=sampling,
                                  tenant=tenant, enqueue_ts=t_submit,
                                  trace=ctx)
+            if isinstance(out, HandoffCursor):
+                # prefill replica parked the request and freed its
+                # slot — release stage-one capacity NOW, before the
+                # decode leg, or the prefill fleet would stall for
+                # the whole generation
+                rep.inflight -= 1
+                released = True
+                self._pump()
+                out = await self._forward_handoff(out, tenant, ctx,
+                                                  rid)
             if not fut.done():
                 fut.set_result(out)
         except Exception as e:  # noqa: BLE001 - surface to caller
             if not fut.done():
                 fut.set_exception(e)
         finally:
-            rep.inflight -= 1
-            self._pump()
+            if not released:
+                rep.inflight -= 1
+                self._pump()
 
     # -- drain ---------------------------------------------------------
 
@@ -401,6 +506,8 @@ class LLMRouter:
             "queue_depth": self.queue_depth(),
             "inflight": self.total_inflight(),
             "routed_by_policy": dict(self.routed_by_policy),
+            "disaggregated": self.disaggregated,
+            "handoffs": self.handoffs,
             "max_inflight_per_replica": self._cap,
             "tenants": {n: {"weight": t.weight,
                             "objective": t.objective,
@@ -432,20 +539,31 @@ class LLMFleet:
                  policy: str = "prefix", wfq: bool = True,
                  autoscale: Optional[AutoscalePolicy] = None,
                  max_inflight_per_replica: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 prefill_factory: Optional[Callable[[], Any]] = None,
+                 num_prefill_replicas: int = 0):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        if (prefill_factory is None) != (num_prefill_replicas == 0):
+            raise ValueError(
+                "prefill_factory and num_prefill_replicas must be "
+                "given together (a disaggregated fleet needs both)")
         self.name = name
         self._factory = factory
+        self._prefill_factory = prefill_factory
         self.telemetry = EngineTelemetry(name)
         self._replicas: List[ReplicaHandle] = []
         self._retired: List[ReplicaHandle] = []
         self._next_replica = itertools.count()
+        self._next_prefill = itertools.count()
         self.autoscale_policy = autoscale or AutoscalePolicy()
         self._breach_since: Optional[float] = None
         self._idle_since: Optional[float] = None
         self._last_up: Optional[float] = None
         self._last_down: Optional[float] = None
+        # prefill replicas first so fleet listings read topology order
+        for _ in range(int(num_prefill_replicas)):
+            self._add_replica(prefill=True)
         for _ in range(num_replicas):
             self._add_replica()
         self.router = LLMRouter(
@@ -461,9 +579,15 @@ class LLMFleet:
     def num_replicas(self) -> int:
         return len([r for r in self._replicas if not r.draining])
 
-    def _add_replica(self) -> ReplicaHandle:
-        rep = ReplicaHandle(f"{self.name}/r{next(self._next_replica)}",
-                            self._factory())
+    def _add_replica(self, prefill: bool = False) -> ReplicaHandle:
+        if prefill:
+            rep = ReplicaHandle(
+                f"{self.name}/p{next(self._next_prefill)}",
+                self._prefill_factory())
+        else:
+            rep = ReplicaHandle(
+                f"{self.name}/r{next(self._next_replica)}",
+                self._factory())
         self._replicas.append(rep)
         return rep
 
@@ -539,7 +663,10 @@ class LLMFleet:
         if not (sustained and cooled and n > p.min_replicas):
             return None
         live = [r for r in self._replicas if not r.draining]
-        victim = min(reversed(live), key=lambda r: r.inflight)
+        # never drain the prefill fleet on idle — role counts are the
+        # operator's chip-split decision, not an autoscaler signal
+        decodable = [r for r in live if r.role != "prefill"] or live
+        victim = min(reversed(decodable), key=lambda r: r.inflight)
         idle_for = now - self._idle_since
         self._idle_since = None
         self._last_down = now
@@ -618,9 +745,21 @@ class LLMFleet:
         waste_by_tenant: Dict[str, int] = {}
         occ_by_replica: Dict[str, float] = {}
         occ_p95s: List[float] = []
+        # role-aware occupancy pooling: a decode pool's occupancy is a
+        # capacity signal (whole resident chains), a prefill pool's is
+        # churn (blocks park in the LRU the moment a handoff leaves) —
+        # averaging them together would report a meaningless blend
+        occ_by_role: Dict[str, List[float]] = {}
+        occ_p95_by_role: Dict[str, List[float]] = {}
+        handoff = {"handoffs_out": 0, "handoffs_in": 0,
+                   "blocks_moved": 0, "fast_path": 0, "staged": 0,
+                   "requeues": 0}
         replicas = {}
         for rep in self._replicas + self._retired:
             st = rep.engine_stats()
+            for k, v in (st.get("handoff") or {}).items():
+                if k in handoff:
+                    handoff[k] += int(v)
             kv = st.get("kv_cache") or {}
             hits += int(kv.get("prefix_block_hits", 0))
             misses += int(kv.get("prefix_block_misses", 0))
@@ -641,6 +780,10 @@ class LLMFleet:
             occ_by_replica[rep.name] = float(
                 occ.get("occupancy_ratio", 0.0))
             occ_p95s.append(float(occ.get("occupancy_p95", 0.0)))
+            occ_by_role.setdefault(rep.role, []).append(float(
+                occ.get("occupancy_ratio", 0.0)))
+            occ_p95_by_role.setdefault(rep.role, []).append(float(
+                occ.get("occupancy_p95", 0.0)))
             kt = st.get("kv_tier") or {}
             if kt.get("enabled"):
                 tier_enabled = True
@@ -649,12 +792,14 @@ class LLMFleet:
                     if k.endswith("_ms") else tier[k] + int(kt.get(k)
                                                            or 0)
             replicas[rep.name] = {
+                "role": rep.role,
                 "draining": rep.draining,
                 "retired": rep in self._retired,
                 "inflight": rep.inflight,
                 "routed": rep.routed,
                 "requests": st.get("requests"),
                 "kv_cache": kv,
+                "handoff": st.get("handoff"),
                 "slo_breached": (st.get("slo") or {}).get("breached")
                 if st.get("slo") else None,
             }
@@ -673,7 +818,14 @@ class LLMFleet:
             if occ_vals else 0.0,
             # worst replica's ring p95 — the fleet headline occupancy
             # number (an average would hide one pool running hot)
-            occupancy_p95=max(occ_p95s) if occ_p95s else 0.0)
+            occupancy_p95=max(occ_p95s) if occ_p95s else 0.0,
+            occupancy_by_role={
+                role: {
+                    "mean": round(sum(vals) / len(vals), 4),
+                    "max": max(vals),
+                    "p95": max(occ_p95_by_role.get(role) or [0.0]),
+                }
+                for role, vals in occ_by_role.items() if vals})
         tier_probes = tier["hits"] + tier["misses"]
         kv_tier = dict(
             tier, enabled=tier_enabled,
@@ -690,6 +842,7 @@ class LLMFleet:
             "prefill_chunks": chunks,
             "kv_scope": kv_scope,
             "kv_tier": kv_tier,
+            "handoff": handoff,
             "tenants": self.tenant_report(),
             "replicas": replicas,
             "flightrec": self.telemetry.flightrec.stats(),
@@ -757,31 +910,89 @@ class LLMFleet:
 
 def build_llm_fleet(family: str = "gpt2", preset: str = "nano", *,
                     num_replicas: int = 2,
+                    num_prefill_replicas: Optional[int] = None,
+                    num_decode_replicas: Optional[int] = None,
+                    prefill_engine_kw: Optional[Dict[str, Any]] = None,
+                    decode_engine_kw: Optional[Dict[str, Any]] = None,
+                    handoff_staged: bool = False,
                     tenants: Optional[Sequence[TenantClass]] = None,
                     routing: str = "prefix", wfq: bool = True,
                     autoscale: Optional[AutoscalePolicy] = None,
                     max_inflight_per_replica: Optional[int] = None,
                     fleet_name: Optional[str] = None, seed: int = 0,
                     **engine_kw) -> LLMFleet:
-    """Stand up `num_replicas` independent continuous-engine replicas
-    (each its own jitted programs / BlockPager / SLOTracker) behind an
-    `LLMRouter`.  `engine_kw` is forwarded to `build_llm_deployment`;
-    the continuous scheduler and paged KV layout are forced on (prefix
-    routing needs the pager's key export — a dense-layout fleet would
-    route by load only).  `max_inflight_per_replica` defaults to the
-    engine's `max_slots`, keeping any backlog at the router where WFQ
-    can reorder it."""
+    """Stand up independent continuous-engine replicas (each its own
+    jitted programs / BlockPager / SLOTracker) behind an `LLMRouter`.
+    `engine_kw` is forwarded to `build_llm_deployment`; the continuous
+    scheduler and paged KV layout are forced on (prefix routing needs
+    the pager's key export — a dense-layout fleet would route by load
+    only).  `max_inflight_per_replica` defaults to the engine's
+    `max_slots`, keeping any backlog at the router where WFQ can
+    reorder it.
+
+    Homogeneous by default (`num_replicas` role="both" engines).
+    Setting BOTH `num_prefill_replicas` and `num_decode_replicas`
+    builds a DISAGGREGATED fleet instead: role-typed replica sets with
+    block-granular KV handoff (docs/serve.md#disaggregated-serving) —
+    the router admits to the least-loaded prefill replica, the prefill
+    engine exports the filled block rows at last-chunk completion, and
+    a decode replica chosen by free-block headroom splices them in and
+    finishes the generation.  `prefill_engine_kw` / `decode_engine_kw`
+    overlay per-role engine knobs (mesh degree, batch shape, slot
+    count: `mesh`, `prefill_bucket`, `max_slots`, `kv_num_blocks`, …)
+    on top of the shared `engine_kw`; `kv_block_size` must stay equal
+    across roles — the handoff moves whole blocks.  `handoff_staged`
+    forces the D2H→H2D host-staging hop (the cross-process path) even
+    in-process.  `spec_decode` applies to decode replicas only
+    (drafting is decode-side work)."""
     from ray_tpu.serve.llm import build_llm_deployment
 
     engine_kw.setdefault("scheduler", "continuous")
     engine_kw.setdefault("kv_layout", "paged")
+    name = fleet_name or f"fleet_{family}_{preset}"
+    disagg = (num_prefill_replicas is not None
+              or num_decode_replicas is not None)
+    if disagg:
+        if not (num_prefill_replicas and num_decode_replicas):
+            raise ValueError(
+                "a disaggregated fleet needs BOTH "
+                "num_prefill_replicas and num_decode_replicas >= 1, "
+                f"got {num_prefill_replicas}/{num_decode_replicas}")
+        pre_kw = dict(engine_kw)
+        pre_kw.update(prefill_engine_kw or {})
+        # drafting is decode-side work; the prefill replica's first
+        # token is the same with or without a draft model
+        pre_kw.pop("spec_decode", None)
+        pre_kw.update(role="prefill", handoff_staged=handoff_staged)
+        dec_kw = dict(engine_kw)
+        dec_kw.update(decode_engine_kw or {})
+        dec_kw["role"] = "decode"
+        bs_pre = int(pre_kw.get("kv_block_size", 16))
+        bs_dec = int(dec_kw.get("kv_block_size", 16))
+        if bs_pre != bs_dec:
+            raise ValueError(
+                "kv_block_size must match across roles (the handoff "
+                f"moves whole blocks), got prefill={bs_pre} "
+                f"decode={bs_dec}")
+        pre_dep = build_llm_deployment(family, preset, **pre_kw)
+        dec_dep = build_llm_deployment(family, preset, **dec_kw)
+        if max_inflight_per_replica is None:
+            max_inflight_per_replica = int(dec_kw.get("max_slots", 4))
+        return LLMFleet(
+            dec_dep.func_or_class, int(num_decode_replicas),
+            prefill_factory=pre_dep.func_or_class,
+            num_prefill_replicas=int(num_prefill_replicas),
+            name=name, block_size=bs_dec, tenants=tenants,
+            policy=routing, wfq=wfq, autoscale=autoscale,
+            max_inflight_per_replica=max_inflight_per_replica,
+            seed=seed)
     max_slots = int(engine_kw.get("max_slots", 4))
     if max_inflight_per_replica is None:
         max_inflight_per_replica = max_slots
     dep = build_llm_deployment(family, preset, **engine_kw)
     return LLMFleet(
         dep.func_or_class, num_replicas,
-        name=fleet_name or f"fleet_{family}_{preset}",
+        name=name,
         block_size=int(engine_kw.get("kv_block_size", 16)),
         tenants=tenants, policy=routing, wfq=wfq,
         autoscale=autoscale,

@@ -254,7 +254,7 @@ class TraceContext:
 #: ``e2e_ms`` exactly (modulo float rounding) by construction.
 CRITICAL_PATH_COMPONENTS = (
     "router_wait_ms", "queue_wait_ms", "requeue_ms", "kv_fetch_ms",
-    "prefill_ms", "prefill_wait_ms", "inter_token_ms",
+    "prefill_ms", "prefill_wait_ms", "handoff_ms", "inter_token_ms",
     "spec_rollback_ms")
 
 
@@ -262,7 +262,7 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Decompose one completed request's e2e latency:
 
         e2e = router_wait + queue_wait + requeue + kv_fetch + prefill
-              + prefill_wait + inter_token + spec_rollback
+              + prefill_wait + handoff + inter_token + spec_rollback
 
     * router_wait — submit → engine enqueue (0 without a router);
     * queue_wait  — engine enqueue → admit, minus time spent requeued
@@ -275,8 +275,12 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     * prefill_wait — the rest of admit → first token: time a chunked
       prefill spent parked between chunks while decode waves ran
       (exactly 0 for one-shot prefill);
+    * handoff     — disaggregated serving only: prefill-side KV
+      export → decode-side block install (serve/router.py two-stage
+      dispatch), carved out of the decode leg it delays (exactly 0
+      for monolithic engines);
     * inter_token — Σ inter-token gaps (first token → finish), minus
-      the estimated rollback share below;
+      the estimated rollback share below and the handoff window;
     * spec_rollback — decode time attributed to rejected draft
       positions in speculative verify rounds.
 
@@ -327,6 +331,16 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     decode = fin - first
     rollback = min(max(0.0, float(rec.get("spec_rollback_s") or 0.0)),
                    decode)
+    # disaggregated handoff: the export→install window sits between
+    # the prefill replica's first token and the decode replica's first
+    # decode wave, so it is carved out of the decode leg it delayed
+    # (clamped into [first, finish] like every other component)
+    handoff = 0.0
+    kh = rec.get("kv_handoff")
+    if kh is not None:
+        handoff = min(max(0.0, min(float(kh[1]), fin)
+                          - max(float(kh[0]), first)),
+                      decode - rollback)
     ms = 1e3
     return {
         "e2e_ms": round(e2e * ms, 4),
@@ -336,7 +350,8 @@ def critical_path(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         "kv_fetch_ms": round(kv_fetch * ms, 4),
         "prefill_ms": round(prefill * ms, 4),
         "prefill_wait_ms": round(prefill_wait * ms, 4),
-        "inter_token_ms": round((decode - rollback) * ms, 4),
+        "handoff_ms": round(handoff * ms, 4),
+        "inter_token_ms": round((decode - rollback - handoff) * ms, 4),
         "spec_rollback_ms": round(rollback * ms, 4),
     }
 
@@ -388,6 +403,8 @@ def request_snapshot(rec: Dict[str, Any],
         "kv_reserve": list(kv) if kv is not None else None,
         "kv_fetch": (list(rec["kv_fetch"])
                      if rec.get("kv_fetch") is not None else None),
+        "kv_handoff": (list(rec["kv_handoff"])
+                       if rec.get("kv_handoff") is not None else None),
         "prefill_chunks": ([list(c) for c in rec["prefill_chunks"]]
                            if rec.get("prefill_chunks") else None),
         "spans": ([dict(s) for s in ctx.spans]
@@ -444,9 +461,13 @@ class EngineTelemetry:
     production callers omit it."""
 
     def __init__(self, deployment: str, max_slots: int = 0,
-                 history: int = 4096):
+                 history: int = 4096, role: str = "both"):
         self.deployment = deployment
         self.max_slots = int(max_slots)
+        #: disaggregated serving role ("prefill" | "decode" | "both");
+        #: surfaced as engine_stats()["role"] so fleet pooling can
+        #: keep decode-pool occupancy apart from prefill pools
+        self.role = str(role)
         self._m = _engine_metrics()
         self._tags = {"deployment": deployment}
         self._lock = threading.Lock()
@@ -488,6 +509,14 @@ class EngineTelemetry:
         #: block-sized chunks interleaved with decode waves
         self._chunks = {"requests": 0, "chunks": 0, "tokens": 0,
                         "max_chunks": 0}
+        #: round-18 disaggregated serving: block-granular KV handoffs
+        #: between prefill and decode replicas.  Kept OUT of `_counts`
+        #: (that dict's keys are a stable "requests" schema contract);
+        #: handoffs_out books on the prefill side, everything else on
+        #: the decode side.
+        self._handoff = {"handoffs_out": 0, "handoffs_in": 0,
+                         "blocks_moved": 0, "fast_path": 0,
+                         "staged": 0, "requeues": 0}
         #: round-12 flight recorder: every lifecycle transition below
         #: also journals a compact decision event (one deque append)
         #: so postmortems can replay what the engine DID, not just its
@@ -704,6 +733,11 @@ class EngineTelemetry:
         rec["requeues"] = rec.get("requeues", 0) + 1
         if rec.get("requeue_ts") is None:
             rec["requeue_ts"] = now
+        if reason.startswith("handoff"):
+            # decode-side pool exhaustion bouncing an arriving handoff
+            # back to the queue head — surfaced in the handoff block
+            with self._lock:
+                self._handoff["requeues"] += 1
         self.flightrec.record(
             "requeue", ts=now, req=rec["id"], need=int(need),
             reason=reason, **self._trace_tag(rec))
@@ -773,6 +807,124 @@ class EngineTelemetry:
             "prefill_chunk", ts=end, req=rec["id"],
             chunk=len(chunks) - 1, tokens=int(tokens),
             bucket=int(bucket), last=bool(last),
+            dur_ms=round((end - start) * 1e3, 3),
+            **self._trace_tag(rec))
+
+    # -- disaggregated prefill/decode handoff (round 18) -------------------
+
+    def record_handoff_out(self, rec: Dict[str, Any], blocks: int = 0,
+                           nbytes: int = 0, path: str = "fast",
+                           now: Optional[float] = None) -> None:
+        """Prefill-side retirement of a handed-off request: this
+        engine finished the prompt's last chunk, exported the filled
+        KV block rows, and the DECODE replica now owns the request's
+        lifecycle.  The record leaves the active set but is NOT
+        retired into ``_done`` and books none of the request counters
+        — the decode-side record (``record_enqueue_handoff``) is the
+        authoritative one, and keeping a second first-token-stamped
+        record here would double-count TTFT/e2e in fleet pooling."""
+        now = self._now(now)
+        rec["finish"] = now
+        rec["status"] = "handoff"
+        with self._lock:
+            self._handoff["handoffs_out"] += 1
+            if rec["admit"] is None:
+                self._queue_depth = max(0, self._queue_depth - 1)
+            self._active.pop(rec["id"], None)
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+        self.flightrec.record(
+            "handoff_out", ts=now, req=rec["id"], blocks=int(blocks),
+            bytes=int(nbytes), path=str(path), **self._trace_tag(rec))
+
+    def record_enqueue_handoff(self, meta: Dict[str, Any],
+                               now: Optional[float] = None
+                               ) -> Dict[str, Any]:
+        """Decode-side record for an arriving pre-filled request.  The
+        record is pre-populated with the PREFILL replica's timing
+        (enqueue/admit/first-token/chunk windows travel with the
+        handoff package) so the critical-path decomposition of the
+        finished request reads exactly like a monolithic engine's —
+        queue wait is the prefill queue, the prefill leg is the chunk
+        windows, and the extra export→install cost shows up ONLY as
+        the new ``handoff_ms`` component carved from the decode leg."""
+        now = self._now(now)
+        ctx = meta.get("ctx")
+        rec: Dict[str, Any] = {
+            "id": next(self._ids),
+            "prompt_len": int(meta.get("prompt_len", 0)),
+            "enqueue": meta.get("enqueue", now),
+            "engine_enqueue": meta.get("engine_enqueue",
+                                       meta.get("enqueue", now)),
+            "admit": meta.get("admit"),
+            "first_token": meta.get("first_token"),
+            "finish": None, "slot": None,
+            "bucket": meta.get("bucket"), "tokens": 1,
+            "spec_proposed": 0, "spec_accepted": 0,
+            "spec_rounds": 0, "spec_rollback_s": 0.0,
+            "requeues": int(meta.get("requeues", 0)),
+            "requeue_ts": meta.get("requeue_ts"),
+            "kv_reserve": meta.get("kv_reserve"),
+            "kv_fetch": meta.get("kv_fetch"),
+            "kv_handoff": None,
+            "prefill_chunks": meta.get("prefill_chunks"),
+            "token_ts": ([meta["first_token"]]
+                         if ctx is not None
+                         and meta.get("first_token") is not None
+                         else ([] if ctx is not None else None)),
+            "status": "queued", "trace": None,
+            "tenant": meta.get("tenant"), "ctx": ctx,
+        }
+        with self._lock:
+            self._counts["enqueued"] += 1
+            self._handoff["handoffs_in"] += 1
+            self._queue_depth += 1
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+        self.flightrec.record(
+            "handoff_in", ts=now, req=rec["id"],
+            prompt_len=rec["prompt_len"], **self._trace_tag(rec))
+        return rec
+
+    def record_admit_handoff(self, rec: Dict[str, Any], slot: int,
+                             now: Optional[float] = None) -> None:
+        """Admit an arriving handoff into a decode slot.  Unlike
+        ``record_admit`` this must NOT overwrite ``admit`` (the
+        prefill replica's admission instant is the one the
+        decomposition needs) and must not observe queue-wait or
+        prefill-bucket metrics — the prefill side already did."""
+        now = self._now(now)
+        rec["slot"] = int(slot)
+        rec["status"] = "active"
+        with self._lock:
+            self._counts["admitted"] += 1
+            self._queue_depth = max(0, self._queue_depth - 1)
+            self._active[rec["id"]] = rec
+        self._m["admitted"].inc(tags=self._tags)
+        self._m["queue_depth"].set(self._queue_depth, tags=self._tags)
+        self.flightrec.record(
+            "handoff_admit", ts=now, req=rec["id"], slot=int(slot),
+            **self._trace_tag(rec))
+
+    def record_kv_handoff(self, rec: Dict[str, Any], start: float,
+                          end: float, blocks: int = 0, nbytes: int = 0,
+                          path: str = "fast") -> None:
+        """The export→install window of one handoff: `blocks` filled
+        KV block rows moved from the prefill replica's pool into this
+        decode replica's over [start, end] (`path` is "fast" for the
+        same-process device copy, "staged" for the D2H→H2D hop through
+        host staging buffers).  Kept on the record so critical_path()
+        can carve the window out of the decode leg as ``handoff_ms``
+        and the tracebus can render a ``kv.handoff`` span."""
+        rec["kv_handoff"] = (float(start), float(end), int(blocks),
+                             int(nbytes), str(path))
+        with self._lock:
+            self._handoff["blocks_moved"] += int(blocks)
+            if path == "fast":
+                self._handoff["fast_path"] += 1
+            else:
+                self._handoff["staged"] += 1
+        self.flightrec.record(
+            "kv_handoff", ts=end, req=rec["id"], blocks=int(blocks),
+            bytes=int(nbytes), path=str(path),
             dur_ms=round((end - start) * 1e3, 3),
             **self._trace_tag(rec))
 
@@ -970,6 +1122,10 @@ class EngineTelemetry:
         out: Dict[str, List[tuple]] = {"ttft": [], "e2e": [],
                                        "queue_wait": []}
         for r in recs:
+            if r.get("status") == "handoff":
+                # prefill-side shadow of a handed-off request: the
+                # decode replica's record is the authoritative one
+                continue
             if r["first_token"] is not None:
                 out["ttft"].append(
                     (r["first_token"],
@@ -995,6 +1151,8 @@ class EngineTelemetry:
         out = empty_anatomy_samples()
         tenants: set = set()
         for r in recs:
+            if r.get("status") == "handoff":
+                continue
             if r.get("tenant"):
                 tenants.add(r["tenant"])
             out["itl_ms"].extend(_token_gaps_ms(r))
@@ -1060,6 +1218,8 @@ class EngineTelemetry:
             kv_tier = self._kv_tier
             spec = dict(self._spec)
             chunks = dict(self._chunks)
+            handoff = dict(self._handoff)
+        recs = [r for r in recs if r.get("status") != "handoff"]
         ttft = [(r["first_token"] - r["enqueue"]) * 1e3 for r in recs
                 if r["first_token"] is not None]
         qwait = [(r["admit"] - r["enqueue"]) * 1e3 for r in recs
@@ -1078,6 +1238,10 @@ class EngineTelemetry:
             throughput = 0.0
         return {
             "deployment": self.deployment,
+            # round-18: disaggregated serving role — "prefill" engines
+            # park at handoff, "decode" engines admit pre-filled
+            # requests, "both" is the monolithic engine
+            "role": self.role,
             "uptime_s": round(time.perf_counter() - self._t0, 3),
             "requests": dict(counts, active=n_active,
                              queued=queue_depth),
@@ -1139,6 +1303,11 @@ class EngineTelemetry:
                 "tokens": chunks["tokens"],
                 "max_chunks_per_request": chunks["max_chunks"],
             },
+            # round-18: disaggregated prefill/decode handoffs — block
+            # moves out of (prefill role) and into (decode role) this
+            # engine's pool, by path, plus decode-side pool-exhaustion
+            # requeues (all zeros on monolithic engines)
+            "handoff": handoff,
             # round-14: per-token latency anatomy — ITL/TPOT
             # percentiles and the critical-path decomposition
             # (e2e = router_wait + queue_wait + requeue + prefill +

@@ -503,6 +503,11 @@ async def drive_fleet(fleet, requests: List[TrafficRequest], *,
 
 
 def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
+                      num_prefill_replicas: Optional[int] = None,
+                      num_decode_replicas: Optional[int] = None,
+                      prefill_engine_kw: Optional[Dict[str, Any]] = None,
+                      decode_engine_kw: Optional[Dict[str, Any]] = None,
+                      handoff_staged: bool = False,
                       family: str = "gpt2", preset: str = "nano",
                       kv_block_size: int = 16,
                       kv_num_blocks: Optional[int] = None,
@@ -523,13 +528,29 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
     the client-side :func:`drive_fleet` numbers with the fleet's own
     stats — ``router_prefix_hit_rate`` (pooled over replicas) and
     ``tenants`` (per-tenant SLO attainment) are the headline fields
-    bench/sweep publish."""
+    bench/sweep publish.
+
+    Setting both `num_prefill_replicas` and `num_decode_replicas`
+    runs the DISAGGREGATED fleet instead (role-split replica sets with
+    block-granular KV handoff — see build_llm_fleet); the report then
+    carries ``handoff_ms_p99`` (the pooled handoff leg of the critical
+    path), the fleet ``handoff`` counter block, and ``{role}_``-
+    prefixed pool-utilization lines so a sweep can A/B disagg vs
+    homogeneous at equal chip count.  `prefill_engine_kw` /
+    `decode_engine_kw` overlay per-role engine knobs (mesh degree,
+    batch shape, slot count); `handoff_staged` forces the D2H→H2D
+    host-staging hop."""
     import asyncio
 
     from ray_tpu.serve.router import build_llm_fleet
 
     fleet = build_llm_fleet(
         family, preset, num_replicas=num_replicas,
+        num_prefill_replicas=num_prefill_replicas,
+        num_decode_replicas=num_decode_replicas,
+        prefill_engine_kw=prefill_engine_kw,
+        decode_engine_kw=decode_engine_kw,
+        handoff_staged=handoff_staged,
         tenants=[t.to_class() for t in spec.tenants],
         routing=routing, wfq=wfq, autoscale=autoscale,
         max_slots=max_slots, max_new_tokens=max_new_tokens,
@@ -557,6 +578,9 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
     report = asyncio.run(main())
     report["spec"] = dataclasses.asdict(spec)
     report["num_replicas"] = num_replicas
+    report["num_prefill_replicas"] = num_prefill_replicas
+    report["num_decode_replicas"] = num_decode_replicas
+    report["handoff_staged"] = handoff_staged
     report["routing"] = routing
     report["wfq"] = wfq
     report["router_prefix_hit_rate"] = \
@@ -570,6 +594,22 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
     # fleet-pooled host-tier headline (see fleet_stats()["kv_tier"])
     report["kv_tier_hit_rate"] = \
         (report["fleet"].get("kv_tier") or {}).get("hit_rate", 0.0)
+    # role-aware pool utilization: one `{role}_`-prefixed line per
+    # replica role so a disagg run's decode-pool pressure is never
+    # averaged into the prefill pools' churn (monolithic fleets emit
+    # the single `both_` role)
+    for role, occ in (fleet_scope.get("occupancy_by_role")
+                      or {}).items():
+        report[f"{role}_kv_occupancy_mean"] = occ.get("mean", 0.0)
+        report[f"{role}_kv_occupancy_p95"] = occ.get("p95", 0.0)
+    # disaggregation headlines: the fleet handoff counter block and
+    # the pooled handoff leg of the critical path (0.0 on homogeneous
+    # fleets so sweep identity stays stable)
+    report["handoff"] = report["fleet"].get("handoff")
+    cp_blk = (report["fleet"].get("latency_anatomy") or {}).get(
+        "critical_path") or {}
+    report["handoff_ms_p99"] = \
+        (cp_blk.get("handoff_ms") or {}).get("p99") or 0.0
     report["tenants"] = report["fleet"]["tenants"]
     #: flattened for SWEEPJSON consumers: {tenant}_{obj}_slo_attainment
     flat: Dict[str, Any] = {}

@@ -55,6 +55,8 @@ PROGRAM_KNOBS: Dict[str, Tuple[str, ...]] = {
                      "flash_resident"),
     "serve.spec_verify": ("spec_k", "spec_draft", "kv_layout"),
     "serve.spec_draft": ("spec_k", "spec_draft"),
+    "serve.kv_handoff_export": ("block_size", "prefill_replicas"),
+    "serve.kv_handoff_install": ("block_size", "decode_replicas"),
     "serve.sharded_prefill": ("tensor", "prefill_bucket", "batch"),
     "serve.sharded_paged_prefill": ("tensor", "prefill_bucket",
                                     "block_size"),
@@ -62,6 +64,10 @@ PROGRAM_KNOBS: Dict[str, Tuple[str, ...]] = {
                              "block_size"),
     "serve.sharded_spec_verify": ("tensor", "spec_k", "spec_draft"),
     "serve.sharded_spec_draft": ("tensor", "spec_k", "spec_draft"),
+    "serve.sharded_kv_handoff_export": ("tensor", "block_size",
+                                        "prefill_replicas"),
+    "serve.sharded_kv_handoff_install": ("tensor", "block_size",
+                                         "decode_replicas"),
 }
 
 

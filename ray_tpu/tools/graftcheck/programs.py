@@ -269,6 +269,76 @@ def _build_gpt2_chunked_prefill():
          jnp.int32(_CHUNK_T), jnp.int32(0)))
 
 
+def _paged_nano_pool():
+    """The serve engine's default nano paged pool (null block + one
+    full chain per pooled row) with identity tables — shared by the
+    handoff program builders below."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2_config, gpt2_decode
+
+    cfg = gpt2_config("nano", dtype=jnp.float32, use_flash=False,
+                      remat=False)
+    bs = 16
+    per_row = cfg.max_seq // bs
+    cache = gpt2_decode.init_paged_cache(
+        cfg, _PB, num_blocks=1 + _PB * per_row, block_size=bs)
+    cache["block_tables"] = 1 + jnp.arange(
+        _PB * per_row, dtype=jnp.int32).reshape(_PB, per_row)
+    return cache, per_row
+
+
+def _build_gpt2_kv_handoff_export():
+    """Disaggregated serving's prefill-side program (round 18): ONE
+    dispatch gathers a finished prefill's filled block rows out of the
+    pool — the read twin of the tier's install program, fixed-shape
+    over a padded id vector.  The export must be a pure slice of the
+    pool: no logits buffer may appear (the handoff moves K/V bytes,
+    never recomputes), and peak HBM is pool + one stacked-row copy —
+    a densified whole-pool intermediate would double the prefill
+    replica's steady-state footprint on every handoff."""
+    import jax.numpy as jnp
+
+    cache, per_row = _paged_nano_pool()
+    ids = jnp.zeros((per_row,), jnp.int32)
+
+    def export(c, blk_ids):
+        return (c["k"][:, blk_ids].swapaxes(0, 1),
+                c["v"][:, blk_ids].swapaxes(0, 1))
+
+    return export, (cache, ids)
+
+
+def _build_gpt2_kv_handoff_install():
+    """The decode-side splice: exported rows + block table + pos +
+    start land in ONE donated dispatch, so the receiving row is
+    decode-ready when the program retires and the first decode step
+    reads exactly the rows the prefill replica wrote.  The pool (arg
+    0) must be donated — an undonated install would hold two pools
+    live per handoff, exactly the HBM spike disaggregation cannot
+    afford on the decode fleet."""
+    import jax.numpy as jnp
+
+    cache, per_row = _paged_nano_pool()
+    ids = jnp.zeros((per_row,), jnp.int32)
+    row_shape = (per_row,) + cache["k"][:, 0].shape
+    k_stack = jnp.zeros(row_shape, cache["k"].dtype)
+    v_stack = jnp.zeros(row_shape, cache["v"].dtype)
+    row_bt = jnp.zeros((per_row,), jnp.int32)
+
+    def install(c, blk_ids, ks, vs, slot, bt, pos):
+        out = dict(c)
+        out["k"] = c["k"].at[:, blk_ids].set(ks.swapaxes(0, 1))
+        out["v"] = c["v"].at[:, blk_ids].set(vs.swapaxes(0, 1))
+        out["block_tables"] = c["block_tables"].at[slot].set(bt)
+        out["pos"] = c["pos"].at[slot].set(pos)
+        out["start"] = c["start"].at[slot].set(0)
+        return out
+
+    return install, (cache, ids, k_stack, v_stack, jnp.int32(0),
+                     row_bt, jnp.int32(48))
+
+
 def _ce_inputs():
     import jax
     import jax.numpy as jnp
@@ -397,6 +467,25 @@ def default_programs() -> List[ProgramSpec]:
             # pool (same sizing as the paged decode step) + (Tt, ...)
             # chunk temps; a dense pool re-materialization per chunk
             # blows through this
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_kv_handoff_export",
+            build=_build_gpt2_kv_handoff_export,
+            # a handoff never computes: full-sequence logits in the
+            # export program mean someone routed a forward through it
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            # pool + one (maxn, L, bs, H, hd) stacked-row pair; a
+            # densified whole-pool gather would blow through this
+            hbm_budget_bytes=6 * _MiB),
+        ProgramSpec(
+            name="gpt2_kv_handoff_install",
+            build=_build_gpt2_kv_handoff_install,
+            forbid_logits=(_PB * 128, _NANO_VOCAB),  # B * max_seq rows
+            allow_f32_matmul=True,
+            # the donated pool is the whole point: two live pools per
+            # install is the regression this spec exists to catch
+            donate_argnums=(0,),
             hbm_budget_bytes=6 * _MiB),
         ProgramSpec(
             name="fused_ce_fwd",

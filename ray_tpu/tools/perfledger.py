@@ -85,6 +85,10 @@ _SWEEP_FIELDS = (
     # second-chance probes the tier absorbed — higher is better via
     # the "hit_rate" override below
     "kv_tier_hit_rate",
+    # disaggregated prefill/decode (traffic_disagg records): tail
+    # cost of the block-granular KV handoff hop — "_ms" marks it
+    # lower-is-better
+    "handoff_ms_p99",
 )
 
 #: substrings marking a metric where SMALLER is better

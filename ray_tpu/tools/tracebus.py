@@ -16,13 +16,16 @@ program via parent ids on a single timeline.
   invokes.
 * ``build_request_spans(req)`` — one request's span tree
   (router.route → engine.queue / kv.reserve / engine.requeue →
-  engine.prefill → engine.decode), every span a monotonic-clock
-  window with a parent id; ``attach_device_spans`` parents the
-  matching prefill program dispatch under the request's prefill span.
+  engine.prefill → kv.handoff → engine.decode; kv.handoff appears
+  only on disaggregated fleets, covering the prefill-replica export
+  through the decode-replica install fence), every span a
+  monotonic-clock window with a parent id; ``attach_device_spans``
+  parents the matching prefill program dispatch under the request's
+  prefill span.
 * ``critical_path_table(...)`` — the pXX decomposition
-  e2e = router_wait + queue_wait + requeue + prefill + inter_token +
-  spec_rollback (components from serve/telemetry.py ``critical_path``,
-  which sum to e2e by construction).
+  e2e = router_wait + queue_wait + requeue + prefill + handoff +
+  inter_token + spec_rollback (components from serve/telemetry.py
+  ``critical_path``, which sum to e2e by construction).
 * ``chrome_trace(doc)`` — the merged Perfetto timeline: one pid per
   replica (slot lanes + a flightrec decision lane), a router pid, and
   a device-program pid.
@@ -238,6 +241,16 @@ def build_request_spans(req: Dict[str, Any]) -> List[Dict[str, Any]]:
         else:
             emit("engine.prefill", admit, first,
                  bucket=req.get("bucket"), slot=req.get("slot"))
+    # disaggregated handoff (serve/llm.py role-split fleets): the
+    # block move from prefill replica to decode replica — export
+    # start through install fence, between the prefill and decode
+    # legs, matching the handoff_ms critical-path component
+    kh = req.get("kv_handoff")
+    if kh:
+        emit("kv.handoff", kh[0], kh[1],
+             blocks=kh[2] if len(kh) > 2 else None,
+             bytes=kh[3] if len(kh) > 3 else None,
+             path=kh[4] if len(kh) > 4 else None)
     if first is not None and finish is not None:
         emit("engine.decode", first, finish,
              tokens=req.get("tokens"),

@@ -59,6 +59,19 @@ tok_s_chip:
                         [8, {"mode": "traffic", "kv_layout": "paged",
                              "tensor": 4}]]'
 
+{"mode": "traffic_fleet", ...} drives a multi-replica router fleet
+(prefix-affinity routing + per-tenant WFQ) over the same two-tenant
+churn mix; {"mode": "traffic_disagg", "prefill_replicas": P,
+"decode_replicas": D, ...} splits the fleet by role with
+block-granular KV handoff (add "handoff_staged": true for the
+D2H→H2D hop), surfacing handoff_ms_p99 + per-role occupancy — a
+traffic_fleet record at equal chip count is the A/B control:
+
+  python sweep_tpu.py '[[8, {"mode": "traffic_fleet", "replicas": 2}],
+                        [8, {"mode": "traffic_disagg",
+                             "prefill_replicas": 1,
+                             "decode_replicas": 1}]]'
+
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
 clean JSONL stream).  The first record is the graftcheck static-audit
@@ -379,6 +392,143 @@ def _run_traffic_fleet_variant(max_slots, kw, out):
     return rec
 
 
+def _run_traffic_disagg_variant(max_slots, kw, out):
+    """One {"mode": "traffic_disagg"} sweep entry → SWEEPJSON record.
+
+    Drives a role-split fleet — ``prefill_replicas`` prefill engines
+    feeding ``decode_replicas`` decode engines over block-granular KV
+    handoff — against the same two-tenant churn mix as traffic_fleet,
+    so a traffic_fleet record at equal chip count is the A/B control.
+    Surfaces ``handoff_ms_p99`` and the per-role occupancy headlines
+    at the record's top level for perfledger."""
+    from ray_tpu.serve.slo import SLOConfig
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    prefill_replicas = kw.pop("prefill_replicas", 1)
+    decode_replicas = kw.pop("decode_replicas", 1)
+    handoff_staged = bool(kw.pop("handoff_staged", False))
+    prefill_overrides = kw.pop("prefill_overrides", None) or None
+    decode_overrides = kw.pop("decode_overrides", None) or None
+    routing = kw.pop("routing", "prefix")
+    wfq = kw.pop("wfq", True)
+    ttft_slo_ms = kw.pop("ttft_slo_ms", None)
+    e2e_slo_ms = kw.pop("e2e_slo_ms", None)
+    latency_slo_ms = kw.pop("latency_slo_ms", 20000.0)
+    if ttft_slo_ms is None:
+        ttft_slo_ms = latency_slo_ms / 2
+    if e2e_slo_ms is None:
+        e2e_slo_ms = latency_slo_ms
+    groups = kw.pop("prefix_groups", 4)
+    lo = tuple(range(groups // 2)) or (0,)
+    hi = tuple(range(groups // 2, groups)) or (0,)
+    p_int = min(max(kw.pop("p_interactive", 0.5), 0.01), 0.99)
+    tenants = (
+        TenantSpec("interactive", rate_share=p_int,
+                   slo_class="interactive", prefix_groups=lo,
+                   ttft_slo_ms=ttft_slo_ms, e2e_slo_ms=e2e_slo_ms),
+        TenantSpec("batch", rate_share=1.0 - p_int,
+                   slo_class="batch", prefix_groups=hi,
+                   e2e_slo_ms=2 * e2e_slo_ms),
+    )
+    spec = TrafficSpec(
+        num_requests=kw.pop("requests", 64),
+        seed=kw.pop("seed", 0),
+        rate_rps=kw.pop("rate_rps", 32.0),
+        num_prefix_groups=groups,
+        prefix_len=kw.pop("prefix_len", 256),
+        p_shared=kw.pop("p_shared", 0.75),
+        tail_len_mean=kw.pop("tail_len_mean", 32.0),
+        tail_len_max=kw.pop("tail_len_max", 128),
+        vocab=kw.pop("vocab", 50000),
+        tenants=tenants)
+    kv_host_tier_bytes = kw.pop("kv_host_tier_bytes", None) or None
+    kv_num_blocks = kw.pop("kv_num_blocks", None) or None
+    run_kw = {
+        "preset": kw.pop("preset", "gpt2"),
+        "kv_block_size": kw.pop("block_size", 16),
+        "kv_num_blocks": kv_num_blocks,
+        "kv_host_tier_bytes": kv_host_tier_bytes,
+        "max_new_tokens": kw.pop("new_tokens", 64),
+        "prefill_bucket": kw.pop("prefill_bucket", 128),
+        "time_scale": kw.pop("time_scale", 1.0),
+    }
+    slo_cfg = SLOConfig(ttft_ms=ttft_slo_ms, e2e_ms=e2e_slo_ms)
+    variant = {"mode": "traffic_disagg", "max_slots": max_slots,
+               "prefill_replicas": prefill_replicas,
+               "decode_replicas": decode_replicas,
+               "handoff_staged": handoff_staged,
+               "routing": routing, "wfq": wfq,
+               "requests": spec.num_requests,
+               "prefix_len": spec.prefix_len,
+               "p_shared": spec.p_shared, "rate_rps": spec.rate_rps,
+               "preset": run_kw["preset"],
+               "kv_host_tier_bytes": kv_host_tier_bytes,
+               "kv_num_blocks": kv_num_blocks,
+               "overrides": kw}
+    try:
+        rep = run_traffic_fleet(
+            spec, num_replicas=decode_replicas,
+            num_prefill_replicas=prefill_replicas,
+            num_decode_replicas=decode_replicas,
+            prefill_engine_kw=prefill_overrides,
+            decode_engine_kw=decode_overrides,
+            handoff_staged=handoff_staged,
+            family="gpt2", max_slots=max_slots,
+            routing=routing, wfq=wfq, slo=slo_cfg,
+            config_overrides=kw or None, **run_kw)
+        hoff = rep.get("handoff") or {}
+        print(f"traffic_disagg slots={max_slots} "
+              f"p={prefill_replicas} d={decode_replicas} "
+              f"staged={handoff_staged} n={rep['offered']}: "
+              f"handoffs={hoff.get('handoffs_in')} "
+              f"handoff_ms_p99={rep.get('handoff_ms_p99')} "
+              f"shed={rep['shed']}", file=out, flush=True)
+        rec = {"sweep": variant,
+               "router_prefix_hit_rate":
+                   rep["router_prefix_hit_rate"],
+               "itl_ms_p50": rep.get("itl_ms_p50"),
+               "itl_ms_p99": rep.get("itl_ms_p99"),
+               "ttft_critical_path": rep.get("ttft_critical_path"),
+               # handoff hop cost, top-level for perfledger
+               # (lower-is-better)
+               "handoff_ms_p99": rep.get("handoff_ms_p99"),
+               "handoff": hoff,
+               "kv_occupancy_p95": rep.get("kv_occupancy_p95"),
+               "reprefill_waste_frac":
+                   rep.get("reprefill_waste_frac"),
+               "kv_tier_hit_rate": rep.get("kv_tier_hit_rate"),
+               "completed": rep["completed"], "shed": rep["shed"],
+               "latency_p50_ms": rep["latency_ms"]["p50"],
+               "latency_p95_ms": rep["latency_ms"]["p95"],
+               "fleet": {
+                   "num_replicas": rep["num_replicas"],
+                   "num_prefill_replicas":
+                       rep.get("num_prefill_replicas"),
+                   "num_decode_replicas":
+                       rep.get("num_decode_replicas"),
+                   "routed_by_policy":
+                       rep["fleet"]["router"]["routed_by_policy"],
+                   "tenants": rep["tenants"]}}
+        # per-role occupancy headlines (prefill pools should run
+        # near-empty; decode pools carry the steady-state residency)
+        for key in ("prefill_kv_occupancy_mean",
+                    "prefill_kv_occupancy_p95",
+                    "decode_kv_occupancy_mean",
+                    "decode_kv_occupancy_p95"):
+            if rep.get(key) is not None:
+                rec[key] = rep[key]
+        rec.update(rep.get("tenant_slo_attainment") or {})
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        print(f"traffic_disagg slots={max_slots} "
+              f"p={prefill_replicas} d={decode_replicas} {kw}: "
+              f"FAILED {type(e).__name__}: {str(e)[:160]}",
+              file=out, flush=True)
+        rec = {"sweep": variant, "failed": _failure_tag(e),
+               "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    return rec
+
+
 def _autopilot_record():
     """One SWEEPJSON record attributing every program this sweep
     registered (compute- vs HBM-bound against the device ridge, ranked
@@ -520,6 +670,11 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
             continue
         if mode == "traffic_fleet":
             rec = _run_traffic_fleet_variant(batch_per_chip, kw, out)
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
+        if mode == "traffic_disagg":
+            rec = _run_traffic_disagg_variant(batch_per_chip, kw, out)
             print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
             records.append(rec)
             continue

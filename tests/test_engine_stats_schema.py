@@ -30,7 +30,7 @@ TOP_KEYS = {
     "max_active_slots", "max_slots", "prefill_buckets",
     "prefill_compiles", "program_compiles", "rejections_by_reason",
     "kv_cache", "kv_scope", "kv_tier", "spec", "slo", "flightrec",
-    "programs", "latency_anatomy", "prefill_chunks",
+    "programs", "latency_anatomy", "prefill_chunks", "role", "handoff",
 }
 
 KV_SCOPE_KEYS = {"enabled", "occupancy", "forensics",
@@ -55,8 +55,11 @@ ANATOMY_KEYS = {"requests", "itl_ms", "tpot_ms", "ttft_ms",
 
 CRITICAL_PATH_KEYS = {"e2e_ms", "router_wait_ms", "queue_wait_ms",
                       "requeue_ms", "kv_fetch_ms", "prefill_ms",
-                      "prefill_wait_ms", "inter_token_ms",
-                      "spec_rollback_ms"}
+                      "prefill_wait_ms", "handoff_ms",
+                      "inter_token_ms", "spec_rollback_ms"}
+
+HANDOFF_KEYS = {"handoffs_out", "handoffs_in", "blocks_moved",
+                "fast_path", "staged", "requeues"}
 
 PREFILL_CHUNK_KEYS = {"requests", "chunks", "tokens",
                       "max_chunks_per_request"}
@@ -214,6 +217,13 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
     assert comp_sum == pytest.approx(cp["e2e_ms"]["mean"], rel=0.05)
     assert anatomy["by_tenant"] == {}  # no tenant tags in this run
 
+    # disaggregation block: monolithic engines report role "both" and
+    # the zero-shaped handoff counter dict — same keys a role-split
+    # replica reports live, so fleet_stats pooling never branches
+    assert stats["role"] == "both"
+    assert set(stats["handoff"]) == HANDOFF_KEYS
+    assert all(v == 0 for v in stats["handoff"].values())
+
     # chunked-prefill counter block: always present, all-zero when
     # chunking is off (as here — short prompts, no chunk knob)
     assert set(stats["prefill_chunks"]) == PREFILL_CHUNK_KEYS
@@ -269,3 +279,61 @@ def test_engine_stats_kv_tier_enabled_shape():
     assert set(kt) == KV_TIER_KEYS
     assert kt["enabled"] is True
     assert kt["bytes_budget"] == 1 << 20
+
+
+def test_engine_stats_role_split_shape():
+    """A prefill/decode role pair keeps the identical golden key set;
+    only ``role`` and the ``handoff`` counters differ.  Handoff-parked
+    requests must NOT count as finished on the prefill side — they
+    retire with the dedicated handoff status — while the decode side
+    owns the end-to-end record (handoff_ms in its critical path)."""
+    slo = SLOConfig(ttft_ms=60_000.0, e2e_ms=120_000.0,
+                    queue_wait_ms=60_000.0)
+    kw = dict(scheduler="continuous", kv_layout="paged",
+              kv_block_size=16, prefill_bucket=16, max_slots=2,
+              max_new_tokens=3, temperature=0.0, slo=slo,
+              config_overrides=_OVR)
+    pre = build_llm_deployment("gpt2", "nano", role="prefill", **kw)
+    dec = build_llm_deployment("gpt2", "nano", role="decode", **kw)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(2, 50, size=rng.randint(8, 14))
+               .astype(np.int32) for _ in range(2)]
+
+    async def main():
+        p_inst = pre.func_or_class()
+        d_inst = dec.func_or_class()
+        try:
+            pkgs = await asyncio.gather(*[p_inst(p) for p in prompts])
+            await asyncio.gather(*[d_inst.admit_prefilled(pkg)
+                                   for pkg in pkgs])
+            return p_inst.engine_stats(), d_inst.engine_stats()
+        finally:
+            p_inst.shutdown_engine()
+            d_inst.shutdown_engine()
+
+    p_st, d_st = asyncio.run(main())
+    for stats in (p_st, d_st):
+        missing = TOP_KEYS - set(stats)
+        assert not missing, f"engine_stats() lost keys: {missing}"
+        assert set(stats["handoff"]) == HANDOFF_KEYS
+
+    assert p_st["role"] == "prefill"
+    assert p_st["handoff"]["handoffs_out"] == 2
+    assert p_st["handoff"]["handoffs_in"] == 0
+    # parked ≠ finished: the decode side owns the completion record
+    assert p_st["requests"]["finished"] == 0
+    assert p_st["latency_anatomy"]["requests"] == 0
+
+    assert d_st["role"] == "decode"
+    assert d_st["handoff"]["handoffs_in"] == 2
+    assert d_st["handoff"]["handoffs_out"] == 0
+    assert d_st["handoff"]["blocks_moved"] > 0
+    assert d_st["requests"]["finished"] == 2
+    anatomy = d_st["latency_anatomy"]
+    assert anatomy["requests"] == 2
+    assert set(anatomy["critical_path"]) == CRITICAL_PATH_KEYS
+    assert anatomy["critical_path"]["handoff_ms"]["count"] == 2
+    cp = anatomy["critical_path"]
+    comp_sum = sum(cp[k]["mean"] for k in CRITICAL_PATH_KEYS
+                   if k != "e2e_ms")
+    assert comp_sum == pytest.approx(cp["e2e_ms"]["mean"], rel=0.05)

@@ -339,6 +339,58 @@ def test_planted_chunked_prefill_full_sequence_detected():
     assert "t0-scan" in _rules(vs)
 
 
+def test_planted_handoff_logits_and_donation_detected():
+    """The disaggregated-handoff ProgramSpecs pin the hop's two
+    invariants: a handoff moves K/V bytes and never computes (no
+    logits-class buffer in either side), and the decode-side install
+    donates the pool (two live pools per handoff is exactly the HBM
+    spike disaggregation cannot afford).  A variant that materializes
+    the full logits class, or an install that drops the donation, must
+    trip under the real specs' own constraints."""
+    from ray_tpu.tools.graftcheck.programs import default_programs
+
+    progs = {s.name: s for s in default_programs()}
+    exp = progs["gpt2_kv_handoff_export"]
+    ins = progs["gpt2_kv_handoff_install"]
+    assert exp.hbm_budget_bytes > 0 and ins.hbm_budget_bytes > 0
+    assert ins.donate_argnums == (0,)
+
+    # export that routes a forward through the hop: logits buffer
+    fn, args = exp.build()
+
+    def bad_export(c, blk_ids):
+        ks, vs = fn(c, blk_ids)
+        full = jnp.zeros(exp.forbid_logits, jnp.float32)  # planted
+        return ks + jnp.sum(full), vs
+
+    vs_, _ = audit_program(
+        ProgramSpec(name="planted", build=lambda: (bad_export, args),
+                    forbid_logits=exp.forbid_logits,
+                    allow_f32_matmul=True))
+    assert "logits-buffer" in _rules(vs_)
+
+    # install that reduces the spliced pool instead of returning it:
+    # no output can alias the donated pool, so the donation is dropped
+    ifn, iargs = ins.build()
+
+    def bad_install(c, blk_ids, ks, vs, slot, bt, pos):
+        out = ifn(c, blk_ids, ks, vs, slot, bt, pos)
+        return jnp.sum(out["k"]) + jnp.sum(out["v"])
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")   # cpu donation warning
+        vs_, _ = audit_program(
+            ProgramSpec(name="planted",
+                        build=lambda: (bad_install, iargs),
+                        donate_argnums=(0,), allow_f32_matmul=True))
+    assert "donation" in _rules(vs_)
+    # the REAL install keeps the donation live end to end
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        vs_, _ = audit_program(ins)
+    assert "donation" not in _rules(vs_)
+
+
 def test_peak_estimate_counts_live_buffers():
     one_mib = jnp.zeros((512, 512), jnp.float32)  # exactly 1 MiB
     _, info = audit_program(_spec(lambda x: x + 1.0, (one_mib,)))

@@ -1,0 +1,301 @@
+"""Disaggregated prefill/decode serving: role-split replica fleets
+with block-granular KV handoff (serve/llm.py + serve/router.py).
+
+The correctness bar is the same as every other serve-layer feature:
+whatever the fleet splits, stages, or requeues, every caller must get
+the bit-identical greedy continuation the dense single-engine oracle
+produces — the handoff install reproduces ``paged_prefill``'s exact
+post-state (pos = prompt length, start = 0, same filled block rows),
+so the first decode step on the receiving replica is the same program
+on the same bytes.  Covered here:
+
+- cold traffic through a 1-prefill + 1-decode fleet, both model
+  families, fast (same-process device copy) and staged (D2H→H2D host
+  hop) handoff paths;
+- resident-prefix bypass: a prefix already hot on a decode replica
+  routes straight to it (prefix_affinity), skipping the prefill fleet;
+- speculative decoding on the decode side of the split;
+- chunked streaming prefill on the prefill side (long prompts hand
+  off at last-chunk completion);
+- handoff pool exhaustion: a decode pool too small for the arriving
+  package requeues (push-front) and completes once blocks free, still
+  bit-identical;
+- construction-time validation and the traffic-harness report keys.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.serve.llm import (SpecConfig,
+                               build_llm_deployment)  # noqa: E402
+from ray_tpu.serve.router import build_llm_fleet  # noqa: E402
+
+MAX_NEW = 6
+_OVR = {"dtype": jnp.float32, "use_flash": False, "remat": False}
+_ENGINE_KW = dict(max_new_tokens=MAX_NEW, temperature=0.0,
+                  kv_block_size=16, prefill_bucket=16, max_slots=2,
+                  config_overrides=_OVR)
+
+
+def _fleet(name, family="gpt2", **kw):
+    kw = {**_ENGINE_KW, **kw}
+    kw.setdefault("num_prefill_replicas", 1)
+    kw.setdefault("num_decode_replicas", 1)
+    return build_llm_fleet(family, "nano", fleet_name=name, **kw)
+
+
+def _oracle(family, prompt, max_new=MAX_NEW):
+    """Dense solo greedy continuation — the parity reference."""
+    if family == "gpt2":
+        from ray_tpu.models import gpt2_config, gpt2_init
+        from ray_tpu.models.gpt2_decode import generate
+        cfg = gpt2_config("nano", **_OVR)
+        params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    else:
+        from ray_tpu.models import llama_config, llama_init
+        from ray_tpu.models.llama_decode import llama_generate \
+            as generate
+        cfg = llama_config("nano", **_OVR)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+    out = generate(params, jnp.asarray(np.asarray(prompt)[None]), cfg,
+                   max_new_tokens=max_new, temperature=0.0)
+    return np.asarray(out)[0]
+
+
+def _prompts(lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(2, 500, n).astype(np.int32) for n in lens]
+
+
+def _drive(fleet, prompts, tenant=None, timeout=300):
+    """All prompts concurrently through the fleet; fleet_stats and
+    per-role engine stats taken before shutdown so handoff counters
+    and roles are live."""
+    async def main():
+        try:
+            outs = await asyncio.wait_for(
+                asyncio.gather(*[fleet(p, tenant=tenant)
+                                 for p in prompts]), timeout)
+            by_role = {r.role: r.engine_stats()
+                       for r in fleet.router.live_replicas}
+            return outs, fleet.fleet_stats(), by_role
+        finally:
+            fleet.shutdown()
+
+    return asyncio.run(main())
+
+
+def _assert_oracle(family, prompts, outs):
+    for p, o in zip(prompts, outs):
+        np.testing.assert_array_equal(np.asarray(o),
+                                      _oracle(family, p))
+
+
+# ---------------------------------------------------------------------------
+# cold traffic, both families, both handoff paths
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_disagg_cold_matches_oracle(family):
+    """Block-boundary-crossing prompt mix through a 1p+1d fleet: every
+    request routes prefill-first, hands its blocks to the decode
+    replica over the fast path, and lands the oracle continuation."""
+    prompts = _prompts([7, 19, 33, 12])
+    fleet = _fleet(f"t_disagg_{family}", family=family)
+    outs, st, by_role = _drive(fleet, prompts)
+    _assert_oracle(family, prompts, outs)
+
+    assert st["router"]["disaggregated"] is True
+    assert st["router"]["routed_by_policy"]["disagg_prefill"] == 4
+    assert st["router"]["handoffs"] == 4
+    hoff = st["handoff"]
+    assert hoff["handoffs_out"] == 4 and hoff["handoffs_in"] == 4
+    assert hoff["fast_path"] == 4 and hoff["staged"] == 0
+    # blocks actually moved: ceil(len/16) summed over the mix
+    assert hoff["blocks_moved"] == sum(-(-n // 16)
+                                       for n in (7, 19, 33, 12))
+    roles = {name: rep["role"]
+             for name, rep in st["replicas"].items()}
+    assert sorted(roles.values()) == ["decode", "prefill"]
+    # per-role occupancy pooled for the kvscope observatory
+    assert set(st["kv_scope"]["occupancy_by_role"]) == {"prefill",
+                                                        "decode"}
+
+
+def test_disagg_staged_path_matches_oracle():
+    """handoff_staged=True forces the D2H→H2D host-staging hop (the
+    cross-process wire path) — byte-for-byte the same splice."""
+    prompts = _prompts([7, 19, 33, 12], seed=3)
+    fleet = _fleet("t_disagg_staged", handoff_staged=True)
+    outs, st, by_role = _drive(fleet, prompts)
+    _assert_oracle("gpt2", prompts, outs)
+    assert st["handoff"]["staged"] == 4
+    assert st["handoff"]["fast_path"] == 0
+
+
+# ---------------------------------------------------------------------------
+# resident prefix skips the prefill fleet entirely
+# ---------------------------------------------------------------------------
+
+def test_disagg_resident_prefix_routes_straight_to_decode():
+    """Once a shared prefix is resident on a decode replica, the
+    router's stage-one check sends the request straight there —
+    no prefill admission, no handoff — and the continuation is still
+    the oracle's."""
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(2, 500, 32)
+    wave1 = [np.concatenate([prefix, rng.randint(2, 500, 3)])
+             .astype(np.int32) for _ in range(2)]
+    wave2 = [np.concatenate([prefix, rng.randint(2, 500, 4)])
+             .astype(np.int32) for _ in range(2)]
+    fleet = _fleet("t_disagg_prefix", routing="prefix")
+
+    async def main():
+        try:
+            o1 = [await fleet(p) for p in wave1]
+            o2 = [await fleet(p) for p in wave2]
+            return o1, o2, fleet.fleet_stats()
+        finally:
+            fleet.shutdown()
+
+    o1, o2, st = asyncio.run(main())
+    _assert_oracle("gpt2", wave1, o1)
+    _assert_oracle("gpt2", wave2, o2)
+    by_policy = st["router"]["routed_by_policy"]
+    # only the cold first request pays the prefill→handoff hop; once
+    # its two full prefix blocks are resident on the decode replica,
+    # every later sharer routes straight there
+    assert by_policy["disagg_prefill"] >= 1
+    assert by_policy["prefix_affinity"] >= 3
+    assert st["handoff"]["handoffs_in"] < len(wave1) + len(wave2)
+
+
+# ---------------------------------------------------------------------------
+# decode-side speculative decoding + prefill-side chunked streaming
+# ---------------------------------------------------------------------------
+
+def test_disagg_spec_decode_matches_oracle():
+    """spec_decode applies to the decode fleet only (drafting is
+    decode-side work): the verify loop starts from the handed-off
+    state and greedy outputs stay oracle-identical."""
+    prompts = _prompts([9, 21, 33], seed=5)
+    fleet = _fleet("t_disagg_spec",
+                   spec_decode=SpecConfig(draft="ngram", k=2))
+    outs, st, by_role = _drive(fleet, prompts)
+    _assert_oracle("gpt2", prompts, outs)
+    assert by_role["decode"]["spec"]["rounds"] > 0
+    assert by_role["prefill"]["spec"]["rounds"] == 0
+
+
+def test_disagg_chunked_long_prompts_match_oracle():
+    """Long prompts admitted chunk-by-chunk on the prefill replica
+    hand off at last-chunk completion — the package carries the chunk
+    windows, and the splice is still bit-exact."""
+    prompts = _prompts([70, 96, 50], seed=7)
+    fleet = _fleet("t_disagg_chunk", prefill_bucket=32,
+                   prefill_engine_kw={"prefill_chunk_tokens": 32})
+    outs, st, by_role = _drive(fleet, prompts)
+    _assert_oracle("gpt2", prompts, outs)
+    assert st["handoff"]["handoffs_in"] == 3
+    assert by_role["prefill"]["prefill_chunks"]["requests"] >= 2
+    assert by_role["decode"]["prefill_chunks"]["requests"] == 0
+
+
+# ---------------------------------------------------------------------------
+# handoff pool exhaustion requeues, then completes
+# ---------------------------------------------------------------------------
+
+def test_disagg_handoff_pool_exhaustion_requeues():
+    """A decode pool with room for one resident request at a time:
+    concurrent handoffs collide on allocation, requeue (push-front,
+    never dropped), and every request still finishes bit-identical."""
+    prompts = _prompts([65, 67, 66, 68], seed=9)
+    # 5 blocks per request (65-68 prompt + 6 new <= 80 tokens); the
+    # smallest legal pool (8 usable + null sink) fits one resident
+    # request at a time but never two
+    fleet = _fleet("t_disagg_requeue",
+                   decode_engine_kw={"kv_num_blocks": 9})
+    outs, st, by_role = _drive(fleet, prompts)
+    _assert_oracle("gpt2", prompts, outs)
+    hoff = st["handoff"]
+    assert hoff["handoffs_in"] == 4
+    assert hoff["requeues"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# construction-time validation
+# ---------------------------------------------------------------------------
+
+def test_disagg_validation_errors():
+    with pytest.raises(ValueError, match="BOTH"):
+        build_llm_fleet("gpt2", "nano", num_prefill_replicas=1,
+                        **_ENGINE_KW)
+    with pytest.raises(ValueError, match="kv_block_size must match"):
+        build_llm_fleet("gpt2", "nano", num_prefill_replicas=1,
+                        num_decode_replicas=1,
+                        decode_engine_kw={"kv_block_size": 32},
+                        **_ENGINE_KW)
+    with pytest.raises(ValueError, match="role"):
+        build_llm_deployment("gpt2", "nano", scheduler="continuous",
+                             kv_layout="paged", role="oracle")
+    with pytest.raises(ValueError, match="paged"):
+        build_llm_deployment("gpt2", "nano", scheduler="continuous",
+                             kv_layout="dense", role="prefill")
+    with pytest.raises(ValueError, match="split roles"):
+        build_llm_deployment("gpt2", "nano", scheduler="continuous",
+                             kv_layout="paged", handoff_staged=True)
+
+
+def test_admit_prefilled_rejected_on_prefill_replica():
+    dep = build_llm_deployment(
+        "gpt2", "nano", scheduler="continuous", kv_layout="paged",
+        role="prefill", **_ENGINE_KW)
+    inst = dep.func_or_class()
+    try:
+        with pytest.raises(ValueError, match="decode-capable"):
+            asyncio.run(inst.admit_prefilled(object()))
+    finally:
+        inst.shutdown_engine()
+
+
+# ---------------------------------------------------------------------------
+# traffic harness surfaces the disagg report keys
+# ---------------------------------------------------------------------------
+
+def test_traffic_disagg_report_keys():
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    tenants = (
+        TenantSpec("interactive", rate_share=1.0,
+                   slo_class="interactive", prefix_groups=(0,)),
+        TenantSpec("batch", rate_share=1.0, slo_class="batch",
+                   prefix_groups=(1,)))
+    spec = TrafficSpec(num_requests=8, seed=0, rate_rps=100.0,
+                       num_prefix_groups=2, prefix_len=32,
+                       p_shared=0.5, tail_len_mean=6.0,
+                       tail_len_max=16, vocab=500, tenants=tenants)
+    rep = run_traffic_fleet(
+        spec, num_replicas=1, num_prefill_replicas=1,
+        num_decode_replicas=1, family="gpt2", preset="nano",
+        kv_block_size=16, max_slots=2, max_new_tokens=4,
+        prefill_bucket=16, time_scale=0.0,
+        config_overrides={"dtype": jnp.float32, "use_flash": False})
+    assert rep["num_prefill_replicas"] == 1
+    assert rep["num_decode_replicas"] == 1
+    assert rep["handoff_staged"] is False
+    assert rep["completed"] + rep["shed"] == rep["offered"]
+    assert rep["handoff"]["handoffs_in"] > 0
+    assert isinstance(rep["handoff_ms_p99"], (int, float))
+    # flattened per-role pool-pressure lines for the sweep record
+    for key in ("prefill_kv_occupancy_mean", "prefill_kv_occupancy_p95",
+                "decode_kv_occupancy_mean", "decode_kv_occupancy_p95"):
+        assert key in rep, key
+    # decode pools carry the steady-state residency; prefill pools
+    # drain at handoff
+    assert rep["decode_kv_occupancy_mean"] >= 0.0

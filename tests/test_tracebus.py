@@ -145,6 +145,91 @@ def test_critical_path_chunk_windows_clamp_to_first_token():
     assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
 
 
+def _synthetic_handoff_rec():
+    """A disaggregated request on deterministic clocks: the decode-side
+    record seeded from the prefill replica's handoff package meta
+    (enqueue 1.0, engine 1.2, admit 1.5, first token 2.0), with the
+    export→install window 2.0-2.2 carved out of the decode leg."""
+    ctx = T.TraceContext(origin="router")
+    ctx.span("router.route", 0.5, 1.0, replica="fleet/p0",
+             policy="disagg_prefill", tenant="a", router_req=7)
+    tel = T.EngineTelemetry("fleet/d0", role="decode")
+    rec = tel.record_enqueue_handoff(
+        {"prompt_len": 12, "enqueue": 1.0, "engine_enqueue": 1.2,
+         "admit": 1.5, "first_token": 2.0, "bucket": 16,
+         "tenant": "a", "ctx": ctx}, now=2.05)
+    tel.record_kv_handoff(rec, 2.0, 2.2, blocks=2, nbytes=4096,
+                          path="staged")
+    tel.record_admit_handoff(rec, slot=0, now=2.2)
+    tel.record_token(rec, now=2.4)
+    tel.record_finish(rec, n_tokens=3, now=2.5)
+    return tel, rec
+
+
+def test_critical_path_handoff_exact_sum():
+    """handoff_ms is the export→install window carved from the decode
+    leg — the other components read exactly like the monolithic
+    engine's, and the decomposition still sums to e2e exactly."""
+    _tel, rec = _synthetic_handoff_rec()
+    cp = T.critical_path(rec)
+    assert cp["e2e_ms"] == pytest.approx(1500.0)
+    assert cp["router_wait_ms"] == pytest.approx(200.0)
+    assert cp["queue_wait_ms"] == pytest.approx(300.0)
+    assert cp["prefill_ms"] == pytest.approx(500.0)
+    assert cp["handoff_ms"] == pytest.approx(200.0)
+    assert cp["inter_token_ms"] == pytest.approx(300.0)
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+
+
+def test_critical_path_handoff_clamps_to_decode_leg():
+    """A handoff window leaking outside [first_token, finish] (clock
+    skew across two replicas' journals) clamps into the decode leg and
+    the exact-sum invariant holds."""
+    tel = T.EngineTelemetry("d", role="decode")
+    rec = tel.record_enqueue_handoff(
+        {"prompt_len": 12, "enqueue": 1.0, "engine_enqueue": 1.2,
+         "admit": 1.5, "first_token": 2.0}, now=2.0)
+    tel.record_kv_handoff(rec, 1.8, 3.0, blocks=1, nbytes=64,
+                          path="fast")
+    tel.record_admit_handoff(rec, slot=0, now=2.1)
+    tel.record_finish(rec, n_tokens=2, now=2.5)
+    cp = T.critical_path(rec)
+    # (1.8..3.0) clamps to the 2.0..2.5 decode window -> all 500 ms
+    assert cp["handoff_ms"] == pytest.approx(500.0)
+    assert cp["inter_token_ms"] == pytest.approx(0.0)
+    assert all(v >= 0.0 for v in cp.values())
+    comp_sum = sum(cp[k] for k in T.CRITICAL_PATH_COMPONENTS)
+    assert comp_sum == pytest.approx(cp["e2e_ms"], abs=1e-9)
+
+
+def test_handoff_span_chain_parent_ids():
+    """The merged timeline shows the full disaggregated chain —
+    router.route → engine.prefill → kv.handoff → engine.decode — every
+    leg a child of the request root, in causal start order, with the
+    handoff span carrying blocks/bytes/path attrs."""
+    _tel, rec = _synthetic_handoff_rec()
+    snap = T.request_snapshot(rec, deployment="fleet/d0")
+    spans = TB.build_request_spans(snap)
+    by_id = {s["span_id"]: s for s in spans}
+    names = [s["name"] for s in spans]
+    for name in ("router.route", "engine.queue", "engine.prefill",
+                 "kv.handoff", "engine.decode"):
+        assert name in names, name
+    root = next(s for s in spans if s["parent_id"] is None)
+    chain = [next(s for s in spans if s["name"] == nm)
+             for nm in ("router.route", "engine.prefill",
+                        "kv.handoff", "engine.decode")]
+    for s in chain:
+        assert by_id[s["parent_id"]] is root, s["name"]
+    starts = [s["start"] for s in chain]
+    assert starts == sorted(starts)
+    kh = chain[2]
+    assert (kh["start"], kh["end"]) == (2.0, 2.2)
+    assert kh["attrs"] == {"blocks": 2, "bytes": 4096,
+                           "path": "staged"}
+
+
 def test_tracebus_opt_out(monkeypatch):
     monkeypatch.setenv("RAYTPU_TRACEBUS", "0")
     tel = T.EngineTelemetry("d")
