@@ -133,6 +133,14 @@ def parse_args(argv=None):
                          "D2H→H2D host-staging handoff hop (the "
                          "cross-process path) instead of the same-"
                          "process device fast path")
+    ap.add_argument("--chaos-freeze-replica", type=int, default=None,
+                    help="--traffic --replicas N chaos A/B: freeze "
+                         "this replica's engine loop (by build-order "
+                         "index) mid-traffic via seeded fault "
+                         "injection (serve/chaos.py); healthwatch "
+                         "detects the death and the router routes "
+                         "around it; emits time_to_detect_ms and "
+                         "requests_requeued_on_death lines")
     ap.add_argument("--kv-host-tier-bytes", type=int, default=None,
                     help="--traffic tiered host-RAM KV cache A/B: give "
                          "the engine's BlockPager a host tier of this "
@@ -1005,6 +1013,20 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
         kw["num_prefill_replicas"] = args.prefill_replicas
         kw["num_decode_replicas"] = args.decode_replicas
         kw["handoff_staged"] = args.handoff_staged
+    chaos_freeze = args.chaos_freeze_replica
+    if chaos_freeze is not None:
+        from ray_tpu.serve.chaos import ChaosConfig
+        from ray_tpu.serve.health import HealthConfig
+
+        base += "_chaos"
+        # tight thresholds so the CPU-smoke run detects within the
+        # freeze window; the freeze outlasts dead_ms by construction
+        kw["health"] = HealthConfig(suspect_ms=40.0, dead_ms=120.0,
+                                    stall_ms=80.0, probe_ms=5.0)
+        kw["chaos"] = ChaosConfig(
+            seed=spec.seed, freeze_replica=int(chaos_freeze),
+            freeze_after_waves=2, freeze_waves=200,
+            freeze_poll_ms=5.0)
     rep = run_traffic_fleet(
         spec, num_replicas=args.replicas, family="gpt2",
         preset=preset, kv_block_size=16,
@@ -1040,6 +1062,18 @@ def main_traffic_fleet(args, on_tpu: bool) -> None:
                     "metric": f"{base}_{key}",
                     "value": rep[key], "unit": "fraction",
                     "vs_baseline": None, "detail": detail})
+    if chaos_freeze is not None:
+        detail["chaos_freeze_replica"] = chaos_freeze
+        detail["health"] = fleet.get("health")
+        emit({
+            "metric": f"{base}_time_to_detect_ms",
+            "value": rep.get("time_to_detect_ms"), "unit": "ms",
+            "vs_baseline": None, "detail": detail})
+        emit({
+            "metric": f"{base}_requests_requeued_on_death",
+            "value": rep.get("requests_requeued_on_death"),
+            "unit": "requests", "vs_baseline": None,
+            "detail": detail})
     emit({
         "metric": f"{base}_router_prefix_hit_rate",
         "value": rep["router_prefix_hit_rate"], "unit": "fraction",

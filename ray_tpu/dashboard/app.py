@@ -334,7 +334,9 @@ class DashboardActor:
         # Fleet control plane (serve/router.py): every live
         # build_llm_fleet() in this process — routing policy mix,
         # pooled prefix hit rate, per-tenant SLO attainment, and the
-        # autoscaler's current signals, keyed by fleet name.
+        # autoscaler's current signals, keyed by fleet name.  The
+        # document's "health" block is also served standalone at
+        # /api/serve/health for liveness pollers.
         async def serve_fleet(_req):
             def _collect():
                 from ray_tpu.serve.router import fleet_registry
@@ -352,6 +354,29 @@ class DashboardActor:
                 await loop.run_in_executor(None, _collect))
 
         app.router.add_get("/api/serve/fleet", serve_fleet)
+
+        # Healthwatch (serve/health.py): every live fleet's health
+        # block only — per-replica liveness state, last-heartbeat age,
+        # transition history, and detection latency — the poll target
+        # for liveness dashboards.  The full fleet document above
+        # (/api/serve/fleet) carries the same block under "health".
+        async def serve_health(_req):
+            def _collect():
+                from ray_tpu.serve.router import fleet_registry
+
+                out = {}
+                for name, fleet in fleet_registry().items():
+                    try:
+                        out[name] = fleet._health_block()
+                    except Exception as e:  # noqa: BLE001
+                        out[name] = {
+                            "error": f"{type(e).__name__}: {e}"[:300]}
+                return out
+
+            return web.json_response(
+                await loop.run_in_executor(None, _collect))
+
+        app.router.add_get("/api/serve/health", serve_health)
 
         # Trainwatch (train/telemetry.py + train/goodput.py): one
         # train_stats() snapshot per trainer that has stepped in THIS

@@ -604,6 +604,14 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             #: disaggregated serving role — the fleet router reads
             #: this to type replicas ("prefill" | "decode" | "both")
             self.role = role
+            #: round-19 healthwatch/chaos attach points — the fleet
+            #: (serve/router.py LLMFleet) overwrites these after
+            #: construction; standalone engines keep them None, so
+            #: the engine loop's only cost is one `is None` check
+            #: per wave
+            self._health = None
+            self._chaos = None
+            self._replica_label = f"llm_{family}_{preset}"
             if scheduler == "batch":
                 self._generate = jax.jit(
                     lambda p, toks, k: gen_fn(
@@ -1658,6 +1666,16 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
 
             while True:
                 try:
+                    if self._chaos is not None and \
+                            self._chaos.frozen(self._replica_label):
+                        # chaos freeze: poll without processing and —
+                        # crucially — without heartbeating, exactly
+                        # what a wedged host looks like to healthwatch
+                        await asyncio.sleep(self._chaos.freeze_poll_s)
+                        continue
+                    if self._health is not None:
+                        # one liveness stamp per wave (a dict store)
+                        self._health.heartbeat(self._replica_label)
                     self._admit_pending()
                     prefilling = [
                         i for i, s in enumerate(self._slots)
@@ -1667,9 +1685,23 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     if not n_active:
                         self._wake.clear()
                         if not len(self._queue):
+                            if self._health is not None:
+                                # parked-idle is not a failure: the
+                                # probe skips idle replicas until the
+                                # next heartbeat re-arms the clock
+                                self._health.note_idle(
+                                    self._replica_label)
                             await self._wake.wait()
                         continue
                     n_decode = n_active - len(prefilling)
+                    if self._chaos is not None and n_decode:
+                        delay_s = self._chaos.token_delay_s(
+                            self._replica_label)
+                        if delay_s > 0:
+                            # chaos token delay: the loop still
+                            # heartbeats but its requests go token-
+                            # silent — only the stall sweep sees this
+                            await asyncio.sleep(delay_s)
                     # step walltime: dispatch + the np.asarray host
                     # fence the engine already performs — perf_counter
                     # pairs only, no extra device sync
@@ -1715,6 +1747,11 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                         # throttled burn-rate watchdog: breach / storm
                         # transitions postmortem-dump the flight record
                         self._telemetry.slo.check()
+                    if self._health is not None:
+                        # throttled liveness sweep: healthy replicas'
+                        # waves age their peers' heartbeats even while
+                        # the router is quiet
+                        self._health.maybe_probe()
                     if self._pager is not None:
                         # kvscope occupancy ring: one pool snapshot
                         # per wave (host counters only, no device
@@ -1910,6 +1947,9 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 if pager.tier is not None:
                     self._telemetry.record_kv_tier(
                         pager.tier.stats())
+            if self._health is not None:
+                self._telemetry.record_health(
+                    self._health.replica_block(self._replica_label))
             stats = self._telemetry.engine_stats()
             if admission_policy is not None:
                 stats["admission_policy"] = admission_policy.describe()

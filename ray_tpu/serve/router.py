@@ -59,6 +59,10 @@ import numpy as np
 
 from ray_tpu._private import telemetry as _core
 from ray_tpu.serve.batching import HandoffCursor
+from ray_tpu.serve.chaos import ChaosConfig, ChaosInjector
+from ray_tpu.serve.health import (DEAD, HEALTHY, HealthConfig,
+                                  HealthMonitor, empty_fleet_health,
+                                  healthwatch_enabled)
 from ray_tpu.serve.slo import worst_burn_rate
 from ray_tpu.serve.telemetry import (EngineTelemetry, TraceContext,
                                      _tracebus_enabled, latency_anatomy,
@@ -243,10 +247,20 @@ class LLMRouter:
                  max_inflight_per_replica: Optional[int] = None,
                  seed: int = 0,
                  telemetry: Optional[EngineTelemetry] = None,
-                 name: str = "llm_fleet"):
+                 name: str = "llm_fleet",
+                 health: Optional[HealthMonitor] = None,
+                 chaos: Optional[ChaosInjector] = None):
         if policy not in ("prefix", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r}")
         self._replicas = replicas          # shared with LLMFleet
+        #: fleet HealthMonitor (None = healthwatch off) — consulted at
+        #: every pump so DEAD replicas are skipped and SUSPECT ones
+        #: deprioritized without any extra control loop
+        self._health = health
+        self._chaos = chaos
+        #: not-yet-admitted requests rescued off DEAD replicas' engine
+        #: queues and push_front-requeued to healthy peers
+        self.requeued_on_death = 0
         self._block_size = int(block_size)
         self.tenants: Dict[str, TenantClass] = {
             t.name: t for t in (tenants or ())}
@@ -309,10 +323,27 @@ class LLMRouter:
 
     # -- dispatch ------------------------------------------------------
 
+    def _state_of(self, rep: ReplicaHandle) -> str:
+        return (self._health.state(rep.name)
+                if self._health is not None else HEALTHY)
+
+    def _prefer_healthy(self, cands: List[ReplicaHandle]
+                        ) -> List[ReplicaHandle]:
+        """SUSPECT deprioritization: route to HEALTHY replicas while
+        any exist; a fleet that is ALL suspect still serves (suspicion
+        is a hint, not a verdict — only DEAD is disqualifying)."""
+        if self._health is None:
+            return cands
+        healthy = [r for r in cands
+                   if self._state_of(r) == HEALTHY]
+        return healthy or cands
+
     def _candidates(self, reps: Optional[List[ReplicaHandle]] = None
                     ) -> List[ReplicaHandle]:
         live = self.live_replicas if reps is None \
             else [r for r in reps if not r.draining]
+        if self._health is not None:
+            live = [r for r in live if self._state_of(r) != DEAD]
         if self._cap is None:
             return live
         return [r for r in live if r.inflight < self._cap]
@@ -334,19 +365,21 @@ class LLMRouter:
         least-loaded prefill replica and rides the handoff path."""
         if self.policy == "prefix":
             best, best_match = None, 0
-            for rep in dec:
+            for rep in self._prefer_healthy(dec):
                 rep.refresh_metadata()
                 m = rep.prefix_match(tokens, self._block_size)
                 if m > best_match:
                     best, best_match = rep, m
             if best is not None:
                 return best, "prefix_affinity", best_match
-        rep = min(pre, key=lambda r: r.inflight)
+        rep = min(self._prefer_healthy(pre),
+                  key=lambda r: r.inflight)
         return rep, "disagg_prefill", 0
 
     def _pick(self, tokens: Tuple[int, ...],
               cands: List[ReplicaHandle]
               ) -> Tuple[ReplicaHandle, str, int]:
+        cands = self._prefer_healthy(cands)
         if self.policy == "round_robin":
             rep = cands[self._rr % len(cands)]
             self._rr += 1
@@ -365,10 +398,77 @@ class LLMRouter:
         rep = a if a.inflight <= b.inflight else b
         return rep, "p2c", 0
 
+    def _health_sweep(self) -> None:
+        """Liveness consult at every pump: age heartbeats (throttled
+        by the monitor's probe interval) and rescue the engine-queued
+        requests of any replica the sweep finds DEAD.  Idempotent —
+        a dead replica with an empty queue costs one state read."""
+        if self._health is None:
+            return
+        self._health.maybe_probe()
+        for rep in self._replicas:
+            if not rep.draining and self._state_of(rep) == DEAD:
+                self._requeue_dead(rep)
+
+    def _requeue_dead(self, dead: ReplicaHandle) -> int:
+        """Rescue the DEAD replica's not-yet-admitted engine queue:
+        every queued prompt is push_front-requeued to a healthy
+        compatible replica with its ORIGINAL future and a fresh
+        engine-side record backdated to the original enqueue instant,
+        so the caller still gets its result and TTFT/e2e still charge
+        the full wait.  Requests already admitted to slots are the
+        dead engine's to finish (or fail) — recovery proper is ROADMAP
+        item 4; this is the detection + queue-rescue substrate.
+        Handoff packages stay queued on the dead replica (their KV
+        block rows live in ITS pager — nothing to rescue host-side)."""
+        q = getattr(dead.inst, "_queue", None)
+        if q is None or not len(q):
+            return 0
+        # role compatibility: "both" replicas take anything; a dead
+        # "both" replica's prompts may also land on "decode" peers
+        # (decode engines paged-prefill whole requests — the same
+        # bypass _pick_disagg's prefix-affinity path uses)
+        ok_roles = {"both", dead.role}
+        if dead.role == "both":
+            ok_roles.add("decode")
+        targets = [r for r in self._replicas
+                   if not r.draining and r is not dead
+                   and r.role in ok_roles
+                   and self._state_of(r) == HEALTHY
+                   and getattr(r.inst, "_wake", None) is not None]
+        items = q.pop(len(q))
+        if not targets:
+            for (arg, rec, sp), fut in reversed(items):
+                q.push_front((arg, rec, sp), fut)
+            return 0
+        moved = 0
+        stay = []
+        for (arg, rec, sp), fut in items:
+            if isinstance(arg, HandoffCursor):
+                stay.append(((arg, rec, sp), fut))
+                continue
+            dead.inst._telemetry.record_requeue(
+                rec, reason="replica_dead")
+            target = min(targets, key=lambda r: (
+                len(r.inst._queue), r.inflight))
+            rec2 = target.inst._telemetry.record_enqueue(
+                int(arg.shape[0]), now=rec.get("enqueue"),
+                tenant=rec.get("tenant"), ctx=rec.get("ctx"))
+            target.inst._queue.push_front((arg, rec2, sp), fut)
+            target.inst._wake.set()
+            moved += 1
+        for (arg, rec, sp), fut in reversed(stay):
+            q.push_front((arg, rec, sp), fut)
+        if moved:
+            self.requeued_on_death += moved
+            self._health.note_requeued(moved)
+        return moved
+
     def _pump(self) -> None:
         """Dispatch queued requests while replica capacity is free.
         Synchronous and re-entrant-safe: called on submit, on every
         completion, and when the replica set changes."""
+        self._health_sweep()
         while self.queue_depth() > 0:
             live = self.live_replicas
             pre = [r for r in live if r.role == "prefill"]
@@ -422,16 +522,40 @@ class LLMRouter:
         its admission at stage one, and the decode engine's own
         queue/requeue machinery absorbs any wait."""
         dec = [r for r in self.live_replicas
-               if r.role in ("decode", "both")]
+               if r.role in ("decode", "both")
+               and self._state_of(r) != DEAD]
         if not dec:
             raise RuntimeError(
                 "no live decode replicas to hand off to")
+        dec = self._prefer_healthy(dec)
         under = [r for r in dec
                  if self._cap is None or r.inflight < self._cap]
         pool = under or dec
         return max(pool, key=lambda r: (r.free_blocks(), -r.inflight))
 
     async def _forward_handoff(self, pkg, tenant, ctx, rid: int):
+        if self._chaos is not None \
+                and self._chaos.should_drop_handoff():
+            # chaos: the package "got lost on the wire".  Journal the
+            # drop and recover by re-running the prompt from scratch
+            # on a decode-capable replica (decode engines paged-
+            # prefill whole requests) — greedy decoding makes the
+            # recovered result bit-identical, only slower.
+            self.telemetry.flightrec.record(
+                "handoff_dropped", req=rid,
+                n_blocks=int(pkg.n_blocks),
+                **({"trace": ctx.trace_id} if ctx is not None else {}))
+            meta = pkg.meta or {}
+            rep = self._pick_handoff_target()
+            rep.inflight += 1
+            rep.routed += 1
+            try:
+                return await rep.inst(
+                    pkg.prompt, sampling=pkg.sampling, tenant=tenant,
+                    enqueue_ts=meta.get("enqueue"), trace=ctx)
+            finally:
+                rep.inflight -= 1
+                self._pump()
         rep = self._pick_handoff_target()
         self.telemetry.record_route(
             req=rid, replica=rep.name, policy="handoff",
@@ -508,6 +632,7 @@ class LLMRouter:
             "routed_by_policy": dict(self.routed_by_policy),
             "disaggregated": self.disaggregated,
             "handoffs": self.handoffs,
+            "requeued_on_death": self.requeued_on_death,
             "max_inflight_per_replica": self._cap,
             "tenants": {n: {"weight": t.weight,
                             "objective": t.objective,
@@ -541,7 +666,9 @@ class LLMFleet:
                  max_inflight_per_replica: Optional[int] = None,
                  seed: int = 0,
                  prefill_factory: Optional[Callable[[], Any]] = None,
-                 num_prefill_replicas: int = 0):
+                 num_prefill_replicas: int = 0,
+                 health: Optional[HealthConfig] = None,
+                 chaos: Optional[ChaosConfig] = None):
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
         if (prefill_factory is None) != (num_prefill_replicas == 0):
@@ -552,6 +679,17 @@ class LLMFleet:
         self._factory = factory
         self._prefill_factory = prefill_factory
         self.telemetry = EngineTelemetry(name)
+        # healthwatch: one monitor per fleet, journaling into the
+        # fleet flight recorder; RAYTPU_HEALTHWATCH=0 disables it
+        # entirely (self.health is None, engines get no attach)
+        self.health = (HealthMonitor(
+            health, deployment=name,
+            recorder=self.telemetry.flightrec)
+            if healthwatch_enabled() else None)
+        # chaos: inert unless the caller hands a ChaosConfig — the
+        # default fleet attaches nothing to the engine loops
+        self.chaos = (ChaosInjector(chaos, monitor=self.health)
+                      if chaos is not None else None)
         self._replicas: List[ReplicaHandle] = []
         self._retired: List[ReplicaHandle] = []
         self._next_replica = itertools.count()
@@ -570,7 +708,8 @@ class LLMFleet:
             self._replicas, block_size=block_size, tenants=tenants,
             policy=policy, wfq=wfq,
             max_inflight_per_replica=max_inflight_per_replica,
-            seed=seed, telemetry=self.telemetry, name=name)
+            seed=seed, telemetry=self.telemetry, name=name,
+            health=self.health, chaos=self.chaos)
         _FLEETS[name] = self
 
     # -- replica lifecycle ---------------------------------------------
@@ -589,6 +728,22 @@ class LLMFleet:
                 f"{self.name}/r{next(self._next_replica)}",
                 self._factory())
         self._replicas.append(rep)
+        # healthwatch attach — covers autoscale-added replicas too.
+        # The engine heartbeats under its fleet name, and the monitor
+        # watches its telemetry for token-silent residents.
+        inst = rep.inst
+        if hasattr(inst, "_replica_label"):
+            inst._replica_label = rep.name
+        if self.health is not None and hasattr(inst, "_health"):
+            inst._health = self.health
+            self.health.register(
+                rep.name, role=rep.role,
+                recorder=getattr(getattr(inst, "_telemetry", None),
+                                 "flightrec", None),
+                telemetry=getattr(inst, "_telemetry", None))
+        if self.chaos is not None and hasattr(inst, "_chaos"):
+            inst._chaos = self.chaos
+            self.chaos.bind(rep.name)
         return rep
 
     async def __call__(self, prompt, tenant: Optional[str] = None,
@@ -676,6 +831,10 @@ class LLMFleet:
         drain = await self.router.drain(victim)
         self._replicas.remove(victim)
         self._retired.append(victim)
+        if self.health is not None:
+            # a drained replica stops heartbeating by design — drop
+            # it from the monitor so retirement never reads as death
+            self.health.unregister(victim.name)
         victim.inst.shutdown_engine()
         self.router._pump()
         return {"action": "down", "reason": "idle",
@@ -845,9 +1004,22 @@ class LLMFleet:
             "handoff": handoff,
             "tenants": self.tenant_report(),
             "replicas": replicas,
+            "health": self._health_block(),
             "flightrec": self.telemetry.flightrec.stats(),
             "latency_anatomy": self.latency_anatomy(),
         }
+
+    def _health_block(self) -> Dict[str, Any]:
+        """Fleet health block — zeroed (enabled=False) when the
+        monitor is off, so /api/serve/health consumers never branch
+        on presence."""
+        if self.health is None:
+            return empty_fleet_health()
+        block = self.health.fleet_block()
+        block["requeued_on_death"] = self.router.requeued_on_death
+        if self.chaos is not None:
+            block["chaos"] = self.chaos.stats()
+        return block
 
     # -- tracebus (tools/tracebus.py collects these) -------------------
 
@@ -920,6 +1092,8 @@ def build_llm_fleet(family: str = "gpt2", preset: str = "nano", *,
                     autoscale: Optional[AutoscalePolicy] = None,
                     max_inflight_per_replica: Optional[int] = None,
                     fleet_name: Optional[str] = None, seed: int = 0,
+                    health: Optional[HealthConfig] = None,
+                    chaos: Optional[ChaosConfig] = None,
                     **engine_kw) -> LLMFleet:
     """Stand up independent continuous-engine replicas (each its own
     jitted programs / BlockPager / SLOTracker) behind an `LLMRouter`.
@@ -985,7 +1159,7 @@ def build_llm_fleet(family: str = "gpt2", preset: str = "nano", *,
             name=name, block_size=bs_dec, tenants=tenants,
             policy=routing, wfq=wfq, autoscale=autoscale,
             max_inflight_per_replica=max_inflight_per_replica,
-            seed=seed)
+            seed=seed, health=health, chaos=chaos)
     max_slots = int(engine_kw.get("max_slots", 4))
     if max_inflight_per_replica is None:
         max_inflight_per_replica = max_slots
@@ -996,4 +1170,5 @@ def build_llm_fleet(family: str = "gpt2", preset: str = "nano", *,
         block_size=int(engine_kw.get("kv_block_size", 16)),
         tenants=tenants, policy=routing, wfq=wfq,
         autoscale=autoscale,
-        max_inflight_per_replica=max_inflight_per_replica, seed=seed)
+        max_inflight_per_replica=max_inflight_per_replica, seed=seed,
+        health=health, chaos=chaos)

@@ -268,7 +268,16 @@ class SLOTracker:
                 self._m["burn_rate"].set(
                     blk["burn_rate"], tags=dict(otags, window=win))
             fresh = obj["breached"] and not self._breached.get(name)
+            cleared = (not obj["breached"]
+                       and self._breached.get(name))
             self._breached[name] = obj["breached"]
+            if cleared and self._recorder is not None:
+                # close the burn window: incidents.py pairs this with
+                # the opening slo_breach to bound the incident span
+                self._recorder.record(
+                    "slo_recover", objective=name,
+                    burn_rate=obj["burn_rate"],
+                    target_ms=obj["target_ms"])
             if fresh:
                 with self._lock:
                     self.breaches += 1

@@ -38,6 +38,7 @@ from typing import Any, Deque, Dict, List, Optional
 
 from ray_tpu._private import telemetry as _core
 from ray_tpu._private.flightrec import FlightRecorder
+from ray_tpu.serve.health import empty_health as _empty_health
 from ray_tpu.serve.kv_tier import empty_kv_tier as _empty_kv_tier
 from ray_tpu.serve.kvscope import empty_kv_scope as _empty_kv_scope
 from ray_tpu.util import tracing
@@ -504,6 +505,10 @@ class EngineTelemetry:
         #: pushes; same delta-tracking idiom for its restored counter
         self._kv_tier: Optional[Dict[str, Any]] = None
         self._kv_tier_restored_reported = 0
+        #: round-19 healthwatch block (serve/health.py) the deployment
+        #: refreshes from its fleet HealthMonitor — zero-shaped when
+        #: no monitor watches this engine (standalone / disabled)
+        self._health_block: Optional[Dict[str, Any]] = None
         self._spec = {"proposed": 0, "accepted": 0, "rounds": 0}
         #: chunked streaming prefill (round 15): admissions split into
         #: block-sized chunks interleaved with decode waves
@@ -1037,6 +1042,50 @@ class EngineTelemetry:
         if delta > 0:
             self._m["kv_tier_restored"].inc(delta, tags=self._tags)
 
+    def record_health(self, block: Dict[str, Any]) -> None:
+        """Latest healthwatch block (serve/health.py
+        ``HealthMonitor.replica_block``) — mirrored into
+        ``engine_stats()["health"]``.  The monitor publishes its own
+        Prometheus gauges/counters at transition time; this is the
+        stats-surface mirror only."""
+        with self._lock:
+            self._health_block = dict(block)
+
+    def stalled_requests(self, stall_ms: float,
+                         now: Optional[float] = None
+                         ) -> List[Dict[str, Any]]:
+        """Admitted-but-token-silent requests: active records whose
+        last emitted token (or admission, when no token yet) is older
+        than ``stall_ms`` — the healthwatch stall sweep's feed.  Each
+        entry carries the flightrec-known resident state (slot,
+        tokens emitted, tenant, trace) so the ``request_stall``
+        journal entry names exactly what is wedged."""
+        now = self._now(now)
+        with self._lock:
+            recs = list(self._active.values())
+        out: List[Dict[str, Any]] = []
+        for r in recs:
+            if r.get("status") != "active":
+                continue
+            ts = r.get("token_ts")
+            last = ts[-1] if ts else (r.get("first_token")
+                                      or r.get("admit"))
+            if last is None:
+                continue
+            silent_ms = (now - last) * 1e3
+            if silent_ms < stall_ms:
+                continue
+            ctx = r.get("ctx")
+            out.append({
+                "id": r["id"],
+                "slot": r.get("slot"),
+                "tokens": int(r.get("tokens", 0)),
+                "tenant": r.get("tenant"),
+                "silent_ms": round(silent_ms, 3),
+                "trace": ctx.trace_id if ctx is not None else None,
+            })
+        return out
+
     # -- fleet control plane (serve/router.py journals through here) -------
 
     def record_route(self, req: int, replica: str, policy: str,
@@ -1216,6 +1265,7 @@ class EngineTelemetry:
                         if self._kv_stats is not None else None)
             kv_scope = self._kv_scope
             kv_tier = self._kv_tier
+            health = self._health_block
             spec = dict(self._spec)
             chunks = dict(self._chunks)
             handoff = dict(self._handoff)
@@ -1279,6 +1329,11 @@ class EngineTelemetry:
             # block when no tier is configured, dense included)
             "kv_tier": (kv_tier if kv_tier is not None
                         else _empty_kv_tier()),
+            # round-19: healthwatch — liveness state machine counters
+            # (stable zero-shaped block when no HealthMonitor watches
+            # this engine: standalone, dense, or RAYTPU_HEALTHWATCH=0)
+            "health": (health if health is not None
+                       else _empty_health()),
             # round-11: speculative decoding — engine totals plus
             # per-request acceptance-rate percentiles (requests that
             # saw at least one verify round)

@@ -520,7 +520,9 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
                       autoscale=None, slo=None, admission_policy=None,
                       mesh=None,
                       config_overrides: Optional[Dict[str, Any]] = None,
-                      trace_dump: Optional[str] = None
+                      trace_dump: Optional[str] = None,
+                      health=None, chaos=None,
+                      max_inflight_per_replica: Optional[int] = None
                       ) -> Dict[str, Any]:
     """One multi-tenant traffic run against a fresh in-process fleet
     (``build_llm_fleet``): N paged continuous engines behind the
@@ -539,7 +541,14 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
     homogeneous at equal chip count.  `prefill_engine_kw` /
     `decode_engine_kw` overlay per-role engine knobs (mesh degree,
     batch shape, slot count); `handoff_staged` forces the D2H→H2D
-    host-staging hop."""
+    host-staging hop.
+
+    `health` (a serve.health.HealthConfig) tunes the fleet's
+    healthwatch monitor; `chaos` (a serve.chaos.ChaosConfig) injects
+    seeded faults mid-traffic — the report then carries
+    ``time_to_detect_ms`` (fault instant → DEAD transition) and
+    ``requests_requeued_on_death`` so sweeps can track detection
+    latency as a first-class metric (Podracer treats it as one)."""
     import asyncio
 
     from ray_tpu.serve.router import build_llm_fleet
@@ -558,7 +567,8 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
         kv_block_size=kv_block_size, kv_num_blocks=kv_num_blocks,
         kv_host_tier_bytes=kv_host_tier_bytes, slo=slo,
         admission_policy=admission_policy, mesh=mesh,
-        config_overrides=config_overrides)
+        config_overrides=config_overrides, health=health, chaos=chaos,
+        max_inflight_per_replica=max_inflight_per_replica)
     requests = TrafficGenerator(spec).requests()
 
     async def main():
@@ -610,6 +620,13 @@ def run_traffic_fleet(spec: TrafficSpec, *, num_replicas: int = 2,
         "critical_path") or {}
     report["handoff_ms_p99"] = \
         (cp_blk.get("handoff_ms") or {}).get("p99") or 0.0
+    # healthwatch headlines: fault-injection detection latency and
+    # queue rescues (None/0 on chaos-free runs so sweep identity
+    # stays stable — the fields are always present)
+    health_blk = report["fleet"].get("health") or {}
+    report["time_to_detect_ms"] = health_blk.get("time_to_detect_ms")
+    report["requests_requeued_on_death"] = int(
+        health_blk.get("requeued_on_death", 0))
     report["tenants"] = report["fleet"]["tenants"]
     #: flattened for SWEEPJSON consumers: {tenant}_{obj}_slo_attainment
     flat: Dict[str, Any] = {}

@@ -192,6 +192,52 @@ def report_lines(doc: Dict[str, Any]) -> List[str]:
                 f"{row['prefix_affinity']}  {row['p2c']}  "
                 f"{row['round_robin']}  {row['matched_blocks']}  "
                 f"{tenants}")
+    # healthwatch lane (serve/health.py): the liveness state machine's
+    # journaled transitions + stall events, aggregated per replica so
+    # a postmortem reads "which replica got sick, when, and why"
+    # alongside the routing table above
+    trans = [e for e in events if e.get("kind") == "health_transition"]
+    stalls = [e for e in events if e.get("kind") == "request_stall"]
+    if trans or stalls:
+        health: Dict[str, Dict[str, Any]] = {}
+        for e in trans:
+            row = health.setdefault(str(e.get("replica", "?")), {
+                "transitions": 0, "suspect": 0, "dead": 0,
+                "recovered": 0, "stalls": 0, "last": None,
+                "detect_ms": None})
+            row["transitions"] += 1
+            to = str(e.get("to", "?"))
+            if to == "suspect":
+                row["suspect"] += 1
+            elif to == "dead":
+                row["dead"] += 1
+            elif to == "healthy":
+                row["recovered"] += 1
+            row["last"] = (f"{e.get('from')}->{to} "
+                           f"({e.get('reason')})")
+            if e.get("time_to_detect_ms") is not None:
+                row["detect_ms"] = e["time_to_detect_ms"]
+        for e in stalls:
+            row = health.setdefault(str(e.get("replica", "?")), {
+                "transitions": 0, "suspect": 0, "dead": 0,
+                "recovered": 0, "stalls": 0, "last": None,
+                "detect_ms": None})
+            row["stalls"] += 1
+        lines.append("health transitions (by replica):")
+        lines.append("  replica  transitions  suspect  dead  "
+                     "recovered  stalls  detect_ms  last")
+        for name in sorted(health):
+            row = health[name]
+            lines.append(
+                f"  {name}  {row['transitions']}  {row['suspect']}  "
+                f"{row['dead']}  {row['recovered']}  {row['stalls']}  "
+                f"{row['detect_ms'] if row['detect_ms'] is not None else '-'}  "
+                f"{row['last'] or '-'}")
+        tail = filter_events(events, kinds=["request_stall"], last=3)
+        if tail:
+            lines.append("last request stalls:")
+            for e in tail:
+                lines.append("  " + json.dumps(e, sort_keys=True))
     for label, kind in (("scale-ups", "scale_up"),
                         ("scale-downs", "scale_down"),
                         ("drains", "drain")):

@@ -17,10 +17,12 @@ Rule ids:
 * ``blocking-call-in-async`` — ``.block_until_ready()``,
   ``np.asarray(...)``, sync ``ray.get``/``ray_tpu.get``, and
   ``time.sleep`` inside ``async def`` bodies under ``ray_tpu/serve/``
-  or ``ray_tpu/tools/autopilot/`` (the dashboard calls the autopilot
-  from its event loop): each blocks the event loop (and usually the
-  decode engine) on a device or cluster round-trip.  Deliberate host
-  fences carry a disable comment naming the reason.
+  (healthwatch's ``serve/health.py``/``serve/chaos.py`` included),
+  ``tools/incidents.py``, or ``ray_tpu/tools/autopilot/`` (the
+  dashboard calls the autopilot from its event loop): each blocks the
+  event loop (and usually the decode engine) on a device or cluster
+  round-trip.  Deliberate host fences carry a disable comment naming
+  the reason.
 * ``wallclock-in-telemetry`` — ``time.time()`` in ``*/telemetry.py``,
   ``util/tracing.py``, ``_private/flightrec.py``, ``serve/slo.py``,
   ``serve/kv_tier.py`` (the host tier never reads a clock — the
@@ -28,7 +30,11 @@ Rule ids:
   ``note_d2h``, the trainwatch idiom),
   ``serve/router.py`` (the fleet router timestamps routing/autoscale
   decisions and measures drain deadlines — interval math like the
-  rest), ``train/goodput.py`` (the trainwatch anatomy promises legs
+  rest), ``serve/health.py``/``serve/chaos.py``/``tools/incidents.py``
+  (healthwatch: heartbeat ages, detection latency, and merged
+  incident timelines are all perf_counter interval math with
+  injectable ``now=``), ``train/goodput.py`` (the trainwatch anatomy
+  promises legs
   that sum exactly to the step wall — one wall-clock read breaks the
   invariant), or anywhere under ``ray_tpu/tools/autopilot/``
   (verdicts must be reproducible from ledger contents alone):
@@ -100,7 +106,8 @@ def _blocking_calls_in_async(tree: ast.AST, rel: str) -> List[Violation]:
     rel_posix = rel.replace("\\", "/")
     if not (rel_posix.startswith("ray_tpu/serve/")
             or rel_posix.startswith("ray_tpu/tools/autopilot/")
-            or rel_posix.endswith("tools/tracebus.py")):
+            or rel_posix.endswith("tools/tracebus.py")
+            or rel_posix.endswith("tools/incidents.py")):
         return []
     out: List[Violation] = []
 
@@ -146,8 +153,11 @@ def _wallclock_in_telemetry(tree: ast.AST, rel: str) -> List[Violation]:
             or rel_posix.endswith("serve/router.py")
             or rel_posix.endswith("serve/kvscope.py")
             or rel_posix.endswith("serve/kv_tier.py")
+            or rel_posix.endswith("serve/health.py")
+            or rel_posix.endswith("serve/chaos.py")
             or rel_posix.endswith("tools/tracebus.py")
             or rel_posix.endswith("tools/kvscope.py")
+            or rel_posix.endswith("tools/incidents.py")
             or rel_posix.endswith("train/goodput.py")
             or rel_posix.startswith("ray_tpu/tools/autopilot/")):
         return []

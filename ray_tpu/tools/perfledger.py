@@ -89,6 +89,11 @@ _SWEEP_FIELDS = (
     # cost of the block-granular KV handoff hop — "_ms" marks it
     # lower-is-better
     "handoff_ms_p99",
+    # healthwatch (serve/health.py, traffic_chaos records): fault
+    # injection → DEAD-transition latency — "_ms" marks it
+    # lower-is-better (detection latency is the Podracer-style
+    # first-class fleet metric)
+    "time_to_detect_ms",
 )
 
 #: substrings marking a metric where SMALLER is better

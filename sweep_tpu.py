@@ -72,6 +72,19 @@ traffic_fleet record at equal chip count is the A/B control:
                              "prefill_replicas": 1,
                              "decode_replicas": 1}]]'
 
+{"mode": "traffic_chaos", ...} is traffic_fleet with one replica
+FROZEN mid-traffic by seeded fault injection ("freeze_replica", chaos
+knobs in serve/chaos.py): healthwatch must mark it SUSPECT→DEAD and
+the router must requeue and route around it.  The record surfaces
+time_to_detect_ms (fault → DEAD transition; perfledger tracks it
+lower-is-better) and requests_requeued_on_death next to the usual
+latency fields — a chaos-free traffic_fleet record at equal config is
+the A/B control:
+
+  python sweep_tpu.py '[[8, {"mode": "traffic_fleet", "replicas": 2}],
+                        [8, {"mode": "traffic_chaos", "replicas": 2,
+                             "freeze_replica": 1}]]'
+
 Output: for every variant one HUMAN line and one machine-readable JSON
 line (prefixed SWEEPJSON so `grep ^SWEEPJSON | cut -c11-` recovers a
 clean JSONL stream).  The first record is the graftcheck static-audit
@@ -529,6 +542,116 @@ def _run_traffic_disagg_variant(max_slots, kw, out):
     return rec
 
 
+def _run_traffic_chaos_variant(max_slots, kw, out):
+    """One {"mode": "traffic_chaos"} sweep entry → SWEEPJSON record.
+
+    The traffic_fleet mixture with one replica FROZEN mid-traffic by
+    seeded fault injection (serve/chaos.py): healthwatch
+    (serve/health.py) must transition it SUSPECT→DEAD, the router must
+    route around it, and the record surfaces the detection headlines —
+    ``time_to_detect_ms`` (fault instant → DEAD transition,
+    lower-is-better in perfledger) and
+    ``requests_requeued_on_death`` — next to the same latency/hit-rate
+    fields as traffic_fleet, so the chaos-free record at equal config
+    is the A/B control for the blip's cost."""
+    from ray_tpu.serve.chaos import ChaosConfig
+    from ray_tpu.serve.health import HealthConfig
+    from ray_tpu.serve.slo import SLOConfig
+    from ray_tpu.serve.traffic import (TenantSpec, TrafficSpec,
+                                       run_traffic_fleet)
+
+    replicas = kw.pop("replicas", 2)
+    routing = kw.pop("routing", "prefix")
+    freeze_replica = kw.pop("freeze_replica", replicas - 1)
+    suspect_ms = kw.pop("suspect_ms", 40.0)
+    dead_ms = kw.pop("dead_ms", 120.0)
+    stall_ms = kw.pop("stall_ms", 80.0)
+    freeze_waves = kw.pop("freeze_waves", 200)
+    ttft_slo_ms = kw.pop("ttft_slo_ms", 10000.0)
+    e2e_slo_ms = kw.pop("e2e_slo_ms", 20000.0)
+    groups = kw.pop("prefix_groups", 4)
+    lo = tuple(range(groups // 2)) or (0,)
+    hi = tuple(range(groups // 2, groups)) or (0,)
+    tenants = (
+        TenantSpec("interactive", rate_share=0.5,
+                   slo_class="interactive", prefix_groups=lo,
+                   ttft_slo_ms=ttft_slo_ms, e2e_slo_ms=e2e_slo_ms),
+        TenantSpec("batch", rate_share=0.5, slo_class="batch",
+                   prefix_groups=hi, e2e_slo_ms=2 * e2e_slo_ms),
+    )
+    spec = TrafficSpec(
+        num_requests=kw.pop("requests", 64),
+        seed=kw.pop("seed", 0),
+        rate_rps=kw.pop("rate_rps", 32.0),
+        num_prefix_groups=groups,
+        prefix_len=kw.pop("prefix_len", 256),
+        p_shared=kw.pop("p_shared", 0.75),
+        tail_len_mean=kw.pop("tail_len_mean", 32.0),
+        tail_len_max=kw.pop("tail_len_max", 128),
+        vocab=kw.pop("vocab", 50000),
+        tenants=tenants)
+    health = HealthConfig(suspect_ms=suspect_ms, dead_ms=dead_ms,
+                          stall_ms=stall_ms, probe_ms=5.0)
+    chaos = ChaosConfig(seed=spec.seed,
+                        freeze_replica=int(freeze_replica),
+                        freeze_after_waves=2,
+                        freeze_waves=int(freeze_waves),
+                        freeze_poll_ms=5.0)
+    run_kw = {
+        "preset": kw.pop("preset", "gpt2"),
+        "kv_block_size": kw.pop("block_size", 16),
+        "kv_num_blocks": kw.pop("kv_num_blocks", None) or None,
+        "max_new_tokens": kw.pop("new_tokens", 64),
+        "prefill_bucket": kw.pop("prefill_bucket", 128),
+        "time_scale": kw.pop("time_scale", 1.0),
+    }
+    variant = {"mode": "traffic_chaos", "max_slots": max_slots,
+               "replicas": replicas, "routing": routing,
+               "freeze_replica": int(freeze_replica),
+               "suspect_ms": suspect_ms, "dead_ms": dead_ms,
+               "stall_ms": stall_ms, "freeze_waves": int(freeze_waves),
+               "requests": spec.num_requests,
+               "prefix_len": spec.prefix_len,
+               "rate_rps": spec.rate_rps,
+               "preset": run_kw["preset"], "overrides": kw}
+    try:
+        rep = run_traffic_fleet(
+            spec, num_replicas=replicas, family="gpt2",
+            max_slots=max_slots, routing=routing,
+            slo=SLOConfig(ttft_ms=ttft_slo_ms, e2e_ms=e2e_slo_ms),
+            health=health, chaos=chaos,
+            config_overrides=kw or None, **run_kw)
+        print(f"traffic_chaos slots={max_slots} replicas={replicas} "
+              f"frozen=r{freeze_replica} n={rep['offered']}: "
+              f"time_to_detect_ms={rep['time_to_detect_ms']} "
+              f"requeued={rep['requests_requeued_on_death']} "
+              f"shed={rep['shed']}", file=out, flush=True)
+        rec = {"sweep": variant,
+               "time_to_detect_ms": rep.get("time_to_detect_ms"),
+               "requests_requeued_on_death":
+                   rep.get("requests_requeued_on_death"),
+               "router_prefix_hit_rate":
+                   rep["router_prefix_hit_rate"],
+               "itl_ms_p50": rep.get("itl_ms_p50"),
+               "itl_ms_p99": rep.get("itl_ms_p99"),
+               "completed": rep["completed"], "shed": rep["shed"],
+               "latency_p50_ms": rep["latency_ms"]["p50"],
+               "latency_p95_ms": rep["latency_ms"]["p95"],
+               "fleet": {
+                   "num_replicas": rep["num_replicas"],
+                   "health": rep["fleet"].get("health"),
+                   "routed_by_policy":
+                       rep["fleet"]["router"]["routed_by_policy"]}}
+        rec.update(rep.get("tenant_slo_attainment") or {})
+    except Exception as e:  # noqa: BLE001 - sweep must survive
+        print(f"traffic_chaos slots={max_slots} replicas={replicas} "
+              f"{kw}: FAILED {type(e).__name__}: {str(e)[:160]}",
+              file=out, flush=True)
+        rec = {"sweep": variant, "failed": _failure_tag(e),
+               "error": f"{type(e).__name__}: {str(e)[:300]}"}
+    return rec
+
+
 def _autopilot_record():
     """One SWEEPJSON record attributing every program this sweep
     registered (compute- vs HBM-bound against the device ridge, ranked
@@ -675,6 +798,11 @@ def run_sweep(configs, n_chips, n_steps=10, out=sys.stdout,
             continue
         if mode == "traffic_disagg":
             rec = _run_traffic_disagg_variant(batch_per_chip, kw, out)
+            print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
+            records.append(rec)
+            continue
+        if mode == "traffic_chaos":
+            rec = _run_traffic_chaos_variant(batch_per_chip, kw, out)
             print("SWEEPJSON " + json.dumps(rec), file=out, flush=True)
             records.append(rec)
             continue

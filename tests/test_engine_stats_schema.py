@@ -31,7 +31,13 @@ TOP_KEYS = {
     "prefill_compiles", "program_compiles", "rejections_by_reason",
     "kv_cache", "kv_scope", "kv_tier", "spec", "slo", "flightrec",
     "programs", "latency_anatomy", "prefill_chunks", "role", "handoff",
+    "health",
 }
+
+HEALTH_KEYS = {"enabled", "state", "suspect_ms", "dead_ms", "stall_ms",
+               "heartbeats", "heartbeat_age_ms", "idle", "transitions",
+               "suspect_count", "dead_count", "recoveries", "stalls",
+               "time_to_detect_ms", "transition_log"}
 
 KV_SCOPE_KEYS = {"enabled", "occupancy", "forensics",
                  "blocks_by_tenant", "hbm_ledger"}
@@ -224,6 +230,19 @@ def test_engine_stats_schema(kv_layout, spec, sharded):
     assert set(stats["handoff"]) == HANDOFF_KEYS
     assert all(v == 0 for v in stats["handoff"].values())
 
+    # healthwatch block: always present and identically shaped —
+    # standalone engines (no fleet, hence no HealthMonitor attached)
+    # report the zero-shaped disabled block, so dashboards and
+    # incident tooling never branch on whether a monitor exists
+    hb = stats["health"]
+    assert set(hb) == HEALTH_KEYS
+    assert hb["enabled"] is False
+    assert hb["state"] == "healthy"
+    assert hb["heartbeats"] == 0 and hb["transitions"] == 0
+    assert hb["stalls"] == 0
+    assert hb["time_to_detect_ms"] is None
+    assert hb["transition_log"] == []
+
     # chunked-prefill counter block: always present, all-zero when
     # chunking is off (as here — short prompts, no chunk knob)
     assert set(stats["prefill_chunks"]) == PREFILL_CHUNK_KEYS
@@ -316,6 +335,7 @@ def test_engine_stats_role_split_shape():
         missing = TOP_KEYS - set(stats)
         assert not missing, f"engine_stats() lost keys: {missing}"
         assert set(stats["handoff"]) == HANDOFF_KEYS
+        assert set(stats["health"]) == HEALTH_KEYS
 
     assert p_st["role"] == "prefill"
     assert p_st["handoff"]["handoffs_out"] == 2

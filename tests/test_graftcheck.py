@@ -725,6 +725,62 @@ def test_lint_kv_tier_source_clean():
     assert not kept, [str(v) for v in kept]
 
 
+def test_lint_wallclock_covers_healthwatch():
+    # round 19: healthwatch state transitions and incident timelines
+    # are rebased onto the perf_counter clock shared with flightrec
+    # and tracebus — a planted time.time() in the monitor, the chaos
+    # injector, or the incidents CLI would skew detection latency and
+    # mis-order merged lanes around NTP slews, so each must flag
+    src = textwrap.dedent("""\
+        import time
+
+        def heartbeat(name):
+            return time.time()
+    """)
+    for rel in ("ray_tpu/serve/health.py",
+                "ray_tpu/serve/chaos.py",
+                "ray_tpu/tools/incidents.py"):
+        kept, _ = lint_source(src, rel)
+        assert [v.rule for v in kept] == ["wallclock-in-telemetry"], rel
+        kept, _ = lint_source(src.replace("time.time()",
+                                          "time.perf_counter()"), rel)
+        assert not kept, rel
+    # untimed tools neighbours stay out of scope
+    kept, _ = lint_source(src, "ray_tpu/tools/fixture.py")
+    assert not kept
+
+
+def test_lint_blocking_call_covers_incidents():
+    # health.py/chaos.py live under ray_tpu/serve/ (already in the
+    # async blocking-call scope); the incidents CLI is pulled in
+    # explicitly so a future async export path can't sneak a
+    # device-blocking call past review
+    src = textwrap.dedent("""\
+        import numpy as np
+
+        async def export(doc):
+            return np.asarray(doc)
+    """)
+    for rel in ("ray_tpu/serve/health.py",
+                "ray_tpu/serve/chaos.py",
+                "ray_tpu/tools/incidents.py"):
+        kept, _ = lint_source(src, rel)
+        assert [v.rule for v in kept] == ["blocking-call-in-async"], rel
+
+
+def test_lint_healthwatch_sources_clean():
+    # the shipped healthwatch trio lints clean under the full rule set
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel in ("ray_tpu/serve/health.py",
+                "ray_tpu/serve/chaos.py",
+                "ray_tpu/tools/incidents.py"):
+        with open(os.path.join(repo, rel)) as f:
+            kept, _ = lint_source(f.read(), rel)
+        assert not kept, (rel, [str(v) for v in kept])
+
+
 def test_lint_mutable_global_positive():
     src = textwrap.dedent("""\
         from ray_tpu import remote
