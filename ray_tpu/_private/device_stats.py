@@ -484,17 +484,25 @@ class ProgramRegistry:
             except Exception:  # noqa: BLE001
                 sig = None
             fresh = False
+            do_harvest = False
             if sig is not None:
                 with seen_lock:
                     fresh = sig not in seen
                     if fresh:
                         seen.add(sig)
+                    # claim the one-shot cost harvest under the same
+                    # lock: two threads compiling fresh signatures
+                    # concurrently must not both run the AOT side
+                    # compile (the unlocked check-then-act raced)
+                    if (fresh and not harvested[0]
+                            and cost_capture_enabled()
+                            and hasattr(fn, "lower")):
+                        harvested[0] = True
+                        do_harvest = True
             if fresh:
                 cost = None
                 t0 = time.perf_counter()
-                if (not harvested[0] and cost_capture_enabled()
-                        and hasattr(fn, "lower")):
-                    harvested[0] = True
+                if do_harvest:
                     try:
                         # side AOT compile of the first signature, only
                         # for its cost/memory analysis — the executing

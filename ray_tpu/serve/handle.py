@@ -16,6 +16,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional
 
 _REFRESH_S = 5.0  # fallback staleness bound if the listener dies
@@ -153,6 +154,10 @@ class DeploymentHandle:
         #: serializes membership swaps (listener thread) against the
         #: routing counters (request thread)
         self._route_lock = threading.Lock()
+        #: power-of-two-choices sampling: seeded per deployment so a
+        #: replayed request sequence routes identically run to run
+        #: (the process-global `random` module would not)
+        self._rng = random.Random(zlib.crc32(deployment_name.encode()))
         self._closed = False
 
     # -- membership -------------------------------------------------------
@@ -223,7 +228,7 @@ class DeploymentHandle:
                 f"deployment {self.deployment_name!r} has no replicas")
         if len(self._replicas) == 1:
             return self._replicas[0]
-        a, b = random.sample(self._replicas, 2)
+        a, b = self._rng.sample(self._replicas, 2)
         return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
             else b
 

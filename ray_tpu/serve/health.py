@@ -230,7 +230,12 @@ class HealthMonitor:
         #: a replica field, the routing-table idiom) and to each
         #: replica's own recorder
         self._recorder = recorder
-        self._lock = threading.Lock()
+        #: reentrant: _transition locks itself, and the write paths
+        #: (heartbeat, note_fault, the probe sweep) hold the lock
+        #: across their compound updates — heartbeat stamps and chaos
+        #: faults arrive from different execution contexts than the
+        #: probe/reconcile sweeps that read them back
+        self._lock = threading.RLock()
         self._reps: Dict[str, _ReplicaHealth] = {}
         self._last_probe: Optional[float] = None
         self.faults_injected = 0
@@ -275,19 +280,22 @@ class HealthMonitor:
     def heartbeat(self, replica: str,
                   now: Optional[float] = None) -> None:
         """One engine-wave liveness stamp.  Hot path: a dict lookup
-        and two stores when healthy; the recovery transition only runs
-        after a SUSPECT/DEAD episode."""
+        and one uncontended lock round-trip; the recovery transition
+        only runs after a SUSPECT/DEAD episode.  The lock matters:
+        ``beats += 1`` is a read-modify-write racing the probe sweep's
+        reads from another thread."""
         if not self.enabled:
             return
         rep = self._reps.get(replica)
         if rep is None:
             return
-        rep.last_beat = self._now(now)
-        rep.beats += 1
-        rep.idle = False
-        if rep.state != HEALTHY:
-            self._transition(rep, HEALTHY, rep.last_beat,
-                             reason="heartbeat_resumed")
+        with self._lock:
+            rep.last_beat = self._now(now)
+            rep.beats += 1
+            rep.idle = False
+            if rep.state != HEALTHY:
+                self._transition(rep, HEALTHY, rep.last_beat,
+                                 reason="heartbeat_resumed")
 
     def note_idle(self, replica: str,
                   now: Optional[float] = None) -> None:
@@ -315,10 +323,13 @@ class HealthMonitor:
         if rep is None:
             return
         now = self._now(now)
-        rep.fault_ts = now
-        rep.fault_kind = kind
-        rep.detect_ms = None
         with self._lock:
+            # one block: the DEAD transition reads fault_ts/detect_ms
+            # as a pair to compute time_to_detect_ms — a probe landing
+            # between these stores would see a half-initialized fault
+            rep.fault_ts = now
+            rep.fault_kind = kind
+            rep.detect_ms = None
             self.faults_injected += 1
         if self._recorder is not None:
             self._recorder.record("fault_injected", ts=now,
@@ -343,9 +354,14 @@ class HealthMonitor:
         if not self.enabled:
             return []
         now = self._now(now)
-        if self._last_probe is not None and \
-                now - self._last_probe < self.config.probe_ms / 1e3:
-            return []
+        with self._lock:
+            # check-then-claim atomically: the engine loop and the
+            # router pump both throttle through this window, and an
+            # unlocked check would let both run the sweep
+            if self._last_probe is not None and \
+                    now - self._last_probe < self.config.probe_ms / 1e3:
+                return []
+            self._last_probe = now
         return self.probe(now=now)
 
     def probe(self, now: Optional[float] = None
@@ -394,8 +410,9 @@ class HealthMonitor:
         for stall in fn(self.config.stall_ms, now=now):
             if stall["id"] in rep.stalled_ids:
                 continue
-            rep.stalled_ids.add(stall["id"])
-            rep.stalls += 1
+            with self._lock:
+                rep.stalled_ids.add(stall["id"])
+                rep.stalls += 1
             fields = dict(stall, replica=rep.name)
             rid = fields.pop("id")
             if fields.get("trace") is None:
@@ -419,6 +436,18 @@ class HealthMonitor:
                     now: float, reason: str,
                     age_ms: Optional[float] = None
                     ) -> Dict[str, Any]:
+        # reentrant lock: heartbeat/note_fault call in holding it, the
+        # probe sweep calls in bare — either way the state flip, the
+        # episode counters, and the transition-log append land as one
+        # unit against concurrent stats readers
+        with self._lock:
+            return self._transition_locked(rep, to_state, now, reason,
+                                           age_ms)
+
+    def _transition_locked(self, rep: _ReplicaHealth, to_state: str,
+                           now: float, reason: str,
+                           age_ms: Optional[float] = None
+                           ) -> Dict[str, Any]:
         from_state, rep.state = rep.state, to_state
         if to_state == SUSPECT:
             rep.suspect_count += 1
@@ -475,23 +504,28 @@ class HealthMonitor:
             return empty_health()
         now = self._now(now)
         cfg = self.config
-        return {
-            "enabled": True,
-            "state": rep.state,
-            "suspect_ms": cfg.suspect_ms,
-            "dead_ms": cfg.dead_ms,
-            "stall_ms": cfg.stall_ms,
-            "heartbeats": rep.beats,
-            "heartbeat_age_ms": round((now - rep.last_beat) * 1e3, 3),
-            "idle": rep.idle,
-            "transitions": len(rep.transitions),
-            "suspect_count": rep.suspect_count,
-            "dead_count": rep.dead_count,
-            "recoveries": rep.recoveries,
-            "stalls": rep.stalls,
-            "time_to_detect_ms": rep.detect_ms,
-            "transition_log": [dict(t) for t in rep.transitions],
-        }
+        with self._lock:
+            # the transition log grows from the probe sweep's thread;
+            # iterate it (and read the counters as one consistent
+            # snapshot) under the lock
+            return {
+                "enabled": True,
+                "state": rep.state,
+                "suspect_ms": cfg.suspect_ms,
+                "dead_ms": cfg.dead_ms,
+                "stall_ms": cfg.stall_ms,
+                "heartbeats": rep.beats,
+                "heartbeat_age_ms": round((now - rep.last_beat) * 1e3,
+                                          3),
+                "idle": rep.idle,
+                "transitions": len(rep.transitions),
+                "suspect_count": rep.suspect_count,
+                "dead_count": rep.dead_count,
+                "recoveries": rep.recoveries,
+                "stalls": rep.stalls,
+                "time_to_detect_ms": rep.detect_ms,
+                "transition_log": [dict(t) for t in rep.transitions],
+            }
 
     def fleet_block(self, now: Optional[float] = None
                     ) -> Dict[str, Any]:

@@ -641,8 +641,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             import jax.numpy as jnp
 
             self._rng, k = jax.random.split(self._rng)
-            # host-side prompt normalization (python ints, no device
-            # fetch) # graftcheck: disable=blocking-call-in-async
+            # host-side prompt normalization (python ints, no device fetch)
+            # graftcheck: disable=blocking-call-in-async(host-side int normalization)
             arrs = [np.asarray(p, np.int32).reshape(-1)
                     for p in prompts]
             lens = [int(a.shape[0]) for a in arrs]
@@ -651,9 +651,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 # equal-length fast path: no pads, flash-eligible
                 toks = jnp.asarray(np.stack(arrs), jnp.int32)
                 out = self._generate(self.params, toks, k)
-                # deliberate result fetch: the batch is done on device
-                # and callers need host arrays
-                # graftcheck: disable=blocking-call-in-async
+                # the batch is done on device and callers need host arrays
+                # graftcheck: disable=blocking-call-in-async(deliberate result fetch)
                 return [np.asarray(row) for row in out]
             padded = np.zeros((len(arrs), t0), np.int32)
             for i, a in enumerate(arrs):
@@ -662,8 +661,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                 self.params, jnp.asarray(padded),
                 jnp.asarray(lens, jnp.int32), k)
             # trim the left pads: each caller sees prompt+continuation
-            # (deliberate result fetch, same as the fast path above)
-            # graftcheck: disable=blocking-call-in-async
+            # graftcheck: disable=blocking-call-in-async(deliberate result fetch)
             return [np.asarray(row)[t0 - n:]
                     for row, n in zip(out, lens)]
 
@@ -675,9 +673,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                     "one fused generate per micro-batch)")
             # request-level telemetry wraps the @serve.batch queue so
             # the recorded latency includes the batch-collection wait
-            # prompt is a host-side list; measuring its length moves
-            # no device data
-            # graftcheck: disable=blocking-call-in-async
+            # prompt is a host-side list; its length moves no device data
+            # graftcheck: disable=blocking-call-in-async(host-side length probe)
             n_prompt = int(np.asarray(prompt).reshape(-1).shape[0])
             rec = self._telemetry.record_enqueue(n_prompt)
             if n_prompt == 0 or \
@@ -1724,10 +1721,7 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
                             toks, self._cache = self._pool_step(
                                 self.params, self._cache,
                                 jnp.asarray(self._cur), k)
-                            # the engine's one deliberate per-step
-                            # host fence (documented above; telemetry
-                            # brackets it)
-                            # graftcheck: disable=blocking-call-in-async
+                            # graftcheck: disable=blocking-call-in-async(the per-step host fence)
                             toks = np.asarray(toks)
                         t_wave = _time.perf_counter()
                         self._telemetry.record_step(
@@ -1821,8 +1815,8 @@ def build_llm_deployment(family: str = "gpt2", preset: str = "nano",
             if self._engine_task is None or self._engine_task.done():
                 self._engine_task = asyncio.get_running_loop(
                 ).create_task(self._engine())
-            # host-side prompt normalization (python ints, no device
-            # fetch) # graftcheck: disable=blocking-call-in-async
+            # host-side prompt normalization (python ints, no device fetch)
+            # graftcheck: disable=blocking-call-in-async(host-side int normalization)
             arr = np.asarray(prompt, np.int32).reshape(-1)
             if admission_policy is not None:
                 # the control loop: telemetry percentiles feed the

@@ -12,20 +12,29 @@ Two engines, one report:
   ``ast`` for the host-side habits that erode those invariants
   (blocking calls on the async serve path, wall-clock telemetry,
   mutable module state under ``@remote``, invalid metric names,
-  untested pallas kernels).
+  untested pallas kernels, unlocked shared-state races across the
+  fleet's execution contexts, RNG-discipline breaches on the serve
+  path, and registry drift between the critical-path component list
+  and its downstream views).
 
 Run both with ``python -m ray_tpu.tools.graftcheck`` (exit 0 iff
-clean; ``--format json`` for the machine-readable report).  Waive a
-finding with ``# graftcheck: disable=<rule>`` — see
-docs/static-analysis.md for the rule catalog.
+clean; ``--format json`` for the machine-readable report,
+``--format github`` for CI annotations, ``--changed <git-range>``
+for fast pre-commit lint of touched files only).  Waive a finding
+with ``# graftcheck: disable=<rule>(<reason>)`` — see
+docs/static-analysis.md for the rule catalog; bare or no-op waivers
+are themselves findings (``suppression-reason`` /
+``stale-suppression``).
 """
 
 from __future__ import annotations
 
 import pathlib
-from typing import Any, Dict
+from typing import Any, Dict, List
 
-from ray_tpu.tools.graftcheck.core import (Violation, make_report,
+from ray_tpu.tools.graftcheck.core import (SuppressionEntry, Violation,
+                                           make_report,
+                                           parse_suppression_entries,
                                            parse_suppressions,
                                            render_text,
                                            split_suppressed)
@@ -37,15 +46,20 @@ from ray_tpu.tools.graftcheck.jaxpr_audit import (ProgramSpec,
                                                   iter_eqns,
                                                   logits_sized_shapes,
                                                   scan_lengths)
-from ray_tpu.tools.graftcheck.lint import (lint_repo, lint_source,
+from ray_tpu.tools.graftcheck.lint import (KNOWN_RULES, lint_files,
+                                           lint_repo, lint_source,
                                            pallas_modules)
+from ray_tpu.tools.graftcheck.races import THREAD_ROOTS
 
 __all__ = [
-    "Violation", "ProgramSpec", "run_repo_check", "make_report",
-    "render_text", "parse_suppressions", "split_suppressed",
+    "Violation", "ProgramSpec", "SuppressionEntry", "run_repo_check",
+    "run_changed_check", "make_report",
+    "render_text", "parse_suppressions", "parse_suppression_entries",
+    "split_suppressed",
     "audit_program", "audit_programs", "iter_eqns", "collect_shapes",
     "scan_lengths", "logits_sized_shapes", "estimate_peak_bytes",
-    "lint_repo", "lint_source", "pallas_modules",
+    "lint_repo", "lint_source", "lint_files", "pallas_modules",
+    "KNOWN_RULES", "THREAD_ROOTS",
 ]
 
 
@@ -74,3 +88,16 @@ def run_repo_check(root=None, *, skip_jaxpr: bool = False,
         violations.extend(jaxpr_violations)
     return make_report(violations, suppressed=suppressed,
                        files_scanned=files_scanned, programs=infos)
+
+
+def run_changed_check(root=None, *, rels: List[str]) -> Dict[str, Any]:
+    """Per-file lint of an explicit changed-file list (the CLI's
+    ``--changed <git-range>`` resolves the range to paths and calls
+    this).  Skips the jaxpr auditor and the repo-level registry checks
+    — this is the fast pre-commit path; the full run holds the line in
+    CI."""
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[3]
+    violations, stats = lint_files(pathlib.Path(root), rels)
+    return make_report(violations, suppressed=stats["suppressed"],
+                       files_scanned=stats["files"])

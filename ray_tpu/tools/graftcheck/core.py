@@ -2,22 +2,34 @@
 
 A check (jaxpr rule or lint rule) produces :class:`Violation` records;
 the driver filters them through per-line ``# graftcheck:
-disable=<rule>[,<rule>...]`` suppressions and assembles one report that
-both the text renderer and ``--format json`` consume.  Suppression is
-deliberate and visible: a disable comment on the offending line (or on
-a standalone comment line directly above it) names the rule it waives,
-so every waiver is grep-able and reviewable.
+disable=<rule>(<reason>)[,<rule>(<reason>)...]`` suppressions and
+assembles one report that both the text renderer and ``--format json``
+consume.  Suppression is deliberate and visible: a disable comment on
+the offending line (or on a standalone comment line directly above it)
+names the rule it waives AND says why in the parenthesized reason, so
+every waiver is grep-able and reviewable.  The linter's hygiene rules
+(lint.py) flag a bare reason-less waiver (``suppression-reason``) and
+a waiver that drops nothing (``stale-suppression``).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import io
 import re
-from typing import Any, Dict, List, Optional, Set
+import tokenize
+from typing import Any, Dict, List, Optional, Set, Tuple
 
-#: ``# graftcheck: disable=rule-a,rule-b`` (anywhere in a line)
+#: one disable comment: ``disable=`` then one or more
+#: ``<rule>(<reason>)`` entries (the reason is optional at PARSE time —
+#: bare entries still suppress, the hygiene rule just flags them)
 _DISABLE_RE = re.compile(
-    r"#\s*graftcheck:\s*disable=([a-z0-9,\-\s]+)", re.IGNORECASE)
+    r"#\s*graftcheck:\s*disable="
+    r"((?:[a-z][a-z0-9\-]*(?:\([^)\n]*\))?\s*,?\s*)+)",
+    re.IGNORECASE)
+#: one ``<rule>`` or ``<rule>(<reason>)`` entry inside the group above
+_ENTRY_RE = re.compile(r"([a-z][a-z0-9\-]*)(?:\(([^)\n]*)\))?",
+                       re.IGNORECASE)
 
 
 @dataclasses.dataclass
@@ -52,22 +64,66 @@ class Violation:
         return f"{self.location()}: [{self.rule}] {self.message}"
 
 
+def _parse_entry_group(group: str) -> Dict[str, Optional[str]]:
+    """rule id -> reason (None when the entry carries no parens)."""
+    return {m.group(1): m.group(2)
+            for m in _ENTRY_RE.finditer(group)}
+
+
 def parse_suppressions(source: str) -> Dict[int, Set[str]]:
     """1-based line -> set of rule ids disabled on that line.
 
     A disable comment sharing a line with code covers that line; a
     standalone comment line covers itself AND the next line, so wrapped
-    statements can carry the waiver above them."""
+    statements can carry the waiver above them.  Reasons are accepted
+    (``disable=rule(why)``) but not required here — the hygiene rule in
+    lint.py enforces them."""
     out: Dict[int, Set[str]] = {}
     for lineno, text in enumerate(source.splitlines(), start=1):
         m = _DISABLE_RE.search(text)
         if not m:
             continue
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        rules = set(_parse_entry_group(m.group(1)))
         out.setdefault(lineno, set()).update(rules)
         if text.lstrip().startswith("#"):
             out.setdefault(lineno + 1, set()).update(rules)
     return out
+
+
+@dataclasses.dataclass
+class SuppressionEntry:
+    """One disable comment, as the hygiene rules see it."""
+
+    line: int                         # the comment's own line
+    covered: Tuple[int, ...]          # lines the waiver applies to
+    rules: Dict[str, Optional[str]]   # rule id -> reason (None = bare)
+
+
+def parse_suppression_entries(source: str) -> List[SuppressionEntry]:
+    """Every disable comment in ``source`` with its coverage and
+    per-rule reasons.  Token-based (COMMENT tokens only) so disable
+    patterns quoted inside docstrings don't register as waivers for
+    the hygiene rules; returns [] when the source doesn't tokenize
+    (the parse-error violation covers that case)."""
+    try:
+        toks = list(tokenize.generate_tokens(
+            io.StringIO(source).readline))
+    except Exception:  # noqa: BLE001 - broken source: linter reports it
+        return []
+    entries: List[SuppressionEntry] = []
+    for tok in toks:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _DISABLE_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        standalone = tok.line.lstrip().startswith("#")
+        covered = (lineno, lineno + 1) if standalone else (lineno,)
+        entries.append(SuppressionEntry(
+            line=lineno, covered=covered,
+            rules=_parse_entry_group(m.group(1))))
+    return entries
 
 
 def is_suppressed(v: Violation,

@@ -9,8 +9,12 @@ reject.  Two repo-level checks (pallas kernels need interpret-mode
 tests; the kernel entry points stay exported) absorb what
 ``tests/test_ops_kernel_guard.py`` used to pin.
 
-Every rule honors ``# graftcheck: disable=<rule>`` on the offending
-line or a standalone comment line directly above it (core.py).
+Every rule honors ``# graftcheck: disable=<rule>(<reason>)`` on the
+offending line or a standalone comment line directly above it
+(core.py).  The reason is required: a bare waiver is flagged by
+``suppression-reason`` and a waiver that drops nothing by
+``stale-suppression`` — suppression is deliberate, explained, and
+pruned when the code it excused goes away.
 
 Rule ids:
 
@@ -51,6 +55,15 @@ Rule ids:
 * ``metric-name`` — every ``Counter``/``Gauge``/``Histogram`` from
   ``ray_tpu.util.metrics`` must carry a literal
   ``^[a-z][a-z0-9_]*$`` name (absorbs tests/test_metrics_guard.py).
+* ``shared-state-race`` / ``rng-discipline`` — the concurrency and
+  determinism passes (races.py): unlocked compound mutations on
+  attributes reachable from two execution contexts, and jax.random
+  key reuse / entropy-derived seeds / unseeded global RNG draws on
+  the serve path.
+* ``suppression-reason`` / ``stale-suppression`` — waiver hygiene:
+  every disable comment must carry a parenthesized reason naming a
+  known rule, and must actually drop a violation on its covered
+  lines.
 * ``pallas-interpret-test`` — an ``ops/*.py`` building a pallas kernel
   without an interpret-mode test module keeps numerics
   CPU-unverifiable.
@@ -67,6 +80,12 @@ Rule ids:
   ``tools/autopilot/attribution.py``'s ``PROGRAM_KNOBS`` (and every
   knob entry must name a KNOWN_PROGRAMS program): the tuning loop
   cannot name a bottleneck it has no catalogued way to move.
+* ``contract-registry`` / ``perfledger-direction`` — the registry
+  drift checks (contracts.py): the exact-sum critical-path component
+  list must stay pinned in the tracebus span taxonomy, the
+  engine-stats golden schema, traffic's TTFT decomposition and the
+  docs tables; every perfledger sweep field must resolve to an
+  explicit higher/lower-is-better direction.
 """
 
 from __future__ import annotations
@@ -74,10 +93,15 @@ from __future__ import annotations
 import ast
 import pathlib
 import re
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.tools.graftcheck.contracts import (contract_registry,
+                                                perfledger_direction)
 from ray_tpu.tools.graftcheck.core import (Violation, parse_suppressions,
+                                           parse_suppression_entries,
                                            split_suppressed)
+from ray_tpu.tools.graftcheck.races import (rng_discipline,
+                                            shared_state_races)
 
 _METRIC_CLASSES = {"Counter", "Gauge", "Histogram"}
 _METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
@@ -89,6 +113,27 @@ _MUTABLE_FACTORIES = {"list", "dict", "set", "defaultdict", "deque",
 #: entry points that must stay exported from ray_tpu.ops
 KERNEL_EXPORTS = ("causal_attention", "flash_attention", "fused_lm_ce",
                   "streaming_ce", "ring_attention", "ulysses_attention")
+
+#: every rule id a disable comment may legitimately name — a waiver
+#: for anything else is a typo or a removed rule (stale-suppression)
+KNOWN_RULES = frozenset({
+    # lint per-file rules
+    "parse-error", "blocking-call-in-async", "wallclock-in-telemetry",
+    "mutable-global-in-remote", "metric-name", "shared-state-race",
+    "rng-discipline",
+    # repo-level checks
+    "pallas-interpret-test", "kernel-exports", "observatory-mapping",
+    "autopilot-attribution", "contract-registry",
+    "perfledger-direction",
+    # hygiene (listed so `disable=all` docs stay honest; the hygiene
+    # rules themselves are never suppressable)
+    "suppression-reason", "stale-suppression",
+    # jaxpr auditor rules
+    "host-transfer", "f64", "f32-matmul", "logits-buffer", "t0-scan",
+    "donation", "collectives", "per-chip-hbm", "hbm-budget",
+    "audit-error",
+    "all",
+})
 
 
 def _call_label(func: ast.AST) -> str:
@@ -302,6 +347,48 @@ def _metric_names(tree: ast.Module, rel: str,
     return out
 
 
+def _suppression_hygiene(source: str, rel: str,
+                         dropped: List[Violation]) -> List[Violation]:
+    """``suppression-reason`` + ``stale-suppression`` for one file:
+    every disable entry must name a known rule WITH a parenthesized
+    reason, and must have dropped at least one violation on its
+    covered lines.  Computed after the split so these are never
+    themselves suppressable."""
+    out: List[Violation] = []
+    dropped_at: Dict[int, set] = {}
+    for v in dropped:
+        if v.line is not None:
+            dropped_at.setdefault(v.line, set()).add(v.rule)
+    for entry in parse_suppression_entries(source):
+        for rule, reason in entry.rules.items():
+            if rule not in KNOWN_RULES:
+                out.append(Violation(
+                    "stale-suppression",
+                    f"disable comment names unknown rule '{rule}' — "
+                    f"typo, or a rule this linter no longer has",
+                    file=rel, line=entry.line))
+                continue
+            if reason is None or not reason.strip():
+                out.append(Violation(
+                    "suppression-reason",
+                    f"disable={rule} carries no reason — waivers are "
+                    f"reviewable only when they say why: "
+                    f"disable={rule}(<reason>)",
+                    file=rel, line=entry.line))
+            hit = any(
+                rule in dropped_at.get(line, ())
+                or (rule == "all" and dropped_at.get(line))
+                for line in entry.covered)
+            if not hit:
+                out.append(Violation(
+                    "stale-suppression",
+                    f"disable={rule} suppresses nothing on line(s) "
+                    f"{'/'.join(map(str, entry.covered))} — the code "
+                    f"it excused is gone; delete the waiver",
+                    file=rel, line=entry.line))
+    return out
+
+
 def lint_source(source: str, rel: str,
                 metric_names_seen: List[str] = None
                 ) -> Tuple[List[Violation], int]:
@@ -319,8 +406,11 @@ def lint_source(source: str, rel: str,
     violations += _metric_names(
         tree, rel,
         metric_names_seen if metric_names_seen is not None else [])
+    violations += shared_state_races(tree, rel)
+    violations += rng_discipline(tree, rel)
     kept, dropped = split_suppressed(violations,
                                      parse_suppressions(source))
+    kept.extend(_suppression_hygiene(source, rel, dropped))
     return kept, len(dropped)
 
 
@@ -488,6 +578,36 @@ def lint_repo(root) -> Tuple[List[Violation], Dict[str, Any]]:
     violations.extend(_kernel_exports())
     violations.extend(_observatory_mapping())
     violations.extend(_autopilot_attribution())
+    violations.extend(contract_registry(root))
+    violations.extend(perfledger_direction(root))
+    stats = {"files": n_files, "suppressed": n_suppressed,
+             "metric_names": metric_names_seen}
+    return violations, stats
+
+
+def lint_files(root, rels: List[str]
+               ) -> Tuple[List[Violation], Dict[str, Any]]:
+    """Per-file lint of an explicit file list (``--changed`` mode):
+    the repo-level registry checks are skipped — they can only drift
+    via the files that define them, and the full run in CI holds that
+    line.  ``rels`` are repo-relative posix paths; non-package or
+    vanished paths are ignored (deleted files show up in git ranges)."""
+    root = pathlib.Path(root)
+    violations: List[Violation] = []
+    metric_names_seen: List[str] = []
+    n_files = 0
+    n_suppressed = 0
+    for rel in sorted(set(rels)):
+        rel = rel.replace("\\", "/")
+        path = root / rel
+        if not rel.endswith(".py") or not rel.startswith("ray_tpu/") \
+                or "__pycache__" in rel or not path.exists():
+            continue
+        kept, dropped = lint_source(path.read_text(), rel,
+                                    metric_names_seen)
+        violations.extend(kept)
+        n_suppressed += dropped
+        n_files += 1
     stats = {"files": n_files, "suppressed": n_suppressed,
              "metric_names": metric_names_seen}
     return violations, stats

@@ -107,6 +107,15 @@ _LOWER_IS_BETTER = ("_ms", "ttft", "latency", "_bytes", "compile",
 _HIGHER_OVERRIDES = ("slo_attainment", "accept_rate", "goodput",
                      "hit_rate")
 
+#: substrings marking a metric where BIGGER is better in its own right
+#: (throughput and utilization).  Every _SWEEP_FIELDS entry must match
+#: at least one token across the three tuples — graftcheck's
+#: perfledger-direction rule enforces it, so a new sweep field whose
+#: name resolves to no explicit direction (the near-miss class PR
+#: 10/13 each fixed by hand) fails lint instead of silently getting
+#: "higher" by fallthrough.
+_HIGHER_IS_BETTER = ("tok_s", "mfu")
+
 
 def repo_root() -> str:
     """The repo checkout this installed/source tree lives in (ledger
@@ -132,7 +141,28 @@ def higher_is_better(name: str) -> bool:
     low = name.lower()
     if any(tok in low for tok in _HIGHER_OVERRIDES):
         return True
-    return not any(tok in low for tok in _LOWER_IS_BETTER)
+    if any(tok in low for tok in _LOWER_IS_BETTER):
+        return False
+    # explicit throughput/utilization tokens and the free-form
+    # fallthrough both resolve higher; the distinction matters to the
+    # perfledger-direction lint, which accepts only explicit matches
+    # for _SWEEP_FIELDS entries
+    return True
+
+
+def explicit_direction(name: str) -> Optional[bool]:
+    """True/False when ``name`` matches an explicit direction token,
+    None when it would only resolve by fallthrough.  graftcheck's
+    perfledger-direction rule requires every _SWEEP_FIELDS entry to
+    resolve explicitly."""
+    low = name.lower()
+    if any(tok in low for tok in _HIGHER_OVERRIDES):
+        return True
+    if any(tok in low for tok in _LOWER_IS_BETTER):
+        return False
+    if any(tok in low for tok in _HIGHER_IS_BETTER):
+        return True
+    return None
 
 
 def _variant_key(variant: Dict[str, Any]) -> str:

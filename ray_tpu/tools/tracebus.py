@@ -55,12 +55,30 @@ from ray_tpu.serve.telemetry import (CRITICAL_PATH_COMPONENTS,
                                      latency_anatomy,
                                      merge_anatomy_samples)
 
-__all__ = ["collect", "write_dump", "load_dump",
+__all__ = ["collect", "write_dump", "load_dump", "COMPONENT_SPANS",
            "build_request_spans", "attach_device_spans",
            "find_request", "critical_path_table", "chrome_trace",
            "report_lines", "trace_lines", "main"]
 
 DUMP_VERSION = 1
+
+#: critical-path component -> the tracebus span that carries it (None
+#: for derived legs with no dedicated span: prefill_wait is the gap
+#: between prefill chunks, spec_rollback is an attr on engine.decode).
+#: graftcheck's contract-registry rule pins this mapping both ways:
+#: every CRITICAL_PATH_COMPONENTS member must appear here, and every
+#: named span must still be emitted by build_request_spans below.
+COMPONENT_SPANS: Dict[str, Optional[str]] = {
+    "router_wait_ms": "router.wait",
+    "queue_wait_ms": "engine.queue",
+    "requeue_ms": "engine.requeue",
+    "kv_fetch_ms": "kv.fetch",
+    "prefill_ms": "engine.prefill",
+    "prefill_wait_ms": None,
+    "handoff_ms": "kv.handoff",
+    "inter_token_ms": "engine.decode",
+    "spec_rollback_ms": None,
+}
 
 
 # ---------------------------------------------------------------------------

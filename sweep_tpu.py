@@ -129,7 +129,17 @@ def _graftcheck_record():
         from ray_tpu.tools.graftcheck import run_repo_check
 
         report = run_repo_check()
-        return {"graftcheck": report["summary"], "ok": report["ok"]}
+        summary = dict(report["summary"])
+        # per-rule counters for the concurrency/determinism/registry
+        # passes, so a sweep log shows at a glance whether the tree
+        # that produced the numbers carried any of the three v2
+        # finding classes (0 on a clean tree — the counters prove the
+        # rules RAN, rules_failed names them only when they fire)
+        for rule in ("shared-state-race", "rng-discipline",
+                     "contract-registry"):
+            summary[rule.replace("-", "_")] = sum(
+                1 for v in report["violations"] if v["rule"] == rule)
+        return {"graftcheck": summary, "ok": report["ok"]}
     except Exception as e:  # noqa: BLE001 - sweep must survive
         return {"graftcheck": {"error": f"{type(e).__name__}: "
                                f"{str(e)[:200]}"}, "ok": False}

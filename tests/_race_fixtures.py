@@ -1,0 +1,130 @@
+"""Planted shared-state races for the ``shared-state-race`` pass.
+
+Each ``# PLANTED: <kind>`` marker names the violation kind the static
+pass must report on exactly that line; everything else (the locked,
+GIL-atomic, snapshot, and caller-locked sites) is a negative the pass
+must stay silent on.  tests/test_graftcheck_races.py lints this file's
+source under a ``ray_tpu/serve/`` rel path for the static half, and
+drives :meth:`RacyCounter.bump` with 8 real threads for the dynamic
+half — the planted ``+=`` demonstrably loses updates under thread
+preemption, proving the rule polices real bugs, not style.
+"""
+
+import threading
+
+
+class RacyCounter:
+    """Writer/reader/locked-writer threads over one shared state bag."""
+
+    def __init__(self):
+        self.n = 0
+        self.total = 0
+        self.pending = True
+        self.flag = False
+        self.safe = 0
+        self.items = []
+        self.log = []
+        self.index = {}
+        self.safe_items = {}
+        self.reps = {"primary": _Rep()}
+        self._lock = threading.Lock()
+        self._threads = []
+
+    def start(self, iters):
+        self._threads = [
+            threading.Thread(target=self._writer, args=(iters,)),
+            threading.Thread(target=self._reader, args=(iters,)),
+            threading.Thread(target=self._locked_writer, args=(iters,)),
+        ]
+        for t in self._threads:
+            t.start()
+
+    def join(self):
+        for t in self._threads:
+            t.join()
+
+    def bump(self, iters):
+        """The dynamic-stress entry point: 8 threads run this loop
+        concurrently and the unlocked RMW loses updates.  The
+        read-modify-write is stretched across a method call so the
+        interpreter has a switch point between the load and the store
+        (CPython checks the eval breaker only on backward jumps and
+        calls — a bare ``+=`` inside one loop body never yields)."""
+        for _ in range(iters):
+            v = self.n
+            v = self._inc(v)
+            self.n = v  # PLANTED: rmw
+
+    @staticmethod
+    def _inc(v):
+        return v + 1
+
+    def _writer(self, iters):
+        now = 0.0
+        for i in range(iters):
+            self.n += 1  # PLANTED: aug
+            self.total = self.total + 1  # PLANTED: rmw
+            if self.pending:
+                self.pending = False  # PLANTED: check-then-act
+            key = i % 7
+            if key not in self.index:
+                self.index[key] = i  # PLANTED: check-then-act
+            rep = self.reps.get("primary")
+            rep.fault_ts = now  # PLANTED: multi-init
+            rep.fault_kind = "freeze"
+            rep.detect_ms = None
+            # negatives: single GIL-atomic ops need no lock
+            self.items.append(i)
+            self.log.append(i)
+            self.flag = True
+
+    def _reader(self, iters):
+        seen = 0
+        for _ in range(iters):
+            seen += self.n + self.total + len(self.reps)
+            for item in self.items:  # PLANTED: iterate
+                seen += item
+            for item in list(self.log):  # negative: snapshot copy
+                seen += item
+            if self.pending and self.flag:
+                seen += len(self.index)
+        return seen
+
+    def _locked_writer(self, iters):
+        for _ in range(iters):
+            with self._lock:
+                self.safe += 1  # negative: lock held
+                self._drain()
+
+    def _drain(self):
+        # negative: every call site holds self._lock (caller-locked)
+        self.safe_items["k"] = self.safe_items.get("k", 0) + 1
+
+
+class _Rep:
+    """The aliased record _writer re-initializes field by field."""
+
+    def __init__(self):
+        self.fault_ts = None
+        self.fault_kind = None
+        self.detect_ms = None
+
+
+class HealthMonitor:
+    """Name-collides with serve/health.py's monitor on purpose: the
+    THREAD_ROOTS seeding path (not Thread-target auto-detection) must
+    give heartbeat/maybe_probe/fleet_block their contexts."""
+
+    def __init__(self):
+        self.beats = {}
+        self.sweeps = 0
+
+    def heartbeat(self, replica):
+        self.beats[replica] = self.beats.get(replica, 0) + 1  # PLANTED: rmw
+
+    def maybe_probe(self):
+        self.sweeps += 1  # PLANTED: aug
+        return dict(self.beats)
+
+    def fleet_block(self):
+        return {"beats": dict(self.beats), "sweeps": self.sweeps}

@@ -1,0 +1,294 @@
+"""shared-state-race + rng-discipline acceptance suite.
+
+Three layers, mirroring tests/test_graftcheck.py:
+
+1. **planted fixtures** — every ``# PLANTED: <kind>`` line in
+   tests/_race_fixtures.py must be reported with exactly that kind,
+   and none of the negative sites (locked, GIL-atomic single op,
+   snapshot copy, caller-locked helper) may flag;
+2. **dynamic proof** — 8 real threads drive the planted unlocked
+   ``+=`` and demonstrably lose updates, so the rule is policing a
+   real bug class, not style (flaky-free: barrier start, a tiny
+   switch interval, and several rounds — any one round showing a
+   lost update passes);
+3. **rng fixtures** — key reuse, the clean split idiom,
+   wallclock-seeded generators, and unseeded module-level draws.
+"""
+
+import pathlib
+import re
+import sys
+import threading
+import textwrap
+
+import pytest
+
+from ray_tpu.tools.graftcheck.lint import lint_source
+from ray_tpu.tools.graftcheck.races import (THREAD_ROOTS, rng_discipline,
+                                            shared_state_races)
+
+pytestmark = pytest.mark.fast
+
+HERE = pathlib.Path(__file__).resolve().parent
+FIXTURE = HERE / "_race_fixtures.py"
+#: linted under a serve/ rel path so the pass is in scope
+FIXTURE_REL = "ray_tpu/serve/_race_fixtures.py"
+
+#: marker kind -> substring the violation message must carry
+KIND_TEXT = {
+    "aug": "read-modify-write",
+    "rmw": "read-modify-write store",
+    "check-then-act": "check-then-act",
+    "multi-init": "multi-step re-initialization",
+    "iterate": "iteration over mutable shared",
+}
+
+
+def _planted(source):
+    """{lineno: kind} for every PLANTED marker in the fixture."""
+    out = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = re.search(r"#\s*PLANTED:\s*([a-z\-]+)", line)
+        if m:
+            out[lineno] = m.group(1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. static detection of every planted fixture
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fixture_source():
+    return FIXTURE.read_text()
+
+
+@pytest.fixture(scope="module")
+def fixture_violations(fixture_source):
+    import ast
+
+    tree = ast.parse(fixture_source, filename=FIXTURE_REL)
+    return shared_state_races(tree, FIXTURE_REL)
+
+
+def test_every_planted_race_detected(fixture_source, fixture_violations):
+    planted = _planted(fixture_source)
+    assert len(planted) >= 8, "fixture module lost its plants"
+    flagged = {v.line for v in fixture_violations}
+    missed = {ln: kind for ln, kind in planted.items()
+              if ln not in flagged}
+    assert not missed, f"planted races not detected: {missed}"
+
+
+def test_planted_kinds_match(fixture_source, fixture_violations):
+    planted = _planted(fixture_source)
+    by_line = {}
+    for v in fixture_violations:
+        by_line.setdefault(v.line, []).append(v.message)
+    for ln, kind in planted.items():
+        msgs = by_line.get(ln, [])
+        assert any(KIND_TEXT[kind] in m for m in msgs), \
+            f"line {ln}: expected {kind!r} in {msgs}"
+
+
+def test_no_false_positives_on_negatives(fixture_source,
+                                         fixture_violations):
+    # every reported line must be a planted one — the locked,
+    # GIL-atomic, snapshot, and caller-locked negatives stay silent
+    planted = set(_planted(fixture_source))
+    extra = [v for v in fixture_violations if v.line not in planted]
+    assert not extra, [str(v) for v in extra]
+
+
+def test_fixture_covers_thread_roots_and_autodetect(fixture_source,
+                                                    fixture_violations):
+    # both context-seeding paths must be exercised: HealthMonitor.*
+    # methods get their contexts from THREAD_ROOTS (no Thread() call
+    # in that class), RacyCounter's from Thread(target=...) detection
+    assert "HealthMonitor.heartbeat" in THREAD_ROOTS
+    msgs = [v.message for v in fixture_violations]
+    assert any("HealthMonitor.heartbeat" in m for m in msgs)
+    assert any("engine-wave-loop" in m for m in msgs)
+    assert any("RacyCounter._writer" in m for m in msgs)
+    assert any("writer-thread" in m for m in msgs)
+
+
+def test_fixture_out_of_scope_is_silent(fixture_source):
+    import ast
+
+    tree = ast.parse(fixture_source)
+    assert shared_state_races(tree, "ray_tpu/models/gpt2.py") == []
+
+
+def test_lint_source_integration_and_suppression(fixture_source):
+    # through the real lint_source driver the rule respects the
+    # standard disable comment machinery
+    kept, _ = lint_source(fixture_source, FIXTURE_REL)
+    races = [v for v in kept if v.rule == "shared-state-race"]
+    assert races
+    line = races[0].line
+    lines = fixture_source.splitlines()
+    indent = len(lines[line - 1]) - len(lines[line - 1].lstrip())
+    waived = "\n".join(
+        lines[:line - 1]
+        + [" " * indent + "# graftcheck: "
+           "disable=shared-state-race(fixture waiver test)"]
+        + lines[line - 1:])
+    kept2, n_sup = lint_source(waived, FIXTURE_REL)
+    races2 = [v for v in kept2 if v.rule == "shared-state-race"]
+    assert len(races2) == len(races) - 1
+    assert n_sup >= 1
+
+
+# ---------------------------------------------------------------------------
+# 2. the dynamic proof: a planted race loses real updates
+# ---------------------------------------------------------------------------
+
+def test_planted_race_is_real_under_threads():
+    sys.path.insert(0, str(HERE))
+    try:
+        import _race_fixtures
+    finally:
+        sys.path.pop(0)
+
+    n_threads, iters, rounds = 8, 50_000, 6
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(5e-6)
+    try:
+        for _ in range(rounds):
+            counter = _race_fixtures.RacyCounter()
+            barrier = threading.Barrier(n_threads)
+
+            def loop(c=counter, b=barrier):
+                b.wait()
+                c.bump(iters)
+
+            threads = [threading.Thread(target=loop)
+                       for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if counter.n < n_threads * iters:
+                return  # lost updates observed: the race is real
+        pytest.fail(
+            f"no lost update in {rounds} rounds of {n_threads} "
+            f"threads x {iters} unlocked increments — the planted "
+            f"race fixture is no longer racy")
+    finally:
+        sys.setswitchinterval(old_interval)
+
+
+# ---------------------------------------------------------------------------
+# 3. rng-discipline fixtures
+# ---------------------------------------------------------------------------
+
+_SERVE = "ray_tpu/serve/fixture.py"
+
+
+def _rng(src, rel=_SERVE):
+    import ast
+
+    return rng_discipline(ast.parse(textwrap.dedent(src)), rel)
+
+
+def test_rng_key_reuse_detected():
+    vs = _rng("""\
+        import jax
+
+        def sample(key, logits):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b
+    """)
+    assert len(vs) == 1
+    assert vs[0].rule == "rng-discipline"
+    assert "consumed again" in vs[0].message
+    assert vs[0].line == 5
+
+
+def test_rng_split_idiom_is_clean():
+    # the engine idiom: consume-and-rebind in one statement, then
+    # spend the subkey exactly once
+    vs = _rng("""\
+        import jax
+
+        class Engine:
+            def step(self):
+                self._rng, k = jax.random.split(self._rng)
+                return jax.random.categorical(k, self.logits)
+    """)
+    assert vs == []
+
+
+def test_rng_reuse_after_rebind_is_clean():
+    vs = _rng("""\
+        import jax
+
+        def gen(key):
+            a = jax.random.normal(key, (4,))
+            key = jax.random.fold_in(key, 1)
+            b = jax.random.normal(key, (4,))
+            return a + b
+    """)
+    assert vs == []
+
+
+def test_rng_wallclock_seed_detected():
+    vs = _rng("""\
+        import random
+        import time
+
+        def make_rng():
+            return random.Random(time.time())
+    """)
+    assert len(vs) == 1
+    assert "unreproducible" in vs[0].message
+
+
+def test_rng_urandom_key_detected():
+    vs = _rng("""\
+        import os
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(
+                int.from_bytes(os.urandom(4), "little"))
+    """)
+    assert len(vs) == 1
+    assert "os.urandom" in vs[0].message
+
+
+def test_rng_unseeded_global_draw_detected():
+    vs = _rng("""\
+        import random
+
+        def jitter(ms):
+            return ms * random.uniform(0.9, 1.1)
+    """)
+    assert len(vs) == 1
+    assert "process-global" in vs[0].message
+
+
+def test_rng_seeded_instance_is_clean():
+    vs = _rng("""\
+        import random
+        import numpy as np
+
+        def jitter(ms, seed):
+            rng = random.Random(seed)
+            nprng = np.random.default_rng(seed)
+            return ms * rng.uniform(0.9, 1.1) * nprng.random()
+    """)
+    assert vs == []
+
+
+def test_rng_scoped_to_serve():
+    src = """\
+        import random
+
+        def jitter(ms):
+            return ms * random.uniform(0.9, 1.1)
+    """
+    assert _rng(src, "ray_tpu/train/loop.py") == []
+    assert len(_rng(src, "ray_tpu/serve/traffic.py")) == 1
